@@ -1,0 +1,8 @@
+"""Import every stage module so the full registry is populated.
+
+Grows as the framework grows; used by persistence resolution and the
+generic fuzzing test sweep.
+"""
+
+import mmlspark_tpu.core.stage  # noqa: F401
+import mmlspark_tpu.core.pipeline  # noqa: F401

@@ -1,0 +1,25 @@
+from mmlspark_tpu.core.dataframe import DataFrame
+from mmlspark_tpu.core.params import Param, Params
+from mmlspark_tpu.core.stage import (
+    PipelineStage,
+    Transformer,
+    Estimator,
+    Model,
+    Evaluator,
+)
+from mmlspark_tpu.core.pipeline import Pipeline, PipelineModel
+from mmlspark_tpu.core import schema
+
+__all__ = [
+    "DataFrame",
+    "Param",
+    "Params",
+    "PipelineStage",
+    "Transformer",
+    "Estimator",
+    "Model",
+    "Evaluator",
+    "Pipeline",
+    "PipelineModel",
+    "schema",
+]

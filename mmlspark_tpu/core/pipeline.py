@@ -1,0 +1,71 @@
+"""Pipeline composition: fit a chain of stages, get a PipelineModel."""
+
+from __future__ import annotations
+
+import os
+from typing import Dict, List, Sequence
+
+import numpy as np
+
+from mmlspark_tpu.core.dataframe import DataFrame
+from mmlspark_tpu.core.params import Param
+from mmlspark_tpu.core.stage import Estimator, Model, PipelineStage, Transformer
+
+
+class Pipeline(Estimator):
+    """Chain of stages; ``fit`` runs estimators in order, threading data."""
+
+    stages = Param(None, "ordered list of pipeline stages", complex=True)
+
+    def fit(self, df: DataFrame) -> "PipelineModel":
+        fitted: List[Transformer] = []
+        stages = list(self.stages or [])
+        last_fit = max((i for i, s in enumerate(stages)
+                        if isinstance(s, Estimator)), default=-1)
+        for i, stage in enumerate(stages):
+            if isinstance(stage, Estimator):
+                model = stage.fit(df)
+                fitted.append(model)
+            elif isinstance(stage, Transformer):
+                model = stage
+                fitted.append(stage)
+            else:
+                raise TypeError(f"not a pipeline stage: {stage!r}")
+            if i < last_fit:  # no estimator downstream -> skip the transform
+                df = model.transform(df)
+        return PipelineModel(stages=fitted)
+
+    def _save_extra(self, path: str, arrays: Dict[str, np.ndarray]) -> None:
+        _save_stage_list(self.stages or [], path)
+
+    def _load_extra(self, path: str, arrays: Dict[str, np.ndarray]) -> None:
+        self.stages = _load_stage_list(path)
+
+
+class PipelineModel(Model):
+    stages = Param(None, "ordered list of fitted transformers", complex=True)
+
+    def transform(self, df: DataFrame) -> DataFrame:
+        for stage in self.stages or []:
+            df = stage.transform(df)
+        return df
+
+    def _save_extra(self, path: str, arrays: Dict[str, np.ndarray]) -> None:
+        _save_stage_list(self.stages or [], path)
+
+    def _load_extra(self, path: str, arrays: Dict[str, np.ndarray]) -> None:
+        self.stages = _load_stage_list(path)
+
+
+def _save_stage_list(stages: Sequence[PipelineStage], path: str) -> None:
+    for i, stage in enumerate(stages):
+        stage.save(os.path.join(path, f"stage_{i:03d}"))
+
+
+def _load_stage_list(path: str) -> List[PipelineStage]:
+    out = []
+    i = 0
+    while os.path.isdir(os.path.join(path, f"stage_{i:03d}")):
+        out.append(PipelineStage.load(os.path.join(path, f"stage_{i:03d}")))
+        i += 1
+    return out

@@ -1,0 +1,36 @@
+"""Global stage registry.
+
+Every concrete stage class auto-registers by qualified name when defined.
+This powers (a) persistence — ``load`` resolves the class to instantiate —
+and (b) generic fuzzing-style test sweeps over all stages, the role
+reflection over ``Wrappable`` classes plays in the reference
+(`core/utils/src/main/scala/JarLoadingUtils.scala`, `Fuzzing.scala`).
+"""
+
+from __future__ import annotations
+
+import importlib
+from typing import Dict, Type
+
+STAGE_REGISTRY: Dict[str, Type] = {}
+
+
+def register(cls: Type) -> None:
+    STAGE_REGISTRY[f"{cls.__module__}.{cls.__qualname__}"] = cls
+
+
+def resolve(qualname: str) -> Type:
+    if qualname not in STAGE_REGISTRY:
+        module = qualname.rsplit(".", 1)[0]
+        importlib.import_module(module)
+    if qualname not in STAGE_REGISTRY:
+        raise KeyError(f"unknown stage class {qualname!r}")
+    return STAGE_REGISTRY[qualname]
+
+
+def all_stages() -> Dict[str, Type]:
+    """Import the full framework, then return every public registered stage."""
+    import mmlspark_tpu.all  # noqa: F401  (imports every stage module)
+    return {k: v for k, v in STAGE_REGISTRY.items()
+            if not v.__name__.startswith("_")
+            and v.__module__.startswith("mmlspark_tpu")}

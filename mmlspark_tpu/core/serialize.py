@@ -1,0 +1,73 @@
+"""Stage persistence: JSON params + npz arrays in a directory.
+
+Capability parity with the reference's save/load machinery (Spark ML
+persistence extended by `ComplexParamsWritable`/`ConstructorWritable`,
+`core/serialize/src/main/scala/`): every stage saves to a directory with
+``metadata.json`` (class name, version, JSON params) and, when needed,
+``arrays.npz`` plus stage-specific extra files written by ``_save_extra``.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Any, Dict, Optional
+
+import numpy as np
+
+from mmlspark_tpu.core import registry
+from mmlspark_tpu.version import __version__
+
+METADATA_FILE = "metadata.json"
+ARRAYS_FILE = "arrays.npz"
+
+
+def save_stage(stage, path: str) -> None:
+    os.makedirs(path, exist_ok=True)
+    meta: Dict[str, Any] = {
+        "class": f"{type(stage).__module__}.{type(stage).__qualname__}",
+        "framework_version": __version__,
+        "uid": stage.uid,
+        "params": _jsonify(stage._json_params()),
+    }
+    arrays: Dict[str, np.ndarray] = {}
+    stage._save_extra(path, arrays)
+    if arrays:
+        np.savez_compressed(os.path.join(path, ARRAYS_FILE), **arrays)
+    with open(os.path.join(path, METADATA_FILE), "w") as f:
+        json.dump(meta, f, indent=2, default=_json_default)
+
+
+def load_stage(path: str):
+    with open(os.path.join(path, METADATA_FILE)) as f:
+        meta = json.load(f)
+    cls = registry.resolve(meta["class"])
+    stage = cls.__new__(cls)
+    stage._param_values = {}
+    stage._uid = meta.get("uid")
+    stage.set(**meta.get("params", {}))
+    arrays: Dict[str, np.ndarray] = {}
+    npz_path = os.path.join(path, ARRAYS_FILE)
+    if os.path.exists(npz_path):
+        with np.load(npz_path, allow_pickle=True) as npz:
+            arrays = {k: npz[k] for k in npz.files}
+    stage._load_extra(path, arrays)
+    return stage
+
+
+def _jsonify(obj: Any) -> Any:
+    if isinstance(obj, dict):
+        return {k: _jsonify(v) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        return [_jsonify(v) for v in obj]
+    return _json_default(obj) if isinstance(obj, (np.generic, np.ndarray)) else obj
+
+
+def _json_default(obj: Any) -> Any:
+    if isinstance(obj, np.integer):
+        return int(obj)
+    if isinstance(obj, np.floating):
+        return float(obj)
+    if isinstance(obj, np.ndarray):
+        return obj.tolist()
+    raise TypeError(f"not JSON serializable: {type(obj)}")

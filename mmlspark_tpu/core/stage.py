@@ -1,0 +1,142 @@
+"""Pipeline stage base classes: Transformer / Estimator / Model / Evaluator.
+
+Capability parity with the Spark ML stage model the whole reference is built
+on: an ``Estimator.fit(df)`` returns a ``Model`` (a ``Transformer``);
+``Transformer.transform(df)`` maps a columnar frame to a columnar frame;
+``Evaluator.evaluate(df)`` computes metrics. Stages carry declared params,
+a uid, and directory-based persistence.
+"""
+
+from __future__ import annotations
+
+import itertools
+import time
+from typing import Any, Dict, Optional
+
+import numpy as np
+
+from mmlspark_tpu.core import registry, serialize
+from mmlspark_tpu.core.dataframe import DataFrame
+from mmlspark_tpu.core.params import Params
+
+_uid_counter = itertools.count()
+
+
+class PipelineStage(Params):
+    """Base for all stages: params + uid + persistence + registry."""
+
+    def __init__(self, **kwargs):
+        super().__init__(**kwargs)
+        self._uid: Optional[str] = None
+
+    def __init_subclass__(cls, **kwargs):
+        super().__init_subclass__(**kwargs)
+        registry.register(cls)
+
+    @property
+    def uid(self) -> str:
+        if self._uid is None:
+            self._uid = f"{type(self).__name__}_{next(_uid_counter):04d}"
+        return self._uid
+
+    # -- persistence hooks --------------------------------------------------
+
+    def save(self, path: str) -> None:
+        serialize.save_stage(self, path)
+
+    @staticmethod
+    def load(path: str) -> "PipelineStage":
+        return serialize.load_stage(path)
+
+    def _save_extra(self, path: str, arrays: Dict[str, np.ndarray]) -> None:
+        """Override to persist complex state (put ndarrays into ``arrays``)."""
+
+    def _load_extra(self, path: str, arrays: Dict[str, np.ndarray]) -> None:
+        """Override to restore complex state saved by ``_save_extra``."""
+
+    def __repr__(self) -> str:
+        params = ", ".join(f"{k}={v!r}" for k, v in self._param_values.items())
+        return f"{type(self).__name__}({params})"
+
+
+class Transformer(PipelineStage):
+    def transform(self, df: DataFrame) -> DataFrame:
+        raise NotImplementedError
+
+    def __call__(self, df: DataFrame) -> DataFrame:
+        return self.transform(df)
+
+
+class Estimator(PipelineStage):
+    def fit(self, df: DataFrame) -> "Model":
+        raise NotImplementedError
+
+
+class Model(Transformer):
+    """A fitted transformer produced by an Estimator."""
+
+
+class Evaluator(PipelineStage):
+    def evaluate(self, df: DataFrame) -> DataFrame:
+        raise NotImplementedError
+
+
+# -- fluent API (parity: core/spark/FluentAPI.scala df.mlTransform/mlFit) ----
+
+def ml_transform(df: DataFrame, *stages: Transformer) -> DataFrame:
+    for s in stages:
+        df = s.transform(df)
+    return df
+
+
+def ml_fit(df: DataFrame, estimator: Estimator) -> Model:
+    return estimator.fit(df)
+
+
+class Timer(Estimator):
+    """Wraps a stage and logs wall-clock of its fit/transform.
+
+    Parity: pipeline-stages Timer (an Estimator producing a TimerModel,
+    `Timer.scala:14-90`). Fitting times the inner estimator's fit (or wraps
+    a transformer directly); the TimerModel times each transform.
+    """
+
+    from mmlspark_tpu.core.params import Param as _P
+    stage = _P(None, "the stage to time", complex=True)
+
+    def fit(self, df: DataFrame) -> "TimerModel":
+        inner = self.stage
+        if isinstance(inner, Estimator):
+            t0 = time.time()
+            inner = inner.fit(df)
+            print(f"[Timer] {type(self.stage).__name__}.fit took "
+                  f"{time.time() - t0:.3f}s")
+        return TimerModel(stage=inner)
+
+    def _save_extra(self, path, arrays):
+        import os
+        self.stage.save(os.path.join(path, "inner"))
+
+    def _load_extra(self, path, arrays):
+        import os
+        self.stage = PipelineStage.load(os.path.join(path, "inner"))
+
+
+class TimerModel(Model):
+    from mmlspark_tpu.core.params import Param as _P
+    stage = _P(None, "the fitted stage to time", complex=True)
+
+    def transform(self, df: DataFrame) -> DataFrame:
+        t0 = time.time()
+        out = self.stage.transform(df)
+        print(f"[Timer] {type(self.stage).__name__}.transform took "
+              f"{time.time() - t0:.3f}s")
+        return out
+
+    def _save_extra(self, path, arrays):
+        import os
+        self.stage.save(os.path.join(path, "inner"))
+
+    def _load_extra(self, path, arrays):
+        import os
+        self.stage = PipelineStage.load(os.path.join(path, "inner"))

@@ -1,0 +1,202 @@
+"""Core layer tests: DataFrame, params, stages, pipeline, persistence."""
+
+import numpy as np
+import pytest
+
+from mmlspark_tpu import DataFrame, Pipeline, Transformer, Estimator, Model
+from mmlspark_tpu.core.params import Param, HasInputCol, HasOutputCol, in_range
+from mmlspark_tpu.core.stage import PipelineStage, Timer
+from mmlspark_tpu.core import schema
+
+from conftest import assert_df_eq
+
+
+# -- DataFrame ---------------------------------------------------------------
+
+class TestDataFrame:
+    def test_construction_and_shape(self, basic_df):
+        assert basic_df.num_rows == 4
+        assert basic_df.columns == ["numbers", "doubles", "words"]
+        assert basic_df["numbers"].dtype == np.int64
+        assert basic_df["words"].dtype == np.dtype("O")
+
+    def test_ragged_columns_rejected(self):
+        with pytest.raises(ValueError):
+            DataFrame({"a": [1, 2], "b": [1, 2, 3]})
+
+    def test_select_drop_rename(self, basic_df):
+        assert basic_df.select(["words"]).columns == ["words"]
+        assert basic_df.drop("words").columns == ["numbers", "doubles"]
+        renamed = basic_df.rename({"words": "instruments"})
+        assert "instruments" in renamed.columns
+        with pytest.raises(KeyError):
+            basic_df.select(["missing"])
+
+    def test_with_column_and_metadata(self, basic_df):
+        meta = schema.make_categorical_meta(["a", "b"])
+        df = basic_df.with_column("cat", ["a", "b", "a", "b"], metadata=meta)
+        assert schema.is_categorical(df.get_metadata("cat"))
+        assert schema.categorical_levels(df.get_metadata("cat")) == ["a", "b"]
+        # overwriting a column clears stale metadata
+        df2 = df.with_column("cat", [1, 2, 3, 4])
+        assert not schema.is_categorical(df2.get_metadata("cat"))
+
+    def test_filter_take_head_sort(self, basic_df):
+        assert basic_df.filter(basic_df["numbers"] > 1).num_rows == 2
+        assert list(basic_df.take([3, 0])["numbers"]) == [3, 0]
+        assert basic_df.head(2).num_rows == 2
+        assert list(basic_df.sort_by("numbers", ascending=False)["numbers"]) == [3, 2, 1, 0]
+
+    def test_concat_and_split(self, basic_df):
+        both = DataFrame.concat([basic_df, basic_df])
+        assert both.num_rows == 8
+        a, b = both.random_split([0.5, 0.5], seed=1)
+        assert a.num_rows + b.num_rows == 8
+
+    def test_drop_nulls(self):
+        df = DataFrame({"x": [1.0, np.nan, 3.0], "s": ["a", "b", None]})
+        assert df.drop_nulls(subset=["x"]).num_rows == 2
+        assert df.drop_nulls().num_rows == 1
+
+    def test_tensor_columns(self):
+        imgs = np.zeros((3, 8, 8, 3), dtype=np.uint8)
+        df = DataFrame({"image": imgs})
+        assert df.num_rows == 3
+        assert df.schema()["image"][0] == (8, 8, 3)
+
+    def test_iter_batches(self, basic_df):
+        batches = list(basic_df.iter_batches(3))
+        assert [b.num_rows for b in batches] == [3, 1]
+
+    def test_rows_roundtrip(self, basic_df):
+        df2 = DataFrame.from_rows(list(basic_df.rows()))
+        assert_df_eq(df2, basic_df)
+
+    def test_find_unused_column_name(self, basic_df):
+        assert schema.find_unused_column_name("words", basic_df) == "words_1"
+        assert schema.find_unused_column_name("fresh", basic_df) == "fresh"
+
+
+# -- Params ------------------------------------------------------------------
+
+class _Doubler(Transformer, HasInputCol, HasOutputCol):
+    factor = Param(2.0, "multiplier", ptype=float, validator=in_range(lo=0))
+
+    def transform(self, df):
+        return df.with_column(self.output_col, df[self.input_col] * self.factor)
+
+
+class _MeanCenterer(Estimator, HasInputCol, HasOutputCol):
+    def fit(self, df):
+        return _MeanCenterModel(input_col=self.input_col,
+                                output_col=self.output_col,
+                                mean=float(np.mean(df[self.input_col])))
+
+
+class _MeanCenterModel(Model, HasInputCol, HasOutputCol):
+    mean = Param(0.0, "learned mean", ptype=float)
+
+    def transform(self, df):
+        return df.with_column(self.output_col, df[self.input_col] - self.mean)
+
+
+class TestParams:
+    def test_defaults_and_set(self):
+        t = _Doubler(input_col="doubles", output_col="out")
+        assert t.factor == 2.0
+        t.set(factor=3)
+        assert t.factor == 3.0  # int coerced to float
+
+    def test_validation(self):
+        with pytest.raises(ValueError):
+            _Doubler(factor=-1.0)
+        with pytest.raises(TypeError):
+            _Doubler(input_col=7)
+        with pytest.raises(KeyError):
+            _Doubler(nonexistent=1)
+
+    def test_explain_and_copy(self):
+        t = _Doubler(input_col="a", factor=5.0)
+        assert "multiplier" in t.explain_params()
+        c = t.copy(factor=6.0)
+        assert t.factor == 5.0 and c.factor == 6.0 and c.input_col == "a"
+
+    def test_uid_unique(self):
+        assert _Doubler().uid != _Doubler().uid
+
+
+# -- Stages & pipeline -------------------------------------------------------
+
+class TestPipeline:
+    def test_transform_and_fit(self, basic_df):
+        pipe = Pipeline(stages=[
+            _Doubler(input_col="doubles", output_col="x2"),
+            _MeanCenterer(input_col="x2", output_col="centered"),
+        ])
+        model = pipe.fit(basic_df)
+        out = model.transform(basic_df)
+        np.testing.assert_allclose(out["x2"], basic_df["doubles"] * 2)
+        assert abs(float(np.mean(out["centered"]))) < 1e-9
+
+    def test_persistence_roundtrip(self, basic_df, tmp_path):
+        pipe = Pipeline(stages=[
+            _Doubler(input_col="doubles", output_col="x2"),
+            _MeanCenterer(input_col="x2", output_col="centered"),
+        ])
+        model = pipe.fit(basic_df)
+        p = str(tmp_path / "model")
+        model.save(p)
+        loaded = PipelineStage.load(p)
+        assert_df_eq(loaded.transform(basic_df), model.transform(basic_df))
+
+    def test_estimator_persistence(self, tmp_path, basic_df):
+        pipe = Pipeline(stages=[_Doubler(input_col="doubles", output_col="x2")])
+        p = str(tmp_path / "est")
+        pipe.save(p)
+        loaded = PipelineStage.load(p)
+        out = loaded.fit(basic_df).transform(basic_df)
+        np.testing.assert_allclose(out["x2"], basic_df["doubles"] * 2)
+
+    def test_timer(self, basic_df, capsys):
+        t = Timer(stage=_MeanCenterer(input_col="doubles", output_col="c"))
+        model = t.fit(basic_df)
+        out = model.transform(basic_df)
+        assert "c" in out.columns
+        assert "Timer" in capsys.readouterr().out
+
+    def test_timer_in_pipeline(self, basic_df):
+        pipe = Pipeline(stages=[
+            Timer(stage=_MeanCenterer(input_col="doubles", output_col="c")),
+            _Doubler(input_col="c", output_col="c2"),
+        ])
+        out = pipe.fit(basic_df).transform(basic_df)
+        assert abs(float(np.mean(out["c"]))) < 1e-9
+
+    def test_select_empty_keeps_rows(self, basic_df):
+        empty = basic_df.select([])
+        assert empty.num_rows == 4
+        with pytest.raises(ValueError):
+            empty.with_column("x", [1, 2])
+
+    def test_concat_merges_metadata(self, basic_df):
+        meta = schema.make_role_meta(schema.SCORES_KIND, "m1")
+        scored = basic_df.with_column("score", [1.0] * 4, metadata=meta)
+        plain = basic_df.with_column("score", [0.0] * 4)
+        both = DataFrame.concat([plain, scored])
+        assert schema.find_column_by_role(both, schema.SCORES_KIND) == "score"
+
+    def test_fluent(self, basic_df):
+        from mmlspark_tpu.core.stage import ml_transform
+        out = ml_transform(basic_df,
+                           _Doubler(input_col="doubles", output_col="a"),
+                           _Doubler(input_col="a", output_col="b"))
+        np.testing.assert_allclose(out["b"], basic_df["doubles"] * 4)
+
+
+class TestRoleMetadata:
+    def test_score_role_discovery(self, basic_df):
+        meta = schema.make_role_meta(schema.SCORES_KIND, "model_1",
+                                     task=schema.CLASSIFICATION)
+        df = basic_df.with_column("score", [0.1, 0.2, 0.3, 0.4], metadata=meta)
+        assert schema.find_column_by_role(df, schema.SCORES_KIND) == "score"
+        assert schema.find_column_by_role(df, schema.SCORES_KIND, "other") is None
