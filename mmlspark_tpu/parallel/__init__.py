@@ -1,0 +1,27 @@
+from mmlspark_tpu.parallel.topology import (
+    MeshSpec,
+    build_mesh,
+    distributed_init,
+    local_device_count,
+)
+from mmlspark_tpu.parallel.sharding import (
+    batch_sharding,
+    replicated_sharding,
+    named_sharding,
+    pad_to_multiple,
+    shard_batch,
+    unpad,
+)
+
+__all__ = [
+    "MeshSpec",
+    "build_mesh",
+    "distributed_init",
+    "local_device_count",
+    "batch_sharding",
+    "replicated_sharding",
+    "named_sharding",
+    "pad_to_multiple",
+    "shard_batch",
+    "unpad",
+]

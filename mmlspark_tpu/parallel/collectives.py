@@ -1,0 +1,52 @@
+"""Thin, named wrappers over XLA collectives for use inside shard_map/pjit.
+
+One coherent backend (parity inventory: SURVEY.md §2.9) replacing LightGBM's
+TCP allreduce, CNTK's MPI ring, and Spark broadcast: psum/all_gather/
+ppermute/reduce_scatter over ICI, DCN across slices — all inserted by XLA
+from sharding annotations or called explicitly inside ``shard_map``.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Sequence
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def allreduce_sum(x, axis: str = "data"):
+    """Sum across an axis (LightGBM histogram-merge / MPI allreduce parity)."""
+    return lax.psum(x, axis_name=axis)
+
+
+def allreduce_mean(x, axis: str = "data"):
+    return lax.pmean(x, axis_name=axis)
+
+
+def allgather(x, axis: str = "data", tiled: bool = False):
+    return lax.all_gather(x, axis_name=axis, tiled=tiled)
+
+
+def reduce_scatter(x, axis: str = "data", scatter_dimension: int = 0):
+    return lax.psum_scatter(x, axis_name=axis,
+                            scatter_dimension=scatter_dimension, tiled=True)
+
+
+def ring_permute(x, axis: str, shift: int = 1):
+    """Send shard to the next device on a ring (ring-attention building block)."""
+    n = lax.axis_size(axis)
+    perm = [(i, (i + shift) % n) for i in range(n)]
+    return lax.ppermute(x, axis_name=axis, perm=perm)
+
+
+def axis_index(axis: str):
+    return lax.axis_index(axis)
+
+
+def shard_map_fn(fn, mesh, in_specs, out_specs, check_rep: bool = False):
+    """Wrap ``jax.shard_map`` with this framework's mesh conventions."""
+    from jax.experimental.shard_map import shard_map
+    return shard_map(fn, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                     check_rep=check_rep)

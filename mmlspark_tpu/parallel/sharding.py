@@ -1,0 +1,74 @@
+"""Sharding helpers: place columnar batches onto the mesh.
+
+The TPU-native replacement for the reference's broadcast-model /
+partitioned-data idiom: model params are replicated (or model-sharded)
+in HBM once, batches are sharded over the ``data`` axis, and XLA inserts
+the collectives.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional, Sequence, Tuple
+
+import numpy as np
+
+
+def named_sharding(mesh, *axis_for_dim: Optional[str]):
+    """NamedSharding placing dim i on mesh axis ``axis_for_dim[i]`` (None = replicate)."""
+    from jax.sharding import NamedSharding, PartitionSpec
+    return NamedSharding(mesh, PartitionSpec(*axis_for_dim))
+
+
+def batch_sharding(mesh, axis: str = "data"):
+    """Shard the leading (batch) dimension over one mesh axis."""
+    return named_sharding(mesh, axis)
+
+
+def replicated_sharding(mesh):
+    from jax.sharding import NamedSharding, PartitionSpec
+    return NamedSharding(mesh, PartitionSpec())
+
+
+def pad_to_multiple(arr: np.ndarray, multiple: int,
+                    axis: int = 0, pad_value=0) -> Tuple[np.ndarray, int]:
+    """Pad ``axis`` up to a multiple (XLA needs static, divisible shapes).
+
+    Returns (padded, original_length). The padding strategy for ragged
+    batch tails — chosen once here, used by every engine (SURVEY.md §7
+    "dynamic shapes vs XLA" risk).
+    """
+    n = arr.shape[axis]
+    target = ((n + multiple - 1) // multiple) * multiple
+    if target == n:
+        return arr, n
+    widths = [(0, 0)] * arr.ndim
+    widths[axis] = (0, target - n)
+    return np.pad(arr, widths, constant_values=pad_value), n
+
+
+def unpad(arr, n: int, axis: int = 0):
+    """Slice padding back off (host- or device-side)."""
+    index = [slice(None)] * arr.ndim
+    index[axis] = slice(0, n)
+    return arr[tuple(index)]
+
+
+def shard_batch(batch: Dict[str, np.ndarray], mesh, axis: str = "data",
+                pad_value=0) -> Tuple[Dict[str, Any], int]:
+    """Device-put a dict of host arrays sharded over the batch axis.
+
+    Pads every array's leading dim to a multiple of the axis size; returns
+    the device pytree and the true row count for unpadding results.
+    """
+    import jax
+    per_axis = mesh.shape[axis]
+    sharding = batch_sharding(mesh, axis)
+    out = {}
+    n_true = None
+    for name, arr in batch.items():
+        arr = np.asarray(arr)
+        padded, n = pad_to_multiple(arr, per_axis, pad_value=pad_value)
+        if n_true is None:
+            n_true = n
+        out[name] = jax.device_put(padded, sharding)
+    return out, int(n_true or 0)

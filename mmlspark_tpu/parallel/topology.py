@@ -1,0 +1,123 @@
+"""Device mesh topology and multi-host initialization.
+
+This is the framework's single communication story, replacing every
+coordination mechanism in the reference: the Spark-driver ServerSocket
+rendezvous + LightGBM TCP allreduce mesh (`LightGBMUtils.scala:97-142`,
+`TrainUtils.scala:217-267`), the `mpirun --hostfile` ring for CNTK
+(`CommandBuilders.scala:102-128`), and Spark broadcast. Within a slice,
+XLA collectives ride ICI; across hosts, the JAX distributed runtime
+coordinates over DCN.
+
+Axis conventions (reserved from day one so TP/PP/SP/EP are addable without
+API change — SURVEY.md §7 "hard parts"):
+
+- ``data``   — batch/data parallelism (the reference's only strategy)
+- ``model``  — tensor parallelism
+- ``seq``    — sequence/context parallelism (ring attention)
+- ``expert`` — expert parallelism
+- ``pipe``   — pipeline parallelism
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+import os
+from typing import Dict, List, Optional, Sequence, Tuple
+
+AXIS_DATA = "data"
+AXIS_MODEL = "model"
+AXIS_SEQ = "seq"
+AXIS_EXPERT = "expert"
+AXIS_PIPE = "pipe"
+
+ALL_AXES = (AXIS_DATA, AXIS_MODEL, AXIS_SEQ, AXIS_EXPERT, AXIS_PIPE)
+
+
+@dataclasses.dataclass(frozen=True)
+class MeshSpec:
+    """Logical mesh shape over named axes; -1 on one axis means 'the rest'."""
+
+    axes: Tuple[Tuple[str, int], ...] = ((AXIS_DATA, -1),)
+
+    @staticmethod
+    def data_parallel() -> "MeshSpec":
+        return MeshSpec(((AXIS_DATA, -1),))
+
+    @staticmethod
+    def from_dict(shape: Dict[str, int]) -> "MeshSpec":
+        return MeshSpec(tuple(shape.items()))
+
+    def resolve(self, n_devices: int) -> Dict[str, int]:
+        """Concrete per-axis sizes for a device count."""
+        sizes = dict(self.axes)
+        wildcards = [a for a, s in sizes.items() if s == -1]
+        if len(wildcards) > 1:
+            raise ValueError("at most one axis may be -1")
+        fixed = math.prod(s for s in sizes.values() if s != -1)
+        if wildcards:
+            if n_devices % fixed:
+                raise ValueError(
+                    f"{n_devices} devices not divisible by fixed axes {sizes}")
+            sizes[wildcards[0]] = n_devices // fixed
+        elif fixed != n_devices:
+            raise ValueError(f"mesh {sizes} needs {fixed} devices, have {n_devices}")
+        return sizes
+
+    @property
+    def axis_names(self) -> Tuple[str, ...]:
+        return tuple(a for a, _ in self.axes)
+
+
+def local_device_count() -> int:
+    import jax
+    return len(jax.devices())
+
+
+def use_cpu_devices(n: int = 8) -> None:
+    """Switch this process to ``n`` virtual CPU devices (test/dev mode).
+
+    Must run before any jax backend is initialized (first device touch),
+    but works even if jax was already *imported* — e.g. by an image
+    sitecustomize that pins a TPU platform — because backends init lazily.
+    This is how the distributed code paths run unchanged from laptop to pod.
+    """
+    import jax
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = \
+            (flags + f" --xla_force_host_platform_device_count={n}").strip()
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    jax.config.update("jax_platforms", "cpu")
+
+
+def build_mesh(spec: Optional[MeshSpec] = None, devices=None):
+    """Build a ``jax.sharding.Mesh`` over the given (default: all) devices."""
+    import jax
+    import numpy as np
+    from jax.sharding import Mesh
+
+    spec = spec or MeshSpec.data_parallel()
+    devices = list(devices) if devices is not None else list(jax.devices())
+    sizes = spec.resolve(len(devices))
+    shape = tuple(sizes[a] for a in spec.axis_names)
+    dev_array = np.asarray(devices).reshape(shape)
+    return Mesh(dev_array, spec.axis_names)
+
+
+def distributed_init(coordinator_address: Optional[str] = None,
+                     num_processes: Optional[int] = None,
+                     process_id: Optional[int] = None) -> None:
+    """Join the multi-host JAX distributed runtime (DCN rendezvous).
+
+    The one-call replacement for the reference's entire driver-socket
+    rendezvous + ssh/scp/MPI machinery. No-ops when single-process (env
+    unset), so the same program runs unchanged from laptop to pod.
+    """
+    import jax
+    addr = coordinator_address or os.environ.get("MMLSPARK_TPU_COORDINATOR")
+    if addr is None and num_processes is None:
+        return  # single-process
+    jax.distributed.initialize(coordinator_address=addr,
+                               num_processes=num_processes,
+                               process_id=process_id)

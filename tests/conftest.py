@@ -6,15 +6,17 @@ distributed paths in `local[*]` by treating each partition as a worker,
 virtual 8-device CPU mesh via ``xla_force_host_platform_device_count``, so
 the distributed code tested here is identical to what runs on a TPU pod.
 
-Env vars MUST be set before jax is imported anywhere.
+The platform flip must happen before any jax backend is initialized
+(first device touch); jax may already be *imported* by the image's
+sitecustomize, which is fine. MMLSPARK_TPU_TEST_TPU=1 opts out to run
+the suite on real chips.
 """
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
-_flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in _flags:
-    os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+if os.environ.get("MMLSPARK_TPU_TEST_TPU") != "1":
+    from mmlspark_tpu.parallel.topology import use_cpu_devices
+    use_cpu_devices(8)
 
 import numpy as np
 import pytest
