@@ -6,3 +6,5 @@ generic fuzzing test sweep.
 
 import mmlspark_tpu.core.stage  # noqa: F401
 import mmlspark_tpu.core.pipeline  # noqa: F401
+import mmlspark_tpu.stages.image  # noqa: F401
+import mmlspark_tpu.stages.batching  # noqa: F401

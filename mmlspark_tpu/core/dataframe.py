@@ -184,6 +184,8 @@ class DataFrame:
 
     def take(self, indices: ColumnLike) -> "DataFrame":
         idx = np.asarray(indices)
+        if idx.size == 0:
+            idx = np.zeros(0, dtype=np.int64)
         return self._derive({n: c[idx] for n, c in self._data.items()},
                             n_rows=len(idx))
 

@@ -1,0 +1,4 @@
+from mmlspark_tpu.io.binary import read_binary_files
+from mmlspark_tpu.io.images import read_images, decode_image, encode_image
+
+__all__ = ["read_binary_files", "read_images", "decode_image", "encode_image"]

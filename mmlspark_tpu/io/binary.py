@@ -1,0 +1,79 @@
+"""Whole-file binary reader: files -> (path, bytes) rows.
+
+Capability parity with the reference's custom Hadoop FileFormat
+(`io/binary/src/main/scala/BinaryFileFormat.scala:114`,
+`BinaryRecordReader.scala:34`): read a directory tree as rows of
+``(path, bytes)``, with zip-archive inspection (members become rows) and
+record-level subsampling — here against the local/NFS filesystem that
+backs TPU VMs.
+"""
+
+from __future__ import annotations
+
+import fnmatch
+import io as _io
+import os
+import random
+import zipfile
+from typing import Iterator, List, Optional, Tuple
+
+import numpy as np
+
+from mmlspark_tpu.core.dataframe import DataFrame
+
+PATH_COL = "path"
+BYTES_COL = "bytes"
+
+
+def _iter_files(path: str, recursive: bool, pattern: Optional[str]) -> Iterator[str]:
+    if os.path.isfile(path):
+        yield path
+        return
+    if recursive:
+        for root, _, files in os.walk(path):
+            for f in sorted(files):
+                if pattern is None or fnmatch.fnmatch(f, pattern):
+                    yield os.path.join(root, f)
+    else:
+        for f in sorted(os.listdir(path)):
+            full = os.path.join(path, f)
+            if os.path.isfile(full) and (pattern is None or fnmatch.fnmatch(f, pattern)):
+                yield full
+
+
+def read_binary_files(path: str,
+                      recursive: bool = True,
+                      pattern: Optional[str] = None,
+                      sample_ratio: float = 1.0,
+                      inspect_zip: bool = True,
+                      seed: int = 0) -> DataFrame:
+    """Read files under ``path`` as a frame with ``path``/``bytes`` columns.
+
+    Zip archives are expanded into one row per member, with paths like
+    ``archive.zip/member`` (parity: zip inspection + subsampling at the
+    record-reader level, `BinaryRecordReader.scala:34`).
+    """
+    rng = random.Random(seed)
+    paths: List[str] = []
+    blobs: List[bytes] = []
+
+    def emit(p: str, data: bytes) -> None:
+        if sample_ratio >= 1.0 or rng.random() < sample_ratio:
+            paths.append(p)
+            blobs.append(data)
+
+    for fp in _iter_files(path, recursive, pattern):
+        if inspect_zip and fp.lower().endswith(".zip"):
+            with zipfile.ZipFile(fp) as zf:
+                for name in zf.namelist():
+                    if name.endswith("/"):
+                        continue
+                    emit(f"{fp}/{name}", zf.read(name))
+        else:
+            with open(fp, "rb") as f:
+                emit(fp, f.read())
+
+    return DataFrame({
+        PATH_COL: np.array(paths, dtype=object),
+        BYTES_COL: np.array(blobs, dtype=object),
+    })

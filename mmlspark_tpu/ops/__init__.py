@@ -1,0 +1,3 @@
+from mmlspark_tpu.ops import image
+
+__all__ = ["image"]

@@ -1,0 +1,199 @@
+"""Image ops, image stages, I/O readers, and batching stages."""
+
+import io
+import os
+import zipfile
+
+import numpy as np
+import pytest
+
+from mmlspark_tpu.core.dataframe import DataFrame
+from mmlspark_tpu.ops import image as ops
+from mmlspark_tpu.stages.image import (
+    ImageTransformer, ResizeImageTransformer, UnrollImage, UnrollBinaryImage,
+    ImageSetAugmenter,
+)
+from mmlspark_tpu.stages.batching import (
+    FixedBatcher, DynamicBufferedBatcher, TimeIntervalBatcher,
+    FixedMiniBatchTransformer, DynamicMiniBatchTransformer, FlattenBatch,
+)
+from mmlspark_tpu.io.images import read_images, decode_image, encode_image
+from mmlspark_tpu.io.binary import read_binary_files
+
+
+@pytest.fixture
+def imgs(rng):
+    return rng.uniform(0, 255, size=(4, 16, 12, 3)).astype(np.float32)
+
+
+class TestImageOps:
+    def test_resize(self, imgs):
+        out = np.asarray(ops.resize(imgs, 8, 8))
+        assert out.shape == (4, 8, 8, 3)
+        flat = np.asarray(ops.resize(imgs[0], 8, 8))
+        assert flat.shape == (8, 8, 3)
+
+    def test_crop(self, imgs):
+        out = np.asarray(ops.crop(imgs, 2, 3, 4, 5))
+        np.testing.assert_array_equal(out, imgs[:, 3:7, 2:7, :])
+        cc = np.asarray(ops.center_crop(imgs, 8, 8))
+        assert cc.shape == (4, 8, 8, 3)
+
+    def test_flip(self, imgs):
+        np.testing.assert_array_equal(np.asarray(ops.flip(imgs, ops.FLIP_HORIZONTAL)),
+                                      imgs[:, :, ::-1, :])
+        np.testing.assert_array_equal(np.asarray(ops.flip(imgs, ops.FLIP_VERTICAL)),
+                                      imgs[:, ::-1, :, :])
+
+    def test_box_blur_constant_preserved(self):
+        const = np.full((1, 8, 8, 3), 7.0, dtype=np.float32)
+        out = np.asarray(ops.box_blur(const, 3, 3))
+        np.testing.assert_allclose(out, const, rtol=1e-5)
+
+    def test_gaussian_kernel_normalized(self):
+        k = np.asarray(ops.gaussian_kernel(2, 1.0))
+        assert k.shape == (5, 5)
+        assert float(k.sum()) == pytest.approx(1.0)
+
+    def test_threshold_modes(self):
+        x = np.array([[[[10.0], [200.0]]]])
+        assert np.asarray(ops.threshold(x, 100, 255, ops.THRESH_BINARY)).ravel().tolist() == [0, 255]
+        assert np.asarray(ops.threshold(x, 100, 255, ops.THRESH_BINARY_INV)).ravel().tolist() == [255, 0]
+        assert np.asarray(ops.threshold(x, 100, 255, ops.THRESH_TRUNC)).ravel().tolist() == [10, 100]
+        assert np.asarray(ops.threshold(x, 100, 255, ops.THRESH_TOZERO)).ravel().tolist() == [0, 200]
+        assert np.asarray(ops.threshold(x, 100, 255, ops.THRESH_TOZERO_INV)).ravel().tolist() == [10, 0]
+
+    def test_grayscale_and_swap(self, imgs):
+        g = np.asarray(ops.to_grayscale(imgs))
+        assert g.shape == (4, 16, 12, 1)
+        np.testing.assert_array_equal(np.asarray(ops.swap_rb(imgs)), imgs[..., ::-1])
+
+    def test_unroll_reroll_roundtrip(self, imgs):
+        v = np.asarray(ops.unroll(imgs))
+        assert v.shape == (4, 3 * 16 * 12)
+        back = np.asarray(ops.reroll(v, 16, 12, 3))
+        np.testing.assert_allclose(back, imgs, rtol=1e-6)
+
+    def test_unroll_chw_order(self):
+        # pixel (h=0,w=1) of channel 0 must land at index 1 (CHW layout)
+        img = np.zeros((1, 2, 2, 3), dtype=np.float32)
+        img[0, 0, 1, 0] = 5.0
+        v = np.asarray(ops.unroll(img))[0]
+        assert v[1] == 5.0 and v.sum() == 5.0
+
+
+class TestImageStages:
+    def test_transformer_chain(self, imgs):
+        df = DataFrame({"image": imgs})
+        t = ImageTransformer().resize(8, 8).flip().color_format("gray")
+        out = t.transform(df)
+        assert out["image"].shape == (4, 8, 8, 1)
+
+    def test_shape_bucketing(self, rng):
+        images = np.array(
+            [rng.uniform(0, 255, (10, 8, 3)), rng.uniform(0, 255, (6, 6, 3)),
+             rng.uniform(0, 255, (10, 8, 3))], dtype=object)
+        df = DataFrame({"image": images})
+        out = ImageTransformer().resize(4, 4).transform(df)
+        assert out["image"].shape == (3, 4, 4, 3)
+        # resize of bucket members matches individual resize
+        solo = np.asarray(ops.resize(np.asarray(images[1], dtype=np.float32), 4, 4))
+        np.testing.assert_allclose(out["image"][1], solo, rtol=1e-5)
+
+    def test_persistence(self, imgs, tmp_path):
+        from mmlspark_tpu.core.stage import PipelineStage
+        t = ImageTransformer().resize(8, 8).normalize([0.5]*3, [0.5]*3, scale=1/255.)
+        t.save(str(tmp_path / "t"))
+        loaded = PipelineStage.load(str(tmp_path / "t"))
+        df = DataFrame({"image": imgs})
+        np.testing.assert_allclose(loaded.transform(df)["image"],
+                                   t.transform(df)["image"], rtol=1e-6)
+
+    def test_unroll_stage(self, imgs):
+        df = DataFrame({"image": imgs})
+        out = UnrollImage(output_col="features").transform(df)
+        assert out["features"].shape == (4, 3 * 16 * 12)
+
+    def test_resize_then_unroll_binary(self, imgs):
+        blobs = [encode_image(im) for im in imgs.astype(np.uint8)]
+        df = DataFrame({"bytes": np.array(blobs, dtype=object)})
+        out = UnrollBinaryImage(height=8, width=8).transform(df)
+        assert out["features"].shape == (4, 3 * 8 * 8)
+        assert "bytes" in out.columns and "__img" not in out.columns
+
+    def test_augmenter(self, imgs):
+        df = DataFrame({"image": imgs, "label": np.arange(4)})
+        out = ImageSetAugmenter(flip_left_right=True, flip_up_down=True).transform(df)
+        assert out.num_rows == 12
+        np.testing.assert_array_equal(np.asarray(out["image"][4], dtype=np.float32),
+                                      imgs[0, :, ::-1, :])
+
+
+class TestIO:
+    def test_binary_and_zip(self, tmp_path):
+        (tmp_path / "a.bin").write_bytes(b"alpha")
+        with zipfile.ZipFile(tmp_path / "arc.zip", "w") as zf:
+            zf.writestr("inner1.txt", b"one")
+            zf.writestr("sub/inner2.txt", b"two")
+        df = read_binary_files(str(tmp_path))
+        assert df.num_rows == 3
+        by_path = dict(zip(df["path"], df["bytes"]))
+        assert by_path[str(tmp_path / "a.bin")] == b"alpha"
+        assert by_path[str(tmp_path / "arc.zip") + "/inner1.txt"] == b"one"
+
+    def test_sampling(self, tmp_path):
+        for i in range(50):
+            (tmp_path / f"f{i:02d}.bin").write_bytes(bytes([i]))
+        df = read_binary_files(str(tmp_path), sample_ratio=0.3, seed=7)
+        assert 5 < df.num_rows < 30
+
+    def test_read_images(self, tmp_path, rng):
+        img = rng.uniform(0, 255, (9, 7, 3)).astype(np.uint8)
+        (tmp_path / "x.png").write_bytes(encode_image(img))
+        (tmp_path / "bad.png").write_bytes(b"not an image")
+        (tmp_path / "notes.txt").write_bytes(b"skip me")
+        df = read_images(str(tmp_path))
+        assert df.num_rows == 1
+        np.testing.assert_array_equal(df["image"][0], img)
+
+    def test_codec_roundtrip(self, rng):
+        img = rng.uniform(0, 255, (5, 4, 3)).astype(np.uint8)
+        np.testing.assert_array_equal(decode_image(encode_image(img)), img)
+        assert decode_image(b"garbage") is None
+
+
+class TestBatching:
+    def test_fixed_batcher(self):
+        assert list(FixedBatcher(3)(range(7))) == [[0, 1, 2], [3, 4, 5], [6]]
+
+    def test_dynamic_buffered_batcher(self):
+        batches = list(DynamicBufferedBatcher()(range(100)))
+        flat = [x for b in batches for x in b]
+        assert flat == list(range(100))
+        assert all(batches)
+
+    def test_dynamic_batcher_propagates_errors(self):
+        def gen():
+            yield 1
+            raise RuntimeError("boom")
+        with pytest.raises(RuntimeError):
+            list(DynamicBufferedBatcher()(gen()))
+
+    def test_time_interval_batcher(self):
+        batches = list(TimeIntervalBatcher(interval=0.0, max_batch_size=2)(range(5)))
+        flat = [x for b in batches for x in b]
+        assert flat == list(range(5))
+
+    def test_minibatch_flatten_roundtrip(self, basic_df):
+        batched = FixedMiniBatchTransformer(batch_size=3).transform(basic_df)
+        assert batched.num_rows == 2
+        assert len(batched["numbers"][0]) == 3
+        flat = FlattenBatch().transform(batched)
+        assert flat.num_rows == 4
+        np.testing.assert_array_equal(np.asarray(flat["numbers"], dtype=np.int64),
+                                      basic_df["numbers"])
+
+    def test_dynamic_minibatch(self, basic_df):
+        out = DynamicMiniBatchTransformer().transform(basic_df)
+        assert out.num_rows == 1
+        assert len(out["numbers"][0]) == 4
