@@ -1,0 +1,62 @@
+"""Headline benchmark: CIFAR-10 ResNet scoring throughput per chip.
+
+BASELINE config 3 ("CNTKModel.transform CIFAR10 ResNet scoring"). The
+reference publishes no absolute number — its CIFAR10 notebook times
+`CNTKModel.transform` over the 10k test images on a GPU VM without
+committing the result (BASELINE.md). We use 1000 images/sec/chip as the
+GPU-VM wall-clock parity proxy (10k images in ~10s, the era's
+CNTK-on-Spark ballpark including per-partition JNI marshalling);
+``vs_baseline`` = measured / proxy, so >= 1.0 means at-or-above parity.
+
+Prints exactly one JSON line:
+  {"metric": ..., "value": N, "unit": "images/sec/chip", "vs_baseline": N}
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+import numpy as np
+
+BASELINE_IMAGES_PER_SEC = 1000.0  # GPU-VM wall-clock parity proxy (see above)
+BATCH = 1024
+N_IMAGES = 10_240  # ~ the notebook's 10k CIFAR test set
+
+
+def main() -> None:
+    import jax
+    from mmlspark_tpu.models.function import NNFunction
+    from mmlspark_tpu.models.nn import NNModel
+    from mmlspark_tpu.core.dataframe import DataFrame
+
+    model = NNFunction.init(
+        {"builder": "cifar_resnet", "depth": 20, "dtype": "bfloat16"},
+        input_shape=(32, 32, 3), seed=0)
+    rng = np.random.default_rng(0)
+    images = rng.uniform(0, 1, size=(N_IMAGES, 32, 32, 3)).astype(np.float32)
+    df = DataFrame({"image": images})
+
+    scorer = NNModel(model=model, input_col="image", output_col="scores",
+                     batch_size=BATCH)
+
+    # warmup: compile + first dispatch
+    scorer.transform(df.head(BATCH))
+
+    t0 = time.perf_counter()
+    out = scorer.transform(df)
+    assert out["scores"].shape == (N_IMAGES, 10)
+    elapsed = time.perf_counter() - t0
+
+    n_chips = max(len(jax.devices()), 1)
+    images_per_sec_per_chip = N_IMAGES / elapsed / n_chips
+    print(json.dumps({
+        "metric": "cifar10_resnet20_scoring_throughput",
+        "value": round(images_per_sec_per_chip, 1),
+        "unit": "images/sec/chip",
+        "vs_baseline": round(images_per_sec_per_chip / BASELINE_IMAGES_PER_SEC, 3),
+    }))
+
+
+if __name__ == "__main__":
+    main()

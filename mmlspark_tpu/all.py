@@ -8,3 +8,6 @@ import mmlspark_tpu.core.stage  # noqa: F401
 import mmlspark_tpu.core.pipeline  # noqa: F401
 import mmlspark_tpu.stages.image  # noqa: F401
 import mmlspark_tpu.stages.batching  # noqa: F401
+import mmlspark_tpu.models.nn  # noqa: F401
+import mmlspark_tpu.models.trainer  # noqa: F401
+import mmlspark_tpu.models.featurizer  # noqa: F401

@@ -1,0 +1,10 @@
+from mmlspark_tpu.models.function import NNFunction, LayeredModel
+from mmlspark_tpu.models.nn import NNModel
+from mmlspark_tpu.models.resnet import ResNet, ConvNet, cifar_resnet, cifar_convnet
+from mmlspark_tpu.models.featurizer import ImageFeaturizer
+from mmlspark_tpu.models.trainer import NNLearner
+from mmlspark_tpu.models.zoo import ModelDownloader, ModelRepo, ModelSchema
+
+__all__ = ["NNFunction", "LayeredModel", "NNModel", "NNLearner", "ResNet",
+           "ConvNet", "cifar_resnet", "cifar_convnet", "ImageFeaturizer",
+           "ModelDownloader", "ModelRepo", "ModelSchema"]

@@ -1,0 +1,170 @@
+"""NNFunction: the framework's deep-net model format.
+
+Capability parity with the reference's CNTK evaluation engine surface
+(`cntk-model/src/main/scala/SerializableFunction.scala:25-85`,
+`CNTKModel.scala:30-69`): a serialized network that can be loaded,
+evaluated with feed/fetch-dict semantics, truncated at a named layer
+(for transfer learning), and shipped inside a pipeline stage.
+
+TPU-native design: the network is a flax ``LayeredModel`` — an ordered
+list of named layers — whose forward pass is a pure jitted function; the
+"serialized model" is an architecture config (JSON) + a params pytree
+(npz), so persistence is exact and rebuildable. Layer truncation is a
+static argument, giving each cut its own fused XLA program.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import inspect
+import json
+import os
+import typing
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+import flax.linen as nn
+
+
+def _wants_train_flag(layer) -> bool:
+    try:
+        sig = inspect.signature(layer.__call__ if isinstance(layer, nn.Module)
+                                else layer)
+        return "train" in sig.parameters
+    except (TypeError, ValueError):
+        return False
+
+
+class LayeredModel(nn.Module):
+    """Ordered named layers with truncation at any name.
+
+    ``layers`` is a tuple of (name, module-or-callable). Residual wiring
+    lives inside block modules; the top level stays a linear chain so a
+    named cut point exists between any two blocks (parity: CNTK
+    ``layerNames`` + output-node selection, `Schema.scala:54-74`).
+    """
+
+    layers: Tuple[Tuple[str, Any], ...]
+
+    @property
+    def layer_names(self) -> List[str]:
+        return [name for name, _ in self.layers]
+
+    @nn.compact
+    def __call__(self, x, output_layer: Optional[str] = None,
+                 train: bool = False):
+        if output_layer is not None and output_layer not in self.layer_names:
+            raise KeyError(f"no layer named {output_layer!r}; "
+                           f"have {self.layer_names}")
+        for name, layer in self.layers:
+            if _wants_train_flag(layer):
+                x = layer(x, train=train)
+            else:
+                x = layer(x)
+            if output_layer is not None and name == output_layer:
+                return x
+        return x
+
+
+@dataclasses.dataclass
+class NNFunction:
+    """A loadable/evaluable network: architecture config + params pytree.
+
+    ``arch`` is a JSON-able dict whose ``builder`` key names a registered
+    architecture factory (see :mod:`mmlspark_tpu.models.resnet`), so a
+    checkpoint fully reconstructs the module — the analogue of loading a
+    serialized CNTK Function from bytes.
+    """
+
+    arch: Dict[str, Any]
+    params: Any
+
+    # class-level registry of architecture builders (not a dataclass field)
+    _BUILDERS: typing.ClassVar[Dict[str, Callable[..., nn.Module]]] = {}
+
+    @classmethod
+    def register_builder(cls, name: str):
+        def deco(fn):
+            cls._BUILDERS[name] = fn
+            return fn
+        return deco
+
+    def module(self) -> nn.Module:
+        builder = NNFunction._BUILDERS.get(self.arch["builder"])
+        if builder is None:
+            raise KeyError(f"unknown architecture builder "
+                           f"{self.arch['builder']!r}; registered: "
+                           f"{sorted(NNFunction._BUILDERS)}")
+        kwargs = {k: v for k, v in self.arch.items() if k != "builder"}
+        return builder(**kwargs)
+
+    # -- evaluation ---------------------------------------------------------
+
+    @property
+    def layer_names(self) -> List[str]:
+        return list(self.module().layer_names)
+
+    def apply(self, x, output_layer: Optional[str] = None,
+              train: bool = False):
+        """Forward pass; ``output_layer`` truncates at a named layer."""
+        return self.module().apply(self.params, x, output_layer=output_layer,
+                                   train=train)
+
+    def layer_name_for_cut(self, cut_layers: int) -> Optional[str]:
+        """Name of the output layer after cutting the last ``cut_layers``
+        layers (parity: ImageFeaturizer.setCutOutputLayers)."""
+        names = self.layer_names
+        if not 0 <= cut_layers < len(names):
+            raise ValueError(f"cut_layers={cut_layers} out of range for "
+                             f"{len(names)} layers")
+        return None if cut_layers == 0 else names[len(names) - 1 - cut_layers]
+
+    # -- persistence --------------------------------------------------------
+
+    def save(self, path: str) -> None:
+        os.makedirs(path, exist_ok=True)
+        with open(os.path.join(path, "arch.json"), "w") as f:
+            json.dump(self.arch, f, indent=2)
+        np.savez_compressed(os.path.join(path, "params.npz"),
+                            **flatten_params(self.params))
+
+    @staticmethod
+    def load(path: str) -> "NNFunction":
+        with open(os.path.join(path, "arch.json")) as f:
+            arch = json.load(f)
+        with np.load(os.path.join(path, "params.npz")) as npz:
+            params = unflatten_params({k: npz[k] for k in npz.files})
+        return NNFunction(arch=arch, params=params)
+
+    @staticmethod
+    def init(arch: Dict[str, Any], input_shape: Sequence[int],
+             seed: int = 0) -> "NNFunction":
+        """Random-init an architecture (the training entry point)."""
+        import jax
+        fn = NNFunction(arch=arch, params=None)
+        module = fn.module()
+        dummy = np.zeros((1, *input_shape), dtype=np.float32)
+        fn.params = module.init(jax.random.PRNGKey(seed), dummy)
+        return fn
+
+
+def flatten_params(params, prefix="") -> Dict[str, np.ndarray]:
+    out = {}
+    for k, v in params.items():
+        key = f"{prefix}/{k}" if prefix else str(k)
+        if isinstance(v, dict):
+            out.update(flatten_params(v, key))
+        else:
+            out[key] = np.asarray(v)
+    return out
+
+
+def unflatten_params(flat: Dict[str, np.ndarray]):
+    root: Dict[str, Any] = {}
+    for key, v in flat.items():
+        parts = key.split("/")
+        node = root
+        for p in parts[:-1]:
+            node = node.setdefault(p, {})
+        node[parts[-1]] = v
+    return root

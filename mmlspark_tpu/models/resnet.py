@@ -1,0 +1,133 @@
+"""Flagship architectures: CIFAR ResNet + ConvNet, built as LayeredModels.
+
+These fill the role of the reference model zoo's CNTK networks (ResNet
+for CIFAR-10 scoring in the CIFAR10 notebook; truncated nets for
+ImageFeaturizer transfer learning). TPU-first choices: NHWC layouts,
+bfloat16-friendly convs that tile onto the MXU, GroupNorm instead of
+BatchNorm (no mutable running stats, so the same pure function serves
+scoring, training, and feature extraction), and a linear top-level layer
+chain so any block boundary is a named cut point.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Callable, Optional, Sequence, Tuple
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from mmlspark_tpu.models.function import LayeredModel, NNFunction
+
+
+class ResNetBlock(nn.Module):
+    """Pre-activation residual block (GroupNorm + ReLU)."""
+
+    features: int
+    stride: int = 1
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x):
+        residual = x
+        y = nn.GroupNorm(num_groups=min(32, x.shape[-1]))(x)
+        y = nn.relu(y)
+        y = nn.Conv(self.features, (3, 3), strides=(self.stride, self.stride),
+                    use_bias=False, dtype=self.dtype)(y)
+        y = nn.GroupNorm(num_groups=min(32, self.features))(y)
+        y = nn.relu(y)
+        y = nn.Conv(self.features, (3, 3), use_bias=False, dtype=self.dtype)(y)
+        if residual.shape != y.shape:
+            residual = nn.Conv(self.features, (1, 1),
+                               strides=(self.stride, self.stride),
+                               use_bias=False, dtype=self.dtype)(residual)
+        return y + residual
+
+
+class _BlockGroup(nn.Module):
+    features: int
+    n_blocks: int
+    stride: int
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x):
+        for i in range(self.n_blocks):
+            x = ResNetBlock(self.features, stride=self.stride if i == 0 else 1,
+                            dtype=self.dtype)(x)
+        return x
+
+
+def _global_pool(x):
+    return jnp.mean(x, axis=(1, 2))
+
+
+@NNFunction.register_builder("cifar_resnet")
+def cifar_resnet(depth: int = 20, num_classes: int = 10,
+                 width: int = 16, dtype: str = "float32") -> nn.Module:
+    """CIFAR-style ResNet (depth = 6n+2: 20/32/56/110).
+
+    Layer names: conv_in, group1..3, pool, z (logits) — ``pool`` is the
+    transfer-learning feature layer (cut_layers=1 in ImageFeaturizer
+    terms cuts ``z``).
+    """
+    if (depth - 2) % 6:
+        raise ValueError(f"depth must be 6n+2, got {depth}")
+    n = (depth - 2) // 6
+    dt = jnp.bfloat16 if dtype == "bfloat16" else jnp.float32
+    layers = (
+        ("conv_in", nn.Conv(width, (3, 3), use_bias=False, dtype=dt)),
+        ("group1", _BlockGroup(width, n, 1, dt)),
+        ("group2", _BlockGroup(2 * width, n, 2, dt)),
+        ("group3", _BlockGroup(4 * width, n, 2, dt)),
+        ("pool", _global_pool),
+        ("z", nn.Dense(num_classes)),
+    )
+    return LayeredModel(layers=layers)
+
+
+@NNFunction.register_builder("cifar_convnet")
+def cifar_convnet(num_classes: int = 10, dtype: str = "float32") -> nn.Module:
+    """Small CIFAR conv net (the CNTK ConvNet notebook analogue).
+
+    conv/pool stack -> dense features -> logits; ``h2`` is the feature
+    layer.
+    """
+    dt = jnp.bfloat16 if dtype == "bfloat16" else jnp.float32
+
+    def pool2(x):
+        return nn.max_pool(x, (2, 2), strides=(2, 2))
+
+    layers = (
+        ("conv1", nn.Conv(32, (3, 3), dtype=dt)),
+        ("relu1", nn.relu),
+        ("pool1", pool2),
+        ("conv2", nn.Conv(64, (3, 3), dtype=dt)),
+        ("relu2", nn.relu),
+        ("pool2", pool2),
+        ("flatten", lambda x: x.reshape(x.shape[0], -1)),
+        ("h1", nn.Dense(256)),
+        ("relu3", nn.relu),
+        ("h2", nn.Dense(128)),
+        ("relu4", nn.relu),
+        ("z", nn.Dense(num_classes)),
+    )
+    return LayeredModel(layers=layers)
+
+
+@NNFunction.register_builder("mlp")
+def mlp(hidden: Sequence[int] = (128, 64), num_outputs: int = 1,
+        activation: str = "relu") -> nn.Module:
+    """Plain MLP for tabular heads (BrainScript one-hidden-layer parity)."""
+    act = {"relu": nn.relu, "tanh": jnp.tanh, "gelu": nn.gelu}[activation]
+    layers = []
+    for i, h in enumerate(hidden):
+        layers.append((f"h{i + 1}", nn.Dense(h)))
+        layers.append((f"act{i + 1}", act))
+    layers.append(("z", nn.Dense(num_outputs)))
+    return LayeredModel(layers=tuple(layers))
+
+
+# aliases used around the framework
+ResNet = cifar_resnet
+ConvNet = cifar_convnet

@@ -1,0 +1,234 @@
+"""NNLearner: in-process data-parallel deep-net training on the mesh.
+
+Capability parity with `src/cntk-train` (`CNTKLearner.scala:85-190`): an
+Estimator that takes a labeled frame, trains a network with configurable
+loss/optimizer/schedule (the role BrainScript configs play), and returns
+an ``NNModel`` for scoring. The reference's entire data-export ->
+ssh/scp -> `mpirun cntk` -> copy-model-back chain
+(`CommandBuilders.scala:149-266`) collapses to a jitted train step with
+sharding-induced ICI allreduce — zero processes, zero sockets, zero MPI.
+
+Distribution: batches are sharded over the mesh's ``data`` axis, params
+replicated (or sharded over ``model`` for TP); XLA inserts the gradient
+allreduce. Step checkpointing via orbax covers the "resume" capability
+(SURVEY.md §5 checkpoint/resume).
+"""
+
+from __future__ import annotations
+
+import math
+import os
+from typing import Any, Callable, Dict, Optional, Tuple
+
+import numpy as np
+
+from mmlspark_tpu.core.dataframe import DataFrame
+from mmlspark_tpu.core.params import (
+    Param, HasLabelCol, HasFeaturesCol, in_set, in_range,
+)
+from mmlspark_tpu.core.stage import Estimator
+from mmlspark_tpu.models.function import NNFunction
+from mmlspark_tpu.models.nn import NNModel
+from mmlspark_tpu.parallel import (
+    MeshSpec, build_mesh, batch_sharding, replicated_sharding, pad_to_multiple,
+)
+
+LOSSES = ("softmax_cross_entropy", "sigmoid_cross_entropy", "squared_error")
+OPTIMIZERS = ("sgd", "momentum", "adam", "adamw")
+
+
+def make_loss(name: str) -> Callable:
+    import jax.numpy as jnp
+    import optax
+
+    if name == "softmax_cross_entropy":
+        def loss(logits, labels, weights):
+            l = optax.softmax_cross_entropy_with_integer_labels(
+                logits, labels.astype(jnp.int32))
+            return jnp.sum(l * weights) / jnp.maximum(jnp.sum(weights), 1.0)
+    elif name == "sigmoid_cross_entropy":
+        def loss(logits, labels, weights):
+            l = optax.sigmoid_binary_cross_entropy(logits[..., 0], labels)
+            return jnp.sum(l * weights) / jnp.maximum(jnp.sum(weights), 1.0)
+    elif name == "squared_error":
+        def loss(logits, labels, weights):
+            l = jnp.square(logits[..., 0] - labels)
+            return jnp.sum(l * weights) / jnp.maximum(jnp.sum(weights), 1.0)
+    else:
+        raise ValueError(f"unknown loss {name!r}; have {LOSSES}")
+    return loss
+
+
+def make_optimizer(name: str, learning_rate: float, momentum: float = 0.9,
+                   weight_decay: float = 1e-4):
+    import optax
+    if name == "sgd":
+        return optax.sgd(learning_rate)
+    if name == "momentum":
+        return optax.sgd(learning_rate, momentum=momentum)
+    if name == "adam":
+        return optax.adam(learning_rate)
+    if name == "adamw":
+        return optax.adamw(learning_rate, weight_decay=weight_decay)
+    raise ValueError(f"unknown optimizer {name!r}; have {OPTIMIZERS}")
+
+
+class NNLearner(Estimator, HasLabelCol, HasFeaturesCol):
+    """Train an NNFunction on a labeled frame; returns an NNModel."""
+
+    features_col = Param("features", "input column (vectors or images)", ptype=str)
+    label_col = Param("label", "label column", ptype=str)
+    weight_col = Param(None, "optional per-row weight column", ptype=str)
+    arch = Param(None, "architecture config dict (builder + kwargs)", ptype=dict)
+    model = Param(None, "optional warm-start NNFunction", complex=True)
+    loss = Param("softmax_cross_entropy", "training loss",
+                 validator=in_set(*LOSSES))
+    optimizer = Param("momentum", "optimizer", validator=in_set(*OPTIMIZERS))
+    learning_rate = Param(0.1, "peak learning rate", ptype=float)
+    momentum = Param(0.9, "sgd momentum", ptype=float)
+    weight_decay = Param(1e-4, "adamw weight decay", ptype=float)
+    epochs = Param(10, "passes over the data", ptype=int)
+    batch_size = Param(256, "global batch size", ptype=int)
+    warmup_steps = Param(0, "linear LR warmup steps", ptype=int)
+    cosine_decay = Param(True, "cosine-decay LR to 0 over training", ptype=bool)
+    seed = Param(0, "init/shuffle seed", ptype=int)
+    mesh_shape = Param(None, "mesh axes dict, e.g. {'data': -1}", ptype=dict)
+    checkpoint_dir = Param(None, "orbax step-checkpoint directory", ptype=str)
+    checkpoint_every = Param(0, "steps between checkpoints (0 = off)", ptype=int)
+    log_every = Param(50, "steps between loss logs (0 = off)", ptype=int)
+
+    # -- jitted step construction ------------------------------------------
+
+    def build_train_step(self, module, tx, loss_fn):
+        """(params, opt_state, batch) -> (params, opt_state, loss), jittable."""
+        import jax
+
+        def step(params, opt_state, x, y, w):
+            def objective(p):
+                logits = module.apply(p, x, train=True)
+                return loss_fn(logits, y, w)
+
+            loss, grads = jax.value_and_grad(objective)(params)
+            updates, opt_state = tx.update(grads, opt_state, params)
+            import optax
+            params = optax.apply_updates(params, updates)
+            return params, opt_state, loss
+
+        return step
+
+    def _schedule(self, steps_per_epoch: int):
+        import optax
+        warmup = max(self.warmup_steps, 1)
+        total = max(self.epochs * steps_per_epoch, warmup + 1)
+        if self.cosine_decay:
+            return optax.warmup_cosine_decay_schedule(
+                0.0, self.learning_rate, warmup, total)
+        if self.warmup_steps:
+            return optax.linear_schedule(0.0, self.learning_rate,
+                                         self.warmup_steps)
+        return self.learning_rate
+
+    # -- fit ----------------------------------------------------------------
+
+    def fit(self, df: DataFrame) -> NNModel:
+        import jax
+        import optax
+
+        from mmlspark_tpu.models.nn import _stack_column
+        x = _stack_column(df[self.features_col])
+        y = np.asarray(df[self.label_col])
+        w = (np.asarray(df[self.weight_col], dtype=np.float32)
+             if self.weight_col else np.ones(len(y), dtype=np.float32))
+
+        fn = self.model or NNFunction.init(self.arch, x.shape[1:],
+                                           seed=self.seed)
+        module = fn.module()
+
+        mesh = build_mesh(MeshSpec.from_dict(self.mesh_shape)
+                          if self.mesh_shape else None)
+        n_data = mesh.shape.get("data", 1)
+        bs = max(self.batch_size - self.batch_size % n_data, n_data)
+        steps_per_epoch = max(len(x) // bs, 1)
+
+        tx = make_optimizer(self.optimizer, self._schedule(steps_per_epoch),
+                            self.momentum, self.weight_decay)
+        loss_fn = make_loss(self.loss)
+        step = jax.jit(self.build_train_step(module, tx, loss_fn),
+                       donate_argnums=(0, 1))
+
+        repl = replicated_sharding(mesh)
+        shard = batch_sharding(mesh)
+        params = jax.device_put(fn.params, repl)
+        opt_state = jax.device_put(tx.init(params), repl)
+
+        start_step = 0
+        mngr = self._checkpoint_manager()
+        if mngr is not None and mngr.latest_step() is not None:
+            raw_params, raw_opt, start_step = self._restore(mngr, params, opt_state)
+            params = jax.device_put(raw_params, repl)
+            opt_state = jax.device_put(raw_opt, repl)
+
+        rng = np.random.default_rng(self.seed)
+        global_step = 0
+        for epoch in range(self.epochs):
+            order = rng.permutation(len(x))
+            for s in range(steps_per_epoch):
+                global_step += 1
+                if global_step <= start_step:
+                    continue  # fast-forward after resume (same shuffle stream)
+                idx = order[s * bs:(s + 1) * bs]
+                # ragged tail: pad to the data-axis multiple, zero the pad
+                # rows' weights so they contribute nothing to the loss
+                xp, n_real = pad_to_multiple(x[idx], n_data)
+                yp, _ = pad_to_multiple(y[idx], n_data)
+                wp, _ = pad_to_multiple(w[idx], n_data)
+                if n_real < len(wp):
+                    wp = wp.copy()
+                    wp[n_real:] = 0.0
+                xb = jax.device_put(xp, shard)
+                yb = jax.device_put(yp, shard)
+                wb = jax.device_put(wp, shard)
+                params, opt_state, loss = step(params, opt_state, xb, yb, wb)
+                if self.log_every and global_step % self.log_every == 0:
+                    print(f"[NNLearner] step {global_step} "
+                          f"epoch {epoch + 1}/{self.epochs} "
+                          f"loss {float(loss):.5f}")
+                if (mngr is not None and self.checkpoint_every
+                        and global_step % self.checkpoint_every == 0):
+                    self._checkpoint(mngr, global_step, params, opt_state)
+        if mngr is not None:
+            self._checkpoint(mngr, global_step, params, opt_state)
+            mngr.wait_until_finished()
+
+        trained = NNFunction(arch=dict(fn.arch), params=jax.device_get(params))
+        return NNModel(model=trained, input_col=self.features_col,
+                       output_col="scores")
+
+    # -- orbax step checkpointing ------------------------------------------
+
+    def _checkpoint_manager(self):
+        if not self.checkpoint_dir:
+            return None
+        import orbax.checkpoint as ocp
+        return ocp.CheckpointManager(
+            os.path.abspath(self.checkpoint_dir),
+            options=ocp.CheckpointManagerOptions(max_to_keep=3, create=True))
+
+    def _checkpoint(self, mngr, step_num: int, params, opt_state) -> None:
+        import jax
+        import orbax.checkpoint as ocp
+        state = {"params": jax.device_get(params),
+                 "opt_state": jax.device_get(opt_state)}
+        mngr.save(step_num, args=ocp.args.StandardSave(state))
+
+    def _restore(self, mngr, params, opt_state):
+        """Restore against the live (params, opt_state) as structure template,
+        so optax NamedTuple states round-trip intact."""
+        import jax
+        import orbax.checkpoint as ocp
+        latest = mngr.latest_step()
+        template = {"params": jax.device_get(params),
+                    "opt_state": jax.device_get(opt_state)}
+        restored = mngr.restore(latest, args=ocp.args.StandardRestore(template))
+        print(f"[NNLearner] resumed from step {latest}")
+        return restored["params"], restored["opt_state"], latest

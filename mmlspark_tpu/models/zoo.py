@@ -1,0 +1,153 @@
+"""Model zoo: a repository of checkpointed NNFunctions with manifests.
+
+Capability parity with `src/downloader/` (`ModelDownloader.scala`,
+`Schema.scala:54-74`): models live in a repo (a directory or mount) with
+per-model JSON metadata (name, dataset, sha256, input node/shape, layer
+names); ``ModelDownloader`` fetches them into a local cache with hash
+verification and bounded retry (`FaultToleranceUtils.retryWithTimeout`,
+`ModelDownloader.scala:37`).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+import json
+import os
+import shutil
+import time
+from typing import Any, Dict, List, Optional
+
+from mmlspark_tpu.models.function import NNFunction
+
+
+@dataclasses.dataclass
+class ModelSchema:
+    """Parity: downloader ModelSchema (`Schema.scala:54-74`)."""
+
+    name: str
+    dataset: str
+    model_type: str
+    uri: str
+    hash: str
+    input_shape: List[int]
+    layer_names: List[str]
+    num_classes: Optional[int] = None
+
+    def to_json(self) -> Dict[str, Any]:
+        return dataclasses.asdict(self)
+
+    @staticmethod
+    def from_json(d: Dict[str, Any]) -> "ModelSchema":
+        return ModelSchema(**d)
+
+
+def _dir_sha256(path: str) -> str:
+    h = hashlib.sha256()
+    for root, _, files in sorted(os.walk(path)):
+        for f in sorted(files):
+            rel = os.path.relpath(os.path.join(root, f), path)
+            h.update(rel.encode())
+            with open(os.path.join(root, f), "rb") as fh:
+                for chunk in iter(lambda: fh.read(1 << 20), b""):
+                    h.update(chunk)
+    return h.hexdigest()
+
+
+def retry_with_timeout(fn, retries: int = 3, backoff: float = 0.5):
+    """Parity: FaultToleranceUtils.retryWithTimeout."""
+    last: Optional[Exception] = None
+    for attempt in range(retries):
+        try:
+            return fn()
+        except Exception as e:  # noqa: BLE001 - retry any fetch failure
+            last = e
+            if attempt < retries - 1:
+                time.sleep(backoff * (2 ** attempt))
+    raise last  # type: ignore[misc]
+
+
+class ModelRepo:
+    """A directory of checkpoints + ``manifest.json`` describing them."""
+
+    MANIFEST = "manifest.json"
+
+    def __init__(self, root: str):
+        self.root = root
+
+    def _manifest_path(self) -> str:
+        return os.path.join(self.root, self.MANIFEST)
+
+    def models(self) -> Dict[str, ModelSchema]:
+        if not os.path.exists(self._manifest_path()):
+            return {}
+        with open(self._manifest_path()) as f:
+            entries = json.load(f)
+        return {e["name"]: ModelSchema.from_json(e) for e in entries}
+
+    def publish(self, name: str, fn: NNFunction, dataset: str = "",
+                model_type: str = "", input_shape: Optional[List[int]] = None,
+                num_classes: Optional[int] = None) -> ModelSchema:
+        """Add a checkpoint to the repo and record its manifest entry."""
+        model_dir = os.path.join(self.root, name)
+        fn.save(model_dir)
+        meta = ModelSchema(
+            name=name, dataset=dataset, model_type=model_type,
+            uri=model_dir, hash=_dir_sha256(model_dir),
+            input_shape=list(input_shape or []),
+            layer_names=fn.layer_names,
+            num_classes=num_classes)
+        entries = [m.to_json() for m in self.models().values() if m.name != name]
+        entries.append(meta.to_json())
+        os.makedirs(self.root, exist_ok=True)
+        with open(self._manifest_path(), "w") as f:
+            json.dump(entries, f, indent=2)
+        return meta
+
+
+class ModelDownloader:
+    """Fetch models from a repo into a local cache, verifying hashes.
+
+    Parity: `ModelDownloader.scala` (downloadByName/downloadModel with
+    retry + hash check). "Remote" here is any mounted/NFS path — this
+    framework has no Azure dependency.
+    """
+
+    def __init__(self, local_cache: str, repo: Optional[str] = None):
+        self.cache_dir = local_cache
+        self.repo = ModelRepo(repo) if repo else None
+
+    def list_models(self) -> Dict[str, ModelSchema]:
+        if self.repo is None:
+            raise ValueError("no repo configured")
+        return self.repo.models()
+
+    def download_by_name(self, name: str) -> ModelSchema:
+        models = self.list_models()
+        if name not in models:
+            raise KeyError(f"model {name!r} not in repo; have {sorted(models)}")
+        return self.download_model(models[name])
+
+    def download_model(self, meta: ModelSchema) -> ModelSchema:
+        dest = os.path.join(self.cache_dir, meta.name)
+
+        def fetch():
+            if os.path.exists(dest):
+                if _dir_sha256(dest) == meta.hash:
+                    return
+                shutil.rmtree(dest)
+            os.makedirs(self.cache_dir, exist_ok=True)
+            shutil.copytree(meta.uri, dest)
+            actual = _dir_sha256(dest)
+            if actual != meta.hash:
+                shutil.rmtree(dest)
+                raise IOError(f"hash mismatch for {meta.name}: "
+                              f"{actual} != {meta.hash}")
+
+        retry_with_timeout(fetch)
+        out = dataclasses.replace(meta, uri=dest)
+        return out
+
+    def load(self, name: str) -> NNFunction:
+        meta = self.download_by_name(name)
+        return NNFunction.load(meta.uri)

@@ -1,0 +1,164 @@
+"""NN scoring engine: NNFunction, NNModel, zoo, ImageFeaturizer."""
+
+import numpy as np
+import pytest
+
+from mmlspark_tpu.core.dataframe import DataFrame
+from mmlspark_tpu.core.stage import PipelineStage
+from mmlspark_tpu.core import schema
+from mmlspark_tpu.models import (
+    NNFunction, NNModel, ImageFeaturizer, ModelDownloader, ModelRepo,
+)
+
+
+@pytest.fixture(scope="module")
+def convnet():
+    return NNFunction.init({"builder": "cifar_convnet", "num_classes": 10},
+                           input_shape=(32, 32, 3), seed=0)
+
+
+@pytest.fixture(scope="module")
+def resnet():
+    return NNFunction.init({"builder": "cifar_resnet", "depth": 20},
+                           input_shape=(32, 32, 3), seed=0)
+
+
+@pytest.fixture
+def images(rng):
+    return rng.uniform(0, 1, size=(10, 32, 32, 3)).astype(np.float32)
+
+
+class TestNNFunction:
+    def test_forward_shapes(self, convnet, images):
+        out = np.asarray(convnet.apply(images))
+        assert out.shape == (10, 10)
+
+    def test_layer_names_and_truncation(self, convnet, images):
+        assert convnet.layer_names[-1] == "z"
+        feats = np.asarray(convnet.apply(images, output_layer="h2"))
+        assert feats.shape == (10, 128)
+
+    def test_bad_layer(self, convnet, images):
+        with pytest.raises(KeyError):
+            convnet.apply(images, output_layer="nope")
+
+    def test_cut_resolution(self, convnet):
+        assert convnet.layer_name_for_cut(0) is None
+        assert convnet.layer_name_for_cut(1) == "relu4"
+        with pytest.raises(ValueError):
+            convnet.layer_name_for_cut(99)
+
+    def test_save_load_exact(self, convnet, images, tmp_path):
+        p = str(tmp_path / "fn")
+        convnet.save(p)
+        loaded = NNFunction.load(p)
+        np.testing.assert_allclose(np.asarray(loaded.apply(images)),
+                                   np.asarray(convnet.apply(images)),
+                                   rtol=1e-6)
+
+    def test_resnet_forward(self, resnet, images):
+        out = np.asarray(resnet.apply(images))
+        assert out.shape == (10, 10)
+        feats = np.asarray(resnet.apply(images, output_layer="pool"))
+        assert feats.shape == (10, 64)
+
+    def test_unknown_builder(self):
+        with pytest.raises(KeyError):
+            NNFunction(arch={"builder": "nope"}, params={}).module()
+
+
+class TestNNModel:
+    def test_transform_scores(self, convnet, images):
+        df = DataFrame({"image": images, "idx": np.arange(10)})
+        m = NNModel(model=convnet, input_col="image", output_col="scores",
+                    batch_size=4)
+        out = m.transform(df)
+        assert out["scores"].shape == (10, 10)
+        # batching must not change results
+        direct = np.asarray(convnet.apply(images))
+        np.testing.assert_allclose(out["scores"], direct, rtol=1e-4, atol=1e-5)
+        # scores column tagged for downstream evaluators
+        assert schema.find_column_by_role(out, schema.SCORES_KIND) == "scores"
+
+    def test_data_parallel_matches_single(self, convnet, images):
+        df = DataFrame({"image": images})
+        dp = NNModel(model=convnet, input_col="image", batch_size=8,
+                     data_parallel=True).transform(df)
+        sp = NNModel(model=convnet, input_col="image", batch_size=8,
+                     data_parallel=False).transform(df)
+        np.testing.assert_allclose(dp["scores"], sp["scores"], rtol=1e-4,
+                                   atol=1e-5)
+
+    def test_truncated_output(self, convnet, images):
+        df = DataFrame({"image": images})
+        m = NNModel(model=convnet, input_col="image", output_col="feats",
+                    cut_output_layers=2)
+        assert m.transform(df)["feats"].shape == (10, 128)
+
+    def test_persistence(self, convnet, images, tmp_path):
+        df = DataFrame({"image": images})
+        m = NNModel(model=convnet, input_col="image", batch_size=4)
+        p = str(tmp_path / "nnmodel")
+        m.save(p)
+        loaded = PipelineStage.load(p)
+        np.testing.assert_allclose(loaded.transform(df)["scores"],
+                                   m.transform(df)["scores"], rtol=1e-5)
+
+    def test_object_column_input(self, convnet, rng):
+        imgs = np.array([rng.uniform(0, 1, (32, 32, 3)).astype(np.float32)
+                         for _ in range(3)], dtype=object)
+        df = DataFrame({"image": imgs})
+        out = NNModel(model=convnet, input_col="image").transform(df)
+        assert out["scores"].shape == (3, 10)
+
+
+class TestZoo:
+    def test_publish_download_load(self, convnet, tmp_path, images):
+        repo = ModelRepo(str(tmp_path / "repo"))
+        meta = repo.publish("ConvNet_CIFAR10", convnet, dataset="CIFAR10",
+                            model_type="convnet", input_shape=[32, 32, 3],
+                            num_classes=10)
+        assert meta.layer_names[-1] == "z"
+
+        dl = ModelDownloader(str(tmp_path / "cache"), repo=str(tmp_path / "repo"))
+        assert "ConvNet_CIFAR10" in dl.list_models()
+        fn = dl.load("ConvNet_CIFAR10")
+        np.testing.assert_allclose(np.asarray(fn.apply(images)),
+                                   np.asarray(convnet.apply(images)), rtol=1e-6)
+
+    def test_hash_verification(self, convnet, tmp_path):
+        repo = ModelRepo(str(tmp_path / "repo"))
+        meta = repo.publish("m", convnet, input_shape=[32, 32, 3])
+        # corrupt the repo copy
+        import os
+        with open(os.path.join(meta.uri, "arch.json"), "a") as f:
+            f.write(" ")
+        dl = ModelDownloader(str(tmp_path / "cache"), repo=str(tmp_path / "repo"))
+        with pytest.raises(IOError):
+            dl.download_by_name("m")
+
+    def test_missing_model(self, tmp_path):
+        dl = ModelDownloader(str(tmp_path / "c"), repo=str(tmp_path / "r"))
+        with pytest.raises(KeyError):
+            dl.download_by_name("ghost")
+
+
+class TestImageFeaturizer:
+    def test_resize_and_featurize(self, convnet, rng):
+        imgs = np.array([rng.uniform(0, 255, (40 + i, 36, 3)).astype(np.float32)
+                         for i in range(4)], dtype=object)
+        df = DataFrame({"image": imgs})
+        feat = ImageFeaturizer(model=convnet, cut_output_layers=2,
+                               input_shape=[32, 32, 3])
+        out = feat.transform(df)
+        assert out["features"].shape == (4, 128)
+        assert "__feat_img" not in out.columns
+
+    def test_persistence(self, convnet, images, tmp_path):
+        df = DataFrame({"image": images})
+        feat = ImageFeaturizer(model=convnet, cut_output_layers=1)
+        p = str(tmp_path / "feat")
+        feat.save(p)
+        loaded = PipelineStage.load(p)
+        np.testing.assert_allclose(loaded.transform(df)["features"],
+                                   feat.transform(df)["features"], rtol=1e-5)

@@ -1,0 +1,104 @@
+"""NNLearner: pjit data-parallel training, checkpoint/resume."""
+
+import numpy as np
+import pytest
+
+from mmlspark_tpu.core.dataframe import DataFrame
+from mmlspark_tpu.models.trainer import NNLearner
+from mmlspark_tpu.models.nn import NNModel
+
+
+@pytest.fixture
+def blobs(rng):
+    """Two separable gaussian blobs."""
+    n = 256
+    x0 = rng.normal(loc=-2.0, size=(n, 4)).astype(np.float32)
+    x1 = rng.normal(loc=+2.0, size=(n, 4)).astype(np.float32)
+    x = np.concatenate([x0, x1])
+    y = np.concatenate([np.zeros(n), np.ones(n)]).astype(np.int64)
+    perm = rng.permutation(len(x))
+    return DataFrame({"features": x[perm], "label": y[perm]})
+
+
+def _accuracy(model: NNModel, df: DataFrame) -> float:
+    scores = model.transform(df)["scores"]
+    return float((scores.argmax(axis=1) == df["label"]).mean())
+
+
+class TestNNLearner:
+    def test_learns_blobs(self, blobs):
+        learner = NNLearner(arch={"builder": "mlp", "hidden": [16],
+                                  "num_outputs": 2},
+                            loss="softmax_cross_entropy", optimizer="adam",
+                            learning_rate=0.01, epochs=5, batch_size=64,
+                            log_every=0)
+        model = learner.fit(blobs)
+        assert _accuracy(model, blobs) > 0.95
+
+    def test_regression_loss(self, rng):
+        x = rng.normal(size=(512, 3)).astype(np.float32)
+        w_true = np.array([1.0, -2.0, 0.5], dtype=np.float32)
+        y = x @ w_true
+        df = DataFrame({"features": x, "label": y})
+        learner = NNLearner(arch={"builder": "mlp", "hidden": [],
+                                  "num_outputs": 1},
+                            loss="squared_error", optimizer="adam",
+                            learning_rate=0.05, epochs=20, batch_size=128,
+                            cosine_decay=False, log_every=0)
+        model = learner.fit(df)
+        pred = model.transform(df)["scores"][:, 0]
+        assert float(np.mean((pred - y) ** 2)) < 0.05
+
+    def test_weighted_rows_ignore_zero_weight(self, rng):
+        # rows with weight 0 must not affect training: poison half the
+        # labels but zero their weights
+        n = 256
+        x = rng.normal(size=(n, 2)).astype(np.float32)
+        y = (x[:, 0] > 0).astype(np.int64)
+        y_poisoned = y.copy()
+        y_poisoned[:n // 2] = 1 - y_poisoned[:n // 2]
+        w = np.ones(n, dtype=np.float32)
+        w[:n // 2] = 0.0
+        df = DataFrame({"features": x, "label": y_poisoned, "weight": w})
+        learner = NNLearner(arch={"builder": "mlp", "hidden": [8],
+                                  "num_outputs": 2},
+                            weight_col="weight", optimizer="adam",
+                            learning_rate=0.02, epochs=10, batch_size=64,
+                            log_every=0)
+        model = learner.fit(df)
+        clean = DataFrame({"features": x[n // 2:], "label": y[n // 2:]})
+        assert _accuracy(model, clean) > 0.9
+
+    def test_checkpoint_resume(self, blobs, tmp_path):
+        ck = str(tmp_path / "ckpt")
+        common = dict(arch={"builder": "mlp", "hidden": [16], "num_outputs": 2},
+                      optimizer="adam", learning_rate=0.01, batch_size=64,
+                      seed=3, log_every=0, checkpoint_dir=ck,
+                      checkpoint_every=4)
+        # train 2 epochs, writing checkpoints
+        NNLearner(epochs=2, **common).fit(blobs)
+        # resume: the second learner must fast-forward past saved steps
+        import orbax.checkpoint as ocp
+        mngr_steps_before = sorted(
+            ocp.CheckpointManager(ck).all_steps())
+        assert mngr_steps_before
+        model = NNLearner(epochs=4, **common).fit(blobs)
+        assert _accuracy(model, blobs) > 0.9
+
+    def test_data_parallel_mesh(self, blobs):
+        learner = NNLearner(arch={"builder": "mlp", "hidden": [16],
+                                  "num_outputs": 2},
+                            optimizer="adam", learning_rate=0.01,
+                            epochs=5, batch_size=64, log_every=0,
+                            mesh_shape={"data": 8})
+        model = learner.fit(blobs)
+        assert _accuracy(model, blobs) > 0.95
+
+    def test_warm_start(self, blobs):
+        from mmlspark_tpu.models.function import NNFunction
+        base = NNFunction.init({"builder": "mlp", "hidden": [16],
+                                "num_outputs": 2}, input_shape=(4,))
+        learner = NNLearner(model=base, optimizer="adam", learning_rate=0.01,
+                            epochs=3, batch_size=64, log_every=0)
+        model = learner.fit(blobs)
+        assert _accuracy(model, blobs) > 0.9
