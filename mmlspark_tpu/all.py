@@ -11,3 +11,4 @@ import mmlspark_tpu.stages.batching  # noqa: F401
 import mmlspark_tpu.models.nn  # noqa: F401
 import mmlspark_tpu.models.trainer  # noqa: F401
 import mmlspark_tpu.models.featurizer  # noqa: F401
+import mmlspark_tpu.gbdt.stages  # noqa: F401

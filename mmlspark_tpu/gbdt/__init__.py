@@ -1,0 +1,16 @@
+from mmlspark_tpu.gbdt.binning import BinMapper
+from mmlspark_tpu.gbdt.booster import Booster, BoosterParams
+from mmlspark_tpu.gbdt.stages import (
+    GBDTClassifier, GBDTClassificationModel,
+    GBDTRegressor, GBDTRegressionModel,
+    LightGBMClassifier, LightGBMRegressor,
+    load_native_model,
+)
+
+__all__ = [
+    "BinMapper", "Booster", "BoosterParams",
+    "GBDTClassifier", "GBDTClassificationModel",
+    "GBDTRegressor", "GBDTRegressionModel",
+    "LightGBMClassifier", "LightGBMRegressor",
+    "load_native_model",
+]

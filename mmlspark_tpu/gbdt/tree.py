@@ -1,0 +1,394 @@
+"""Histogram building, split finding, and leaf-wise tree growth.
+
+The TPU rebuild of LightGBM's serial tree learner + its distributed
+variants (reference: `LGBM_BoosterUpdateOneIter` hot loop,
+`TrainUtils.scala:95-146`; `tree_learner=data/feature/voting`,
+`LightGBMParams.scala:13-18`). All device work is jitted with static
+shapes:
+
+- **histograms** are one XLA scatter-add over (rows x features) into a
+  flat (F*B, 3) accumulator — when the row arrays are sharded over the
+  mesh's ``data`` axis, GSPMD turns the reduction into the ICI psum that
+  replaces LightGBM's TCP-socket allreduce;
+- **split finding** is a vectorized cumsum scan over every (feature, bin)
+  at once, with L1/L2 regularization, min-child constraints, missing-bin
+  default directions, and G/H-sorted categorical subset splits;
+- **leaf-wise growth** keeps the best-split-per-leaf frontier and splits
+  the globally best leaf until ``num_leaves`` (LightGBM's growth policy),
+  using the parent-minus-child histogram subtraction trick.
+
+Trees are stored as flat arrays (feature/threshold/children/value per
+node) so batched prediction is a short gather loop on device.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from functools import partial
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from mmlspark_tpu.gbdt.binning import MISSING_BIN
+
+
+@dataclasses.dataclass(frozen=True)
+class GrowthParams:
+    num_leaves: int = 31
+    max_depth: int = -1  # -1 = unlimited (bounded by num_leaves)
+    min_data_in_leaf: int = 20
+    min_sum_hessian_in_leaf: float = 1e-3
+    lambda_l1: float = 0.0
+    lambda_l2: float = 0.0
+    min_gain_to_split: float = 0.0
+
+
+# ---------------------------------------------------------------------------
+# Histograms
+# ---------------------------------------------------------------------------
+
+@partial(jax.jit, static_argnames=("n_features", "n_bins"))
+def build_histogram(bins, grad, hess, in_leaf, n_features: int, n_bins: int):
+    """Per-(feature, bin) sums of grad/hess/count for rows where ``in_leaf``.
+
+    bins: (n, F) int32; grad/hess: (n,) f32; in_leaf: (n,) bool.
+    Returns (F, B, 3) float32: [sum_grad, sum_hess, count].
+    """
+    mask = in_leaf.astype(jnp.float32)
+    offsets = jnp.arange(n_features, dtype=jnp.int32) * n_bins
+    flat_idx = (bins + offsets[None, :]).reshape(-1)          # (n*F,)
+    vals = jnp.stack([grad * mask, hess * mask, mask], axis=1)  # (n, 3)
+    vals = jnp.repeat(vals[:, None, :], n_features, axis=1).reshape(-1, 3)
+    hist = jnp.zeros((n_features * n_bins, 3), jnp.float32)
+    hist = hist.at[flat_idx].add(vals)
+    return hist.reshape(n_features, n_bins, 3)
+
+
+# ---------------------------------------------------------------------------
+# Split finding
+# ---------------------------------------------------------------------------
+
+def _leaf_value(g, h, l1, l2):
+    g_reg = jnp.sign(g) * jnp.maximum(jnp.abs(g) - l1, 0.0)
+    return -g_reg / (h + l2 + 1e-12)
+
+
+def _split_score(g, h, l1, l2):
+    g_reg = jnp.sign(g) * jnp.maximum(jnp.abs(g) - l1, 0.0)
+    return jnp.square(g_reg) / (h + l2 + 1e-12)
+
+
+@partial(jax.jit, static_argnames=("params",))
+def find_best_split(hist, is_categorical, params: GrowthParams):
+    """Best split over all (feature, bin) cut points of one leaf.
+
+    hist: (F, B, 3). is_categorical: (F,) bool.
+    Numeric features scan bins in index order twice — once sending the
+    missing bin left, once right (learned default direction). Categorical
+    features scan bins in G/H-sorted order (LightGBM's many-vs-many).
+
+    Returns dict with gain/feature/threshold index info + the sorted bin
+    order used (to reconstruct categorical subsets).
+    """
+    F, B, _ = hist.shape
+    l1, l2 = params.lambda_l1, params.lambda_l2
+
+    g_tot = jnp.sum(hist[:, :, 0], axis=1)   # (F,) same for all features
+    h_tot = jnp.sum(hist[:, :, 1], axis=1)
+    c_tot = jnp.sum(hist[:, :, 2], axis=1)
+    parent_score = _split_score(g_tot[0], h_tot[0], l1, l2)
+
+    # --- ordering per feature ---------------------------------------------
+    # numeric: natural order. categorical: sort non-empty bins by G/H.
+    ratio = hist[:, :, 0] / (hist[:, :, 1] + 1e-12)
+    empty = hist[:, :, 2] < 0.5
+    cat_key = jnp.where(empty, jnp.inf, ratio)  # empty bins sort last
+    cat_order = jnp.argsort(cat_key, axis=1)
+    num_order = jnp.broadcast_to(jnp.arange(B), (F, B))
+    order = jnp.where(is_categorical[:, None], cat_order, num_order)
+
+    hist_ord = jnp.take_along_axis(hist, order[:, :, None], axis=1)
+
+    def scan_gain(h_ordered, skip_first):
+        """Cut after each ordered bin; optionally exclude bin 0 (missing)."""
+        g = h_ordered[:, :, 0]
+        h = h_ordered[:, :, 1]
+        c = h_ordered[:, :, 2]
+        if skip_first:  # missing bin routed right: exclude from left sums
+            g = g.at[:, 0].set(0.0)
+            h = h.at[:, 0].set(0.0)
+            c = c.at[:, 0].set(0.0)
+        gl = jnp.cumsum(g, axis=1)
+        hl = jnp.cumsum(h, axis=1)
+        cl = jnp.cumsum(c, axis=1)
+        gr = g_tot[:, None] - gl
+        hr = h_tot[:, None] - hl
+        cr = c_tot[:, None] - cl
+        gain = (_split_score(gl, hl, l1, l2) + _split_score(gr, hr, l1, l2)
+                - parent_score)
+        ok = ((cl >= params.min_data_in_leaf) & (cr >= params.min_data_in_leaf)
+              & (hl >= params.min_sum_hessian_in_leaf)
+              & (hr >= params.min_sum_hessian_in_leaf))
+        return jnp.where(ok, gain, -jnp.inf)
+
+    gain_left = scan_gain(hist_ord, skip_first=False)   # missing goes left
+    gain_right = scan_gain(hist_ord, skip_first=True)   # missing goes right
+    # categorical uses only the left variant (missing treated as a level)
+    gain_right = jnp.where(is_categorical[:, None], -jnp.inf, gain_right)
+    # last cut position leaves right side empty -> invalid
+    gain_left = gain_left.at[:, B - 1].set(-jnp.inf)
+    gain_right = gain_right.at[:, B - 1].set(-jnp.inf)
+
+    both = jnp.stack([gain_left, gain_right])           # (2, F, B)
+    flat = both.reshape(2, -1)
+    best_flat = jnp.argmax(flat, axis=1)
+    best_gain_lr = jnp.take_along_axis(flat, best_flat[:, None], axis=1)[:, 0]
+    direction = jnp.argmax(best_gain_lr)                # 0: missing left
+    best_gain = best_gain_lr[direction]
+    best_idx = best_flat[direction]
+    feat = best_idx // B
+    cut_pos = best_idx % B                              # position in order
+
+    return {
+        "gain": best_gain,
+        "feature": feat,
+        "cut_pos": cut_pos,
+        "missing_left": (direction == 0),
+        "order": order[feat],
+        "threshold_bin": order[feat, cut_pos],
+    }
+
+
+@jax.jit
+def leaf_stats(hist):
+    """(G, H, count) totals of a leaf from any one feature's histogram."""
+    return (jnp.sum(hist[0, :, 0]), jnp.sum(hist[0, :, 1]),
+            jnp.sum(hist[0, :, 2]))
+
+
+# ---------------------------------------------------------------------------
+# Tree structure
+# ---------------------------------------------------------------------------
+
+@dataclasses.dataclass
+class Tree:
+    """Flat-array decision tree (numeric thresholds + categorical masks)."""
+
+    feature: np.ndarray        # (N,) int32; -1 for leaves
+    threshold: np.ndarray      # (N,) float64 raw-value threshold
+    threshold_bin: np.ndarray  # (N,) int32 bin-space threshold
+    missing_left: np.ndarray   # (N,) bool: NaN/unseen routed left?
+    categorical: np.ndarray    # (N,) bool: membership split?
+    cat_mask: np.ndarray       # (N, B) bool: bins going LEFT for cat splits
+    left: np.ndarray           # (N,) int32 child ids
+    right: np.ndarray
+    value: np.ndarray          # (N,) float32 leaf outputs (post-shrinkage)
+    gain: np.ndarray           # (N,) float32 split gains (importance)
+    n_nodes: int
+
+    def to_json(self) -> Dict[str, Any]:
+        d = {f.name: getattr(self, f.name) for f in dataclasses.fields(self)}
+        return {k: (v.tolist() if isinstance(v, np.ndarray) else v)
+                for k, v in d.items()}
+
+    @staticmethod
+    def from_json(d: Dict[str, Any]) -> "Tree":
+        dtypes = {"feature": np.int32, "threshold": np.float64,
+                  "threshold_bin": np.int32, "missing_left": bool,
+                  "categorical": bool, "cat_mask": bool,
+                  "left": np.int32, "right": np.int32,
+                  "value": np.float32, "gain": np.float32}
+        kw = {k: (np.asarray(v, dtype=dtypes[k]) if k in dtypes else v)
+              for k, v in d.items()}
+        return Tree(**kw)
+
+    def max_depth(self) -> int:
+        depth = np.zeros(self.n_nodes, dtype=np.int32)
+        out = 0
+        for i in range(self.n_nodes):
+            if self.feature[i] >= 0:
+                for ch in (self.left[i], self.right[i]):
+                    depth[ch] = depth[i] + 1
+                    out = max(out, int(depth[ch]))
+        return out
+
+
+def predict_tree_raw(tree_arrays, X, max_depth: int):
+    """Batched raw-feature traversal: X (n, F) float -> (n,) leaf values.
+
+    tree_arrays: dict of jnp arrays mirroring Tree fields.
+    """
+    feature = tree_arrays["feature"]
+    threshold = tree_arrays["threshold"]
+    missing_left = tree_arrays["missing_left"]
+    categorical = tree_arrays["categorical"]
+    cat_mask = tree_arrays["cat_mask"]
+    bins_for_cat = tree_arrays["cat_bins"]  # (n, F) int32 (0 if not needed)
+    left, right = tree_arrays["left"], tree_arrays["right"]
+    value = tree_arrays["value"]
+
+    n = X.shape[0]
+    node = jnp.zeros(n, dtype=jnp.int32)
+
+    def step(node, _):
+        feat = feature[node]
+        is_leaf = feat < 0
+        f = jnp.maximum(feat, 0)
+        xv = jnp.take_along_axis(X, f[:, None], axis=1)[:, 0]
+        is_nan = jnp.isnan(xv)
+        go_left_num = jnp.where(is_nan, missing_left[node], xv <= threshold[node])
+        bv = jnp.take_along_axis(bins_for_cat, f[:, None], axis=1)[:, 0]
+        go_left_cat = cat_mask[node, bv]
+        go_left = jnp.where(categorical[node], go_left_cat, go_left_num)
+        nxt = jnp.where(go_left, left[node], right[node])
+        return jnp.where(is_leaf, node, nxt), None
+
+    node, _ = jax.lax.scan(step, node, None, length=max_depth + 1)
+    return value[node]
+
+
+# ---------------------------------------------------------------------------
+# Leaf-wise grower
+# ---------------------------------------------------------------------------
+
+@partial(jax.jit, static_argnames=())
+def _route_left(bins_col, threshold_bin, missing_left, is_cat, order, cut_pos):
+    """Which rows of a split leaf go left, in bin space."""
+    # categorical: bin's position in sorted order <= cut_pos
+    B = order.shape[0]
+    pos_of_bin = jnp.zeros(B, jnp.int32).at[order].set(jnp.arange(B, dtype=jnp.int32))
+    cat_left = pos_of_bin[bins_col] <= cut_pos
+    num_left = jnp.where(bins_col == MISSING_BIN, missing_left,
+                         (bins_col <= threshold_bin) & (bins_col != MISSING_BIN))
+    return jnp.where(is_cat, cat_left, num_left)
+
+
+class TreeGrower:
+    """Grows one tree leaf-wise over binned data living on device."""
+
+    def __init__(self, bin_mapper, params: GrowthParams, n_features: int,
+                 n_bins: int):
+        self.mapper = bin_mapper
+        self.params = params
+        self.n_features = n_features
+        self.n_bins = n_bins
+        self.is_categorical = jnp.asarray(bin_mapper.categorical, dtype=bool)
+
+    def grow(self, bins, grad, hess, sample_mask,
+             shrinkage: float) -> Tuple[Tree, jnp.ndarray]:
+        """Returns (tree, per-row raw value of the new tree).
+
+        bins (n, F) int32 / grad,hess (n,) f32 / sample_mask (n,) bool —
+        all may be sharded over the data axis; everything here is jitted
+        calls over them, so GSPMD handles cross-device reduction.
+        """
+        p = self.params
+        max_nodes = 2 * p.num_leaves - 1
+        B = self.n_bins
+
+        feature = np.full(max_nodes, -1, np.int32)
+        threshold = np.zeros(max_nodes, np.float64)
+        threshold_bin = np.zeros(max_nodes, np.int32)
+        missing_left = np.zeros(max_nodes, bool)
+        categorical = np.zeros(max_nodes, bool)
+        cat_mask = np.zeros((max_nodes, B), bool)
+        left = np.zeros(max_nodes, np.int32)
+        right = np.zeros(max_nodes, np.int32)
+        value = np.zeros(max_nodes, np.float32)
+        gain_arr = np.zeros(max_nodes, np.float32)
+        depth = np.zeros(max_nodes, np.int32)
+
+        # row -> node assignment, only rows in sample_mask participate
+        node_of_row = jnp.where(sample_mask, 0, -1).astype(jnp.int32)
+
+        root_hist = build_histogram(bins, grad, hess, node_of_row == 0,
+                                    self.n_features, B)
+        g0, h0, c0 = (float(x) for x in leaf_stats(root_hist))
+        value[0] = float(_leaf_value(jnp.float32(g0), jnp.float32(h0),
+                                     p.lambda_l1, p.lambda_l2))
+
+        # frontier: leaf id -> (hist, split-candidate dict, count)
+        frontier: Dict[int, Dict[str, Any]] = {}
+
+        def consider(leaf_id, hist, count):
+            if count < 2 * p.min_data_in_leaf:
+                return
+            if 0 <= p.max_depth <= depth[leaf_id]:
+                return
+            cand = find_best_split(hist, self.is_categorical, p)
+            if float(cand["gain"]) > max(p.min_gain_to_split, 0.0):
+                frontier[leaf_id] = {"hist": hist, "cand": cand,
+                                     "count": count}
+
+        consider(0, root_hist, c0)
+        n_nodes = 1
+        n_leaves = 1
+
+        while n_leaves < p.num_leaves and frontier:
+            # split the leaf with the globally best gain (leaf-wise policy)
+            leaf_id = max(frontier, key=lambda k: float(frontier[k]["cand"]["gain"]))
+            entry = frontier.pop(leaf_id)
+            cand = entry["cand"]
+            feat = int(cand["feature"])
+            is_cat = bool(self.mapper.categorical[feat])
+
+            li, ri = n_nodes, n_nodes + 1
+            n_nodes += 2
+            n_leaves += 1
+
+            feature[leaf_id] = feat
+            threshold_bin[leaf_id] = int(cand["threshold_bin"])
+            missing_left[leaf_id] = bool(cand["missing_left"])
+            categorical[leaf_id] = is_cat
+            gain_arr[leaf_id] = float(cand["gain"])
+            left[leaf_id], right[leaf_id] = li, ri
+            depth[li] = depth[ri] = depth[leaf_id] + 1
+            if is_cat:
+                order = np.asarray(cand["order"])
+                cut = int(cand["cut_pos"])
+                cat_mask[leaf_id, order[:cut + 1]] = True
+            else:
+                threshold[leaf_id] = self.mapper.threshold_value(
+                    feat, int(cand["threshold_bin"]))
+
+            # route rows
+            go_left = _route_left(bins[:, feat],
+                                  jnp.int32(threshold_bin[leaf_id]),
+                                  jnp.asarray(bool(missing_left[leaf_id])),
+                                  jnp.asarray(is_cat),
+                                  jnp.asarray(cand["order"], dtype=jnp.int32),
+                                  jnp.int32(cand["cut_pos"]))
+            in_leaf = node_of_row == leaf_id
+            node_of_row = jnp.where(in_leaf & go_left, li,
+                                    jnp.where(in_leaf, ri, node_of_row))
+
+            # child histograms: build smaller side, subtract for the other
+            lhist = build_histogram(bins, grad, hess, node_of_row == li,
+                                    self.n_features, B)
+            rhist = entry["hist"] - lhist
+            gl, hl, cl = (float(x) for x in leaf_stats(lhist))
+            gr, hr, cr = (float(x) for x in leaf_stats(rhist))
+            value[li] = float(_leaf_value(jnp.float32(gl), jnp.float32(hl),
+                                          p.lambda_l1, p.lambda_l2))
+            value[ri] = float(_leaf_value(jnp.float32(gr), jnp.float32(hr),
+                                          p.lambda_l1, p.lambda_l2))
+            consider(li, lhist, cl)
+            consider(ri, rhist, cr)
+
+        value_arr = (value * shrinkage).astype(np.float32)
+        tree = Tree(feature=feature[:n_nodes], threshold=threshold[:n_nodes],
+                    threshold_bin=threshold_bin[:n_nodes],
+                    missing_left=missing_left[:n_nodes],
+                    categorical=categorical[:n_nodes],
+                    cat_mask=cat_mask[:n_nodes],
+                    left=left[:n_nodes], right=right[:n_nodes],
+                    value=value_arr[:n_nodes], gain=gain_arr[:n_nodes],
+                    n_nodes=n_nodes)
+
+        # training-time prediction of this tree: gather leaf values
+        val_dev = jnp.asarray(value_arr)
+        row_vals = jnp.where(node_of_row >= 0,
+                             val_dev[jnp.maximum(node_of_row, 0)], 0.0)
+        return tree, row_vals

@@ -35,24 +35,39 @@ class ImageFeaturizer(Transformer, HasInputCol, HasOutputCol):
     batch_size = Param(256, "scoring minibatch size", ptype=int)
     drop_nulls = Param(True, "drop rows with missing images", ptype=bool)
 
+    import functools as _functools
+
+    def _set_param(self, name, value):
+        self.__dict__.pop("_scorer", None)  # params invalidate cached scorer
+        super()._set_param(name, value)
+
+    @_functools.cached_property
+    def _scorer(self) -> NNModel:
+        """One NNModel reused across transforms so the truncated forward
+        compiles once (its own cache lives on the instance)."""
+        return NNModel(model=self.model, output_col=self.output_col,
+                       cut_output_layers=self.cut_output_layers,
+                       batch_size=self.batch_size)
+
     def transform(self, df: DataFrame) -> DataFrame:
+        from mmlspark_tpu.core.schema import find_unused_column_name
         if self.drop_nulls:
             df = df.drop_nulls(subset=[self.input_col])
         work = df
+        feed = self.input_col
+        tmp = None
         if self.input_shape:
             h, w = int(self.input_shape[0]), int(self.input_shape[1])
+            tmp = find_unused_column_name("__feat_img", df)
             resizer = ImageTransformer(input_col=self.input_col,
-                                       output_col="__feat_img").resize(h, w)
+                                       output_col=tmp).resize(h, w)
             work = resizer.transform(work)
-            feed = "__feat_img"
-        else:
-            feed = self.input_col
-        scorer = NNModel(model=self.model, input_col=feed,
-                         output_col=self.output_col,
-                         cut_output_layers=self.cut_output_layers,
-                         batch_size=self.batch_size)
+            feed = tmp
+        scorer = self._scorer
+        if scorer.input_col != feed:  # avoid invalidating the compile cache
+            scorer.input_col = feed
         out = scorer.transform(work)
-        return out.drop("__feat_img") if feed == "__feat_img" else out
+        return out.drop(tmp) if tmp else out
 
     def _save_extra(self, path, arrays):
         import os

@@ -45,15 +45,15 @@ def make_loss(name: str) -> Callable:
         def loss(logits, labels, weights):
             l = optax.softmax_cross_entropy_with_integer_labels(
                 logits, labels.astype(jnp.int32))
-            return jnp.sum(l * weights) / jnp.maximum(jnp.sum(weights), 1.0)
+            return jnp.sum(l * weights) / jnp.maximum(jnp.sum(weights), 1e-8)
     elif name == "sigmoid_cross_entropy":
         def loss(logits, labels, weights):
             l = optax.sigmoid_binary_cross_entropy(logits[..., 0], labels)
-            return jnp.sum(l * weights) / jnp.maximum(jnp.sum(weights), 1.0)
+            return jnp.sum(l * weights) / jnp.maximum(jnp.sum(weights), 1e-8)
     elif name == "squared_error":
         def loss(logits, labels, weights):
             l = jnp.square(logits[..., 0] - labels)
-            return jnp.sum(l * weights) / jnp.maximum(jnp.sum(weights), 1.0)
+            return jnp.sum(l * weights) / jnp.maximum(jnp.sum(weights), 1e-8)
     else:
         raise ValueError(f"unknown loss {name!r}; have {LOSSES}")
     return loss

@@ -131,22 +131,23 @@ class ModelDownloader:
     def download_model(self, meta: ModelSchema) -> ModelSchema:
         dest = os.path.join(self.cache_dir, meta.name)
 
-        def fetch():
+        if os.path.exists(dest) and _dir_sha256(dest) == meta.hash:
+            return dataclasses.replace(meta, uri=dest)
+
+        def fetch():  # only the transfer is retried; it can transiently fail
             if os.path.exists(dest):
-                if _dir_sha256(dest) == meta.hash:
-                    return
                 shutil.rmtree(dest)
             os.makedirs(self.cache_dir, exist_ok=True)
             shutil.copytree(meta.uri, dest)
-            actual = _dir_sha256(dest)
-            if actual != meta.hash:
-                shutil.rmtree(dest)
-                raise IOError(f"hash mismatch for {meta.name}: "
-                              f"{actual} != {meta.hash}")
 
         retry_with_timeout(fetch)
-        out = dataclasses.replace(meta, uri=dest)
-        return out
+        actual = _dir_sha256(dest)
+        if actual != meta.hash:
+            # deterministic corruption: fail immediately, no retry
+            shutil.rmtree(dest)
+            raise IOError(f"hash mismatch for {meta.name}: "
+                          f"{actual} != {meta.hash}")
+        return dataclasses.replace(meta, uri=dest)
 
     def load(self, name: str) -> NNFunction:
         meta = self.download_by_name(name)
