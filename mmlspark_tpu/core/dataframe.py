@@ -281,6 +281,36 @@ class DataFrame:
             out[n] = arr
         return out
 
+    # -- persistence ---------------------------------------------------------
+
+    def save(self, path: str) -> None:
+        """Persist to ``<path>`` (.npz columns + .meta.json sidecar)."""
+        np.savez_compressed(path if path.endswith(".npz") else path + ".npz",
+                            **self._data)
+        base = path[:-4] if path.endswith(".npz") else path
+        with open(base + ".meta.json", "w") as f:
+            json.dump({"metadata": self._meta, "n_rows": self._n_rows}, f)
+
+    @staticmethod
+    def load(path: str) -> "DataFrame":
+        npz_path = path if path.endswith(".npz") else path + ".npz"
+        base = path[:-4] if path.endswith(".npz") else path
+        with np.load(npz_path, allow_pickle=True) as z:
+            data = {k: z[k] for k in z.files}
+        meta: Dict[str, Dict[str, Any]] = {}
+        n_rows = None
+        try:
+            with open(base + ".meta.json") as f:
+                side = json.load(f)
+            meta = side.get("metadata", {})
+            n_rows = side.get("n_rows")
+        except FileNotFoundError:
+            pass
+        out = DataFrame(data, metadata=meta)
+        if not data and n_rows:
+            out._n_rows = n_rows
+        return out
+
     # -- misc ----------------------------------------------------------------
 
     def __repr__(self) -> str:

@@ -91,8 +91,12 @@ def eval_metric(name: str, y: np.ndarray, pred: np.ndarray,
     pred = np.asarray(pred, dtype=np.float64)
     eps = 1e-15
     if name == "auc":
-        from scipy.stats import rankdata  # via sklearn dependency chain
-        ranks = rankdata(pred)  # average ranks for ties
+        # tie-averaged ranks (rank-sum AUC), pure numpy
+        uniq, inv, counts = np.unique(pred, return_inverse=True,
+                                      return_counts=True)
+        cum = np.cumsum(counts)
+        avg_rank = (cum - counts + 1 + cum) / 2.0
+        ranks = avg_rank[inv]
         n_pos = float(np.sum(y == 1))
         n_neg = float(np.sum(y == 0))
         if n_pos == 0 or n_neg == 0:
@@ -209,6 +213,9 @@ class Booster:
             raw[:n] += prior
         raw = put(raw)
 
+        # continuation must re-decide the best iteration over the new run
+        booster.best_iteration = -1
+
         grad_fn = jax.jit(obj.grad_hess)
         is_rf = params.boosting_type == "rf"
         is_dart = params.boosting_type == "dart"
@@ -219,12 +226,15 @@ class Booster:
         metric_name = params.metric or DEFAULT_METRICS.get(obj.name, "l2")
         best_metric, best_iter, rounds_no_improve = None, -1, 0
         tree_raw_contribs: List[jnp.ndarray] = []  # dart needs per-tree raw
+        valid_eval: Optional[_ValidEval] = None  # incremental valid scorer
 
         start_iter = len(booster.trees)
         for it in range(start_iter, start_iter + params.num_iterations):
             # -- dart: drop trees for this round's gradient computation
+            # (drop indices are relative to THIS run's trees,
+            # tree_raw_contribs[d] <-> booster.trees[start_iter + d])
             dropped: List[int] = []
-            if is_dart and booster.trees and rng.random() >= params.skip_drop:
+            if is_dart and tree_raw_contribs and rng.random() >= params.skip_drop:
                 k_drop = min(max(1, int(params.drop_rate * len(tree_raw_contribs))),
                              params.max_drop)
                 dropped = list(rng.choice(len(tree_raw_contribs),
@@ -309,7 +319,7 @@ class Booster:
                                             (len(dropped) + params.learning_rate))
                 for d in dropped:
                     tree_raw_contribs[d] = tree_raw_contribs[d] * factor
-                    for t in booster.trees[d]:
+                    for t in booster.trees[start_iter + d]:
                         t.value *= factor
                 raw = raw_for_grad + new_contrib + sum(
                     tree_raw_contribs[d] for d in dropped)
@@ -317,13 +327,16 @@ class Booster:
                 raw = raw + new_contrib
 
             booster.trees.append(iter_trees)
+            booster.__dict__.pop("_mdc", None)  # tree set changed
             if is_dart:
                 tree_raw_contribs.append(new_contrib)
 
             # -- eval + early stopping
             if valid_sets and (params.early_stopping_round > 0 or log_every):
-                vx, vy = valid_sets[0]
-                vpred = booster.predict(vx)
+                if valid_eval is None:
+                    valid_eval = _ValidEval(booster, valid_sets[0][0])
+                vy = valid_sets[0][1]
+                vpred = valid_eval.predict()
                 val, higher = eval_metric(metric_name, vy, vpred, obj,
                                           params.alpha,
                                           params.tweedie_variance_power)
@@ -351,28 +364,27 @@ class Booster:
 
     # -- prediction ---------------------------------------------------------
 
-    def _tree_arrays(self, X_cat_bins: np.ndarray) -> List[List[Dict[str, Any]]]:
-        out = []
+    def _tree_to_arrays(self, t: Tree, cat_bins_dev) -> Dict[str, Any]:
         B = self.mapper.max_bins_total
-        for iteration in self.trees:
-            row = []
-            for t in iteration:
-                cm = t.cat_mask
-                if cm.shape[1] < B:
-                    cm = np.pad(cm, ((0, 0), (0, B - cm.shape[1])))
-                row.append({
-                    "feature": jnp.asarray(t.feature),
-                    "threshold": jnp.asarray(t.threshold, dtype=jnp.float32),
-                    "missing_left": jnp.asarray(t.missing_left),
-                    "categorical": jnp.asarray(t.categorical),
-                    "cat_mask": jnp.asarray(cm),
-                    "left": jnp.asarray(t.left),
-                    "right": jnp.asarray(t.right),
-                    "value": jnp.asarray(t.value),
-                    "cat_bins": jnp.asarray(X_cat_bins),
-                })
-            out.append(row)
-        return out
+        cm = t.cat_mask
+        if cm.shape[1] < B:
+            cm = np.pad(cm, ((0, 0), (0, B - cm.shape[1])))
+        return {
+            "feature": jnp.asarray(t.feature),
+            "threshold": jnp.asarray(t.threshold, dtype=jnp.float32),
+            "missing_left": jnp.asarray(t.missing_left),
+            "categorical": jnp.asarray(t.categorical),
+            "cat_mask": jnp.asarray(cm),
+            "left": jnp.asarray(t.left),
+            "right": jnp.asarray(t.right),
+            "value": jnp.asarray(t.value),
+            "cat_bins": cat_bins_dev,
+        }
+
+    def _tree_arrays(self, X_cat_bins: np.ndarray) -> List[List[Dict[str, Any]]]:
+        cat_bins_dev = jnp.asarray(X_cat_bins)
+        return [[self._tree_to_arrays(t, cat_bins_dev) for t in iteration]
+                for iteration in self.trees]
 
     def _cat_bins(self, X: np.ndarray) -> np.ndarray:
         """Bin-space values for categorical features (0 elsewhere)."""
@@ -474,6 +486,43 @@ class Booster:
         self.best_iteration = len(self.trees) - 1
         self.__dict__.pop("_mdc", None)
         return self
+
+
+class _ValidEval:
+    """Incremental validation scorer for the training loop.
+
+    Bins/uploads the validation set once and accumulates only the newly
+    added iterations' raw scores each eval round (the naive path re-binned
+    the set and re-uploaded every tree each round — O(T^2) over training).
+    DART mutates the leaf values of already-scored trees when it drops
+    them, so DART falls back to a full re-score per eval.
+    """
+
+    def __init__(self, booster: "Booster", vx: np.ndarray):
+        self.booster = booster
+        self.vx = np.asarray(vx, dtype=np.float64)
+        self.cat_bins_dev = jnp.asarray(booster._cat_bins(self.vx))
+        self.X_dev = jnp.asarray(self.vx)
+        K = booster.obj.num_model_outputs
+        self.acc = jnp.zeros((len(self.vx), K), dtype=jnp.float32)
+        self.done = 0
+
+    def predict(self) -> np.ndarray:
+        b = self.booster
+        if b.params.boosting_type == "dart":
+            return b.predict(self.vx, num_iteration=len(b.trees))
+        for iteration in b.trees[self.done:]:
+            for k, t in enumerate(iteration):
+                arrs = b._tree_to_arrays(t, self.cat_bins_dev)
+                self.acc = self.acc.at[:, k].add(
+                    predict_tree_raw(arrs, self.X_dev, t.max_depth()))
+        self.done = len(b.trees)
+        raw = np.asarray(self.acc, dtype=np.float64) + b.init_score[None, :]
+        if b.params.boosting_type == "rf":
+            raw = (b.init_score[None, :]
+                   + (raw - b.init_score[None, :]) / max(self.done, 1))
+        out = np.asarray(b.obj.transform(jnp.asarray(raw)))
+        return out[:, 0] if b.obj.num_model_outputs == 1 else out
 
 
 def _weights(w: Optional[np.ndarray], n: int) -> np.ndarray:

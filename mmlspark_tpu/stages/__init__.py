@@ -1,0 +1,34 @@
+"""Pipeline stages: frame utilities, data prep, batching, image ops."""
+
+from mmlspark_tpu.stages.basic import (
+    DropColumns, SelectColumns, RenameColumn, Repartition, Cacher,
+    CheckpointData, Explode, Lambda, UDFTransformer, TextPreprocessor,
+    UnicodeNormalize, ClassBalancer, ClassBalancerModel, PartitionSample,
+    MultiColumnAdapter, EnsembleByKey, SummarizeData,
+)
+from mmlspark_tpu.stages.prep import (
+    ValueIndexer, ValueIndexerModel, IndexToValue,
+    CleanMissingData, CleanMissingDataModel, DataConversion,
+)
+from mmlspark_tpu.stages.batching import (
+    FixedBatcher, DynamicBufferedBatcher, TimeIntervalBatcher,
+    FixedMiniBatchTransformer, DynamicMiniBatchTransformer, FlattenBatch,
+)
+from mmlspark_tpu.stages.image import (
+    ImageTransformer, ResizeImageTransformer, UnrollImage, UnrollBinaryImage,
+    ImageSetAugmenter,
+)
+
+__all__ = [
+    "DropColumns", "SelectColumns", "RenameColumn", "Repartition", "Cacher",
+    "CheckpointData", "Explode", "Lambda", "UDFTransformer",
+    "TextPreprocessor", "UnicodeNormalize", "ClassBalancer",
+    "ClassBalancerModel", "PartitionSample", "MultiColumnAdapter",
+    "EnsembleByKey", "SummarizeData",
+    "ValueIndexer", "ValueIndexerModel", "IndexToValue",
+    "CleanMissingData", "CleanMissingDataModel", "DataConversion",
+    "FixedBatcher", "DynamicBufferedBatcher", "TimeIntervalBatcher",
+    "FixedMiniBatchTransformer", "DynamicMiniBatchTransformer", "FlattenBatch",
+    "ImageTransformer", "ResizeImageTransformer", "UnrollImage",
+    "UnrollBinaryImage", "ImageSetAugmenter",
+]
