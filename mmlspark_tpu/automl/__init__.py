@@ -1,0 +1,33 @@
+"""AutoML layer: train wrappers, evaluators, model selection, tuning.
+
+Capability parity with the reference's L4 meta-algorithms: `src/train`
+(TrainClassifier/TrainRegressor), `src/compute-model-statistics`,
+`src/compute-per-instance-statistics`, `src/find-best-model`,
+`src/tune-hyperparameters`.
+"""
+
+from mmlspark_tpu.automl.train import (
+    TrainClassifier, TrainedClassifierModel,
+    TrainRegressor, TrainedRegressorModel,
+)
+from mmlspark_tpu.automl.metrics import (
+    ComputeModelStatistics, ComputePerInstanceStatistics,
+    classification_metrics, regression_metrics,
+)
+from mmlspark_tpu.automl.best import FindBestModel, BestModel
+from mmlspark_tpu.automl.tune import (
+    TuneHyperparameters, TuneHyperparametersModel,
+    HyperparamBuilder, DiscreteHyperParam, RangeHyperParam,
+    GridSpace, RandomSpace, DefaultHyperparams,
+)
+
+__all__ = [
+    "TrainClassifier", "TrainedClassifierModel",
+    "TrainRegressor", "TrainedRegressorModel",
+    "ComputeModelStatistics", "ComputePerInstanceStatistics",
+    "classification_metrics", "regression_metrics",
+    "FindBestModel", "BestModel",
+    "TuneHyperparameters", "TuneHyperparametersModel",
+    "HyperparamBuilder", "DiscreteHyperParam", "RangeHyperParam",
+    "GridSpace", "RandomSpace", "DefaultHyperparams",
+]

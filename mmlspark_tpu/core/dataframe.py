@@ -24,6 +24,28 @@ import numpy as np
 ColumnLike = Union[np.ndarray, Sequence[Any]]
 
 
+def py_scalar(v):
+    """Numpy scalar -> plain python (JSON-able, dict-key stable)."""
+    return v.item() if isinstance(v, np.generic) else v
+
+
+def is_null(v) -> bool:
+    """None or float NaN (the framework-wide notion of a missing cell)."""
+    if v is None:
+        return True
+    if isinstance(v, (float, np.floating)) and np.isnan(v):
+        return True
+    return False
+
+
+def obj_col(items) -> np.ndarray:
+    """Sequence -> 1D object array (immune to numpy's 2D inference)."""
+    arr = np.empty(len(items), dtype=object)
+    for i, v in enumerate(items):
+        arr[i] = v
+    return arr
+
+
 def _as_column(values: ColumnLike) -> np.ndarray:
     if isinstance(values, np.ndarray):
         return values
@@ -288,8 +310,10 @@ class DataFrame:
         np.savez_compressed(path if path.endswith(".npz") else path + ".npz",
                             **self._data)
         base = path[:-4] if path.endswith(".npz") else path
+        from mmlspark_tpu.core.serialize import _json_default
         with open(base + ".meta.json", "w") as f:
-            json.dump({"metadata": self._meta, "n_rows": self._n_rows}, f)
+            json.dump({"metadata": self._meta, "n_rows": self._n_rows}, f,
+                      default=_json_default)
 
     @staticmethod
     def load(path: str) -> "DataFrame":

@@ -22,7 +22,8 @@ from typing import Any, Callable, Dict, List, Optional, Sequence
 
 import numpy as np
 
-from mmlspark_tpu.core.dataframe import DataFrame
+from mmlspark_tpu.core.dataframe import DataFrame, py_scalar as _py, \
+    is_null as _is_null, obj_col as _obj_col
 from mmlspark_tpu.core.params import (
     Param, HasInputCol, HasInputCols, HasOutputCol, HasOutputCols,
     HasLabelCol, in_set, in_range,
@@ -108,6 +109,9 @@ class CheckpointData(Transformer):
         out = DataFrame.load(os.path.join(path, "frame.npz"))
         if self.remove_checkpoint:
             os.remove(os.path.join(path, "frame.npz"))
+            meta_path = os.path.join(path, "frame.meta.json")
+            if os.path.exists(meta_path):
+                os.remove(meta_path)
         return out
 
 
@@ -123,9 +127,7 @@ class Explode(Transformer, HasInputCol, HasOutputCol):
         idx = np.repeat(np.arange(df.num_rows), lengths)
         flat: List[Any] = [item for v in col for item in v]
         out = df.take(idx)
-        return out.with_column(self.output_col or self.input_col,
-                               flat if not flat or isinstance(flat[0], str)
-                               else np.asarray(flat))
+        return out.with_column(self.output_col or self.input_col, flat)
 
 
 class Lambda(Transformer):
@@ -282,11 +284,6 @@ class ClassBalancerModel(Model, HasInputCol, HasOutputCol):
         return df.with_column(self.output_col or "weight", out)
 
 
-def _py(v):
-    """Numpy scalar -> plain python (JSON-able, dict-key stable)."""
-    return v.item() if isinstance(v, np.generic) else v
-
-
 class PartitionSample(Transformer):
     """Head / random-sample row selection as a stage.
 
@@ -376,7 +373,7 @@ class EnsembleByKey(Transformer):
                 collected: List[List[Any]] = [[] for _ in range(n_groups)]
                 for g, v in zip(group, col):
                     collected[g].append(v)
-                data[f"{c}_collected"] = np.array(collected, dtype=object)
+                data[f"{c}_collected"] = _obj_col(collected)
                 continue
             sums = np.zeros((n_groups,) + col.shape[1:], dtype=np.float64)
             np.add.at(sums, group, col.astype(np.float64))
@@ -395,7 +392,7 @@ class EnsembleByKey(Transformer):
             col = out[name]
             if col.dtype == np.dtype("O"):
                 joined = joined.with_column(
-                    name, np.array([col[g] for g in group], dtype=object))
+                    name, _obj_col([col[g] for g in group]))
             else:
                 joined = joined.with_column(name, col[group])
         return joined
@@ -433,7 +430,7 @@ class SummarizeData(Transformer):
                 else:
                     row["Unique Value Count"] = float(len(set(map(str, col))))
                     row["Missing Value Count"] = float(
-                        sum(v is None for v in col))
+                        sum(_is_null(v) for v in col))
             if self.basic:
                 row["Mean"] = float(np.mean(finite)) if is_num and len(finite) else float("nan")
                 row["Standard Deviation"] = (

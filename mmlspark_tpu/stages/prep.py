@@ -21,22 +21,13 @@ from typing import Any, Dict, List, Optional, Sequence
 
 import numpy as np
 
-from mmlspark_tpu.core.dataframe import DataFrame
+from mmlspark_tpu.core.dataframe import DataFrame, py_scalar as _py, \
+    is_null as _is_null, obj_col as _obj_col
 from mmlspark_tpu.core.params import (
     Param, HasInputCol, HasOutputCol, in_set,
 )
 from mmlspark_tpu.core import schema as S
 from mmlspark_tpu.core.stage import Transformer, Estimator, Model
-
-
-def _is_null(v) -> bool:
-    if v is None:
-        return True
-    if isinstance(v, float) and np.isnan(v):
-        return True
-    if isinstance(v, np.floating) and np.isnan(v):
-        return True
-    return False
 
 
 class ValueIndexer(Estimator, HasInputCol, HasOutputCol):
@@ -53,7 +44,7 @@ class ValueIndexer(Estimator, HasInputCol, HasOutputCol):
 
     def fit(self, df: DataFrame) -> "ValueIndexerModel":
         col = df[self.input_col]
-        values = [v.item() if isinstance(v, np.generic) else v for v in col]
+        values = [_py(v) for v in col]
         non_null = sorted({v for v in values if not _is_null(v)},
                           key=lambda v: (isinstance(v, str), v))
         has_null = any(_is_null(v) for v in values)
@@ -83,7 +74,7 @@ class ValueIndexerModel(Model, HasInputCol, HasOutputCol):
         col = df[self.input_col]
         out = np.empty(len(col), dtype=np.int64)
         for i, v in enumerate(col):
-            v = v.item() if isinstance(v, np.generic) else v
+            v = _py(v)
             if _is_null(v):
                 if null_index < 0:
                     raise ValueError(
@@ -214,7 +205,6 @@ class DataConversion(Transformer):
         col = df[name]
         target = self.convert_to
         if target == "toCategorical":
-            from mmlspark_tpu.stages.prep import ValueIndexer
             model = ValueIndexer(input_col=name, output_col=name).fit(df)
             return model.transform(df)
         if target == "clearCategorical":
@@ -236,17 +226,21 @@ class DataConversion(Transformer):
         if target == "date":
             fmt = self.date_time_format
             if col.dtype == np.dtype("O"):
-                # string -> epoch seconds (stored as int64) parsing with fmt
+                # string -> epoch seconds; nulls become NaN
                 values = np.array(
-                    [int(_dt.datetime.strptime(str(v), fmt)
-                         .replace(tzinfo=_dt.timezone.utc).timestamp())
-                     for v in col], dtype=np.int64)
+                    [np.nan if _is_null(v) else
+                     _dt.datetime.strptime(str(v), fmt)
+                     .replace(tzinfo=_dt.timezone.utc).timestamp()
+                     for v in col], dtype=np.float64)
+                if not np.any(np.isnan(values)):
+                    values = values.astype(np.int64)
                 return df.with_column(name, values,
                                       metadata={"datetime": True})
-            # numeric epoch seconds -> formatted string
-            values = [
+            # numeric epoch seconds -> formatted string; nulls become None
+            values = _obj_col([
+                None if _is_null(_py(v)) else
                 _dt.datetime.fromtimestamp(int(v), tz=_dt.timezone.utc)
-                .strftime(fmt) for v in col]
+                .strftime(fmt) for v in col])
             return df.with_column(name, values)
         np_type = _CONVERSIONS[target]
         if col.dtype == np.dtype("O"):

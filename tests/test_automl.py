@@ -1,0 +1,159 @@
+"""Tests for the AutoML layer: train wrappers, evaluators, selection, tuning.
+
+Parity model: `train/src/test/scala/VerifyTrainClassifier.scala`,
+`compute-model-statistics/src/test/scala/VerifyComputeModelStatistics.scala`,
+`find-best-model/src/test/scala/VerifyFindBestModel.scala`,
+`tune-hyperparameters/src/test/scala/VerifyTuneHyperparameters.scala`.
+"""
+
+import numpy as np
+import pytest
+
+from mmlspark_tpu import DataFrame, PipelineStage
+from mmlspark_tpu.automl import (
+    TrainClassifier, TrainRegressor, ComputeModelStatistics,
+    ComputePerInstanceStatistics, FindBestModel, TuneHyperparameters,
+    HyperparamBuilder, DiscreteHyperParam, RangeHyperParam, GridSpace,
+    RandomSpace,
+)
+from mmlspark_tpu.automl.metrics import (
+    classification_metrics, regression_metrics,
+)
+from mmlspark_tpu.gbdt.stages import GBDTClassifier, GBDTRegressor
+
+
+def _binary_df(n=200, seed=0):
+    rng = np.random.default_rng(seed)
+    x1 = rng.normal(size=n)
+    x2 = rng.normal(size=n)
+    label = np.where(x1 + 0.5 * x2 + 0.3 * rng.normal(size=n) > 0,
+                     "good", "bad")
+    return DataFrame({"x1": x1, "x2": x2,
+                      "color": rng.choice(["r", "g", "b"], size=n).tolist(),
+                      "label": label.tolist()})
+
+
+def _reg_df(n=200, seed=0):
+    rng = np.random.default_rng(seed)
+    x1 = rng.normal(size=n)
+    x2 = rng.normal(size=n)
+    y = 2.0 * x1 - x2 + 0.1 * rng.normal(size=n)
+    return DataFrame({"x1": x1, "x2": x2, "y": y})
+
+
+SMALL_GBDT = dict(num_iterations=20, num_leaves=7, min_data_in_leaf=5)
+
+
+class TestMetricFns:
+    def test_classification_metrics(self):
+        y = np.array([0, 0, 1, 1])
+        pred = np.array([0, 1, 1, 1])
+        score = np.array([0.1, 0.6, 0.7, 0.9])
+        m = classification_metrics(y, pred, score)
+        assert m["accuracy"] == 0.75
+        assert m["confusion_matrix"].tolist() == [[1, 1], [0, 2]]
+        assert m["AUC"] == 1.0  # scores perfectly rank y
+
+    def test_regression_metrics(self):
+        y = np.array([1.0, 2.0, 3.0])
+        m = regression_metrics(y, y)
+        assert m["root_mean_squared_error"] == 0.0
+        assert m["R^2"] == 1.0
+
+
+class TestTrainClassifier:
+    def test_end_to_end(self, tmp_path):
+        df = _binary_df()
+        trainer = TrainClassifier(
+            model=GBDTClassifier(**SMALL_GBDT), label_col="label")
+        model = trainer.fit(df)
+        scored = model.transform(df)
+        # predictions mapped back to original string labels
+        assert set(scored["prediction"]) <= {"good", "bad"}
+        acc = np.mean(scored["prediction"] == np.asarray(df["label"]))
+        assert acc > 0.85
+        # evaluator auto-detects columns from metadata
+        metrics = ComputeModelStatistics(label_col="label").evaluate(scored)
+        assert float(metrics["accuracy"][0]) == pytest.approx(acc)
+        assert float(metrics["AUC"][0]) > 0.9
+        # persistence round-trip
+        model.save(str(tmp_path / "tc"))
+        loaded = PipelineStage.load(str(tmp_path / "tc"))
+        scored2 = loaded.transform(df)
+        assert list(scored2["prediction"]) == list(scored["prediction"])
+
+    def test_per_instance(self):
+        df = _binary_df()
+        model = TrainClassifier(model=GBDTClassifier(**SMALL_GBDT),
+                                label_col="label").fit(df)
+        scored = model.transform(df)
+        out = ComputePerInstanceStatistics(label_col="label").evaluate(scored)
+        assert "log_loss" in out.columns
+        assert np.all(out["log_loss"] >= 0)
+
+
+class TestTrainRegressor:
+    def test_end_to_end(self):
+        df = _reg_df()
+        model = TrainRegressor(model=GBDTRegressor(**SMALL_GBDT),
+                               label_col="y").fit(df)
+        scored = model.transform(df)
+        metrics = ComputeModelStatistics(label_col="y").evaluate(scored)
+        assert float(metrics["R^2"][0]) > 0.8
+        out = ComputePerInstanceStatistics(label_col="y").evaluate(scored)
+        assert "L1_loss" in out.columns and "L2_loss" in out.columns
+
+
+class TestFindBestModel:
+    def test_picks_better(self):
+        df = _binary_df()
+        weak = TrainClassifier(
+            model=GBDTClassifier(num_iterations=1, num_leaves=2,
+                                 min_data_in_leaf=50),
+            label_col="label").fit(df)
+        strong = TrainClassifier(
+            model=GBDTClassifier(**SMALL_GBDT), label_col="label").fit(df)
+        best = FindBestModel(models=[weak, strong], label_col="label",
+                             evaluation_metric="accuracy").fit(df)
+        assert best.best_model is strong
+        hist = best.get_all_model_metrics()
+        assert hist.num_rows == 2
+        assert best.get_roc_curve() is not None
+
+
+class TestSpaces:
+    def test_grid_space(self):
+        space = (HyperparamBuilder()
+                 .add_hyperparam("a", DiscreteHyperParam([1, 2]))
+                 .add_hyperparam("b", DiscreteHyperParam(["x", "y"]))
+                 .build())
+        maps = list(GridSpace(space).param_maps())
+        assert len(maps) == 4
+        assert {"a": 1, "b": "y"} in maps
+
+    def test_random_space(self):
+        space = {"lr": RangeHyperParam(1e-3, 1e-1, log=True),
+                 "n": RangeHyperParam(1, 10, is_int=True)}
+        samples = list(RandomSpace(space, seed=1).sample(20))
+        assert len(samples) == 20
+        assert all(1e-3 <= s["lr"] <= 1e-1 for s in samples)
+        assert all(isinstance(s["n"], int) and 1 <= s["n"] <= 10
+                   for s in samples)
+
+
+class TestTuneHyperparameters:
+    def test_random_search_cv(self):
+        df = _binary_df(150)
+        space = {"num_leaves": DiscreteHyperParam([3, 7]),
+                 "num_iterations": DiscreteHyperParam([5, 15])}
+        tuned = TuneHyperparameters(
+            models=[TrainClassifier(model=GBDTClassifier(min_data_in_leaf=5),
+                                    label_col="label")],
+            param_space=space, evaluation_metric="accuracy",
+            num_folds=2, num_runs=3, parallelism=2, seed=3).fit(df)
+        assert tuned.best_metric > 0.7
+        assert set(tuned.best_params) == {"num_leaves", "num_iterations"}
+        hist = tuned.get_history()
+        assert hist.num_rows == 3
+        scored = tuned.transform(df)
+        assert "prediction" in scored.columns
