@@ -18,6 +18,7 @@ from mmlspark_tpu.automl.best import FindBestModel, BestModel
 from mmlspark_tpu.automl.tune import (
     TuneHyperparameters, TuneHyperparametersModel,
     HyperparamBuilder, DiscreteHyperParam, RangeHyperParam,
+    IntRangeHyperParam, DoubleRangeHyperParam,
     GridSpace, RandomSpace, DefaultHyperparams,
 )
 
@@ -29,5 +30,6 @@ __all__ = [
     "FindBestModel", "BestModel",
     "TuneHyperparameters", "TuneHyperparametersModel",
     "HyperparamBuilder", "DiscreteHyperParam", "RangeHyperParam",
+    "IntRangeHyperParam", "DoubleRangeHyperParam",
     "GridSpace", "RandomSpace", "DefaultHyperparams",
 ]

@@ -27,11 +27,19 @@ ALL_METRICS = "all"
 
 
 def _roc_points(y: np.ndarray, score: np.ndarray) -> np.ndarray:
-    """ROC curve points (fpr, tpr) sorted by descending score."""
+    """ROC curve points (fpr, tpr): one point per distinct score threshold.
+
+    Grouping by threshold (not by row) makes tied scores contribute a
+    single diagonal segment, so the curve — like the AUC below — does not
+    depend on row order.
+    """
     order = np.argsort(-score, kind="stable")
     y = y[order]
+    s = score[order]
     tps = np.cumsum(y == 1)
     fps = np.cumsum(y == 0)
+    last_of_threshold = np.flatnonzero(np.diff(s, append=np.nan) != 0)
+    tps, fps = tps[last_of_threshold], fps[last_of_threshold]
     n_pos = max(float(tps[-1]) if len(tps) else 0.0, 1e-12)
     n_neg = max(float(fps[-1]) if len(fps) else 0.0, 1e-12)
     tpr = np.concatenate([[0.0], tps / n_pos])
@@ -40,8 +48,29 @@ def _roc_points(y: np.ndarray, score: np.ndarray) -> np.ndarray:
 
 
 def _auc(y: np.ndarray, score: np.ndarray) -> float:
-    pts = _roc_points(y, score)
-    return float(np.trapezoid(pts[:, 1], pts[:, 0]))
+    """Tie-corrected AUC (Mann-Whitney with average ranks).
+
+    Tied scores get half credit, so a constant classifier scores 0.5
+    regardless of row order.
+    """
+    n_pos = int((y == 1).sum())
+    n_neg = len(y) - n_pos
+    if n_pos == 0 or n_neg == 0:
+        return 0.0
+    order = np.argsort(score, kind="stable")
+    ranks = np.empty(len(score), dtype=np.float64)
+    sorted_scores = score[order]
+    # average rank within each tie group
+    i = 0
+    while i < len(sorted_scores):
+        j = i
+        while j + 1 < len(sorted_scores) and \
+                sorted_scores[j + 1] == sorted_scores[i]:
+            j += 1
+        ranks[order[i:j + 1]] = 0.5 * (i + j) + 1.0
+        i = j + 1
+    rank_sum_pos = float(ranks[y == 1].sum())
+    return (rank_sum_pos - n_pos * (n_pos + 1) / 2.0) / (n_pos * n_neg)
 
 
 def classification_metrics(y: np.ndarray, pred: np.ndarray,
@@ -176,7 +205,19 @@ class ComputePerInstanceStatistics(Evaluator, HasLabelCol):
         prob = np.asarray(df[prob_col], dtype=np.float64)
         y_idx = np.asarray(y)
         if y_idx.dtype == np.dtype("O") or y_idx.dtype.kind in "US":
-            levels = sorted(set(y_idx))
+            # Training-time level order rides on the score columns' metadata
+            # (stamped by TrainedClassifierModel); the eval frame's own label
+            # set can be a subset, so deriving order from it would misalign
+            # probability columns.
+            levels = None
+            for col in (prob_col, pred_col):
+                if col is not None:
+                    levels = df.get_metadata(col).get("levels")
+                    if levels:
+                        break
+            if not levels:
+                levels = sorted(set(y_idx))
+            levels = list(levels)
             y_idx = np.array([levels.index(v) for v in y_idx])
         y_idx = y_idx.astype(np.int64)
         p_true = prob[np.arange(len(prob)), np.clip(y_idx, 0,

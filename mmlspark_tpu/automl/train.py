@@ -90,16 +90,22 @@ class TrainedClassifierModel(Model, HasLabelCol):
     def transform(self, df: DataFrame) -> DataFrame:
         out = self.fitted.transform(self.featurizer.transform(df))
         if self.levels is not None:
+            levels = self.levels
             pred_col = getattr(self.fitted, "prediction_col", "prediction")
             if pred_col in out:
                 idx = np.asarray(out[pred_col]).astype(np.int64)
-                levels = self.levels
                 vals = [levels[i] if 0 <= i < len(levels) else None
                         for i in idx]
-                out = out.with_column(
-                    pred_col, vals,
-                    metadata=S.make_role_meta(S.SCORED_LABELS_KIND,
-                                              self.uid))
+                meta = S.make_role_meta(S.SCORED_LABELS_KIND, self.uid)
+                meta["levels"] = list(levels)
+                out = out.with_column(pred_col, vals, metadata=meta)
+            # level order on the probability column tells evaluators which
+            # column belongs to which original label (per-instance log-loss)
+            prob_col = getattr(self.fitted, "probability_col", "probability")
+            if prob_col in out:
+                meta = dict(out.get_metadata(prob_col))
+                meta["levels"] = list(levels)
+                out = out.with_metadata(prob_col, meta)
         return out.drop(self.features_col)
 
     def _save_extra(self, path, arrays):

@@ -17,7 +17,7 @@ from typing import Any, Dict, Iterator, List, Optional, Sequence, Tuple
 
 import numpy as np
 
-from mmlspark_tpu.core.dataframe import DataFrame
+from mmlspark_tpu.core.dataframe import DataFrame, py_scalar as _scalar
 from mmlspark_tpu.core.params import Param, HasLabelCol, in_range
 from mmlspark_tpu.core.stage import Estimator, Model, PipelineStage
 from mmlspark_tpu.automl.metrics import ComputeModelStatistics
@@ -42,11 +42,23 @@ class DiscreteHyperParam:
 
 
 class RangeHyperParam:
-    """A continuous or integer range [lo, hi); optionally log-uniform."""
+    """A continuous or integer range [lo, hi); optionally log-uniform.
 
-    def __init__(self, lo, hi, is_int: bool = False, log: bool = False):
+    ``is_int=None`` (the default) samples continuously — integer bounds do
+    NOT silently switch to integer sampling (``RangeHyperParam(0, 1)`` means
+    uniform [0, 1), not a coin flip). Use ``is_int=True`` or
+    :class:`IntRangeHyperParam` for integer params (parity: the reference
+    has typed IntRangeHyperParam / DoubleRangeHyperParam,
+    `HyperparamBuilder.scala:17-98`).
+    """
+
+    def __init__(self, lo, hi, is_int: Optional[bool] = None,
+                 log: bool = False):
+        if isinstance(lo, bool) or isinstance(hi, bool):
+            raise TypeError("bool bounds make no sense for a range; "
+                            "use DiscreteHyperParam([False, True])")
         self.lo, self.hi = lo, hi
-        self.is_int = is_int or (isinstance(lo, int) and isinstance(hi, int))
+        self.is_int = bool(is_int)
         self.log = log
 
     def grid(self, n: int = 3) -> List[Any]:
@@ -62,6 +74,16 @@ class RangeHyperParam:
         else:
             v = float(rng.uniform(self.lo, self.hi))
         return int(round(v)) if self.is_int else v
+
+
+class IntRangeHyperParam(RangeHyperParam):
+    def __init__(self, lo: int, hi: int, log: bool = False):
+        super().__init__(lo, hi, is_int=True, log=log)
+
+
+class DoubleRangeHyperParam(RangeHyperParam):
+    def __init__(self, lo: float, hi: float, log: bool = False):
+        super().__init__(lo, hi, is_int=False, log=log)
 
 
 class HyperparamBuilder:
@@ -223,10 +245,6 @@ class TuneHyperparameters(Estimator, HasLabelCol):
             best_metric=float(results[best_i]),
             best_params={k: _scalar(v) for k, v in best_pm.items()},
             history=DataFrame.from_rows(rows))
-
-
-def _scalar(v):
-    return v.item() if isinstance(v, np.generic) else v
 
 
 def _apply_params(est, pm: Dict[str, Any]):

@@ -25,7 +25,7 @@ from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
-from mmlspark_tpu.core.dataframe import DataFrame
+from mmlspark_tpu.core.dataframe import DataFrame, is_null
 from mmlspark_tpu.core import schema as S
 from mmlspark_tpu.core.params import Param, HasOutputCol, in_range
 from mmlspark_tpu.core.stage import Transformer, Estimator, Model
@@ -87,6 +87,8 @@ _DATE_PARTS = ("year", "month", "day", "weekday", "hour", "minute")
 def _expand_datetime(epochs: np.ndarray) -> np.ndarray:
     out = np.zeros((len(epochs), len(_DATE_PARTS)), dtype=np.float64)
     for i, e in enumerate(epochs):
+        if is_null(e):
+            continue  # null date -> all-zero expansion (imputed downstream)
         d = _dt.datetime.fromtimestamp(int(e), tz=_dt.timezone.utc)
         out[i] = (d.year, d.month, d.day, d.weekday(), d.hour, d.minute)
     return out

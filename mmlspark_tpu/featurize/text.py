@@ -28,12 +28,7 @@ from mmlspark_tpu.core.stage import Transformer, Estimator, Model
 from mmlspark_tpu.core.pipeline import Pipeline, PipelineModel
 
 
-def _obj_col(items):
-    """List-of-lists -> 1D object array (immune to numpy's 2D inference)."""
-    arr = np.empty(len(items), dtype=object)
-    for i, v in enumerate(items):
-        arr[i] = v
-    return arr
+from mmlspark_tpu.core.dataframe import obj_col as _obj_col  # shared helper
 
 
 def hash_token(token: str, dims: int) -> int:
@@ -259,7 +254,7 @@ class PageSplitter(Transformer, HasInputCol, HasOutputCol):
                 for m in boundary.finditer(s, lo, hi):
                     cut = m.start()
                     break
-                if cut < 0:
+                if cut <= 0:  # no boundary, or boundary at 0 (empty page)
                     cut = hi
                 pages.append(s[:cut])
                 s = s[cut:]

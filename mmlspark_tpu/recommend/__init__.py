@@ -1,0 +1,27 @@
+"""Recommendation: SAR recommender + ranking evaluation infrastructure.
+
+Capability parity with the reference's `src/recommendation/` module
+(`SAR.scala`, `SARModel.scala`, `RecommendationIndexer.scala`,
+`RankingAdapter.scala`, `RankingEvaluator.scala`,
+`RankingTrainValidationSplit.scala`) rebuilt TPU-first: affinity and
+item-item similarity are dense matmuls on the MXU instead of broadcast
+sparse multiplies over Spark partitions.
+"""
+
+from mmlspark_tpu.recommend.indexer import (
+    RecommendationIndexer, RecommendationIndexerModel,
+)
+from mmlspark_tpu.recommend.sar import SAR, SARModel
+from mmlspark_tpu.recommend.ranking import (
+    AdvancedRankingMetrics, RankingAdapter, RankingAdapterModel,
+    RankingEvaluator, RankingTrainValidationSplit,
+    RankingTrainValidationSplitModel, per_user_split,
+)
+
+__all__ = [
+    "RecommendationIndexer", "RecommendationIndexerModel",
+    "SAR", "SARModel",
+    "AdvancedRankingMetrics", "RankingAdapter", "RankingAdapterModel",
+    "RankingEvaluator", "RankingTrainValidationSplit",
+    "RankingTrainValidationSplitModel", "per_user_split",
+]

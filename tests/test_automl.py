@@ -157,3 +157,47 @@ class TestTuneHyperparameters:
         assert hist.num_rows == 3
         scored = tuned.transform(df)
         assert "prediction" in scored.columns
+
+
+class TestReviewRegressions:
+    """Regressions for review findings on metrics/tuning edge cases."""
+
+    def test_auc_constant_scores_is_half(self):
+        from mmlspark_tpu.automl.metrics import _auc
+        score = np.full(4, 0.5)
+        assert _auc(np.array([1, 1, 0, 0]), score) == pytest.approx(0.5)
+        assert _auc(np.array([0, 0, 1, 1]), score) == pytest.approx(0.5)
+
+    def test_auc_ties_get_half_credit(self):
+        from mmlspark_tpu.automl.metrics import _auc
+        y = np.array([0, 1, 1, 0])
+        s = np.array([0.1, 0.5, 0.5, 0.5])
+        # pairs: (pos .5, neg .1) x2 concordant; (pos .5, neg .5) x2 tied
+        assert _auc(y, s) == pytest.approx((2 * 1.0 + 2 * 0.5) / 4)
+
+    def test_range_hyperparam_defaults_continuous(self):
+        from mmlspark_tpu.automl import (RangeHyperParam, IntRangeHyperParam)
+        rng = np.random.default_rng(0)
+        samples = [RangeHyperParam(0, 1).sample(rng) for _ in range(10)]
+        assert any(0 < v < 1 for v in samples)
+        assert all(isinstance(v, float) for v in samples)
+        assert all(isinstance(IntRangeHyperParam(1, 10).sample(rng), int)
+                   for _ in range(5))
+        with pytest.raises(TypeError):
+            RangeHyperParam(False, True)
+
+    def test_per_instance_levels_from_metadata(self):
+        """Eval frame missing some training labels must still pick the
+        right probability column (uses score-column metadata)."""
+        df = _binary_df()
+        model = TrainClassifier(
+            model=GBDTClassifier(**SMALL_GBDT), label_col="label").fit(df)
+        scored = model.transform(df)
+        only_good = scored.filter(
+            np.array([v == "good" for v in scored["label"]]))
+        out = ComputePerInstanceStatistics(label_col="label").evaluate(
+            only_good)
+        prob = np.stack([np.asarray(p) for p in only_good["probability"]])
+        levels = only_good.get_metadata("probability")["levels"]
+        expected = -np.log(np.clip(prob[:, levels.index("good")], 1e-15, 1))
+        np.testing.assert_allclose(out["log_loss"], expected, rtol=1e-5)

@@ -154,3 +154,27 @@ class TestText:
         pages = out["pages"][0]
         assert all(len(p) <= 120 for p in pages)
         assert "".join(pages) == "word " * 100
+
+
+class TestReviewRegressions:
+    def test_page_splitter_no_infinite_loop_on_leading_boundary(self):
+        from mmlspark_tpu.featurize import PageSplitter
+        df = DataFrame({"text": [" leading space text that goes on a while"]})
+        out = PageSplitter(input_col="text", output_col="pages",
+                           minimum_page_length=0,
+                           maximum_page_length=10).transform(df)
+        pages = out["pages"][0]
+        assert all(pages)  # no empty pages
+        assert "".join(pages) == " leading space text that goes on a while"
+
+    def test_featurize_null_dates(self):
+        from mmlspark_tpu.stages.prep import DataConversion
+        df = DataFrame({"d": np.array(["2020-01-02", None, "2021-03-04"],
+                                      dtype=object),
+                        "y": [1.0, 2.0, 3.0]})
+        conv = DataConversion(cols=["d"], convert_to="date",
+                              date_time_format="%Y-%m-%d").transform(df)
+        feat = Featurize(feature_columns=["d"],
+                         output_col="features").fit(conv)
+        X = feat.transform(conv)["features"]
+        assert np.isfinite(np.asarray(X, dtype=np.float64)).all()

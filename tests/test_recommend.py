@@ -1,0 +1,208 @@
+"""Tests for SAR recommender + ranking evaluation.
+
+Parity model: `recommendation/src/test/scala/SARSpec.scala`,
+`RankingAdapterSpec.scala`, `RankingTrainValidationSplitSpec.scala`.
+"""
+
+import numpy as np
+import pytest
+
+from mmlspark_tpu import DataFrame, PipelineStage
+from mmlspark_tpu.recommend import (
+    SAR, SARModel, AdvancedRankingMetrics, RankingAdapter,
+    RankingEvaluator, RankingTrainValidationSplit, RecommendationIndexer,
+    per_user_split,
+)
+
+
+def _events(n_users=12, n_items=20, seed=0):
+    """Synthetic events with block structure: users prefer their cluster."""
+    rng = np.random.default_rng(seed)
+    rows = {"user": [], "item": [], "rating": [], "ts": []}
+    for u in range(n_users):
+        cluster = u % 2
+        for _ in range(8):
+            if rng.random() < 0.8:
+                item = rng.integers(0, n_items // 2) + cluster * (n_items // 2)
+            else:
+                item = rng.integers(0, n_items)
+            rows["user"].append(f"u{u}")
+            rows["item"].append(f"i{item}")
+            rows["rating"].append(float(rng.integers(1, 6)))
+            rows["ts"].append(1.5e9 + float(rng.integers(0, 90)) * 86400)
+    return DataFrame(rows)
+
+
+@pytest.fixture(scope="module")
+def indexed():
+    df = _events()
+    indexer = RecommendationIndexer(
+        user_input_col="user", item_input_col="item")
+    model = indexer.fit(df)
+    return model, model.transform(df)
+
+
+class TestIndexer:
+    def test_roundtrip(self, indexed, tmp_path):
+        model, df = indexed
+        assert df["user_idx"].dtype == np.int32
+        assert df["item_idx"].max() < model.num_items
+        model.save(str(tmp_path / "idx"))
+        loaded = PipelineStage.load(str(tmp_path / "idx"))
+        assert loaded.user_levels == model.user_levels
+
+    def test_inverse_items(self, indexed):
+        model, df = indexed
+        recs = DataFrame({"user_idx": [0], "recommendations": [[0, 1]]})
+        out = model.inverse_transform_items(recs, "recommendations")
+        assert out["recommendations"][0] == [model.item_levels[0],
+                                             model.item_levels[1]]
+
+    def test_unseen_id_raises(self, indexed):
+        model, _ = indexed
+        with pytest.raises(KeyError):
+            model.transform(DataFrame({"user": ["nope"], "item": ["i0"]}))
+
+
+class TestSAR:
+    def test_fit_and_recommend(self, indexed):
+        _, df = indexed
+        model = SAR(timestamp_col="ts", support_threshold=1).fit(df)
+        assert model.similarity.shape[0] == model.similarity.shape[1]
+        # similarity is symmetric
+        np.testing.assert_allclose(model.similarity, model.similarity.T,
+                                   atol=1e-5)
+        recs = model.recommend_for_all_users(5)
+        assert recs.num_rows == model.affinity.shape[0]
+        assert len(recs["recommendations"][0]) == 5
+        # remove_seen: recommended items were not interacted with
+        for u in range(model.affinity.shape[0]):
+            seen = set(np.flatnonzero(model.affinity[u] > 0))
+            assert not seen & set(int(i) for i in recs["recommendations"][u])
+
+    def test_cluster_structure_recovered(self, indexed):
+        """Users mostly get items from their own preference cluster."""
+        idx_model, df = indexed
+        model = SAR(timestamp_col="ts", support_threshold=1).fit(df)
+        recs = model.recommend_for_all_users(4)
+        n_items = model.affinity.shape[1]
+        user_of = {i: name for i, name in enumerate(idx_model.user_levels)}
+        hits = total = 0
+        for u, items in zip(recs["user_idx"], recs["recommendations"]):
+            cluster = int(user_of[int(u)][1:]) % 2
+            for i in items:
+                total += 1
+                item_no = int(idx_model.item_levels[int(i)][1:])
+                hits += (item_no // (n_items // 2)) == cluster
+        assert hits / total > 0.6
+
+    def test_similarity_metrics_differ(self, indexed):
+        _, df = indexed
+        sims = {}
+        for m in ("jaccard", "lift", "cooccurrence"):
+            sims[m] = SAR(similarity_function=m,
+                          support_threshold=1).fit(df).similarity
+        assert sims["jaccard"].max() <= 1.0 + 1e-6
+        assert sims["cooccurrence"].max() > 1.0  # raw counts
+        assert not np.allclose(sims["jaccard"], sims["lift"])
+
+    def test_support_threshold_zeroes(self, indexed):
+        _, df = indexed
+        lo = SAR(support_threshold=1).fit(df).similarity
+        hi = SAR(support_threshold=10).fit(df).similarity
+        assert (hi > 0).sum() < (lo > 0).sum()
+
+    def test_time_decay_downweights_old(self):
+        # same items, one user rated long ago -> lower affinity weight
+        df = DataFrame({
+            "user_idx": [0, 1], "item_idx": [0, 0],
+            "rating": [5.0, 5.0],
+            "ts": [0.0, 365.0 * 86400],
+        })
+        model = SAR(timestamp_col="ts", time_decay_half_life=30.0,
+                    support_threshold=0).fit(df)
+        assert model.affinity[0, 0] < model.affinity[1, 0]
+        no_decay = SAR(timestamp_col="ts", time_decay_enabled=False,
+                       support_threshold=0).fit(df)
+        assert no_decay.affinity[0, 0] == no_decay.affinity[1, 0]
+
+    def test_transform_scores_pairs(self, indexed):
+        _, df = indexed
+        model = SAR(support_threshold=1).fit(df)
+        scored = model.transform(df.head(10))
+        assert "prediction" in scored
+        assert np.isfinite(scored["prediction"]).all()
+
+    def test_save_load(self, indexed, tmp_path):
+        _, df = indexed
+        model = SAR(support_threshold=1).fit(df)
+        model.save(str(tmp_path / "sar"))
+        loaded = PipelineStage.load(str(tmp_path / "sar"))
+        np.testing.assert_allclose(loaded.similarity, model.similarity)
+        a = model.recommend_for_all_users(3)
+        b = loaded.recommend_for_all_users(3)
+        assert [list(map(int, r)) for r in a["recommendations"]] == \
+               [list(map(int, r)) for r in b["recommendations"]]
+
+
+class TestRankingMetrics:
+    def test_perfect_ranking(self):
+        m = AdvancedRankingMetrics([[1, 2, 3]], [[1, 2, 3]], k=3)
+        assert m.ndcg_at_k() == pytest.approx(1.0)
+        assert m.precision_at_k() == pytest.approx(1.0)
+        assert m.recall_at_k() == pytest.approx(1.0)
+        assert m.map_metric() == pytest.approx(1.0)
+        assert m.mrr() == pytest.approx(1.0)
+
+    def test_no_hits(self):
+        m = AdvancedRankingMetrics([[4, 5, 6]], [[1, 2, 3]], k=3)
+        assert m.ndcg_at_k() == 0.0
+        assert m.mrr() == 0.0
+        assert m.recommended_fraction() == 0.0
+
+    def test_partial(self):
+        # relevant item at rank 2 of 2
+        m = AdvancedRankingMetrics([[9, 1]], [[1]], k=2)
+        assert m.precision_at_k() == pytest.approx(0.5)
+        assert m.mrr() == pytest.approx(0.5)
+        assert m.ndcg_at_k() == pytest.approx(1.0 / np.log2(3))
+
+    def test_evaluator_stage(self):
+        df = DataFrame({"recommendations": [[1, 2], [3, 4]],
+                        "labels": [[1], [9]]})
+        ev = RankingEvaluator(k=2, metric_name="precisionAtk")
+        assert ev.evaluate(df) == pytest.approx(0.25)
+        allm = ev.evaluate_all(df)
+        assert set(allm.columns) >= {"map", "ndcgAt", "precisionAtk"}
+
+
+class TestRankingAdapter:
+    def test_adapter_and_split(self, indexed):
+        _, df = indexed
+        train, valid = per_user_split(df, "user_idx", 0.75, seed=1)
+        assert train.num_rows + valid.num_rows == df.num_rows
+        # every user present in train
+        assert set(np.unique(train["user_idx"])) == \
+               set(np.unique(df["user_idx"]))
+        adapter = RankingAdapter(
+            recommender=SAR(support_threshold=1), k=5)
+        model = adapter.fit(train)
+        out = model.transform(valid)
+        assert "recommendations" in out and "labels" in out
+        score = RankingEvaluator(k=5, metric_name="recallAtK").evaluate(out)
+        assert 0.0 <= score <= 1.0
+
+    def test_train_validation_split_picks_best(self, indexed):
+        _, df = indexed
+        tvs = RankingTrainValidationSplit(
+            estimator=SAR(support_threshold=1),
+            evaluator=RankingEvaluator(k=5, metric_name="ndcgAt"),
+            param_maps=[{"similarity_function": "jaccard"},
+                        {"similarity_function": "cooccurrence"}],
+            seed=3)
+        model = tvs.fit(df)
+        assert len(model.validation_metrics) == 2
+        assert model.best_params["similarity_function"] in (
+            "jaccard", "cooccurrence")
+        recs = model.recommend_for_all_users(3)
+        assert recs.num_rows > 0
