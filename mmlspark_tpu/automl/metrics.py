@@ -57,18 +57,12 @@ def _auc(y: np.ndarray, score: np.ndarray) -> float:
     n_neg = len(y) - n_pos
     if n_pos == 0 or n_neg == 0:
         return 0.0
-    order = np.argsort(score, kind="stable")
-    ranks = np.empty(len(score), dtype=np.float64)
-    sorted_scores = score[order]
-    # average rank within each tie group
-    i = 0
-    while i < len(sorted_scores):
-        j = i
-        while j + 1 < len(sorted_scores) and \
-                sorted_scores[j + 1] == sorted_scores[i]:
-            j += 1
-        ranks[order[i:j + 1]] = 0.5 * (i + j) + 1.0
-        i = j + 1
+    # average rank within each tie group, fully vectorized
+    _, inv, counts = np.unique(score, return_inverse=True,
+                               return_counts=True)
+    ends = np.cumsum(counts)
+    avg_rank = (ends - counts + 1 + ends) / 2.0  # mean of 1-based positions
+    ranks = avg_rank[inv]
     rank_sum_pos = float(ranks[y == 1].sum())
     return (rank_sum_pos - n_pos * (n_pos + 1) / 2.0) / (n_pos * n_neg)
 
@@ -217,10 +211,13 @@ class ComputePerInstanceStatistics(Evaluator, HasLabelCol):
                         break
             if not levels:
                 levels = sorted(set(y_idx))
-            levels = list(levels)
-            y_idx = np.array([levels.index(v) for v in y_idx])
+            lookup = {v: i for i, v in enumerate(levels)}
+            y_idx = np.array([lookup.get(v, -1) for v in y_idx])
         y_idx = y_idx.astype(np.int64)
+        unseen = (y_idx < 0) | (y_idx >= prob.shape[1])
         p_true = prob[np.arange(len(prob)), np.clip(y_idx, 0,
                                                     prob.shape[1] - 1)]
-        return df.with_column("log_loss",
-                              -np.log(np.clip(p_true, 1e-15, 1.0)))
+        loss = -np.log(np.clip(p_true, 1e-15, 1.0))
+        # labels outside the training levels have no probability column
+        loss[unseen] = np.nan
+        return df.with_column("log_loss", loss)

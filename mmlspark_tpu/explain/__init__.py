@@ -1,0 +1,21 @@
+"""Model interpretation: LIME (tabular + image) and SLIC superpixels.
+
+Capability parity with the interpretation half of `src/image-featurizer/`
+(`LIME.scala`, `Superpixel.scala`), rebuilt TPU-first: perturbed samples
+are scored in batched jitted forwards and the per-row surrogate fits are
+vmapped device solves.
+"""
+
+from mmlspark_tpu.explain.superpixel import (
+    SuperpixelTransformer, slic_segments, segment_masks, apply_state,
+)
+from mmlspark_tpu.explain.lime import (
+    LIMEBase, TabularLIME, TabularLIMEModel, ImageLIME, ImageLIMEModel,
+    weighted_ridge_fits,
+)
+
+__all__ = [
+    "SuperpixelTransformer", "slic_segments", "segment_masks", "apply_state",
+    "LIMEBase", "TabularLIME", "TabularLIMEModel", "ImageLIME",
+    "ImageLIMEModel", "weighted_ridge_fits",
+]

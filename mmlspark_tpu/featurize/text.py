@@ -252,9 +252,10 @@ class PageSplitter(Transformer, HasInputCol, HasOutputCol):
             while len(s) > hi:
                 cut = -1
                 for m in boundary.finditer(s, lo, hi):
-                    cut = m.start()
-                    break
-                if cut <= 0:  # no boundary, or boundary at 0 (empty page)
+                    if m.start() > 0:  # a cut at 0 would make an empty page
+                        cut = m.start()
+                        break
+                if cut < 0:
                     cut = hi
                 pages.append(s[:cut])
                 s = s[cut:]

@@ -280,13 +280,19 @@ class RankingTrainValidationSplitModel(Model):
         return self.best_model.recommender_model.recommend_for_all_users(k)
 
     def _save_extra(self, path, arrays):
+        import json
         import os
         self.best_model.save(os.path.join(path, "inner"))
         arrays["validation_metrics"] = np.asarray(
             self.validation_metrics or [], dtype=np.float64)
+        with open(os.path.join(path, "best_params.json"), "w") as f:
+            json.dump(self.best_params or {}, f)
 
     def _load_extra(self, path, arrays):
+        import json
         import os
         from mmlspark_tpu.core.stage import PipelineStage
         self.best_model = PipelineStage.load(os.path.join(path, "inner"))
         self.validation_metrics = list(arrays["validation_metrics"])
+        with open(os.path.join(path, "best_params.json")) as f:
+            self.best_params = json.load(f)

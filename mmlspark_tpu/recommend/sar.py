@@ -19,9 +19,16 @@ Users are the batch axis, so multi-chip scoring shards users over the
 
 from __future__ import annotations
 
+import functools as _functools
 from typing import Optional
 
 import numpy as np
+
+
+def _jax_jit(fn, **kwargs):
+    """Deferred jax.jit so importing this module doesn't touch the backend."""
+    import jax
+    return jax.jit(fn, **kwargs)
 
 from mmlspark_tpu.core.dataframe import DataFrame, obj_col
 from mmlspark_tpu.core.params import Param, in_range, in_set
@@ -49,6 +56,30 @@ def _affinity_matrix(users: np.ndarray, items: np.ndarray,
     aff = np.zeros((n_users, n_items), dtype=np.float32)
     np.add.at(aff, (users, items), weights)
     return aff
+
+
+@_functools.partial(_jax_jit, static_argnames=("metric",))
+def _build_similarity(aff, metric, support_threshold):
+    """B = binarize(aff); C = B^T B (one MXU matmul); then the metric."""
+    import jax.numpy as jnp
+    b = (aff > 0).astype(jnp.float32)
+    cooc = b.T @ b
+    return _similarity_from_cooccurrence(cooc, metric, support_threshold)
+
+
+@_functools.partial(_jax_jit, static_argnames=("remove_seen",))
+def _score_users(aff, sim, remove_seen):
+    """scores = aff @ sim, with seen items masked out when asked.
+
+    Module-level jit: compiled once per (shape, remove_seen); aff/sim are
+    arguments, not baked-in constants, so repeated scoring calls hit the
+    trace cache.
+    """
+    import jax.numpy as jnp
+    s = aff @ sim
+    if remove_seen:
+        s = jnp.where(aff > 0, -jnp.inf, s)
+    return s
 
 
 def _similarity_from_cooccurrence(cooc, metric: str,
@@ -92,7 +123,6 @@ class SAR(Estimator):
     num_items = Param(None, "total item count (default: max index + 1)")
 
     def fit(self, df: DataFrame) -> "SARModel":
-        import jax
         import jax.numpy as jnp
 
         users = np.asarray(df[self.user_col], dtype=np.int64)
@@ -112,15 +142,9 @@ class SAR(Estimator):
                                self.time_decay_enabled,
                                self.time_decay_half_life)
 
-        # Co-occurrence C = B^T B (one MXU matmul) then similarity, jitted.
-        @jax.jit
-        def build_similarity(aff_dev):
-            b = (aff_dev > 0).astype(jnp.float32)
-            cooc = b.T @ b
-            return _similarity_from_cooccurrence(
-                cooc, self.similarity_function, self.support_threshold)
-
-        sim = np.asarray(build_similarity(jnp.asarray(aff)))
+        sim = np.asarray(_build_similarity(
+            jnp.asarray(aff), self.similarity_function,
+            jnp.float32(self.support_threshold)))
         return SARModel(user_col=self.user_col, item_col=self.item_col,
                         rating_col=self.rating_col,
                         affinity=aff, similarity=sim)
@@ -136,29 +160,32 @@ class SARModel(Model):
     similarity = Param(None, "(n_items, n_items) similarity", complex=True)
     remove_seen = Param(True, "exclude items the user already interacted with")
 
-    def _scores(self, user_rows: np.ndarray) -> np.ndarray:
-        import jax
+    def _scores(self, user_rows: np.ndarray,
+                remove_seen: bool) -> np.ndarray:
         import jax.numpy as jnp
-
-        @jax.jit
-        def score(aff):
-            s = aff @ jnp.asarray(self.similarity)
-            if self.remove_seen:
-                s = jnp.where(aff > 0, -jnp.inf, s)
-            return s
-
-        return np.asarray(score(jnp.asarray(self.affinity[user_rows])))
+        return np.asarray(_score_users(jnp.asarray(self.affinity[user_rows]),
+                                       jnp.asarray(self.similarity),
+                                       remove_seen))
 
     def recommend_for_all_users(self, k: int) -> DataFrame:
-        """Parity: SARModel.recommendForAllUsers (SARModel.scala:21)."""
+        """Parity: SARModel.recommendForAllUsers (SARModel.scala:21).
+
+        With ``remove_seen``, users with fewer than k unseen items get
+        shorter (ragged) recommendation lists rather than -inf fillers.
+        """
         n_users = self.affinity.shape[0]
-        scores = self._scores(np.arange(n_users))
+        scores = self._scores(np.arange(n_users), self.remove_seen)
         top = np.argsort(-scores, axis=1)[:, :k].astype(np.int32)
         ratings = np.take_along_axis(scores, top, axis=1)
+        recs, rats = [], []
+        for t, r in zip(top, ratings.astype(np.float32)):
+            valid = np.isfinite(r)
+            recs.append(t[valid])
+            rats.append(r[valid])
         return DataFrame({
             self.user_col: np.arange(n_users, dtype=np.int32),
-            "recommendations": obj_col(list(top)),
-            "ratings": obj_col(list(ratings.astype(np.float32))),
+            "recommendations": obj_col(recs),
+            "ratings": obj_col(rats),
         })
 
     def transform(self, df: DataFrame) -> DataFrame:
@@ -166,11 +193,7 @@ class SARModel(Model):
         users = np.asarray(df[self.user_col], dtype=np.int64)
         items = np.asarray(df[self.item_col], dtype=np.int64)
         uniq, inverse = np.unique(users, return_inverse=True)
-        remove_seen, self.remove_seen = self.remove_seen, False
-        try:
-            scores = self._scores(uniq)
-        finally:
-            self.remove_seen = remove_seen
+        scores = self._scores(uniq, remove_seen=False)
         return df.with_column("prediction",
                               scores[inverse, items].astype(np.float32))
 

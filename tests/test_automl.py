@@ -201,3 +201,15 @@ class TestReviewRegressions:
         levels = only_good.get_metadata("probability")["levels"]
         expected = -np.log(np.clip(prob[:, levels.index("good")], 1e-15, 1))
         np.testing.assert_allclose(out["log_loss"], expected, rtol=1e-5)
+
+    def test_per_instance_unseen_label_is_nan(self):
+        df = _binary_df()
+        model = TrainClassifier(
+            model=GBDTClassifier(**SMALL_GBDT), label_col="label").fit(df)
+        scored = model.transform(df.head(4))
+        weird = scored.with_column(
+            "label", np.array(["good", "UNSEEN", "bad", "good"],
+                              dtype=object))
+        out = ComputePerInstanceStatistics(label_col="label").evaluate(weird)
+        loss = np.asarray(out["log_loss"], dtype=np.float64)
+        assert np.isnan(loss[1]) and np.isfinite(loss[[0, 2, 3]]).all()

@@ -1,0 +1,165 @@
+"""Tests for LIME interpretation + SLIC superpixels.
+
+Parity model: `image-featurizer/src/test/scala/LIMESuite.scala`,
+`SuperpixelSuite.scala`.
+"""
+
+import numpy as np
+import pytest
+
+from mmlspark_tpu import DataFrame, PipelineStage
+from mmlspark_tpu.core.stage import Transformer
+from mmlspark_tpu.core.params import Param
+from mmlspark_tpu.explain import (
+    SuperpixelTransformer, slic_segments, segment_masks, apply_state,
+    TabularLIME, ImageLIME, weighted_ridge_fits,
+)
+
+
+class LinearScorer(Transformer):
+    """Deterministic model: score = x @ beta (vector input)."""
+
+    input_col = Param("features", "in")
+    beta = Param(None, "weights", complex=True)
+
+    def transform(self, df):
+        X = np.stack([np.asarray(v, dtype=np.float64)
+                      for v in df[self.input_col]])
+        return df.with_column("scores", X @ np.asarray(self.beta))
+
+    def _save_extra(self, path, arrays):
+        arrays["beta"] = np.asarray(self.beta)
+
+    def _load_extra(self, path, arrays):
+        self.beta = arrays["beta"]
+
+
+class PatchScorer(Transformer):
+    """Image model: score = mean brightness of the top-left quadrant."""
+
+    input_col = Param("image", "in")
+
+    def transform(self, df):
+        scores = []
+        for img in df[self.input_col]:
+            img = np.asarray(img, dtype=np.float64)
+            h, w = img.shape[:2]
+            scores.append(img[: h // 2, : w // 2].mean())
+        return df.with_column("scores", np.asarray(scores))
+
+
+class TestSlic:
+    def test_label_map_shape_and_contiguity(self):
+        img = np.random.default_rng(0).random((32, 32, 3)).astype(np.float32)
+        labels = slic_segments(img, cell_size=8)
+        assert labels.shape == (32, 32)
+        uniq = np.unique(labels)
+        assert uniq[0] == 0 and uniq[-1] == len(uniq) - 1
+        assert 4 <= len(uniq) <= 32
+
+    def test_segments_respect_color_blocks(self):
+        # two flat color halves -> no segment spans the boundary much
+        img = np.zeros((16, 16, 3), dtype=np.float32)
+        img[:, 8:] = 1.0
+        labels = slic_segments(img, cell_size=8, modifier=10.0)
+        left = set(np.unique(labels[:, :7]))
+        right = set(np.unique(labels[:, 9:]))
+        assert not left & right
+
+    def test_masks_and_apply_state(self):
+        img = np.ones((8, 8, 3), dtype=np.float32)
+        labels = slic_segments(img, cell_size=4)
+        masks = segment_masks(labels)
+        assert masks.sum(axis=0).max() == 1  # partition
+        state = np.zeros(masks.shape[0], dtype=bool)
+        censored = apply_state(img, labels, state, background=0.0)
+        assert censored.sum() == 0.0
+        state[:] = True
+        np.testing.assert_array_equal(apply_state(img, labels, state), img)
+
+    def test_transformer_stage(self):
+        rng = np.random.default_rng(1)
+        df = DataFrame({"image": [rng.random((16, 16, 3), )
+                                  for _ in range(3)]})
+        out = SuperpixelTransformer(cell_size=8).transform(df)
+        assert out["superpixels"][0].shape == (16, 16)
+
+
+class TestWeightedRidge:
+    def test_recovers_linear_model(self):
+        rng = np.random.default_rng(0)
+        X = rng.standard_normal((3, 200, 4))
+        beta = np.array([1.0, -2.0, 0.5, 0.0])
+        y = X @ beta + 3.0
+        w = np.ones((3, 200))
+        fit = weighted_ridge_fits(X, y, w, reg=1e-6)
+        np.testing.assert_allclose(fit[:, :4], np.tile(beta, (3, 1)),
+                                   atol=1e-3)
+        np.testing.assert_allclose(fit[:, 4], 3.0, atol=1e-3)
+
+    def test_weights_localize(self):
+        # two regimes; near-zero weight on the second -> fit ignores it
+        X = np.concatenate([np.linspace(-1, 1, 50)[:, None],
+                            np.linspace(5, 6, 50)[:, None]])[None]
+        y = np.concatenate([2 * np.linspace(-1, 1, 50),
+                            -np.ones(50)])[None]
+        w = np.concatenate([np.ones(50), 1e-9 * np.ones(50)])[None]
+        fit = weighted_ridge_fits(X, y, w, reg=1e-6)
+        assert fit[0, 0] == pytest.approx(2.0, abs=1e-2)
+
+
+class TestTabularLIME:
+    def test_explains_linear_model(self):
+        rng = np.random.default_rng(0)
+        X = rng.standard_normal((64, 3))
+        beta = np.array([2.0, -1.0, 0.0])
+        df = DataFrame({"features": list(X)})
+        lime = TabularLIME(model=LinearScorer(beta=beta),
+                           predict_col="scores", n_samples=256,
+                           kernel_width=5.0)
+        model = lime.fit(df)
+        out = model.transform(df.head(6))
+        W = np.stack(list(out["lime_weights"]))
+        assert W.shape == (6, 3)
+        # local surrogate of a global linear model recovers its coefs
+        np.testing.assert_allclose(W.mean(axis=0), beta, atol=0.15)
+
+    def test_save_load(self, tmp_path):
+        rng = np.random.default_rng(0)
+        df = DataFrame({"features": list(rng.standard_normal((32, 3)))})
+        lime = TabularLIME(model=LinearScorer(beta=np.ones(3)),
+                           predict_col="scores", n_samples=64)
+        model = lime.fit(df)
+        model.save(str(tmp_path / "lime"))
+        loaded = PipelineStage.load(str(tmp_path / "lime"))
+        a = model.transform(df.head(2))["lime_weights"]
+        b = loaded.transform(df.head(2))["lime_weights"]
+        np.testing.assert_allclose(np.stack(list(a)), np.stack(list(b)))
+
+
+class TestImageLIME:
+    def test_highlights_informative_quadrant(self):
+        rng = np.random.default_rng(0)
+        img = rng.random((16, 16, 3)).astype(np.float32) * 0.5 + 0.5
+        df = DataFrame({"image": [img]})
+        lime = ImageLIME(model=PatchScorer(), predict_col="scores",
+                         n_samples=128, cell_size=8, modifier=500.0,
+                         kernel_width=2.0).fit(df)
+        out = lime.transform(df)
+        weights = out["lime_weights"][0]
+        labels = out["superpixels"][0]
+        # the superpixel with the highest weight must lie in the scored
+        # (top-left) quadrant
+        best = int(np.argmax(weights))
+        ys, xs = np.nonzero(labels == best)
+        assert ys.mean() < 8 and xs.mean() < 8
+
+    def test_precomputed_superpixels_used(self):
+        img = np.ones((8, 8, 3), dtype=np.float32)
+        labels = np.zeros((8, 8), dtype=np.int32)
+        labels[:, 4:] = 1
+        df = DataFrame({"image": [img], "superpixels": [labels]})
+        lime = ImageLIME(model=PatchScorer(), predict_col="scores",
+                         n_samples=32).fit(df)
+        out = lime.transform(df)
+        assert len(out["lime_weights"][0]) == 2

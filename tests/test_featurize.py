@@ -178,3 +178,15 @@ class TestReviewRegressions:
                          output_col="features").fit(conv)
         X = feat.transform(conv)["features"]
         assert np.isfinite(np.asarray(X, dtype=np.float64)).all()
+
+    def test_page_splitter_prefers_inner_boundary(self):
+        from mmlspark_tpu.featurize import PageSplitter
+        df = DataFrame({"text": ["word " * 20]})
+        out = PageSplitter(input_col="text", output_col="pages",
+                           minimum_page_length=0,
+                           maximum_page_length=10).transform(df)
+        pages = out["pages"][0]
+        # every page breaks at whitespace, never mid-word
+        assert all(p.rstrip(" ").endswith("word") or p == " "
+                   for p in pages if p.strip())
+        assert "".join(pages) == "word " * 20

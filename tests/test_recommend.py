@@ -206,3 +206,26 @@ class TestRankingAdapter:
             "jaccard", "cooccurrence")
         recs = model.recommend_for_all_users(3)
         assert recs.num_rows > 0
+
+
+class TestReviewRegressions:
+    def test_tvs_best_params_roundtrip(self, indexed, tmp_path):
+        _, df = indexed
+        tvs = RankingTrainValidationSplit(
+            estimator=SAR(support_threshold=1),
+            evaluator=RankingEvaluator(k=3),
+            param_maps=[{"similarity_function": "jaccard"}])
+        model = tvs.fit(df)
+        model.save(str(tmp_path / "tvs"))
+        loaded = PipelineStage.load(str(tmp_path / "tvs"))
+        assert loaded.best_params == {"similarity_function": "jaccard"}
+
+    def test_remove_seen_truncates_instead_of_minus_inf(self):
+        # one user saw every item but one: only 1 recommendation comes back
+        df = DataFrame({"user_idx": [0, 0, 0], "item_idx": [0, 1, 2],
+                        "rating": [1.0, 1.0, 1.0]})
+        model = SAR(support_threshold=0, num_items=4).fit(df)
+        recs = model.recommend_for_all_users(3)
+        r = recs["recommendations"][0]
+        assert list(r) == [3]
+        assert np.isfinite(recs["ratings"][0]).all()
