@@ -29,6 +29,21 @@ from mmlspark_tpu.explain.superpixel import (
 )
 
 
+_solve_cache = []
+
+
+def _solve_all(Xb, yb, wb, reg):
+    import jax
+    import jax.numpy as jnp
+
+    def one(Xi, yi, wi):
+        Xw = Xi * wi[:, None]
+        A = Xw.T @ Xi + reg * jnp.eye(Xi.shape[1], dtype=Xi.dtype)
+        b = Xw.T @ yi
+        return jnp.linalg.solve(A, b)
+    return jax.vmap(one)(Xb, yb, wb)
+
+
 def weighted_ridge_fits(X: np.ndarray, y: np.ndarray, w: np.ndarray,
                         reg: float = 1e-3) -> np.ndarray:
     """Batched weighted ridge regressions.
@@ -36,26 +51,20 @@ def weighted_ridge_fits(X: np.ndarray, y: np.ndarray, w: np.ndarray,
     X: (R, S, D) perturbation designs, y: (R, S) model outputs,
     w: (R, S) locality weights -> (R, D+1) [coefs..., intercept] per row.
     One vmapped solve; the (D+1, D+1) normal matrices batch onto the MXU.
+    The jitted solver is module-cached so repeated batches (LIME loops)
+    hit the trace cache instead of recompiling.
     """
     import jax
     import jax.numpy as jnp
 
+    if not _solve_cache:
+        _solve_cache.append(jax.jit(_solve_all))
     Xb = jnp.concatenate(
         [jnp.asarray(X, jnp.float32),
          jnp.ones(X.shape[:2] + (1,), jnp.float32)], axis=-1)
-    yb = jnp.asarray(y, jnp.float32)
-    wb = jnp.asarray(w, jnp.float32)
-
-    @jax.jit
-    def solve_all(Xb, yb, wb):
-        def one(Xi, yi, wi):
-            Xw = Xi * wi[:, None]
-            A = Xw.T @ Xi + reg * jnp.eye(Xi.shape[1], dtype=Xi.dtype)
-            b = Xw.T @ yi
-            return jnp.linalg.solve(A, b)
-        return jax.vmap(one)(Xb, yb, wb)
-
-    return np.asarray(solve_all(Xb, yb, wb))
+    return np.asarray(_solve_cache[0](
+        Xb, jnp.asarray(y, jnp.float32), jnp.asarray(w, jnp.float32),
+        jnp.float32(reg)))
 
 
 def _model_scores(model: Transformer, df: DataFrame, input_col: str,

@@ -1,4 +1,16 @@
 from mmlspark_tpu.io.binary import read_binary_files
 from mmlspark_tpu.io.images import read_images, decode_image, encode_image
+from mmlspark_tpu.io.http import (
+    HTTPRequestData, HTTPResponseData, HTTPClient, HTTPTransformer,
+    SimpleHTTPTransformer, JSONInputParser, JSONOutputParser,
+    StringOutputParser, CustomInputParser, CustomOutputParser,
+    basic_handler, advanced_handler,
+)
 
-__all__ = ["read_binary_files", "read_images", "decode_image", "encode_image"]
+__all__ = [
+    "read_binary_files", "read_images", "decode_image", "encode_image",
+    "HTTPRequestData", "HTTPResponseData", "HTTPClient", "HTTPTransformer",
+    "SimpleHTTPTransformer", "JSONInputParser", "JSONOutputParser",
+    "StringOutputParser", "CustomInputParser", "CustomOutputParser",
+    "basic_handler", "advanced_handler",
+]

@@ -1,0 +1,207 @@
+"""Cognitive-service-style transformers + PowerBI-style writer.
+
+Capability parity with the reference's Cognitive Services layer
+(`io/http/src/main/scala/CognitiveServiceBase.scala:25-241`,
+`services/TextAnalytics.scala:184-248`, `services/ComputerVision.scala:180-474`,
+`services/AnamolyDetection.scala:118,131`) and the PowerBI writer
+(`io/powerbi/src/main/scala/PowerBIWriter.scala:25`). Per the build plan
+(SURVEY §7) the full ~25-transformer Azure catalog is out of scope; this
+provides the generic service base plus representative bindings as the
+capability proof. Every stage takes an explicit ``url`` so they run
+against any compatible endpoint (tests use localhost).
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+from mmlspark_tpu.core.dataframe import DataFrame, obj_col
+from mmlspark_tpu.core.params import Param, HasOutputCol, in_range
+from mmlspark_tpu.core.stage import Transformer
+from mmlspark_tpu.io.http import (
+    CustomInputParser, HTTPRequestData, JSONOutputParser,
+    SimpleHTTPTransformer,
+)
+
+
+class CognitiveServiceBase(Transformer, HasOutputCol):
+    """Shared plumbing: build a JSON request per row, send, parse.
+
+    Parity: `CognitiveServiceBase.scala:25-241` (HasServiceParams /
+    subscription key header / SimpleHTTPTransformer internals).
+    """
+
+    url = Param(None, "service endpoint", ptype=str)
+    subscription_key = Param(None, "subscription key header value")
+    concurrency = Param(4, "max in-flight requests", in_range(lo=1))
+    timeout = Param(60.0, "request timeout, s", in_range(lo=0.0))
+    error_col = Param("error", "failed-request info column")
+    output_col = Param("result", "parsed output column")
+
+    def _headers(self) -> Dict[str, str]:
+        h = {"Content-Type": "application/json"}
+        if self.subscription_key:
+            h["Ocp-Apim-Subscription-Key"] = self.subscription_key
+        return h
+
+    def _make_request(self, value: Any) -> Optional[HTTPRequestData]:
+        """Row value -> request; override per service."""
+        raise NotImplementedError
+
+    def _input_column(self) -> str:
+        raise NotImplementedError
+
+    def _output_parser(self) -> Transformer:
+        return JSONOutputParser()
+
+    def transform(self, df: DataFrame) -> DataFrame:
+        inner = SimpleHTTPTransformer(
+            input_col=self._input_column(), output_col=self.output_col,
+            input_parser=CustomInputParser(udf=self._make_request),
+            output_parser=self._output_parser(),
+            error_col=self.error_col, concurrency=self.concurrency,
+            timeout=self.timeout)
+        return inner.transform(df)
+
+
+class _TextAnalyticsBase(CognitiveServiceBase):
+    """Documents-array protocol shared by the text services.
+
+    Parity: TextAnalyticsBase (`TextAnalytics.scala`): rows become
+    ``{"documents": [{"id", "text", "language"?}]}`` requests.
+    """
+
+    text_col = Param("text", "input text column")
+    language = Param(None, "language hint")
+
+    def _input_column(self) -> str:
+        return self.text_col
+
+    def _make_request(self, value) -> Optional[HTTPRequestData]:
+        if value is None:
+            return None
+        doc: Dict[str, Any] = {"id": "0", "text": str(value)}
+        if self.language:
+            doc["language"] = self.language
+        return HTTPRequestData.post_json(
+            self.url, {"documents": [doc]}, self._headers())
+
+    def _output_parser(self) -> Transformer:
+        return JSONOutputParser(data_field="documents")
+
+
+class TextSentiment(_TextAnalyticsBase):
+    """Parity: `TextAnalytics.scala:184` (TextSentiment)."""
+
+
+class LanguageDetector(_TextAnalyticsBase):
+    """Parity: `TextAnalytics.scala` LanguageDetector."""
+
+
+class EntityDetector(_TextAnalyticsBase):
+    """Parity: `TextAnalytics.scala` EntityDetector."""
+
+
+class NER(_TextAnalyticsBase):
+    """Parity: `TextAnalytics.scala` NER."""
+
+
+class KeyPhraseExtractor(_TextAnalyticsBase):
+    """Parity: `TextAnalytics.scala` KeyPhraseExtractor."""
+
+
+class _ImageServiceBase(CognitiveServiceBase):
+    """Image-url protocol shared by the vision services."""
+
+    image_url_col = Param("image_url", "column of image URLs")
+
+    def _input_column(self) -> str:
+        return self.image_url_col
+
+    def _make_request(self, value) -> Optional[HTTPRequestData]:
+        if value is None:
+            return None
+        return HTTPRequestData.post_json(
+            self.url, {"url": str(value)}, self._headers())
+
+
+class AnalyzeImage(_ImageServiceBase):
+    """Parity: `ComputerVision.scala` AnalyzeImage."""
+
+
+class OCR(_ImageServiceBase):
+    """Parity: `ComputerVision.scala` OCR."""
+
+
+class DescribeImage(_ImageServiceBase):
+    """Parity: `ComputerVision.scala` DescribeImage."""
+
+
+class TagImage(_ImageServiceBase):
+    """Parity: `ComputerVision.scala` TagImage."""
+
+
+class DetectAnomalies(CognitiveServiceBase):
+    """Series-in, anomalies-out (parity: `AnamolyDetection.scala:118`).
+
+    The input column holds ``[{"timestamp": ..., "value": ...}, ...]``
+    series per row; the request wraps it with granularity.
+    """
+
+    series_col = Param("series", "column of timestamp/value series")
+    granularity = Param("daily", "series granularity")
+
+    def _input_column(self) -> str:
+        return self.series_col
+
+    def _make_request(self, value) -> Optional[HTTPRequestData]:
+        if value is None:
+            return None
+        if isinstance(value, np.ndarray):
+            value = value.tolist()
+        return HTTPRequestData.post_json(
+            self.url, {"series": list(value),
+                       "granularity": self.granularity}, self._headers())
+
+
+class PowerBIWriter:
+    """POST frame rows to a REST dataset endpoint in batches.
+
+    Parity: `PowerBIWriter.scala:25` — rows serialized as a JSON array per
+    batch with the advanced retry handler (throttling-aware).
+    """
+
+    def __init__(self, url: str, batch_size: int = 100,
+                 concurrency: int = 2, timeout: float = 30.0):
+        self.url = url
+        self.batch_size = int(batch_size)
+        self.concurrency = concurrency
+        self.timeout = timeout
+
+    def write(self, df: DataFrame) -> List[Dict[str, Any]]:
+        """Send all rows; returns a list of per-batch error dicts (empty
+        when everything succeeded)."""
+        from mmlspark_tpu.core.serialize import _jsonify
+        from mmlspark_tpu.io.http import HTTPClient, advanced_handler
+
+        reqs = []
+        rows = [_jsonify(row) for row in df.rows()]
+        for start in range(0, len(rows), self.batch_size):
+            reqs.append(HTTPRequestData.post_json(
+                self.url, rows[start:start + self.batch_size]))
+        client = HTTPClient(concurrency=self.concurrency,
+                            timeout=self.timeout, handler=advanced_handler)
+        try:
+            resps = client.send(reqs)
+        finally:
+            client.close()
+        errors = []
+        for i, r in enumerate(resps):
+            if r is None or not (200 <= r.status_code < 300):
+                errors.append({"batch": i,
+                               "status_code": getattr(r, "status_code", 0),
+                               "reason": getattr(r, "reason", "no response")})
+        return errors

@@ -285,8 +285,9 @@ class RankingTrainValidationSplitModel(Model):
         self.best_model.save(os.path.join(path, "inner"))
         arrays["validation_metrics"] = np.asarray(
             self.validation_metrics or [], dtype=np.float64)
+        from mmlspark_tpu.core.serialize import _json_default
         with open(os.path.join(path, "best_params.json"), "w") as f:
-            json.dump(self.best_params or {}, f)
+            json.dump(self.best_params or {}, f, default=_json_default)
 
     def _load_extra(self, path, arrays):
         import json
@@ -294,5 +295,7 @@ class RankingTrainValidationSplitModel(Model):
         from mmlspark_tpu.core.stage import PipelineStage
         self.best_model = PipelineStage.load(os.path.join(path, "inner"))
         self.validation_metrics = list(arrays["validation_metrics"])
-        with open(os.path.join(path, "best_params.json")) as f:
-            self.best_params = json.load(f)
+        params_file = os.path.join(path, "best_params.json")
+        if os.path.exists(params_file):  # absent in pre-fix checkpoints
+            with open(params_file) as f:
+                self.best_params = json.load(f)

@@ -25,10 +25,21 @@ from typing import Optional
 import numpy as np
 
 
-def _jax_jit(fn, **kwargs):
-    """Deferred jax.jit so importing this module doesn't touch the backend."""
-    import jax
-    return jax.jit(fn, **kwargs)
+def _lazy_jit(**jit_kwargs):
+    """jax.jit applied on first call, so importing this module neither
+    imports jax nor touches the backend; the jitted function is cached, so
+    repeated calls hit the trace cache (no per-call retrace)."""
+    def deco(fn):
+        compiled = []
+
+        @_functools.wraps(fn)
+        def wrapper(*args, **kwargs):
+            if not compiled:
+                import jax
+                compiled.append(jax.jit(fn, **jit_kwargs))
+            return compiled[0](*args, **kwargs)
+        return wrapper
+    return deco
 
 from mmlspark_tpu.core.dataframe import DataFrame, obj_col
 from mmlspark_tpu.core.params import Param, in_range, in_set
@@ -58,7 +69,7 @@ def _affinity_matrix(users: np.ndarray, items: np.ndarray,
     return aff
 
 
-@_functools.partial(_jax_jit, static_argnames=("metric",))
+@_lazy_jit(static_argnames=("metric",))
 def _build_similarity(aff, metric, support_threshold):
     """B = binarize(aff); C = B^T B (one MXU matmul); then the metric."""
     import jax.numpy as jnp
@@ -67,7 +78,7 @@ def _build_similarity(aff, metric, support_threshold):
     return _similarity_from_cooccurrence(cooc, metric, support_threshold)
 
 
-@_functools.partial(_jax_jit, static_argnames=("remove_seen",))
+@_lazy_jit(static_argnames=("remove_seen",))
 def _score_users(aff, sim, remove_seen):
     """scores = aff @ sim, with seen items masked out when asked.
 
@@ -165,7 +176,7 @@ class SARModel(Model):
         import jax.numpy as jnp
         return np.asarray(_score_users(jnp.asarray(self.affinity[user_rows]),
                                        jnp.asarray(self.similarity),
-                                       remove_seen))
+                                       remove_seen=remove_seen))
 
     def recommend_for_all_users(self, k: int) -> DataFrame:
         """Parity: SARModel.recommendForAllUsers (SARModel.scala:21).

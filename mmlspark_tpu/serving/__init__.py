@@ -1,0 +1,10 @@
+"""Serving: batched HTTP inference frontends + multi-host coordination.
+
+Capability parity with Spark Serving (`src/io/http` serving sources/sinks)
+rebuilt for the TPU execution model — see :mod:`mmlspark_tpu.serving.server`.
+"""
+
+from mmlspark_tpu.serving.server import ServingServer, ServingCoordinator
+from mmlspark_tpu.serving.consolidator import PartitionConsolidator
+
+__all__ = ["ServingServer", "ServingCoordinator", "PartitionConsolidator"]

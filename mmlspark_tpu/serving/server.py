@@ -1,0 +1,270 @@
+"""Serving: HTTP frontend -> batching queue -> jitted inference -> replies.
+
+Capability parity with Spark Serving (`HTTPSourceV2.scala:50,178,272`,
+`HTTPSinkV2.scala:20-106`, `DistributedHTTPSource.scala:89,244`,
+`ServingUDFs.scala:15`) rebuilt for the TPU execution model: instead of
+streaming rows through a query plan, each host runs an HTTP server whose
+requests are micro-batched into a columnar frame, pushed through any
+fitted Transformer (whose own jitted/sharded forward runs on TPU), and
+answered from the output columns. Request identity -> reply routing is
+the in-process equivalent of the reference's exchange-id state holder.
+
+Multi-host: workers register with a :class:`ServingCoordinator` (parity:
+DriverServiceUtils' coordination server, `HTTPSourceV2.scala:111-167`).
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+import uuid
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from queue import Empty, Queue
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+from mmlspark_tpu.core.dataframe import DataFrame
+from mmlspark_tpu.core.serialize import _jsonify
+from mmlspark_tpu.core.stage import Transformer
+
+
+class _Server(ThreadingHTTPServer):
+    # the stdlib default backlog (5) resets connections under bursty load;
+    # serving frontends must absorb a full batch's worth of simultaneous
+    # connects
+    request_queue_size = 1024
+    daemon_threads = True
+
+
+class _PendingRequest:
+    __slots__ = ("rid", "payload", "event", "reply", "status")
+
+    def __init__(self, payload: Any):
+        self.rid = uuid.uuid4().hex
+        self.payload = payload
+        self.event = threading.Event()
+        self.reply: Optional[bytes] = None
+        self.status = 200
+
+
+class ServingServer:
+    """One host's serving frontend.
+
+    ``model`` is any Transformer; request JSON objects become rows of a
+    micro-batched frame, ``reply_cols`` (default: columns the model added)
+    are returned per row as JSON.
+    """
+
+    def __init__(self, model: Transformer, host: str = "127.0.0.1",
+                 port: int = 0, api_path: str = "/predict",
+                 max_batch_size: int = 64, max_latency_ms: float = 10.0,
+                 reply_cols: Optional[List[str]] = None,
+                 request_timeout: float = 30.0):
+        self.model = model
+        self.api_path = api_path
+        self.max_batch_size = int(max_batch_size)
+        self.max_latency_ms = float(max_latency_ms)
+        self.reply_cols = reply_cols
+        self.request_timeout = request_timeout
+        self._queue: "Queue[_PendingRequest]" = Queue()
+        self._stop = threading.Event()
+        self._server = _Server((host, port), self._handler_class())
+        self.host, self.port = self._server.server_address[:2]
+        self._threads: List[threading.Thread] = []
+        self.n_requests = 0
+        self.n_batches = 0
+
+    # -- HTTP side -----------------------------------------------------------
+
+    def _handler_class(self):
+        serving = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def do_POST(self):
+                if self.path != serving.api_path:
+                    self.send_error(404)
+                    return
+                length = int(self.headers.get("Content-Length", 0))
+                try:
+                    payload = json.loads(self.rfile.read(length) or b"{}")
+                except ValueError:
+                    self.send_error(400, "invalid JSON")
+                    return
+                pending = _PendingRequest(payload)
+                serving._queue.put(pending)
+                if not pending.event.wait(serving.request_timeout):
+                    self.send_error(504, "inference timed out")
+                    return
+                body = pending.reply or b"{}"
+                self.send_response(pending.status)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def log_message(self, *args):  # quiet
+                pass
+
+        return Handler
+
+    # -- batching loop -------------------------------------------------------
+
+    def _collect_batch(self) -> List[_PendingRequest]:
+        try:
+            first = self._queue.get(timeout=0.05)
+        except Empty:
+            return []
+        batch = [first]
+        deadline = time.monotonic() + self.max_latency_ms / 1000.0
+        while len(batch) < self.max_batch_size:
+            remaining = deadline - time.monotonic()
+            if remaining <= 0:
+                break
+            try:
+                batch.append(self._queue.get(timeout=remaining))
+            except Empty:
+                break
+        return batch
+
+    def _serve_batch(self, batch: List[_PendingRequest]) -> None:
+        rows = [p.payload if isinstance(p.payload, dict) else
+                {"value": p.payload} for p in batch]
+        try:
+            df = DataFrame.from_rows(rows)
+            out = self.model.transform(df)
+            if out.num_rows != len(batch):
+                raise RuntimeError(
+                    f"model returned {out.num_rows} rows for a "
+                    f"{len(batch)}-request batch; serving models must "
+                    f"preserve row count")
+            cols = self.reply_cols or \
+                [c for c in out.columns if c not in df.columns]
+            replies = []
+            for row in out.select(cols).rows():
+                replies.append(json.dumps(_jsonify(row)).encode())
+            for p, r in zip(batch, replies):
+                p.reply = r
+                p.event.set()
+        except Exception as e:  # noqa: BLE001 — any model failure -> 500s
+            err = json.dumps({"error": str(e)}).encode()
+            for p in batch:
+                p.status = 500
+                p.reply = err
+                p.event.set()
+        self.n_batches += 1
+        self.n_requests += len(batch)
+
+    def _batch_loop(self):
+        while not self._stop.is_set():
+            batch = self._collect_batch()
+            if batch:
+                self._serve_batch(batch)
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def start(self) -> "ServingServer":
+        t_http = threading.Thread(target=self._server.serve_forever,
+                                  daemon=True)
+        t_batch = threading.Thread(target=self._batch_loop, daemon=True)
+        t_http.start()
+        t_batch.start()
+        self._threads = [t_http, t_batch]
+        return self
+
+    def stop(self):
+        self._stop.set()
+        self._server.shutdown()
+        self._server.server_close()
+        for t in self._threads:
+            t.join(timeout=5)
+
+    @property
+    def address(self) -> str:
+        return f"http://{self.host}:{self.port}{self.api_path}"
+
+    def __enter__(self):
+        return self.start()
+
+    def __exit__(self, *exc):
+        self.stop()
+
+
+class ServingCoordinator:
+    """Driver-side service registry for multi-host serving.
+
+    Parity: the coordination HttpServer in `HTTPSourceV2.scala:111-167` —
+    workers POST ``{"host": ..., "port": ...}`` to ``/register``; clients
+    GET ``/services`` for the worker list and round-robin between them.
+    """
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0):
+        self._services: List[Dict[str, Any]] = []
+        self._lock = threading.Lock()
+        coordinator = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def do_POST(self):
+                if self.path != "/register":
+                    self.send_error(404)
+                    return
+                length = int(self.headers.get("Content-Length", 0))
+                try:
+                    info = json.loads(self.rfile.read(length))
+                except ValueError:
+                    self.send_error(400, "invalid JSON")
+                    return
+                with coordinator._lock:
+                    coordinator._services.append(info)
+                self.send_response(200)
+                self.send_header("Content-Length", "2")
+                self.end_headers()
+                self.wfile.write(b"{}")
+
+            def do_GET(self):
+                if self.path != "/services":
+                    self.send_error(404)
+                    return
+                with coordinator._lock:
+                    body = json.dumps(coordinator._services).encode()
+                self.send_response(200)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def log_message(self, *args):
+                pass
+
+        self._server = _Server((host, port), Handler)
+        self.host, self.port = self._server.server_address[:2]
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self) -> "ServingCoordinator":
+        self._thread = threading.Thread(target=self._server.serve_forever,
+                                        daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self):
+        self._server.shutdown()
+        self._server.server_close()
+        if self._thread:
+            self._thread.join(timeout=5)
+
+    def services(self) -> List[Dict[str, Any]]:
+        with self._lock:
+            return list(self._services)
+
+    @staticmethod
+    def register_worker(coordinator_url: str, host: str, port: int):
+        import requests
+        requests.post(f"{coordinator_url}/register",
+                      json={"host": host, "port": port}, timeout=10)
+
+    def __enter__(self):
+        return self.start()
+
+    def __exit__(self, *exc):
+        self.stop()

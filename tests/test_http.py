@@ -1,0 +1,305 @@
+"""Tests for HTTP-on-columns, serving, and service bindings.
+
+Parity model: `io/http/src/test/scala/HTTPTransformerSuite.scala`,
+`SimpleHTTPTransformerSuite.scala`, `HTTPv2Suite.scala`,
+`DistributedHTTPSuite.scala` — like the reference, real HTTP servers on
+localhost ports stand in for remote services.
+"""
+
+import json
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import numpy as np
+import pytest
+import requests
+
+from mmlspark_tpu import DataFrame
+from mmlspark_tpu.core.params import Param
+from mmlspark_tpu.core.stage import Transformer
+from mmlspark_tpu.io.http import (
+    HTTPRequestData, HTTPResponseData, HTTPTransformer, HTTPClient,
+    JSONInputParser, JSONOutputParser, StringOutputParser,
+    CustomOutputParser, SimpleHTTPTransformer, advanced_handler,
+)
+from mmlspark_tpu.io.services import (
+    TextSentiment, DetectAnomalies, PowerBIWriter,
+)
+from mmlspark_tpu.serving import (
+    ServingServer, ServingCoordinator, PartitionConsolidator,
+)
+
+
+class _EchoHandler(BaseHTTPRequestHandler):
+    """Echoes JSON body back as {"echo": <payload>, "n": calls-so-far}."""
+
+    calls = 0
+    fail_first = 0  # set >0 to 429 the first N calls
+    lock = threading.Lock()
+
+    def do_POST(self):
+        cls = type(self)
+        with cls.lock:
+            cls.calls += 1
+            n = cls.calls
+            should_fail = cls.fail_first > 0
+            if should_fail:
+                cls.fail_first -= 1
+        if should_fail:
+            self.send_response(429)
+            self.send_header("Retry-After", "0.01")
+            self.send_header("Content-Length", "0")
+            self.end_headers()
+            return
+        length = int(self.headers.get("Content-Length", 0))
+        payload = json.loads(self.rfile.read(length) or b"null")
+        reply = {"echo": payload, "n": n}
+        if isinstance(payload, dict):
+            reply.update(payload)  # so field-extracting parsers see them
+        body = json.dumps(reply).encode()
+        self.send_response(200)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def log_message(self, *args):
+        pass
+
+
+@pytest.fixture()
+def echo_server():
+    class Handler(_EchoHandler):
+        calls = 0
+        fail_first = 0
+        lock = threading.Lock()
+
+    server = ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+    thread = threading.Thread(target=server.serve_forever, daemon=True)
+    thread.start()
+    url = f"http://127.0.0.1:{server.server_address[1]}"
+    yield url, Handler
+    server.shutdown()
+    server.server_close()
+
+
+class TestHTTPTransformer:
+    def test_round_trip(self, echo_server):
+        url, _ = echo_server
+        reqs = [HTTPRequestData.post_json(url, {"x": i}).to_dict()
+                for i in range(5)]
+        df = DataFrame({"request": reqs})
+        out = HTTPTransformer(concurrency=4).transform(df)
+        bodies = [HTTPResponseData(**r).json() for r in out["response"]]
+        assert [b["echo"]["x"] for b in bodies] == list(range(5))
+
+    def test_null_rows_pass_through(self, echo_server):
+        url, _ = echo_server
+        reqs = [HTTPRequestData.post_json(url, 1).to_dict(), None]
+        out = HTTPTransformer().transform(DataFrame({"request": reqs}))
+        assert out["response"][1] is None
+        assert out["response"][0] is not None
+
+    def test_retry_on_429(self, echo_server):
+        url, handler = echo_server
+        handler.fail_first = 2
+        client = HTTPClient(handler=advanced_handler)
+        resp = client.send([HTTPRequestData.post_json(url, "hi")])[0]
+        assert resp.status_code == 200
+        assert handler.calls == 3  # 2 throttles + 1 success
+
+    def test_transport_error_gives_status_zero(self):
+        df = DataFrame({"request": [
+            HTTPRequestData.post_json("http://127.0.0.1:9/none", 1).to_dict()
+        ]})
+        out = HTTPTransformer(handler="basic", timeout=0.5).transform(df)
+        assert out["response"][0]["status_code"] == 0
+
+
+class TestSimpleHTTPTransformer:
+    def test_json_pipeline(self, echo_server):
+        url, _ = echo_server
+        df = DataFrame({"value": [{"q": "a"}, {"q": "b"}]})
+        out = SimpleHTTPTransformer(
+            input_parser=JSONInputParser(url=url),
+            output_parser=JSONOutputParser(data_field="echo"),
+            output_col="parsed").transform(df)
+        assert [p["q"] for p in out["parsed"]] == ["a", "b"]
+        assert all(e is None for e in out["error"])
+
+    def test_error_column_on_404(self, echo_server):
+        url, _ = echo_server
+
+        class NotFoundParser(JSONInputParser):
+            pass
+
+        df = DataFrame({"value": [1]})
+        out = SimpleHTTPTransformer(
+            input_parser=JSONInputParser(url=url + "/missing_is_fine"),
+            handler="basic").transform(df)
+        # echo handler answers any path; use a GET to an invalid port for 404?
+        # simpler: transport failure -> status 0 -> error col set
+        out2 = SimpleHTTPTransformer(
+            input_parser=JSONInputParser(url="http://127.0.0.1:9/x"),
+            handler="basic", timeout=0.5).transform(df)
+        assert out2["error"][0] is not None
+        assert out2["parsed"][0] is None
+
+    def test_string_and_custom_parsers(self, echo_server):
+        url, _ = echo_server
+        df = DataFrame({"value": [{"k": 1}]})
+        out = SimpleHTTPTransformer(
+            input_parser=JSONInputParser(url=url),
+            output_parser=StringOutputParser(),
+            output_col="text").transform(df)
+        assert "echo" in out["text"][0]
+        out = SimpleHTTPTransformer(
+            input_parser=JSONInputParser(url=url),
+            output_parser=CustomOutputParser(
+                udf=lambda r: r.json()["n"]),
+            output_col="n").transform(df)
+        assert isinstance(out["n"][0], int)
+
+
+class DoubleIt(Transformer):
+    """Toy model for serving tests: doubles the 'x' column."""
+
+    def transform(self, df):
+        return df.with_column("y", np.asarray(df["x"], dtype=np.float64) * 2)
+
+
+class TestServing:
+    def test_single_requests(self):
+        with ServingServer(DoubleIt(), max_latency_ms=5) as srv:
+            r = requests.post(srv.address, json={"x": 21}, timeout=10)
+            assert r.status_code == 200
+            assert r.json() == {"y": 42.0}
+
+    def test_batching_under_load(self):
+        with ServingServer(DoubleIt(), max_batch_size=32,
+                           max_latency_ms=25) as srv:
+            results = {}
+
+            def hit(i):
+                results[i] = requests.post(
+                    srv.address, json={"x": i}, timeout=10).json()["y"]
+
+            threads = [threading.Thread(target=hit, args=(i,))
+                       for i in range(64)]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+            assert all(results[i] == 2.0 * i for i in range(64))
+            # batching actually happened (fewer batches than requests)
+            assert srv.n_batches < srv.n_requests
+
+    def test_model_error_gives_500(self):
+        class Boom(Transformer):
+            def transform(self, df):
+                raise RuntimeError("kaput")
+
+        with ServingServer(Boom(), max_latency_ms=5) as srv:
+            r = requests.post(srv.address, json={"x": 1}, timeout=10)
+            assert r.status_code == 500
+            assert "kaput" in r.json()["error"]
+
+    def test_bad_json_400_and_unknown_path_404(self):
+        with ServingServer(DoubleIt(), max_latency_ms=5) as srv:
+            r = requests.post(srv.address, data=b"{nope",
+                              headers={"Content-Type": "application/json"},
+                              timeout=10)
+            assert r.status_code == 400
+            r = requests.post(srv.address.replace("/predict", "/other"),
+                              json={}, timeout=10)
+            assert r.status_code == 404
+
+    def test_coordinator_registry(self):
+        with ServingCoordinator() as coord:
+            base = f"http://{coord.host}:{coord.port}"
+            ServingCoordinator.register_worker(base, "hostA", 1111)
+            ServingCoordinator.register_worker(base, "hostB", 2222)
+            services = requests.get(base + "/services", timeout=10).json()
+            assert {s["host"] for s in services} == {"hostA", "hostB"}
+            assert coord.services() == services
+
+
+class TestConsolidator:
+    def test_caps_concurrency(self):
+        active = []
+        peak = []
+        lock = threading.Lock()
+
+        class Slow(Transformer):
+            def transform(self, df):
+                with lock:
+                    active.append(1)
+                    peak.append(len(active))
+                time.sleep(0.02)
+                with lock:
+                    active.pop()
+                return df
+
+        stage = PartitionConsolidator(stage=Slow(), group="t1",
+                                      max_concurrency=1)
+        df = DataFrame({"x": [1.0]})
+        threads = [threading.Thread(target=stage.transform, args=(df,))
+                   for _ in range(6)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert max(peak) == 1
+
+
+class TestServices:
+    def test_text_sentiment_protocol(self, echo_server):
+        url, _ = echo_server
+        df = DataFrame({"text": ["great product", None]})
+        out = TextSentiment(url=url, subscription_key="k",
+                            language="en").transform(df)
+        doc = out["result"][0][0]  # parser extracted the documents array
+        assert doc["text"] == "great product"
+        assert doc["language"] == "en"
+        assert out["result"][1] is None  # null passthrough
+
+    def test_anomaly_protocol(self, echo_server):
+        url, _ = echo_server
+        series = [{"timestamp": "2020-01-01", "value": 1.0}]
+        df = DataFrame({"series": [series]})
+        out = DetectAnomalies(url=url).transform(df)
+        assert out["result"][0]["echo"]["granularity"] == "daily"
+
+    def test_powerbi_writer(self, echo_server):
+        url, handler = echo_server
+        df = DataFrame({"a": np.arange(250), "b": np.arange(250) * 1.0})
+        errors = PowerBIWriter(url, batch_size=100).write(df)
+        assert errors == []
+        assert handler.calls == 3  # 250 rows / 100 per batch
+
+    def test_powerbi_reports_failures(self):
+        df = DataFrame({"a": [1]})
+        errors = PowerBIWriter("http://127.0.0.1:9/x", timeout=0.5).write(df)
+        assert len(errors) == 1 and errors[0]["status_code"] == 0
+
+
+class TestReviewRegressions:
+    def test_row_dropping_model_gives_500_not_hang(self):
+        class Dropper(Transformer):
+            def transform(self, df):
+                return df.head(0).with_column("y", [])
+
+        with ServingServer(Dropper(), max_latency_ms=5,
+                           request_timeout=5) as srv:
+            t0 = time.time()
+            r = requests.post(srv.address, json={"x": 1}, timeout=10)
+            assert r.status_code == 500
+            assert "row count" in r.json()["error"]
+            assert time.time() - t0 < 4  # immediate, not a timeout
+
+    def test_coordinator_rejects_bad_json(self):
+        with ServingCoordinator() as coord:
+            r = requests.post(f"http://{coord.host}:{coord.port}/register",
+                              data=b"{bad", timeout=10)
+            assert r.status_code == 400
