@@ -4,7 +4,15 @@ from mmlspark_tpu.models.resnet import ResNet, ConvNet, cifar_resnet, cifar_conv
 from mmlspark_tpu.models.featurizer import ImageFeaturizer
 from mmlspark_tpu.models.trainer import NNLearner
 from mmlspark_tpu.models.zoo import ModelDownloader, ModelRepo, ModelSchema
+from mmlspark_tpu.models.transformer import (
+    TransformerConfig,
+    build_spmd_train_step,
+    init_params as init_transformer_params,
+    shard_params as shard_transformer_params,
+)
 
 __all__ = ["NNFunction", "LayeredModel", "NNModel", "NNLearner", "ResNet",
            "ConvNet", "cifar_resnet", "cifar_convnet", "ImageFeaturizer",
-           "ModelDownloader", "ModelRepo", "ModelSchema"]
+           "ModelDownloader", "ModelRepo", "ModelSchema",
+           "TransformerConfig", "build_spmd_train_step",
+           "init_transformer_params", "shard_transformer_params"]

@@ -12,9 +12,17 @@ from mmlspark_tpu.parallel.sharding import (
     shard_batch,
     unpad,
 )
+from mmlspark_tpu.parallel.ring_attention import (
+    dense_attention,
+    ring_attention,
+    ring_attention_local,
+)
 
 __all__ = [
     "MeshSpec",
+    "dense_attention",
+    "ring_attention",
+    "ring_attention_local",
     "build_mesh",
     "distributed_init",
     "local_device_count",

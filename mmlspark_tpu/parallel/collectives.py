@@ -45,8 +45,13 @@ def axis_index(axis: str):
     return lax.axis_index(axis)
 
 
-def shard_map_fn(fn, mesh, in_specs, out_specs, check_rep: bool = False):
-    """Wrap ``jax.shard_map`` with this framework's mesh conventions."""
-    from jax.experimental.shard_map import shard_map
-    return shard_map(fn, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
-                     check_rep=check_rep)
+def shard_map_fn(fn, mesh, in_specs, out_specs):
+    """Wrap ``jax.shard_map`` with this framework's mesh conventions.
+
+    VMA (varying-manual-axes) checking stays on: it is what makes
+    autodiff through manual collectives type-correct (psum/ppermute
+    transposes) — see models/transformer.py.
+    """
+    import jax
+    return jax.shard_map(fn, mesh=mesh, in_specs=in_specs,
+                         out_specs=out_specs)

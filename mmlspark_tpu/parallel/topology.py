@@ -48,6 +48,23 @@ class MeshSpec:
     def from_dict(shape: Dict[str, int]) -> "MeshSpec":
         return MeshSpec(tuple(shape.items()))
 
+    @staticmethod
+    def full_spmd(n_devices: int) -> "MeshSpec":
+        """All five axes over ``n_devices``: factors of 2 are handed to
+        ``model``, ``pipe``, ``seq``, ``expert`` in that order; the
+        remainder becomes ``data``. Every axis is always present so the
+        complete tp/pp/sp/ep/dp code path compiles and runs at any
+        device count (size-1 axes degenerate gracefully)."""
+        sizes = {AXIS_DATA: 1, AXIS_SEQ: 1, AXIS_MODEL: 1,
+                 AXIS_EXPERT: 1, AXIS_PIPE: 1}
+        rest = n_devices
+        for axis in (AXIS_MODEL, AXIS_PIPE, AXIS_SEQ, AXIS_EXPERT):
+            if rest % 2 == 0 and rest > 1:
+                sizes[axis] = 2
+                rest //= 2
+        sizes[AXIS_DATA] = rest
+        return MeshSpec.from_dict(sizes)
+
     def resolve(self, n_devices: int) -> Dict[str, int]:
         """Concrete per-axis sizes for a device count."""
         sizes = dict(self.axes)
