@@ -45,13 +45,16 @@ def axis_index(axis: str):
     return lax.axis_index(axis)
 
 
-def shard_map_fn(fn, mesh, in_specs, out_specs):
+def shard_map_fn(fn, mesh, in_specs, out_specs, check_vma: bool = True):
     """Wrap ``jax.shard_map`` with this framework's mesh conventions.
 
-    VMA (varying-manual-axes) checking stays on: it is what makes
-    autodiff through manual collectives type-correct (psum/ppermute
-    transposes) — see models/transformer.py.
+    VMA (varying-manual-axes) checking stays on by default: it is what
+    makes autodiff through manual collectives type-correct
+    (psum/ppermute transposes) — see models/transformer.py. Pass
+    ``check_vma=False`` only for forward-only programs whose replicated
+    outputs the type system cannot infer (e.g. returning an
+    ``all_gather`` result with a replicated out_spec).
     """
     import jax
     return jax.shard_map(fn, mesh=mesh, in_specs=in_specs,
-                         out_specs=out_specs)
+                         out_specs=out_specs, check_vma=check_vma)

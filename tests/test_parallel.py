@@ -85,7 +85,7 @@ class TestCollectives:
             return coll.allgather(xs, tiled=True)
 
         f = coll.shard_map_fn(gather, mesh, in_specs=P("data", None),
-                              out_specs=P(None, None))
+                              out_specs=P(None, None), check_vma=False)
         out = np.asarray(f(x))
         np.testing.assert_array_equal(out[:, 0], np.arange(8.0))
 
