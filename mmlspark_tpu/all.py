@@ -6,9 +6,25 @@ generic fuzzing test sweep.
 
 import mmlspark_tpu.core.stage  # noqa: F401
 import mmlspark_tpu.core.pipeline  # noqa: F401
+import mmlspark_tpu.stages.basic  # noqa: F401
+import mmlspark_tpu.stages.prep  # noqa: F401
 import mmlspark_tpu.stages.image  # noqa: F401
 import mmlspark_tpu.stages.batching  # noqa: F401
+import mmlspark_tpu.featurize.assemble  # noqa: F401
+import mmlspark_tpu.featurize.text  # noqa: F401
 import mmlspark_tpu.models.nn  # noqa: F401
 import mmlspark_tpu.models.trainer  # noqa: F401
 import mmlspark_tpu.models.featurizer  # noqa: F401
 import mmlspark_tpu.gbdt.stages  # noqa: F401
+import mmlspark_tpu.automl.train  # noqa: F401
+import mmlspark_tpu.automl.metrics  # noqa: F401
+import mmlspark_tpu.automl.best  # noqa: F401
+import mmlspark_tpu.automl.tune  # noqa: F401
+import mmlspark_tpu.recommend.indexer  # noqa: F401
+import mmlspark_tpu.recommend.ranking  # noqa: F401
+import mmlspark_tpu.recommend.sar  # noqa: F401
+import mmlspark_tpu.explain.lime  # noqa: F401
+import mmlspark_tpu.explain.superpixel  # noqa: F401
+import mmlspark_tpu.io.http  # noqa: F401
+import mmlspark_tpu.io.services  # noqa: F401
+import mmlspark_tpu.serving.consolidator  # noqa: F401

@@ -54,6 +54,20 @@ class PipelineStage(Params):
     def _load_extra(self, path: str, arrays: Dict[str, np.ndarray]) -> None:
         """Override to restore complex state saved by ``_save_extra``."""
 
+    def _save_substage(self, path: str, name: str) -> None:
+        """Persist a complex stage-valued param under ``path/name`` (None ok)."""
+        import os
+        stage = getattr(self, name)
+        if stage is not None:
+            stage.save(os.path.join(path, name))
+
+    def _load_substage(self, path: str, name: str) -> None:
+        """Restore a stage saved by ``_save_substage`` (missing -> None)."""
+        import os
+        sub = os.path.join(path, name)
+        if os.path.isdir(sub):
+            setattr(self, name, PipelineStage.load(sub))
+
     def __repr__(self) -> str:
         params = ", ".join(f"{k}={v!r}" for k, v in self._param_values.items())
         return f"{type(self).__name__}({params})"
@@ -114,12 +128,10 @@ class Timer(Estimator):
         return TimerModel(stage=inner)
 
     def _save_extra(self, path, arrays):
-        import os
-        self.stage.save(os.path.join(path, "inner"))
+        self._save_substage(path, "stage")
 
     def _load_extra(self, path, arrays):
-        import os
-        self.stage = PipelineStage.load(os.path.join(path, "inner"))
+        self._load_substage(path, "stage")
 
 
 class TimerModel(Model):
@@ -134,9 +146,7 @@ class TimerModel(Model):
         return out
 
     def _save_extra(self, path, arrays):
-        import os
-        self.stage.save(os.path.join(path, "inner"))
+        self._save_substage(path, "stage")
 
     def _load_extra(self, path, arrays):
-        import os
-        self.stage = PipelineStage.load(os.path.join(path, "inner"))
+        self._load_substage(path, "stage")

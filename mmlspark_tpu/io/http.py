@@ -369,3 +369,11 @@ class SimpleHTTPTransformer(Transformer, HasInputCol, HasOutputCol):
         out = out_parser.transform(work)
         out = out.with_column(self.error_col, obj_col(errors))
         return out.drop(req_col, resp_col)
+
+    def _save_extra(self, path, arrays):
+        self._save_substage(path, "input_parser")
+        self._save_substage(path, "output_parser")
+
+    def _load_extra(self, path, arrays):
+        self._load_substage(path, "input_parser")
+        self._load_substage(path, "output_parser")

@@ -335,6 +335,12 @@ class MultiColumnAdapter(Transformer):
             df = self.base_stage.copy(input_col=i, output_col=o).transform(df)
         return df
 
+    def _save_extra(self, path, arrays):
+        self._save_substage(path, "base_stage")
+
+    def _load_extra(self, path, arrays):
+        self._load_substage(path, "base_stage")
+
 
 class EnsembleByKey(Transformer):
     """Group rows by key column(s); average (or collect) value columns.

@@ -1,0 +1,629 @@
+"""Generic fuzzing sweep over every registered stage.
+
+Parity model: `core/test/fuzzing/src/test/scala/Fuzzing.scala` — every
+stage gets, for free: an *experiment* run (fit/transform executes), a
+*serialization* round-trip (save/load the stage, the fitted model, and a
+pipeline wrapping it; outputs must match), and a *determinism* check
+(two transforms agree).  `FuzzingTest.scala`'s reflection assertion maps
+to ``test_every_stage_has_fuzzing_objects``: each class in the registry
+must appear in FUZZING_OBJECTS, COVERED_BY_ESTIMATOR, or EXEMPT.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Callable, Optional
+
+import numpy as np
+import pytest
+
+from mmlspark_tpu import DataFrame
+from mmlspark_tpu.core.registry import all_stages
+from mmlspark_tpu.core.serialize import save_stage, load_stage
+from mmlspark_tpu.core.stage import Transformer, Estimator, Evaluator
+from mmlspark_tpu.core.pipeline import Pipeline, PipelineModel
+
+
+def _val_eq(a, b, rtol=1e-5, atol=1e-6) -> bool:
+    """Deep equality tolerant of nested arrays/dicts/lists in object cells."""
+    if a is None or b is None:
+        return a is None and b is None
+    if isinstance(a, dict) or isinstance(b, dict):
+        return (isinstance(a, dict) and isinstance(b, dict)
+                and a.keys() == b.keys()
+                and all(_val_eq(a[k], b[k], rtol, atol) for k in a))
+    if isinstance(a, (list, tuple, np.ndarray)) or \
+            isinstance(b, (list, tuple, np.ndarray)):
+        aa, bb = np.asarray(a), np.asarray(b)
+        if aa.shape != bb.shape:
+            return False
+        if aa.dtype == np.dtype("O") or bb.dtype == np.dtype("O"):
+            return all(_val_eq(x, y, rtol, atol)
+                       for x, y in zip(aa.ravel(), bb.ravel()))
+        if aa.dtype.kind in "if" and bb.dtype.kind in "if":
+            return bool(np.allclose(aa, bb, rtol=rtol, atol=atol,
+                                    equal_nan=True))
+        return bool((aa == bb).all())
+    if isinstance(a, float) and isinstance(b, float):
+        return bool(np.isclose(a, b, rtol=rtol, atol=atol, equal_nan=True))
+    return a == b
+
+
+def assert_df_eq(a, b, rtol=1e-5, atol=1e-6):
+    assert a.columns == b.columns, f"{a.columns} != {b.columns}"
+    assert a.num_rows == b.num_rows
+    for name in a.columns:
+        assert _val_eq(a[name], b[name], rtol, atol), f"column {name} differs"
+
+
+# --------------------------------------------------------------------------
+# input frames
+# --------------------------------------------------------------------------
+
+def _basic_df():
+    return DataFrame({
+        "numbers": np.array([0, 1, 2, 3], dtype=np.int64),
+        "doubles": np.array([0.0, 1.5, 2.5, 3.5]),
+        "words": ["guitars", "drums", "bass", "keys"],
+    })
+
+
+def _text_df():
+    return DataFrame({"text": ["the quick brown fox", "jumps over the dog",
+                               "pack my box", "five dozen jugs"]})
+
+
+def _tabular_df(n=96, seed=0):
+    rng = np.random.default_rng(seed)
+    x = rng.normal(size=(n, 3)).astype(np.float64)
+    y = (x[:, 0] + x[:, 1] > 0).astype(np.int64)
+    return DataFrame({"features": x, "label": y,
+                      "a": x[:, 0], "b": x[:, 1], "c": x[:, 2]})
+
+
+def _image_df(n=2, h=16, w=16, seed=0):
+    rng = np.random.default_rng(seed)
+    return DataFrame({
+        "image": rng.uniform(0, 255, size=(n, h, w, 3)).astype(np.float32)})
+
+
+def _events_df(seed=0):
+    rng = np.random.default_rng(seed)
+    n = 64
+    return DataFrame({
+        "user": [f"u{int(i)}" for i in rng.integers(0, 6, n)],
+        "item": [f"i{int(i)}" for i in rng.integers(0, 10, n)],
+        "user_idx": rng.integers(0, 6, n).astype(np.int64),
+        "item_idx": rng.integers(0, 10, n).astype(np.int64),
+        "rating": rng.integers(1, 6, n).astype(np.float64),
+    })
+
+
+def _scored_df():
+    df = _tabular_df()
+    p = 1.0 / (1.0 + np.exp(-(np.asarray(df["a"]) + np.asarray(df["b"]))))
+    return (df.with_column("prediction", (p > 0.5).astype(np.float64))
+              .with_column("probability", np.stack([1 - p, p], axis=1))
+              .with_column("raw_prediction", np.stack([-p, p], axis=1)))
+
+
+class _LinearScorer(Transformer):
+    """Deterministic stand-in model for LIME fuzzing."""
+    from mmlspark_tpu.core.params import Param
+    input_col = Param("features", "in")
+    beta = Param(None, "weights", complex=True)
+
+    def transform(self, df):
+        X = np.stack([np.asarray(v, dtype=np.float64)
+                      for v in df[self.input_col]])
+        return df.with_column("scores", X @ np.asarray(self.beta))
+
+    def _save_extra(self, path, arrays):
+        arrays["beta"] = np.asarray(self.beta)
+
+    def _load_extra(self, path, arrays):
+        self.beta = arrays["beta"]
+
+
+class _PatchScorer(Transformer):
+    def transform(self, df):
+        out = [float(np.asarray(v, dtype=np.float64).mean())
+               for v in df["image"]]
+        return df.with_column("scores", np.asarray(out))
+
+
+# --------------------------------------------------------------------------
+# fuzzing objects
+# --------------------------------------------------------------------------
+
+@dataclasses.dataclass
+class Case:
+    make: Callable[[], object]          # () -> stage
+    df: Callable[[], DataFrame]         # () -> input frame
+    experiment: bool = True             # run fit/transform
+    serialization: bool = True          # save/load round-trip
+    deterministic: bool = True          # transform twice must agree
+
+
+SMALL_GBDT = dict(num_iterations=8, num_leaves=7, min_data_in_leaf=5)
+
+
+def _gbdt_cls():
+    from mmlspark_tpu.gbdt.stages import GBDTClassifier
+    return GBDTClassifier(**SMALL_GBDT)
+
+
+def _gbdt_reg():
+    from mmlspark_tpu.gbdt.stages import GBDTRegressor
+    return GBDTRegressor(**SMALL_GBDT)
+
+
+def _mlp_learner(**kw):
+    from mmlspark_tpu.models.trainer import NNLearner
+    return NNLearner(arch={"builder": "mlp", "hidden": [8], "num_outputs": 2},
+                     epochs=1, batch_size=32, log_every=0, **kw)
+
+
+def _nn_model():
+    from mmlspark_tpu.models.function import NNFunction
+    from mmlspark_tpu.models.nn import NNModel
+    fn = NNFunction.init({"builder": "mlp", "hidden": [8], "num_outputs": 2},
+                         input_shape=(3,), seed=0)
+    return NNModel(model=fn, input_col="features", batch_size=32)
+
+
+def _image_featurizer():
+    from mmlspark_tpu.models.function import NNFunction
+    from mmlspark_tpu.models.featurizer import ImageFeaturizer
+    fn = NNFunction.init({"builder": "cifar_convnet"},
+                         input_shape=(16, 16, 3), seed=0)
+    return ImageFeaturizer(model=fn, cut_output_layers=1, batch_size=8)
+
+
+def _sar():
+    from mmlspark_tpu.recommend.sar import SAR
+    return SAR(support_threshold=1)
+
+
+FUZZING_OBJECTS = {}
+
+
+def case(name, **kw):
+    FUZZING_OBJECTS[name] = Case(**kw)
+
+
+B = "mmlspark_tpu.stages.basic."
+P = "mmlspark_tpu.stages.prep."
+I = "mmlspark_tpu.stages.image."
+BT = "mmlspark_tpu.stages.batching."
+F = "mmlspark_tpu.featurize."
+A = "mmlspark_tpu.automl."
+R = "mmlspark_tpu.recommend."
+E = "mmlspark_tpu.explain."
+H = "mmlspark_tpu.io.http."
+S = "mmlspark_tpu.io.services."
+
+# ---- core ----------------------------------------------------------------
+case("mmlspark_tpu.core.stage.Timer",
+     make=lambda: __import__("mmlspark_tpu.core.stage", fromlist=["Timer"])
+         .Timer(stage=_LinearScorer(beta=np.ones(3))),
+     df=_tabular_df, serialization=False)  # wraps a test-local class
+case("mmlspark_tpu.core.pipeline.Pipeline",
+     make=lambda: Pipeline(stages=[_dc(["c"])]), df=_tabular_df,
+     serialization=False)  # pipeline round-trip tested per-stage below
+
+# ---- basic stages --------------------------------------------------------
+def _dc(cols):
+    from mmlspark_tpu.stages.basic import DropColumns
+    return DropColumns(cols=cols)
+
+def _mk(mod, cls, **kw):
+    def f():
+        m = __import__(mod, fromlist=[cls])
+        return getattr(m, cls)(**kw)
+    return f
+
+case(B + "DropColumns", make=_mk("mmlspark_tpu.stages.basic", "DropColumns",
+     cols=["words"]), df=_basic_df)
+case(B + "SelectColumns", make=_mk("mmlspark_tpu.stages.basic",
+     "SelectColumns", cols=["words"]), df=_basic_df)
+case(B + "RenameColumn", make=_mk("mmlspark_tpu.stages.basic", "RenameColumn",
+     input_col="words", output_col="w"), df=_basic_df)
+case(B + "Repartition", make=_mk("mmlspark_tpu.stages.basic", "Repartition",
+     n=2), df=_basic_df)
+case(B + "Cacher", make=_mk("mmlspark_tpu.stages.basic", "Cacher"),
+     df=_basic_df)
+case(B + "CheckpointData",
+     make=lambda: __import__("mmlspark_tpu.stages.basic",
+                             fromlist=["CheckpointData"])
+         .CheckpointData(path=__import__("tempfile").mkdtemp()),
+     df=_basic_df, serialization=False)  # path is run-local scratch
+case(B + "Explode",
+     make=_mk("mmlspark_tpu.stages.basic", "Explode", input_col="vals",
+              output_col="v"),
+     df=lambda: DataFrame({"vals": [[1, 2], [3]], "k": ["a", "b"]}))
+case(B + "Lambda", make=_mk("mmlspark_tpu.stages.basic", "Lambda",
+     transform_fn=lambda d: d.head(2)), df=_basic_df, serialization=False)
+case(B + "UDFTransformer", make=_mk("mmlspark_tpu.stages.basic",
+     "UDFTransformer", input_col="numbers", output_col="sq",
+     udf=lambda x: x * x), df=_basic_df, serialization=False)
+case(B + "TextPreprocessor", make=_mk("mmlspark_tpu.stages.basic",
+     "TextPreprocessor", input_col="text", output_col="o",
+     map={"quick": "slow"}), df=_text_df)
+case(B + "UnicodeNormalize", make=_mk("mmlspark_tpu.stages.basic",
+     "UnicodeNormalize", input_col="text", output_col="o"), df=_text_df)
+case(B + "ClassBalancer", make=_mk("mmlspark_tpu.stages.basic",
+     "ClassBalancer", input_col="label", output_col="w"), df=_tabular_df)
+case(B + "PartitionSample", make=_mk("mmlspark_tpu.stages.basic",
+     "PartitionSample", mode="head", count=2), df=_basic_df)
+case(B + "MultiColumnAdapter",
+     make=lambda: __import__("mmlspark_tpu.stages.basic",
+                             fromlist=["MultiColumnAdapter"])
+         .MultiColumnAdapter(
+             base_stage=__import__("mmlspark_tpu.stages.basic",
+                                   fromlist=["UnicodeNormalize"])
+                 .UnicodeNormalize(),
+             input_cols=["text"], output_cols=["o"]),
+     df=_text_df)
+case(B + "EnsembleByKey",
+     make=_mk("mmlspark_tpu.stages.basic", "EnsembleByKey", keys=["k"],
+              cols=["x"]),
+     df=lambda: DataFrame({"k": ["a", "a", "b"],
+                           "x": np.array([1.0, 2.0, 3.0])}))
+case(B + "SummarizeData", make=_mk("mmlspark_tpu.stages.basic",
+     "SummarizeData"), df=_basic_df)
+
+# ---- prep ----------------------------------------------------------------
+case(P + "ValueIndexer", make=_mk("mmlspark_tpu.stages.prep", "ValueIndexer",
+     input_col="words", output_col="idx"), df=_basic_df)
+case(P + "IndexToValue",
+     make=_mk("mmlspark_tpu.stages.prep", "IndexToValue", input_col="cat",
+              output_col="orig"),
+     df=lambda: __import__("mmlspark_tpu.stages.prep",
+                           fromlist=["ValueIndexer"])
+         .ValueIndexer(input_col="words", output_col="cat")
+         .fit(_basic_df()).transform(_basic_df()))
+case(P + "CleanMissingData",
+     make=_mk("mmlspark_tpu.stages.prep", "CleanMissingData",
+              input_cols=["a"]),
+     df=lambda: DataFrame({"a": np.array([1.0, np.nan, 3.0, 4.0])}))
+case(P + "DataConversion", make=_mk("mmlspark_tpu.stages.prep",
+     "DataConversion", cols=["numbers"], convert_to="double"), df=_basic_df)
+
+# ---- image / batching ----------------------------------------------------
+case(I + "ImageTransformer",
+     make=lambda: __import__("mmlspark_tpu.stages.image",
+                             fromlist=["ImageTransformer"])
+         .ImageTransformer().resize(8, 8).flip(),
+     df=_image_df)
+case(I + "ResizeImageTransformer", make=_mk("mmlspark_tpu.stages.image",
+     "ResizeImageTransformer", height=8, width=8), df=_image_df)
+case(I + "UnrollImage", make=_mk("mmlspark_tpu.stages.image", "UnrollImage"),
+     df=_image_df)
+case(I + "UnrollBinaryImage",
+     make=_mk("mmlspark_tpu.stages.image", "UnrollBinaryImage", height=8,
+              width=8),
+     df=lambda: DataFrame({"bytes": [
+         __import__("mmlspark_tpu.io.images", fromlist=["encode_image"])
+         .encode_image(np.zeros((8, 8, 3), dtype=np.uint8), "bmp")]}))
+case(I + "ImageSetAugmenter", make=_mk("mmlspark_tpu.stages.image",
+     "ImageSetAugmenter"), df=_image_df)
+case(BT + "FixedMiniBatchTransformer", make=_mk("mmlspark_tpu.stages.batching",
+     "FixedMiniBatchTransformer", batch_size=3), df=_basic_df)
+case(BT + "DynamicMiniBatchTransformer",
+     make=_mk("mmlspark_tpu.stages.batching", "DynamicMiniBatchTransformer"),
+     df=_basic_df)
+case(BT + "FlattenBatch",
+     make=_mk("mmlspark_tpu.stages.batching", "FlattenBatch"),
+     df=lambda: __import__("mmlspark_tpu.stages.batching",
+                           fromlist=["FixedMiniBatchTransformer"])
+         .FixedMiniBatchTransformer(batch_size=2).transform(_basic_df()))
+
+# ---- featurize -----------------------------------------------------------
+case(F + "assemble.VectorAssembler", make=_mk(
+     "mmlspark_tpu.featurize.assemble", "VectorAssembler",
+     input_cols=["a", "b"], output_col="f"), df=_tabular_df)
+case(F + "assemble.Featurize", make=_mk("mmlspark_tpu.featurize.assemble",
+     "Featurize", feature_columns=["a", "b"], output_col="f"),
+     df=_tabular_df)
+case(F + "text.Tokenizer", make=_mk("mmlspark_tpu.featurize.text",
+     "Tokenizer", input_col="text", output_col="toks"), df=_text_df)
+case(F + "text.StopWordsRemover",
+     make=_mk("mmlspark_tpu.featurize.text", "StopWordsRemover",
+              input_col="toks", output_col="ns"),
+     df=lambda: __import__("mmlspark_tpu.featurize.text",
+                           fromlist=["Tokenizer"])
+         .Tokenizer(input_col="text", output_col="toks")
+         .transform(_text_df()))
+case(F + "text.NGram",
+     make=_mk("mmlspark_tpu.featurize.text", "NGram", input_col="toks",
+              output_col="bi"),
+     df=lambda: __import__("mmlspark_tpu.featurize.text",
+                           fromlist=["Tokenizer"])
+         .Tokenizer(input_col="text", output_col="toks")
+         .transform(_text_df()))
+case(F + "text.MultiNGram",
+     make=_mk("mmlspark_tpu.featurize.text", "MultiNGram", input_col="toks",
+              output_col="g", lengths=[1, 2]),
+     df=lambda: __import__("mmlspark_tpu.featurize.text",
+                           fromlist=["Tokenizer"])
+         .Tokenizer(input_col="text", output_col="toks")
+         .transform(_text_df()))
+case(F + "text.HashingTF",
+     make=_mk("mmlspark_tpu.featurize.text", "HashingTF", input_col="toks",
+              output_col="tf", num_features=16),
+     df=lambda: __import__("mmlspark_tpu.featurize.text",
+                           fromlist=["Tokenizer"])
+         .Tokenizer(input_col="text", output_col="toks")
+         .transform(_text_df()))
+case(F + "text.IDF",
+     make=_mk("mmlspark_tpu.featurize.text", "IDF", input_col="tf",
+              output_col="tfidf"),
+     df=lambda: __import__("mmlspark_tpu.featurize.text",
+                           fromlist=["Tokenizer", "HashingTF"])
+         .HashingTF(input_col="toks", output_col="tf", num_features=16)
+         .transform(__import__("mmlspark_tpu.featurize.text",
+                               fromlist=["Tokenizer"])
+                    .Tokenizer(input_col="text", output_col="toks")
+                    .transform(_text_df())))
+case(F + "text.TextFeaturizer", make=_mk("mmlspark_tpu.featurize.text",
+     "TextFeaturizer", input_col="text", output_col="f", num_features=16),
+     df=_text_df)
+case(F + "text.PageSplitter", make=_mk("mmlspark_tpu.featurize.text",
+     "PageSplitter", input_col="text", output_col="pages",
+     maximum_page_length=10, minimum_page_length=5), df=_text_df)
+
+# ---- gbdt / nn / automl --------------------------------------------------
+case("mmlspark_tpu.gbdt.stages.GBDTClassifier", make=_gbdt_cls,
+     df=_tabular_df)
+case("mmlspark_tpu.gbdt.stages.GBDTRegressor", make=_gbdt_reg,
+     df=lambda: DataFrame({"features": np.random.default_rng(0)
+                           .normal(size=(96, 3)),
+                           "label": np.random.default_rng(1)
+                           .normal(size=96)}))
+case("mmlspark_tpu.models.trainer.NNLearner", make=_mlp_learner,
+     df=_tabular_df)
+case("mmlspark_tpu.models.nn.NNModel", make=_nn_model, df=_tabular_df)
+case("mmlspark_tpu.models.featurizer.ImageFeaturizer",
+     make=_image_featurizer, df=_image_df)
+case(A + "train.TrainClassifier",
+     make=lambda: __import__("mmlspark_tpu.automl.train",
+                             fromlist=["TrainClassifier"])
+         .TrainClassifier(model=_gbdt_cls(), label_col="label"),
+     df=_tabular_df)
+case(A + "train.TrainRegressor",
+     make=lambda: __import__("mmlspark_tpu.automl.train",
+                             fromlist=["TrainRegressor"])
+         .TrainRegressor(model=_gbdt_reg(), label_col="c"),
+     df=_tabular_df)
+case(A + "metrics.ComputeModelStatistics", make=_mk(
+     "mmlspark_tpu.automl.metrics", "ComputeModelStatistics",
+     label_col="label", scored_labels_col="prediction",
+     scored_probabilities_col="probability"), df=_scored_df)
+case(A + "metrics.ComputePerInstanceStatistics", make=_mk(
+     "mmlspark_tpu.automl.metrics", "ComputePerInstanceStatistics",
+     label_col="label"), df=_scored_df)
+case(A + "best.FindBestModel",
+     make=lambda: __import__("mmlspark_tpu.automl.best",
+                             fromlist=["FindBestModel"])
+         .FindBestModel(models=[
+             __import__("mmlspark_tpu.automl.train",
+                        fromlist=["TrainClassifier"])
+             .TrainClassifier(model=_gbdt_cls(), label_col="label")
+             .fit(_tabular_df())],
+             label_col="label", evaluation_metric="accuracy"),
+     df=_tabular_df)
+case(A + "tune.TuneHyperparameters",
+     make=lambda: __import__("mmlspark_tpu.automl.tune",
+                             fromlist=["TuneHyperparameters",
+                                       "DiscreteHyperParam"])
+         .TuneHyperparameters(
+             models=[__import__("mmlspark_tpu.automl.train",
+                                fromlist=["TrainClassifier"])
+                     .TrainClassifier(model=_gbdt_cls(), label_col="label")],
+             param_space={"num_leaves": __import__(
+                 "mmlspark_tpu.automl.tune",
+                 fromlist=["DiscreteHyperParam"]).DiscreteHyperParam([3, 7])},
+             evaluation_metric="accuracy", num_folds=2, num_runs=2,
+             parallelism=1, seed=3),
+     df=_tabular_df)
+
+# ---- recommend -----------------------------------------------------------
+case(R + "indexer.RecommendationIndexer", make=_mk(
+     "mmlspark_tpu.recommend.indexer", "RecommendationIndexer",
+     user_input_col="user", item_input_col="item"), df=_events_df)
+case(R + "sar.SAR", make=_sar, df=_events_df)
+case(R + "ranking.RankingAdapter",
+     make=lambda: __import__("mmlspark_tpu.recommend.ranking",
+                             fromlist=["RankingAdapter"])
+         .RankingAdapter(recommender=_sar(), k=3),
+     df=_events_df)
+case(R + "ranking.RankingEvaluator",
+     make=_mk("mmlspark_tpu.recommend.ranking", "RankingEvaluator", k=2),
+     df=lambda: DataFrame({"recommendations": [[1, 2], [3, 4]],
+                           "labels": [[1], [4]]}))
+case(R + "ranking.RankingTrainValidationSplit",
+     make=lambda: __import__("mmlspark_tpu.recommend.ranking",
+                             fromlist=["RankingTrainValidationSplit",
+                                       "RankingEvaluator"])
+         .RankingTrainValidationSplit(
+             estimator=_sar(),
+             evaluator=__import__("mmlspark_tpu.recommend.ranking",
+                                  fromlist=["RankingEvaluator"])
+             .RankingEvaluator(k=3),
+             param_maps=[{"similarity_function": "jaccard"}]),
+     df=_events_df)
+
+# ---- explain -------------------------------------------------------------
+case(E + "superpixel.SuperpixelTransformer", make=_mk(
+     "mmlspark_tpu.explain.superpixel", "SuperpixelTransformer", cell_size=8),
+     df=_image_df)
+case(E + "lime.TabularLIME",
+     make=lambda: __import__("mmlspark_tpu.explain.lime",
+                             fromlist=["TabularLIME"])
+         .TabularLIME(model=_LinearScorer(beta=np.ones(3)), n_samples=32,
+                      sample_batch=4),
+     df=_tabular_df, serialization=False)  # model is a test-local class
+case(E + "lime.ImageLIME",
+     make=lambda: __import__("mmlspark_tpu.explain.lime",
+                             fromlist=["ImageLIME"])
+         .ImageLIME(model=_PatchScorer(), predict_col="scores", n_samples=8,
+                    sample_batch=4, cell_size=8),
+     df=_image_df, serialization=False)
+
+# ---- http / services (network stages: construction + persistence only) ---
+case(H + "HTTPTransformer", make=_mk("mmlspark_tpu.io.http",
+     "HTTPTransformer", concurrency=2), df=_basic_df, experiment=False)
+case(H + "SimpleHTTPTransformer",
+     make=lambda: __import__("mmlspark_tpu.io.http",
+                             fromlist=["SimpleHTTPTransformer",
+                                       "JSONInputParser"])
+         .SimpleHTTPTransformer(
+             input_parser=__import__("mmlspark_tpu.io.http",
+                                     fromlist=["JSONInputParser"])
+             .JSONInputParser(url="http://127.0.0.1:9/x")),
+     df=_basic_df, experiment=False)
+case(H + "JSONInputParser", make=_mk("mmlspark_tpu.io.http",
+     "JSONInputParser", url="http://127.0.0.1:9/x"),
+     df=lambda: DataFrame({"value": [{"q": 1}, {"q": 2}]}))
+case(H + "JSONOutputParser", make=_mk("mmlspark_tpu.io.http",
+     "JSONOutputParser"), df=_basic_df, experiment=False)
+case(H + "StringOutputParser", make=_mk("mmlspark_tpu.io.http",
+     "StringOutputParser"), df=_basic_df, experiment=False)
+case(H + "CustomInputParser", make=_mk("mmlspark_tpu.io.http",
+     "CustomInputParser", udf=lambda v: v), df=_basic_df,
+     experiment=False, serialization=False)
+case(H + "CustomOutputParser", make=_mk("mmlspark_tpu.io.http",
+     "CustomOutputParser", udf=lambda r: r), df=_basic_df,
+     experiment=False, serialization=False)
+for _svc in ("TextSentiment", "LanguageDetector", "EntityDetector", "NER",
+             "KeyPhraseExtractor", "AnalyzeImage", "OCR", "DescribeImage",
+             "TagImage", "DetectAnomalies"):
+    case(S + _svc, make=_mk("mmlspark_tpu.io.services", _svc,
+         url="http://127.0.0.1:9/x"), df=_basic_df, experiment=False)
+case("mmlspark_tpu.serving.consolidator.PartitionConsolidator",
+     make=lambda: __import__("mmlspark_tpu.serving.consolidator",
+                             fromlist=["PartitionConsolidator"])
+         .PartitionConsolidator(stage=_LinearScorer(beta=np.ones(3)),
+                                group="fuzz"),
+     df=_tabular_df, serialization=False)
+
+# Models produced (and therefore exercised) by fitting these estimators.
+COVERED_BY_ESTIMATOR = {
+    "mmlspark_tpu.core.stage.TimerModel": "mmlspark_tpu.core.stage.Timer",
+    "mmlspark_tpu.core.pipeline.PipelineModel":
+        "mmlspark_tpu.core.pipeline.Pipeline",
+    B + "ClassBalancerModel": B + "ClassBalancer",
+    P + "ValueIndexerModel": P + "ValueIndexer",
+    P + "CleanMissingDataModel": P + "CleanMissingData",
+    F + "assemble.FeaturizeModel": F + "assemble.Featurize",
+    F + "text.IDFModel": F + "text.IDF",
+    F + "text.TextFeaturizerModel": F + "text.TextFeaturizer",
+    "mmlspark_tpu.gbdt.stages.GBDTClassificationModel":
+        "mmlspark_tpu.gbdt.stages.GBDTClassifier",
+    "mmlspark_tpu.gbdt.stages.GBDTRegressionModel":
+        "mmlspark_tpu.gbdt.stages.GBDTRegressor",
+    A + "train.TrainedClassifierModel": A + "train.TrainClassifier",
+    A + "train.TrainedRegressorModel": A + "train.TrainRegressor",
+    A + "best.BestModel": A + "best.FindBestModel",
+    A + "tune.TuneHyperparametersModel": A + "tune.TuneHyperparameters",
+    R + "indexer.RecommendationIndexerModel":
+        R + "indexer.RecommendationIndexer",
+    R + "ranking.RankingAdapterModel": R + "ranking.RankingAdapter",
+    R + "ranking.RankingTrainValidationSplitModel":
+        R + "ranking.RankingTrainValidationSplit",
+    R + "sar.SARModel": R + "sar.SAR",
+    E + "lime.TabularLIMEModel": E + "lime.TabularLIME",
+    E + "lime.ImageLIMEModel": E + "lime.ImageLIME",
+}
+
+# Abstract bases / infra that cannot be fuzzed standalone.
+EXEMPT = {
+    "mmlspark_tpu.core.stage.Transformer",
+    "mmlspark_tpu.core.stage.Estimator",
+    "mmlspark_tpu.core.stage.Model",
+    "mmlspark_tpu.core.stage.Evaluator",
+    "mmlspark_tpu.explain.lime.LIMEBase",
+    "mmlspark_tpu.io.services.CognitiveServiceBase",
+}
+
+
+# --------------------------------------------------------------------------
+# the sweep
+# --------------------------------------------------------------------------
+
+def test_every_stage_has_fuzzing_objects():
+    """Parity: FuzzingTest.scala's reflection assertion."""
+    missing = []
+    for name in all_stages():
+        if (name not in FUZZING_OBJECTS and name not in COVERED_BY_ESTIMATOR
+                and name not in EXEMPT):
+            missing.append(name)
+    assert not missing, f"stages without fuzzing objects: {missing}"
+
+
+_IDS = sorted(FUZZING_OBJECTS)
+
+
+def _run(stage, df):
+    """fit/evaluate/transform as appropriate; return output DF or None."""
+    if isinstance(stage, Estimator):
+        model = stage.fit(df)
+        return model, model.transform(df)
+    if isinstance(stage, Evaluator):
+        stage.evaluate(df)
+        return stage, None
+    return stage, stage.transform(df)
+
+
+@pytest.mark.parametrize("name", _IDS)
+def test_experiment(name):
+    """Parity: ExperimentFuzzing — the stage runs end-to-end."""
+    c = FUZZING_OBJECTS[name]
+    if not c.experiment:
+        pytest.skip("network/side-effect stage: construction-only")
+    stage, df = c.make(), c.df()
+    _run(stage, df)
+
+
+@pytest.mark.parametrize("name", _IDS)
+def test_serialization_roundtrip(name, tmp_path):
+    """Parity: SerializationFuzzing — save/load stage, model, pipeline."""
+    c = FUZZING_OBJECTS[name]
+    if not c.serialization:
+        pytest.skip("carries non-serializable state (udf/test-local class)")
+    stage, df = c.make(), c.df()
+    # 1. unfitted stage round-trips with identical params
+    save_stage(stage, str(tmp_path / "stage"))
+    loaded = load_stage(str(tmp_path / "stage"))
+    assert type(loaded) is type(stage)
+    assert loaded._json_params().keys() == stage._json_params().keys()
+    if not c.experiment:
+        return
+    # 2. fitted artifact (estimator) / output (transformer) survives
+    fitted, out = _run(stage, df)
+    save_stage(fitted, str(tmp_path / "fitted"))
+    refit = load_stage(str(tmp_path / "fitted"))
+    if out is not None and c.deterministic:
+        out2 = refit.transform(df)
+        assert_df_eq(out2, fitted.transform(df))
+    # 3. pipeline wrapping the fitted stage round-trips
+    if isinstance(fitted, Transformer):
+        pipe = PipelineModel(stages=[fitted])
+        pipe.save(str(tmp_path / "pipe"))
+        from mmlspark_tpu.core.stage import PipelineStage
+        pl = PipelineStage.load(str(tmp_path / "pipe"))
+        if out is not None and c.deterministic:
+            assert_df_eq(pl.transform(df), out)
+
+
+@pytest.mark.parametrize("name", _IDS)
+def test_determinism(name):
+    """Two identical runs produce identical outputs."""
+    c = FUZZING_OBJECTS[name]
+    if not (c.experiment and c.deterministic):
+        pytest.skip("non-deterministic or network stage")
+    _, out1 = _run(c.make(), c.df())
+    _, out2 = _run(c.make(), c.df())
+    if out1 is not None and out2 is not None:
+        assert_df_eq(out1, out2)
