@@ -37,7 +37,9 @@ class BinMapper:
     def n_bins(self, feature: int) -> int:
         if self.categorical[feature]:
             return len(self.cat_levels[feature]) + 1  # + missing bin
-        return len(self.upper_bounds[feature]) + 1    # + missing bin
+        # numeric values land in 1..len(bounds)+1 (searchsorted can return
+        # len(bounds)), plus the missing bin 0
+        return len(self.upper_bounds[feature]) + 2
 
     @property
     def max_bins_total(self) -> int:

@@ -67,6 +67,13 @@ class BoosterParams:
     early_stopping_round: int = 0
     metric: str = ""                     # default chosen from objective
     seed: int = 0
+    # histogram engine: auto -> Pallas MXU kernel on TPU (single-device),
+    # XLA scatter-add otherwise (see pallas_hist.py)
+    histogram_impl: str = "auto"         # auto | xla | pallas | pallas_interpret
+    # distributed tree learner (parity: tree_learner param,
+    # `LightGBMParams.scala:13-18`): data | feature | voting
+    tree_learner: str = "data"
+    top_k: int = 20                      # voting-parallel candidates/worker
 
     def growth(self) -> GrowthParams:
         return GrowthParams(
@@ -182,25 +189,61 @@ class Booster:
         w_np = _weights(weights, n).astype(np.float32)
         y_np = np.asarray(y, dtype=np.float32)
         valid_rows = np.ones(n, dtype=bool)
-        if sharding is not None:
-            # pad rows to the data-axis multiple; pad rows carry zero weight
-            # and are excluded from sampling masks, so histograms and leaf
-            # stats are untouched
+        if params.tree_learner not in ("data", "feature", "voting"):
+            raise ValueError(f"unknown tree_learner {params.tree_learner!r}")
+        tree_learner = params.tree_learner if sharding is not None else "data"
+        if sharding is not None and tree_learner == "feature":
+            # feature-parallel: shard the bin matrix over the FEATURE axis
+            # (each device histograms its feature shard locally, zero
+            # histogram traffic); row-dim arrays stay replicated
+            from jax.sharding import NamedSharding, PartitionSpec as P
+            feat_sharding = NamedSharding(sharding.mesh, P(None, "data"))
+            n_padded = n
+            # pad the feature dim to the shard multiple; pad columns are
+            # all-missing-bin so every candidate split on them is invalid
             from mmlspark_tpu.parallel import pad_to_multiple
-            n_shards = sharding.mesh.shape["data"]
-            bins_np, _ = pad_to_multiple(bins_np, n_shards)
-            y_np, _ = pad_to_multiple(y_np, n_shards)
-            w_np, _ = pad_to_multiple(w_np, n_shards)
-            valid_rows, _ = pad_to_multiple(valid_rows, n_shards,
-                                            pad_value=False)
-        n_padded = len(bins_np)
-        put = (lambda a: jax.device_put(a, sharding)) if sharding is not None \
-            else jnp.asarray
-        bins = put(bins_np)
-        w = put(w_np)
-        y_dev = put(y_np)
+            bins_np, _ = pad_to_multiple(bins_np,
+                                         sharding.mesh.shape["data"], axis=1)
+            bins = jax.device_put(bins_np, feat_sharding)
+            put = jnp.asarray
+            w, y_dev = put(w_np), put(y_np)
+        else:
+            if sharding is not None:
+                # pad rows to the data-axis multiple; pad rows carry zero
+                # weight and are excluded from sampling masks, so histograms
+                # and leaf stats are untouched
+                from mmlspark_tpu.parallel import pad_to_multiple
+                n_shards = sharding.mesh.shape["data"]
+                bins_np, _ = pad_to_multiple(bins_np, n_shards)
+                y_np, _ = pad_to_multiple(y_np, n_shards)
+                w_np, _ = pad_to_multiple(w_np, n_shards)
+                valid_rows, _ = pad_to_multiple(valid_rows, n_shards,
+                                                pad_value=False)
+            n_padded = len(bins_np)
+            put = (lambda a: jax.device_put(a, sharding)) \
+                if sharding is not None else jnp.asarray
+            bins = put(bins_np)
+            w = put(w_np)
+            y_dev = put(y_np)
 
-        grower = TreeGrower(mapper, params.growth(), F, n_bins)
+        hist_impl = params.histogram_impl
+        if hist_impl not in ("auto", "xla", "pallas", "pallas_interpret"):
+            raise ValueError(f"unknown histogram_impl {hist_impl!r}")
+        if hist_impl == "auto":
+            from mmlspark_tpu.gbdt.pallas_hist import pallas_available
+            hist_impl = ("pallas" if sharding is None and pallas_available()
+                         else "xla")
+        elif hist_impl != "xla" and sharding is not None:
+            # the pallas kernel has no GSPMD partitioning rule; sharded
+            # fits always take the XLA path (its reductions become psums)
+            import warnings
+            warnings.warn("histogram_impl='pallas' is single-device only; "
+                          "falling back to 'xla' for the sharded fit")
+            hist_impl = "xla"
+        grower = TreeGrower(mapper, params.growth(), bins_np.shape[1], n_bins,
+                            hist_impl=hist_impl, tree_learner=tree_learner,
+                            mesh=sharding.mesh if sharding is not None else None,
+                            top_k=params.top_k)
         rng = np.random.default_rng(params.seed)
 
         # raw predictions (n_padded, K) on device
@@ -295,16 +338,14 @@ class Booster:
                 gk, hk = grad[:, k], hess[:, k]
                 if amp_dev is not None:
                     gk, hk = gk * amp_dev, hk * amp_dev
+                fm_dev = None
                 if feat_mask is not None:
-                    gk_bins = bins
-                    # zero out masked features by remapping them to the
-                    # missing bin: build per-call view
-                    drop = jnp.asarray(~feat_mask)
-                    gk_bins = jnp.where(drop[None, :], 0, bins)
-                else:
-                    gk_bins = bins
-                tree, row_vals = grower.grow(gk_bins, gk, hk, sample_dev,
-                                             shrink)
+                    # excluded at split-finding time (find_best_split), so
+                    # the bin matrix is never copied per iteration
+                    fm_dev = jnp.asarray(np.pad(
+                        feat_mask, (0, bins.shape[1] - len(feat_mask))))
+                tree, row_vals = grower.grow(bins, gk, hk, sample_dev,
+                                             shrink, feat_mask=fm_dev)
                 iter_trees.append(tree)
                 new_contrib = new_contrib.at[:, k].add(row_vals)
 

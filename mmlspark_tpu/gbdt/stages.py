@@ -28,6 +28,10 @@ from mmlspark_tpu.core.stage import Estimator, Model
 from mmlspark_tpu.core import schema
 from mmlspark_tpu.gbdt.booster import Booster, BoosterParams
 
+# stage-level parallelism names (reference spelling) -> Booster tree_learner
+_TREE_LEARNERS = {"data_parallel": "data", "feature_parallel": "feature",
+                  "voting_parallel": "voting", "serial": "data"}
+
 
 class _GBDTParams(HasFeaturesCol, HasLabelCol, HasWeightCol):
     """Shared LightGBM-parity params (`LightGBMParams.scala:13`)."""
@@ -62,15 +66,24 @@ class _GBDTParams(HasFeaturesCol, HasLabelCol, HasWeightCol):
                                         ptype=list)
     num_batches = Param(0, "split training into N sequential batches merged "
                         "into one booster (parity: numBatches)", ptype=int)
-    parallelism = Param("data_parallel", "tree learner: data_parallel | "
-                        "serial (feature/voting map to data on TPU)",
-                        ptype=str)
+    parallelism = Param("data_parallel", "tree learner (parity: parallelism "
+                        "= tree_learner, `LightGBMParams.scala:13-18`): "
+                        "data_parallel | feature_parallel | voting_parallel "
+                        "| serial", ptype=str)
+    top_k = Param(20, "voting-parallel candidates per worker (parity: "
+                  "top_k voting param)", ptype=int)
+    histogram_impl = Param("auto", "histogram engine: auto | xla | pallas",
+                           ptype=str)
     seed = Param(0, "random seed", ptype=int)
     verbosity = Param(0, "log every N iterations (0 = silent)", ptype=int)
     init_score_col = Param(None, "unused; API parity", ptype=str)
 
     def _booster_params(self, objective: str, num_class: int = 2,
                         **extra) -> BoosterParams:
+        if self.parallelism not in _TREE_LEARNERS:
+            raise ValueError(
+                f"unknown parallelism {self.parallelism!r}; expected one of "
+                f"{sorted(_TREE_LEARNERS)}")
         return BoosterParams(
             objective=objective, boosting_type=self.boosting_type,
             num_iterations=self.num_iterations,
@@ -87,7 +100,9 @@ class _GBDTParams(HasFeaturesCol, HasLabelCol, HasWeightCol):
             max_drop=self.max_drop, skip_drop=self.skip_drop,
             top_rate=self.top_rate, other_rate=self.other_rate,
             early_stopping_round=self.early_stopping_round,
-            metric=self.metric, seed=self.seed, **extra)
+            metric=self.metric, seed=self.seed,
+            tree_learner=_TREE_LEARNERS[self.parallelism],
+            top_k=self.top_k, histogram_impl=self.histogram_impl, **extra)
 
     def _categoricals(self, df: DataFrame) -> List[int]:
         if self.categorical_feature_indexes is not None:

@@ -80,25 +80,25 @@ def _split_score(g, h, l1, l2):
     return jnp.square(g_reg) / (h + l2 + 1e-12)
 
 
-@partial(jax.jit, static_argnames=("params",))
-def find_best_split(hist, is_categorical, params: GrowthParams):
-    """Best split over all (feature, bin) cut points of one leaf.
+def split_gain_matrix(hist, is_categorical, params: GrowthParams):
+    """All candidate-split gains of one leaf: ((2, F, B) gains, (F, B) order).
 
-    hist: (F, B, 3). is_categorical: (F,) bool.
-    Numeric features scan bins in index order twice — once sending the
-    missing bin left, once right (learned default direction). Categorical
-    features scan bins in G/H-sorted order (LightGBM's many-vs-many).
-
-    Returns dict with gain/feature/threshold index info + the sorted bin
-    order used (to reconstruct categorical subsets).
+    Factored out of :func:`find_best_split` so the distributed learners
+    (voting votes, feature-parallel local search — `learners.py`) can
+    score candidates with identical math. Slot 0 of the first axis sends
+    the missing bin left, slot 1 sends it right.
     """
     F, B, _ = hist.shape
     l1, l2 = params.lambda_l1, params.lambda_l2
 
-    g_tot = jnp.sum(hist[:, :, 0], axis=1)   # (F,) same for all features
+    g_tot = jnp.sum(hist[:, :, 0], axis=1)   # (F,)
     h_tot = jnp.sum(hist[:, :, 1], axis=1)
     c_tot = jnp.sum(hist[:, :, 2], axis=1)
-    parent_score = _split_score(g_tot[0], h_tot[0], l1, l2)
+    # parent stats are per-leaf constants; feature histograms can disagree
+    # on them only when a feature's histogram is masked out (voting mode),
+    # so take the row-count-richest feature as the source of truth
+    src = jnp.argmax(c_tot)
+    parent_score = _split_score(g_tot[src], h_tot[src], l1, l2)
 
     # --- ordering per feature ---------------------------------------------
     # numeric: natural order. categorical: sort non-empty bins by G/H.
@@ -141,7 +141,28 @@ def find_best_split(hist, is_categorical, params: GrowthParams):
     gain_left = gain_left.at[:, B - 1].set(-jnp.inf)
     gain_right = gain_right.at[:, B - 1].set(-jnp.inf)
 
-    both = jnp.stack([gain_left, gain_right])           # (2, F, B)
+    return jnp.stack([gain_left, gain_right]), order    # (2, F, B), (F, B)
+
+
+@partial(jax.jit, static_argnames=("params",))
+def find_best_split(hist, is_categorical, params: GrowthParams,
+                    feat_mask=None):
+    """Best split over all (feature, bin) cut points of one leaf.
+
+    hist: (F, B, 3). is_categorical: (F,) bool. feat_mask: optional (F,)
+    bool — features outside the mask (feature_fraction sampling) are
+    excluded without touching the bin matrix.
+    Numeric features scan bins in index order twice — once sending the
+    missing bin left, once right (learned default direction). Categorical
+    features scan bins in G/H-sorted order (LightGBM's many-vs-many).
+
+    Returns dict with gain/feature/threshold index info + the sorted bin
+    order used (to reconstruct categorical subsets).
+    """
+    F, B, _ = hist.shape
+    both, order = split_gain_matrix(hist, is_categorical, params)
+    if feat_mask is not None:
+        both = jnp.where(feat_mask[None, :, None], both, -jnp.inf)
     flat = both.reshape(2, -1)
     best_flat = jnp.argmax(flat, axis=1)
     best_gain_lr = jnp.take_along_axis(flat, best_flat[:, None], axis=1)[:, 0]
@@ -163,9 +184,14 @@ def find_best_split(hist, is_categorical, params: GrowthParams):
 
 @jax.jit
 def leaf_stats(hist):
-    """(G, H, count) totals of a leaf from any one feature's histogram."""
-    return (jnp.sum(hist[0, :, 0]), jnp.sum(hist[0, :, 1]),
-            jnp.sum(hist[0, :, 2]))
+    """(G, H, count) totals of a leaf from one feature's histogram.
+
+    Uses the count-richest feature so voting-mode histograms (exact only
+    on the voted subset, zero elsewhere) still yield the true totals.
+    """
+    c = jnp.sum(hist[:, :, 2], axis=1)
+    src = jnp.argmax(c)
+    return (jnp.sum(hist[src, :, 0]), jnp.sum(hist[src, :, 1]), c[src])
 
 
 # ---------------------------------------------------------------------------
@@ -269,15 +295,59 @@ class TreeGrower:
     """Grows one tree leaf-wise over binned data living on device."""
 
     def __init__(self, bin_mapper, params: GrowthParams, n_features: int,
-                 n_bins: int):
+                 n_bins: int, hist_impl: str = "xla",
+                 tree_learner: str = "data", mesh=None, top_k: int = 20):
         self.mapper = bin_mapper
         self.params = params
         self.n_features = n_features
         self.n_bins = n_bins
-        self.is_categorical = jnp.asarray(bin_mapper.categorical, dtype=bool)
+        # n_features may exceed the mapper's count (feature-parallel pads
+        # the feature dim to the shard multiple); pads are numeric
+        cats = list(bin_mapper.categorical)
+        cats += [False] * (n_features - len(cats))
+        self.is_categorical = jnp.asarray(cats, dtype=bool)
+        self.hist_impl = hist_impl       # xla | pallas | pallas_interpret
+        self.tree_learner = tree_learner  # data | feature | voting
+        self._bins_src = None            # identity key for the cached
+        self._bins_t = None              # pre-transposed pallas layout
+        self._voting_fn = None
+        if tree_learner == "voting" and mesh is not None:
+            from mmlspark_tpu.gbdt.learners import make_voting_hist
+            self._voting_fn = make_voting_hist(
+                mesh, params, self.is_categorical, n_features, n_bins, top_k)
+
+    # voting histograms are exact only on the voted feature subset, which
+    # differs between a parent and its children — the parent-minus-child
+    # subtraction trick is unsound there, so both children build directly
+    @property
+    def _no_subtract(self) -> bool:
+        return self._voting_fn is not None
+
+    def _hist(self, bins, grad, hess, in_leaf, feat_mask=None):
+        """Histogram dispatch: XLA scatter-add, per-feature scatter
+        (feature-parallel), voting shard_map, or the Pallas MXU kernel."""
+        if self._voting_fn is not None:
+            fm = (feat_mask if feat_mask is not None
+                  else jnp.ones(self.n_features, bool))
+            return self._voting_fn(bins, grad, hess, in_leaf, fm)
+        if self.tree_learner == "feature":
+            from mmlspark_tpu.gbdt.learners import build_histogram_per_feature
+            return build_histogram_per_feature(bins, grad, hess, in_leaf,
+                                               self.n_bins)
+        if self.hist_impl == "xla":
+            return build_histogram(bins, grad, hess, in_leaf,
+                                   self.n_features, self.n_bins)
+        from mmlspark_tpu.gbdt import pallas_hist
+        if self._bins_src is not bins:   # one transpose per fit, reused
+            self._bins_t = pallas_hist.prepare_bins_t(bins)
+            self._bins_src = bins
+        return pallas_hist.build_histogram_pallas(
+            self._bins_t, grad, hess, in_leaf,
+            self.n_features, self.n_bins,
+            interpret=(self.hist_impl == "pallas_interpret"))
 
     def grow(self, bins, grad, hess, sample_mask,
-             shrinkage: float) -> Tuple[Tree, jnp.ndarray]:
+             shrinkage: float, feat_mask=None) -> Tuple[Tree, jnp.ndarray]:
         """Returns (tree, per-row raw value of the new tree).
 
         bins (n, F) int32 / grad,hess (n,) f32 / sample_mask (n,) bool —
@@ -303,8 +373,7 @@ class TreeGrower:
         # row -> node assignment, only rows in sample_mask participate
         node_of_row = jnp.where(sample_mask, 0, -1).astype(jnp.int32)
 
-        root_hist = build_histogram(bins, grad, hess, node_of_row == 0,
-                                    self.n_features, B)
+        root_hist = self._hist(bins, grad, hess, node_of_row == 0, feat_mask)
         g0, h0, c0 = (float(x) for x in leaf_stats(root_hist))
         value[0] = float(_leaf_value(jnp.float32(g0), jnp.float32(h0),
                                      p.lambda_l1, p.lambda_l2))
@@ -317,7 +386,7 @@ class TreeGrower:
                 return
             if 0 <= p.max_depth <= depth[leaf_id]:
                 return
-            cand = find_best_split(hist, self.is_categorical, p)
+            cand = find_best_split(hist, self.is_categorical, p, feat_mask)
             if float(cand["gain"]) > max(p.min_gain_to_split, 0.0):
                 frontier[leaf_id] = {"hist": hist, "cand": cand,
                                      "count": count}
@@ -365,9 +434,9 @@ class TreeGrower:
                                     jnp.where(in_leaf, ri, node_of_row))
 
             # child histograms: build smaller side, subtract for the other
-            lhist = build_histogram(bins, grad, hess, node_of_row == li,
-                                    self.n_features, B)
-            rhist = entry["hist"] - lhist
+            lhist = self._hist(bins, grad, hess, node_of_row == li, feat_mask)
+            rhist = (self._hist(bins, grad, hess, node_of_row == ri, feat_mask)
+                     if self._no_subtract else entry["hist"] - lhist)
             gl, hl, cl = (float(x) for x in leaf_stats(lhist))
             gr, hr, cr = (float(x) for x in leaf_stats(rhist))
             value[li] = float(_leaf_value(jnp.float32(gl), jnp.float32(hl),

@@ -6,6 +6,7 @@ metric values with per-entry precision, `Benchmarks.scala:35-113`,
 """
 
 import json
+from dataclasses import replace as dataclasses_replace
 
 import numpy as np
 import pytest
@@ -242,6 +243,103 @@ class TestBoosterMechanics:
         clean_mask = ~np.isnan(X_miss[:, 0])
         acc = float(((pred[clean_mask] > 0.5) == (y[clean_mask] > 0.5)).mean())
         assert acc > 0.9
+
+    def test_feature_parallel_matches_serial(self, breast_cancer):
+        """Feature-sharded histograms must reproduce the serial trees."""
+        from mmlspark_tpu.parallel import build_mesh, batch_sharding
+        Xtr, ytr, Xte, _ = breast_cancer
+        p = BoosterParams(objective="binary", num_iterations=5,
+                          num_leaves=15, min_data_in_leaf=5)
+        serial = Booster.train(p, Xtr, ytr)
+        feat = Booster.train(dataclasses_replace(p, tree_learner="feature"),
+                             Xtr, ytr,
+                             sharding=batch_sharding(build_mesh()))
+        np.testing.assert_allclose(serial.predict(Xte), feat.predict(Xte),
+                                   rtol=1e-4, atol=1e-5)
+
+    def test_voting_parallel(self, breast_cancer):
+        """With 2*top_k >= F voting selects every feature -> identical
+        trees; with a small top_k it must still train a usable model."""
+        from mmlspark_tpu.parallel import build_mesh, batch_sharding
+        Xtr, ytr, Xte, yte = breast_cancer
+        n = (len(Xtr) // 8) * 8
+        sharding = batch_sharding(build_mesh())
+        p = BoosterParams(objective="binary", num_iterations=5,
+                          num_leaves=15, min_data_in_leaf=5)
+        serial = Booster.train(p, Xtr[:n], ytr[:n])
+        full = Booster.train(
+            dataclasses_replace(p, tree_learner="voting", top_k=30),
+            Xtr[:n], ytr[:n], sharding=sharding)
+        # per-shard summation order + direct child histograms (no
+        # subtraction trick) shift float ties, so near- not exact-equal
+        diff = np.abs(serial.predict(Xte) - full.predict(Xte))
+        assert np.mean(diff > 0.05) < 0.05, f"large diffs: {np.mean(diff):.4f}"
+        small = Booster.train(
+            dataclasses_replace(p, tree_learner="voting", top_k=4),
+            Xtr[:n], ytr[:n], sharding=sharding)
+        from sklearn.metrics import roc_auc_score
+        assert roc_auc_score(yte, small.predict(Xte)) > 0.95
+
+    def test_feature_fraction(self, breast_cancer):
+        """Column sampling goes through the split-finding mask (bins are
+        never copied); same seed -> same model, and quality holds."""
+        from sklearn.metrics import roc_auc_score
+        Xtr, ytr, Xte, yte = breast_cancer
+        p = BoosterParams(objective="binary", num_iterations=8,
+                          num_leaves=15, feature_fraction=0.5, seed=3)
+        b1 = Booster.train(p, Xtr, ytr)
+        b2 = Booster.train(p, Xtr, ytr)
+        np.testing.assert_array_equal(b1.predict(Xte), b2.predict(Xte))
+        assert roc_auc_score(yte, b1.predict(Xte)) > 0.95
+
+    def test_voting_small_leaves_high_index_features(self, rng):
+        """Vote gains on small leaves must use shard-scaled gates: with
+        all signal in HIGH-index features and leaves smaller than
+        min_data_in_leaf * n_shards, degenerate votes would only ever
+        select low-index (noise) features."""
+        from mmlspark_tpu.parallel import build_mesh, batch_sharding
+        from sklearn.metrics import roc_auc_score
+        n = 640
+        noise = rng.normal(size=(n, 24))
+        signal = rng.normal(size=(n, 4))
+        y = (signal.sum(axis=1) > 0).astype(int)
+        X = np.concatenate([noise, signal], axis=1)  # signal at cols 24..27
+        p = BoosterParams(objective="binary", num_iterations=8,
+                          num_leaves=31, min_data_in_leaf=20,
+                          tree_learner="voting", top_k=3)
+        b = Booster.train(p, X, y, sharding=batch_sharding(build_mesh()))
+        assert roc_auc_score(y, b.predict(X)) > 0.9
+        imp = b.feature_importances("split")
+        assert imp[24:].sum() > imp[:24].sum()
+
+    def test_pallas_histogram_matches_xla(self, rng):
+        """Pallas MXU histogram (interpret mode on CPU) == XLA scatter-add."""
+        import jax.numpy as jnp
+        from mmlspark_tpu.gbdt.tree import build_histogram
+        from mmlspark_tpu.gbdt.pallas_hist import (
+            build_histogram_pallas, prepare_bins_t)
+        n, F, B = 777, 11, 37  # deliberately unaligned sizes
+        bins = jnp.asarray(rng.integers(0, B, size=(n, F)), jnp.int32)
+        grad = jnp.asarray(rng.normal(size=n), jnp.float32)
+        hess = jnp.asarray(rng.uniform(0.1, 1, size=n), jnp.float32)
+        mask = jnp.asarray(rng.uniform(size=n) < 0.7)
+        ref = build_histogram(bins, grad, hess, mask, F, B)
+        got = build_histogram_pallas(prepare_bins_t(bins), grad, hess, mask,
+                                     F, B, interpret=True)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                                   rtol=1e-5, atol=1e-4)
+
+    def test_pallas_booster_matches_xla(self, breast_cancer):
+        """Full fit through the pallas histogram path gives the same model."""
+        Xtr, ytr, Xte, _ = breast_cancer
+        p = BoosterParams(objective="binary", num_iterations=4,
+                          num_leaves=7, min_data_in_leaf=5)
+        ref = Booster.train(p, Xtr, ytr)
+        pal = Booster.train(
+            dataclasses_replace(p, histogram_impl="pallas_interpret"),
+            Xtr, ytr)
+        np.testing.assert_allclose(pal.predict(Xte), ref.predict(Xte),
+                                   rtol=1e-4, atol=1e-5)
 
     def test_data_parallel_matches_serial(self, breast_cancer):
         """The sharded (GSPMD psum) path must give identical trees."""
