@@ -229,10 +229,15 @@ class Booster:
         hist_impl = params.histogram_impl
         if hist_impl not in ("auto", "xla", "pallas", "pallas_interpret"):
             raise ValueError(f"unknown histogram_impl {hist_impl!r}")
+        from mmlspark_tpu.gbdt.pallas_hist import pallas_available
         if hist_impl == "auto":
-            from mmlspark_tpu.gbdt.pallas_hist import pallas_available
             hist_impl = ("pallas" if sharding is None and pallas_available()
                          else "xla")
+        elif hist_impl == "pallas" and not pallas_available():
+            raise ValueError(
+                "histogram_impl='pallas' needs a TPU backend; use 'auto' "
+                "(selects the right engine) or 'pallas_interpret' for "
+                "CPU debugging")
         elif hist_impl != "xla" and sharding is not None:
             # the pallas kernel has no GSPMD partitioning rule; sharded
             # fits always take the XLA path (its reductions become psums)

@@ -72,8 +72,8 @@ class _GBDTParams(HasFeaturesCol, HasLabelCol, HasWeightCol):
                         "| serial", ptype=str)
     top_k = Param(20, "voting-parallel candidates per worker (parity: "
                   "top_k voting param)", ptype=int)
-    histogram_impl = Param("auto", "histogram engine: auto | xla | pallas",
-                           ptype=str)
+    histogram_impl = Param("auto", "histogram engine: auto | xla | pallas "
+                           "| pallas_interpret", ptype=str)
     seed = Param(0, "random seed", ptype=int)
     verbosity = Param(0, "log every N iterations (0 = silent)", ptype=int)
     init_score_col = Param(None, "unused; API parity", ptype=str)

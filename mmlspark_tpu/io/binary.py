@@ -26,19 +26,22 @@ BYTES_COL = "bytes"
 
 
 def _iter_files(path: str, recursive: bool, pattern: Optional[str]) -> Iterator[str]:
+    """Matching files in global sorted-path order (same as the native reader)."""
     if os.path.isfile(path):
         yield path
         return
+    out: List[str] = []
     if recursive:
         for root, _, files in os.walk(path):
-            for f in sorted(files):
+            for f in files:
                 if pattern is None or fnmatch.fnmatch(f, pattern):
-                    yield os.path.join(root, f)
+                    out.append(os.path.join(root, f))
     else:
-        for f in sorted(os.listdir(path)):
+        for f in os.listdir(path):
             full = os.path.join(path, f)
             if os.path.isfile(full) and (pattern is None or fnmatch.fnmatch(f, pattern)):
-                yield full
+                out.append(full)
+    yield from sorted(out)
 
 
 def read_binary_files(path: str,
@@ -46,32 +49,62 @@ def read_binary_files(path: str,
                       pattern: Optional[str] = None,
                       sample_ratio: float = 1.0,
                       inspect_zip: bool = True,
-                      seed: int = 0) -> DataFrame:
+                      seed: int = 0,
+                      engine: str = "auto") -> DataFrame:
     """Read files under ``path`` as a frame with ``path``/``bytes`` columns.
 
     Zip archives are expanded into one row per member, with paths like
     ``archive.zip/member`` (parity: zip inspection + subsampling at the
     record-reader level, `BinaryRecordReader.scala:34`).
+
+    ``engine``: ``native`` uses the C++ prefetching reader
+    (``native/binary_reader.cpp``, threads off the GIL), ``python`` the
+    in-process fallback, ``auto`` prefers native when it builds. Both
+    deliver records in sorted-path file order; the two engines draw
+    different RNG streams for ``sample_ratio``, so sampled *subsets*
+    (not semantics) differ between them.
     """
-    rng = random.Random(seed)
+    if engine not in ("auto", "native", "python"):
+        raise ValueError(f"unknown engine {engine!r}")
+    if not os.path.exists(path):
+        # both engines would otherwise silently yield an empty frame
+        # (os.walk and the native scanner both swallow missing roots)
+        raise FileNotFoundError(path)
+    use_native = False
+    if engine in ("auto", "native"):
+        from mmlspark_tpu.native import native_available
+        use_native = native_available()
+        if engine == "native" and not use_native:
+            raise RuntimeError("native reader unavailable (no g++/zlib?)")
+
     paths: List[str] = []
     blobs: List[bytes] = []
-
-    def emit(p: str, data: bytes) -> None:
-        if sample_ratio >= 1.0 or rng.random() < sample_ratio:
+    if use_native:
+        from mmlspark_tpu.native import native_read_records
+        for p, data in native_read_records(
+                path, recursive=recursive, pattern=pattern,
+                sample_ratio=sample_ratio, inspect_zip=inspect_zip,
+                seed=seed):
             paths.append(p)
             blobs.append(data)
+    else:
+        rng = random.Random(seed)
 
-    for fp in _iter_files(path, recursive, pattern):
-        if inspect_zip and fp.lower().endswith(".zip"):
-            with zipfile.ZipFile(fp) as zf:
-                for name in zf.namelist():
-                    if name.endswith("/"):
-                        continue
-                    emit(f"{fp}/{name}", zf.read(name))
-        else:
-            with open(fp, "rb") as f:
-                emit(fp, f.read())
+        def emit(p: str, data: bytes) -> None:
+            if sample_ratio >= 1.0 or rng.random() < sample_ratio:
+                paths.append(p)
+                blobs.append(data)
+
+        for fp in _iter_files(path, recursive, pattern):
+            if inspect_zip and fp.lower().endswith(".zip"):
+                with zipfile.ZipFile(fp) as zf:
+                    for name in zf.namelist():
+                        if name.endswith("/"):
+                            continue
+                        emit(f"{fp}/{name}", zf.read(name))
+            else:
+                with open(fp, "rb") as f:
+                    emit(fp, f.read())
 
     return DataFrame({
         PATH_COL: np.array(paths, dtype=object),

@@ -3,12 +3,14 @@
 Capability parity with the reference's Cognitive Services layer
 (`io/http/src/main/scala/CognitiveServiceBase.scala:25-241`,
 `services/TextAnalytics.scala:184-248`, `services/ComputerVision.scala:180-474`,
+`services/Face.scala:19-277`, `services/Speech.scala:23`,
+`services/ImageSearch.scala:63`, `services/AzureSearch.scala:81,143`,
 `services/AnamolyDetection.scala:118,131`) and the PowerBI writer
-(`io/powerbi/src/main/scala/PowerBIWriter.scala:25`). Per the build plan
-(SURVEY §7) the full ~25-transformer Azure catalog is out of scope; this
-provides the generic service base plus representative bindings as the
-capability proof. Every stage takes an explicit ``url`` so they run
-against any compatible endpoint (tests use localhost).
+(`io/powerbi/src/main/scala/PowerBIWriter.scala:25`): text analytics,
+computer vision, face, speech, anomaly detection, image search, plus the
+two batch writers. Every stage takes an explicit ``url`` so they run
+against any compatible endpoint (tests use localhost) rather than
+hard-coding Azure regions.
 """
 
 from __future__ import annotations
@@ -144,6 +146,245 @@ class TagImage(_ImageServiceBase):
     """Parity: `ComputerVision.scala` TagImage."""
 
 
+class GenerateThumbnails(_ImageServiceBase):
+    """Parity: `ComputerVision.scala` GenerateThumbnails (width/height/
+    smartCropping as query params)."""
+
+    width = Param(64, "thumbnail width", ptype=int)
+    height = Param(64, "thumbnail height", ptype=int)
+    smart_cropping = Param(True, "crop around region of interest",
+                           ptype=bool)
+
+    def _make_request(self, value) -> Optional[HTTPRequestData]:
+        if value is None:
+            return None
+        q = (f"width={self.width}&height={self.height}"
+             f"&smartCropping={str(self.smart_cropping).lower()}")
+        sep = "&" if "?" in self.url else "?"
+        return HTTPRequestData.post_json(
+            f"{self.url}{sep}{q}", {"url": str(value)}, self._headers())
+
+
+class RecognizeText(_ImageServiceBase):
+    """Parity: `ComputerVision.scala` RecognizeText (mode query param)."""
+
+    mode = Param("Printed", "Printed | Handwritten")
+
+    def _make_request(self, value) -> Optional[HTTPRequestData]:
+        if value is None:
+            return None
+        sep = "&" if "?" in self.url else "?"
+        return HTTPRequestData.post_json(
+            f"{self.url}{sep}mode={self.mode}", {"url": str(value)},
+            self._headers())
+
+
+class RecognizeDomainSpecificContent(_ImageServiceBase):
+    """Parity: `ComputerVision.scala` RecognizeDomainSpecificContent
+    (celebrity/landmark model in the path)."""
+
+    model = Param("celebrities", "domain model name")
+
+    def _make_request(self, value) -> Optional[HTTPRequestData]:
+        if value is None:
+            return None
+        return HTTPRequestData.post_json(
+            f"{self.url.rstrip('/')}/models/{self.model}/analyze",
+            {"url": str(value)}, self._headers())
+
+
+class DetectFace(_ImageServiceBase):
+    """Parity: `Face.scala:19` DetectFace (returnFaceAttributes etc.)."""
+
+    return_face_id = Param(True, "include faceId", ptype=bool)
+    return_face_landmarks = Param(False, "include landmarks", ptype=bool)
+    return_face_attributes = Param(None, "attribute list", ptype=list)
+
+    def _make_request(self, value) -> Optional[HTTPRequestData]:
+        if value is None:
+            return None
+        q = [f"returnFaceId={str(self.return_face_id).lower()}",
+             f"returnFaceLandmarks={str(self.return_face_landmarks).lower()}"]
+        if self.return_face_attributes:
+            q.append("returnFaceAttributes="
+                     + ",".join(self.return_face_attributes))
+        sep = "&" if "?" in self.url else "?"
+        return HTTPRequestData.post_json(
+            f"{self.url}{sep}{'&'.join(q)}", {"url": str(value)},
+            self._headers())
+
+
+class FindSimilarFace(CognitiveServiceBase):
+    """Parity: `Face.scala` FindSimilarFaces: one probe faceId per row
+    against a fixed candidate list."""
+
+    face_id_col = Param("face_id", "column of probe face ids")
+    face_ids = Param(None, "candidate face ids", ptype=list)
+    max_candidates = Param(20, "max returned matches", ptype=int)
+
+    def _input_column(self) -> str:
+        return self.face_id_col
+
+    def _make_request(self, value) -> Optional[HTTPRequestData]:
+        if value is None:
+            return None
+        return HTTPRequestData.post_json(
+            self.url, {"faceId": str(value),
+                       "faceIds": list(self.face_ids or []),
+                       "maxNumOfCandidatesReturned": self.max_candidates},
+            self._headers())
+
+
+class GroupFaces(CognitiveServiceBase):
+    """Parity: `Face.scala` GroupFaces: each row holds a faceIds list."""
+
+    face_ids_col = Param("face_ids", "column of face-id lists")
+
+    def _input_column(self) -> str:
+        return self.face_ids_col
+
+    def _make_request(self, value) -> Optional[HTTPRequestData]:
+        if value is None:
+            return None
+        ids = value.tolist() if isinstance(value, np.ndarray) else list(value)
+        return HTTPRequestData.post_json(
+            self.url, {"faceIds": [str(v) for v in ids]}, self._headers())
+
+
+class IdentifyFaces(GroupFaces):
+    """Parity: `Face.scala` IdentifyFaces (faceIds + personGroupId)."""
+
+    person_group_id = Param(None, "person group to search")
+    max_candidates = Param(1, "candidates per face", ptype=int)
+
+    def _make_request(self, value) -> Optional[HTTPRequestData]:
+        if value is None:
+            return None
+        ids = value.tolist() if isinstance(value, np.ndarray) else list(value)
+        return HTTPRequestData.post_json(
+            self.url, {"faceIds": [str(v) for v in ids],
+                       "personGroupId": self.person_group_id,
+                       "maxNumOfCandidatesReturned": self.max_candidates},
+            self._headers())
+
+
+class VerifyFaces(CognitiveServiceBase):
+    """Parity: `Face.scala` VerifyFaces — two face-id columns per row."""
+
+    face_id1_col = Param("face_id1", "first face id column")
+    face_id2_col = Param("face_id2", "second face id column")
+
+    def _input_column(self) -> str:
+        return "__verify_pair__"
+
+    def _make_request(self, value) -> Optional[HTTPRequestData]:
+        if value is None:
+            return None
+        f1, f2 = value
+        return HTTPRequestData.post_json(
+            self.url, {"faceId1": str(f1), "faceId2": str(f2)},
+            self._headers())
+
+    def transform(self, df: DataFrame) -> DataFrame:
+        pairs = obj_col(list(zip(df[self.face_id1_col],
+                                 df[self.face_id2_col])))
+        out = super().transform(df.with_column("__verify_pair__", pairs))
+        return out.drop("__verify_pair__")
+
+
+class SpeechToText(CognitiveServiceBase):
+    """Parity: `Speech.scala:23` SpeechToText — posts raw audio bytes."""
+
+    audio_col = Param("audio", "column of raw audio bytes")
+    audio_format = Param("wav", "audio container format")
+    language = Param("en-US", "recognition language")
+
+    def _input_column(self) -> str:
+        return self.audio_col
+
+    def _make_request(self, value) -> Optional[HTTPRequestData]:
+        if value is None:
+            return None
+        h = self._headers()
+        h["Content-Type"] = f"audio/{self.audio_format}"
+        sep = "&" if "?" in self.url else "?"
+        return HTTPRequestData(url=f"{self.url}{sep}language={self.language}",
+                               method="POST", headers=h, body=bytes(value))
+
+
+class BingImageSearch(CognitiveServiceBase):
+    """Parity: `ImageSearch.scala:63` BingImageSearch — GET per query row;
+    results land under the response's ``value`` array."""
+
+    query_col = Param("query", "column of search queries")
+    count = Param(10, "results per query", ptype=int)
+    offset = Param(0, "result offset", ptype=int)
+
+    def _input_column(self) -> str:
+        return self.query_col
+
+    def _make_request(self, value) -> Optional[HTTPRequestData]:
+        if value is None:
+            return None
+        from urllib.parse import quote
+        sep = "&" if "?" in self.url else "?"
+        return HTTPRequestData(
+            url=(f"{self.url}{sep}q={quote(str(value))}"
+                 f"&count={self.count}&offset={self.offset}"),
+            method="GET", headers=self._headers())
+
+    def _output_parser(self) -> Transformer:
+        return JSONOutputParser(data_field="value")
+
+
+def _post_batches(url: str, payloads: List[Any],
+                  headers: Optional[Dict[str, str]] = None,
+                  concurrency: int = 2,
+                  timeout: float = 30.0) -> List[Dict[str, Any]]:
+    """POST each payload (throttling-aware retry handler); returns the
+    per-batch error dicts shared by the batch writers."""
+    from mmlspark_tpu.io.http import HTTPClient, advanced_handler
+
+    reqs = [HTTPRequestData.post_json(url, p, headers) for p in payloads]
+    client = HTTPClient(concurrency=concurrency, timeout=timeout,
+                        handler=advanced_handler)
+    try:
+        resps = client.send(reqs)
+    finally:
+        client.close()
+    return [{"batch": i, "status_code": getattr(r, "status_code", 0),
+             "reason": getattr(r, "reason", "no response")}
+            for i, r in enumerate(resps)
+            if r is None or not (200 <= r.status_code < 300)]
+
+
+class AzureSearchWriter:
+    """Batch-POST rows as index actions (parity: `AzureSearch.scala:81,143`
+    — rows wrapped as ``{"value": [{"@search.action": ...}, ...]}``)."""
+
+    def __init__(self, url: str, action: str = "mergeOrUpload",
+                 key: Optional[str] = None, batch_size: int = 100,
+                 concurrency: int = 2, timeout: float = 30.0):
+        self.url = url
+        self.action = action
+        self.key = key
+        self.batch_size = int(batch_size)
+        self.concurrency = concurrency
+        self.timeout = timeout
+
+    def write(self, df: DataFrame) -> List[Dict[str, Any]]:
+        from mmlspark_tpu.core.serialize import _jsonify
+        headers = {"Content-Type": "application/json"}
+        if self.key:
+            headers["api-key"] = self.key
+        rows = [dict(_jsonify(row), **{"@search.action": self.action})
+                for row in df.rows()]
+        payloads = [{"value": rows[s:s + self.batch_size]}
+                    for s in range(0, len(rows), self.batch_size)]
+        return _post_batches(self.url, payloads, headers,
+                             self.concurrency, self.timeout)
+
+
 class DetectAnomalies(CognitiveServiceBase):
     """Series-in, anomalies-out (parity: `AnamolyDetection.scala:118`).
 
@@ -185,23 +426,9 @@ class PowerBIWriter:
         """Send all rows; returns a list of per-batch error dicts (empty
         when everything succeeded)."""
         from mmlspark_tpu.core.serialize import _jsonify
-        from mmlspark_tpu.io.http import HTTPClient, advanced_handler
 
-        reqs = []
         rows = [_jsonify(row) for row in df.rows()]
-        for start in range(0, len(rows), self.batch_size):
-            reqs.append(HTTPRequestData.post_json(
-                self.url, rows[start:start + self.batch_size]))
-        client = HTTPClient(concurrency=self.concurrency,
-                            timeout=self.timeout, handler=advanced_handler)
-        try:
-            resps = client.send(reqs)
-        finally:
-            client.close()
-        errors = []
-        for i, r in enumerate(resps):
-            if r is None or not (200 <= r.status_code < 300):
-                errors.append({"batch": i,
-                               "status_code": getattr(r, "status_code", 0),
-                               "reason": getattr(r, "reason", "no response")})
-        return errors
+        payloads = [rows[s:s + self.batch_size]
+                    for s in range(0, len(rows), self.batch_size)]
+        return _post_batches(self.url, payloads, None,
+                             self.concurrency, self.timeout)

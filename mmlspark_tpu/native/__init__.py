@@ -1,0 +1,14 @@
+"""Native host-side runtime components (C++ behind ctypes).
+
+The reference ships its native engines as prebuilt ``.so``s inside jars,
+extracted and loaded by `core/env/src/main/scala/NativeLoader.java:28`.
+Here the native layer is built from bundled C++ sources on first use
+(g++ is part of the supported toolchain) and cached; every consumer has
+a pure-Python fallback so the framework degrades gracefully when no
+compiler is present.
+"""
+
+from mmlspark_tpu.native.loader import NativeLoader, native_available
+from mmlspark_tpu.native.binary import native_read_records
+
+__all__ = ["NativeLoader", "native_available", "native_read_records"]

@@ -1,0 +1,92 @@
+"""Build-and-load machinery for the bundled C++ runtime components.
+
+Parity: `core/env/src/main/scala/NativeLoader.java:28,48-62` — the
+reference extracts named ``.so``s (plus a ``NATIVE_MANIFEST`` of
+dependencies) from jar resources into a temp dir and ``System.load``s
+them, preferring ``java.library.path``. The TPU framework instead ships
+C++ *sources* inside the package and compiles them on first use:
+
+search order for ``load_library_by_name(name)``:
+1. ``$MMLSPARK_TPU_NATIVE_DIR/lib<name>.so`` (operator-provided prebuilt,
+   the ``java.library.path`` analogue),
+2. the package build cache (``native/_build``), rebuilt whenever the
+   source is newer than the cached binary,
+3. fresh compile via ``g++`` (declared in ``_SOURCES``).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from typing import Dict, List, Optional
+
+_SRC_DIR = os.path.join(os.path.dirname(__file__), "src")
+_BUILD_DIR = os.path.join(os.path.dirname(__file__), "_build")
+
+# name -> (sources, extra link flags); the NATIVE_MANIFEST analogue
+_SOURCES: Dict[str, List[str]] = {
+    "mmlbinary": ["binary_reader.cpp"],
+}
+_LINK_FLAGS: Dict[str, List[str]] = {
+    "mmlbinary": ["-lz"],
+}
+
+_lock = threading.Lock()
+# name -> CDLL, or the Exception a previous attempt raised (negative cache:
+# a missing toolchain must not re-run g++ on every read)
+_cache: Dict[str, object] = {}
+
+
+class NativeLoader:
+    """Loads (building if needed) a named native library."""
+
+    @staticmethod
+    def load_library_by_name(name: str) -> ctypes.CDLL:
+        with _lock:
+            hit = _cache.get(name)
+            if isinstance(hit, ctypes.CDLL):
+                return hit
+            if isinstance(hit, Exception):
+                raise hit
+            try:
+                lib = ctypes.CDLL(_find_or_build(name))
+            except Exception as e:
+                _cache[name] = e
+                raise
+            _cache[name] = lib
+            return lib
+
+
+def _find_or_build(name: str) -> str:
+    so_name = f"lib{name}.so"
+    override = os.environ.get("MMLSPARK_TPU_NATIVE_DIR")
+    if override:
+        cand = os.path.join(override, so_name)
+        if os.path.exists(cand):
+            return cand
+    if name not in _SOURCES:
+        raise FileNotFoundError(f"unknown native library {name!r}")
+    sources = [os.path.join(_SRC_DIR, s) for s in _SOURCES[name]]
+    built = os.path.join(_BUILD_DIR, so_name)
+    if os.path.exists(built) and all(
+            os.path.getmtime(built) >= os.path.getmtime(s) for s in sources):
+        return built
+    os.makedirs(_BUILD_DIR, exist_ok=True)
+    cmd = ["g++", "-O2", "-std=c++17", "-shared", "-fPIC", "-pthread",
+           *sources, "-o", built, *_LINK_FLAGS.get(name, [])]
+    proc = subprocess.run(cmd, capture_output=True, text=True)
+    if proc.returncode != 0:
+        raise RuntimeError(
+            f"native build of {name} failed:\n{proc.stderr[-2000:]}")
+    return built
+
+
+def native_available(name: str = "mmlbinary") -> bool:
+    """True when the named native library can be loaded (builds on demand)."""
+    try:
+        NativeLoader.load_library_by_name(name)
+        return True
+    except Exception:
+        return False

@@ -156,6 +156,69 @@ class TestText:
         assert "".join(pages) == "word " * 100
 
 
+class TestWord2Vec:
+    def _corpus(self):
+        # two disjoint co-occurrence clusters; embeddings must separate them
+        a = [["cat", "dog", "pet"], ["dog", "cat"], ["pet", "cat", "dog"]] * 20
+        b = [["car", "road", "drive"], ["road", "car"],
+             ["drive", "car", "road"]] * 20
+        return DataFrame({"tokens": (a + b)})
+
+    def test_synonym_structure(self):
+        from mmlspark_tpu.featurize.text import Word2Vec
+        model = Word2Vec(input_col="tokens", vector_size=16, max_iter=150,
+                         step_size=0.3, seed=0).fit(self._corpus())
+        syn = model.find_synonyms("cat", 2)
+        assert {w for w, _ in syn} <= {"dog", "pet"}
+
+    def test_transform_and_roundtrip(self, tmp_path):
+        from mmlspark_tpu.featurize.text import Word2Vec
+        df = self._corpus()
+        model = Word2Vec(input_col="tokens", output_col="vec",
+                         vector_size=8).fit(df)
+        out = model.transform(df)
+        assert out["vec"].shape == (df.num_rows, 8)
+        model.save(str(tmp_path / "w2v"))
+        re = PipelineStage.load(str(tmp_path / "w2v"))
+        np.testing.assert_allclose(re.transform(df)["vec"], out["vec"])
+
+    def test_empty_doc_and_unknown_tokens(self):
+        from mmlspark_tpu.featurize.text import Word2Vec
+        model = Word2Vec(input_col="tokens", output_col="vec",
+                         vector_size=4).fit(self._corpus())
+        out = model.transform(DataFrame({"tokens": [[], ["zzz"]]}))
+        np.testing.assert_array_equal(out["vec"], np.zeros((2, 4)))
+
+    def test_featurizer_word2vec_path(self):
+        from mmlspark_tpu.featurize.text import TextFeaturizer
+        df = DataFrame({"text": ["cat dog pet", "car road drive"] * 10})
+        model = TextFeaturizer(input_col="text", output_col="f",
+                               use_word2vec=True, word2vec_size=8).fit(df)
+        out = model.transform(df)
+        assert out["f"].shape == (20, 8)
+
+
+class TestUdfsAndPlot:
+    def test_udfs(self):
+        from mmlspark_tpu.udfs import to_vector, get_value_at
+        col = [[1, 2], [3, 4]]
+        m = to_vector(col)
+        assert m.shape == (2, 2) and m.dtype == np.float64
+        np.testing.assert_array_equal(get_value_at(col, 1), [2.0, 4.0])
+
+    def test_plot_helpers(self):
+        import matplotlib
+        matplotlib.use("Agg")
+        import matplotlib.pyplot as plt
+        from mmlspark_tpu import plot
+        ax = plot.confusion_matrix([0, 1, 1, 0], [0, 1, 0, 0])
+        assert ax is not None
+        plt.close("all")
+        ax = plot.roc([0, 1, 1, 0], [0.1, 0.9, 0.4, 0.2])
+        assert ax is not None
+        plt.close("all")
+
+
 class TestReviewRegressions:
     def test_page_splitter_no_infinite_loop_on_leading_boundary(self):
         from mmlspark_tpu.featurize import PageSplitter

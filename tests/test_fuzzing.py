@@ -369,6 +369,13 @@ case(F + "text.IDF",
 case(F + "text.TextFeaturizer", make=_mk("mmlspark_tpu.featurize.text",
      "TextFeaturizer", input_col="text", output_col="f", num_features=16),
      df=_text_df)
+case(F + "text.Word2Vec",
+     make=_mk("mmlspark_tpu.featurize.text", "Word2Vec", input_col="toks",
+              output_col="vec", vector_size=4, max_iter=2),
+     df=lambda: __import__("mmlspark_tpu.featurize.text",
+                           fromlist=["Tokenizer"])
+         .Tokenizer(input_col="text", output_col="toks")
+         .transform(_text_df()))
 case(F + "text.PageSplitter", make=_mk("mmlspark_tpu.featurize.text",
      "PageSplitter", input_col="text", output_col="pages",
      maximum_page_length=10, minimum_page_length=5), df=_text_df)
@@ -498,7 +505,10 @@ case(H + "CustomOutputParser", make=_mk("mmlspark_tpu.io.http",
      experiment=False, serialization=False)
 for _svc in ("TextSentiment", "LanguageDetector", "EntityDetector", "NER",
              "KeyPhraseExtractor", "AnalyzeImage", "OCR", "DescribeImage",
-             "TagImage", "DetectAnomalies"):
+             "TagImage", "DetectAnomalies", "GenerateThumbnails",
+             "RecognizeText", "RecognizeDomainSpecificContent", "DetectFace",
+             "FindSimilarFace", "GroupFaces", "IdentifyFaces", "VerifyFaces",
+             "SpeechToText", "BingImageSearch"):
     case(S + _svc, make=_mk("mmlspark_tpu.io.services", _svc,
          url="http://127.0.0.1:9/x"), df=_basic_df, experiment=False)
 case("mmlspark_tpu.serving.consolidator.PartitionConsolidator",
@@ -519,6 +529,7 @@ COVERED_BY_ESTIMATOR = {
     F + "assemble.FeaturizeModel": F + "assemble.Featurize",
     F + "text.IDFModel": F + "text.IDF",
     F + "text.TextFeaturizerModel": F + "text.TextFeaturizer",
+    F + "text.Word2VecModel": F + "text.Word2Vec",
     "mmlspark_tpu.gbdt.stages.GBDTClassificationModel":
         "mmlspark_tpu.gbdt.stages.GBDTClassifier",
     "mmlspark_tpu.gbdt.stages.GBDTRegressionModel":

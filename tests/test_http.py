@@ -24,7 +24,9 @@ from mmlspark_tpu.io.http import (
     CustomOutputParser, SimpleHTTPTransformer, advanced_handler,
 )
 from mmlspark_tpu.io.services import (
-    TextSentiment, DetectAnomalies, PowerBIWriter,
+    AzureSearchWriter, BingImageSearch, DetectAnomalies, DetectFace,
+    FindSimilarFace, GenerateThumbnails, GroupFaces, IdentifyFaces,
+    PowerBIWriter, SpeechToText, TextSentiment, VerifyFaces,
 )
 from mmlspark_tpu.serving import (
     ServingServer, ServingCoordinator, PartitionConsolidator,
@@ -53,11 +55,28 @@ class _EchoHandler(BaseHTTPRequestHandler):
             self.end_headers()
             return
         length = int(self.headers.get("Content-Length", 0))
-        payload = json.loads(self.rfile.read(length) or b"null")
-        reply = {"echo": payload, "n": n}
+        raw = self.rfile.read(length)
+        try:
+            payload = json.loads(raw or b"null")
+        except ValueError:  # binary bodies (e.g. SpeechToText audio)
+            payload = {"raw_len": length,
+                       "content_type": self.headers.get("Content-Type")}
+        reply = {"echo": payload, "path": self.path, "n": n}
         if isinstance(payload, dict):
             reply.update(payload)  # so field-extracting parsers see them
+        type(self).last_payload = payload
         body = json.dumps(reply).encode()
+        self.send_response(200)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def do_GET(self):
+        with type(self).lock:
+            type(self).calls += 1
+        body = json.dumps({"path": self.path,
+                           "value": [{"name": "hit"}]}).encode()
         self.send_response(200)
         self.send_header("Content-Type", "application/json")
         self.send_header("Content-Length", str(len(body)))
@@ -277,6 +296,68 @@ class TestServices:
         errors = PowerBIWriter(url, batch_size=100).write(df)
         assert errors == []
         assert handler.calls == 3  # 250 rows / 100 per batch
+
+    def test_face_suite_protocols(self, echo_server):
+        url, _ = echo_server
+        out = DetectFace(url=url, return_face_attributes=["age"]).transform(
+            DataFrame({"image_url": ["http://x/im.jpg"]}))
+        assert "returnFaceAttributes=age" in out["result"][0]["path"]
+        assert out["result"][0]["echo"]["url"] == "http://x/im.jpg"
+
+        out = FindSimilarFace(url=url, face_ids=["a", "b"]).transform(
+            DataFrame({"face_id": ["probe"]}))
+        assert out["result"][0]["faceId"] == "probe"
+        assert out["result"][0]["faceIds"] == ["a", "b"]
+
+        out = GroupFaces(url=url).transform(
+            DataFrame({"face_ids": [["f1", "f2"]]}))
+        assert out["result"][0]["faceIds"] == ["f1", "f2"]
+
+        out = IdentifyFaces(url=url, person_group_id="g").transform(
+            DataFrame({"face_ids": [["f1"]]}))
+        assert out["result"][0]["personGroupId"] == "g"
+
+        out = VerifyFaces(url=url).transform(
+            DataFrame({"face_id1": ["x"], "face_id2": ["y"]}))
+        assert out["result"][0]["faceId1"] == "x"
+        assert out["result"][0]["faceId2"] == "y"
+        assert "__verify_pair__" not in out.columns
+
+    def test_vision_extras_protocols(self, echo_server):
+        url, _ = echo_server
+        df = DataFrame({"image_url": ["http://x/im.jpg"]})
+        out = GenerateThumbnails(url=url, width=32, height=16).transform(df)
+        assert "width=32&height=16" in out["result"][0]["path"]
+        out = __import__("mmlspark_tpu.io.services", fromlist=["RecognizeText"]
+                         ).RecognizeText(url=url, mode="Handwritten").transform(df)
+        assert "mode=Handwritten" in out["result"][0]["path"]
+        rd = __import__("mmlspark_tpu.io.services",
+                        fromlist=["RecognizeDomainSpecificContent"]
+                        ).RecognizeDomainSpecificContent(
+            url=url, model="landmarks").transform(df)
+        assert "/models/landmarks/analyze" in rd["result"][0]["path"]
+
+    def test_speech_to_text_binary_body(self, echo_server):
+        url, _ = echo_server
+        audio = bytes(range(64))
+        out = SpeechToText(url=url).transform(DataFrame({"audio": [audio]}))
+        assert out["result"][0]["echo"]["raw_len"] == 64
+        assert out["result"][0]["echo"]["content_type"] == "audio/wav"
+
+    def test_bing_image_search_get(self, echo_server):
+        url, _ = echo_server
+        out = BingImageSearch(url=url, count=3).transform(
+            DataFrame({"query": ["tpu chips"]}))
+        assert out["result"][0] == [{"name": "hit"}]
+
+    def test_azure_search_writer(self, echo_server):
+        url, handler = echo_server
+        df = DataFrame({"id": ["1", "2"], "score": [0.5, 0.9]})
+        errors = AzureSearchWriter(url, key="k", batch_size=1).write(df)
+        assert errors == []
+        assert handler.calls == 2
+        assert handler.last_payload["value"][0]["@search.action"] \
+            == "mergeOrUpload"
 
     def test_powerbi_reports_failures(self):
         df = DataFrame({"a": [1]})
