@@ -1,0 +1,38 @@
+"""BASELINE config 3: CIFAR10 ResNet scoring throughput (the bench.py metric).
+
+Reference pipeline: CNTKModel.transform over the 10k CIFAR test images —
+per-partition JNI marshalling into CNTK's C++ eval engine. Here the
+whole path is one jitted bfloat16 forward over device-resident batches.
+"""
+
+import numpy as np
+
+from _common import setup_devices, timed
+
+
+def main():
+    devices = setup_devices()
+    from mmlspark_tpu.core.dataframe import DataFrame
+    from mmlspark_tpu.models.function import NNFunction
+    from mmlspark_tpu.models.nn import NNModel
+
+    model = NNFunction.init(
+        {"builder": "cifar_resnet", "depth": 20, "dtype": "bfloat16"},
+        input_shape=(32, 32, 3), seed=0)
+    rng = np.random.default_rng(0)
+    n = 10_240
+    df = DataFrame({"image": rng.uniform(0, 1, (n, 32, 32, 3))
+                    .astype(np.float32)})
+    scorer = NNModel(model=model, input_col="image", output_col="scores",
+                     batch_size=1024)
+    scorer.transform(df.head(1024))  # compile
+    with timed() as t:
+        out = scorer.transform(df)
+    assert out["scores"].shape == (n, 10)
+    rate = n / t.seconds / max(len(devices), 1)
+    print(f"resnet20 scoring: {rate:.0f} images/sec/chip "
+          f"({len(devices)} device(s))")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,41 @@
+"""BASELINE config 5: distributed data-parallel SGD training.
+
+Reference pipeline: CNTKLearner.fit — the driver writes CNTKTextFormat,
+scp's a working dir to GPU VMs, and launches `mpirun ... cntk` over ssh
+(`CommandBuilders.scala:108-267`). Here the identical capability is one
+in-process jitted train step with the batch sharded over the mesh and
+the gradient allreduce inserted by XLA — no ssh, scp, MPI, or external
+processes anywhere.
+"""
+
+import numpy as np
+
+from _common import setup_devices, timed
+
+
+def main():
+    devices = setup_devices()
+    from mmlspark_tpu.core.dataframe import DataFrame
+    from mmlspark_tpu.models.trainer import NNLearner
+
+    rng = np.random.default_rng(0)
+    n = 4096
+    y = rng.integers(0, 10, n)
+    X = (rng.normal(size=(n, 16, 16, 3)) * 0.1
+         + (y / 10.0)[:, None, None, None]).astype(np.float32)
+    df = DataFrame({"features": X, "label": y})
+
+    learner = NNLearner(
+        arch={"builder": "cifar_resnet", "depth": 8, "width": 8},
+        epochs=4, batch_size=256, learning_rate=0.05,
+        mesh_shape={"data": -1})
+    with timed() as t:
+        model = learner.fit(df)
+    scored = model.transform(df)
+    acc = float((np.asarray(scored["scores"]).argmax(axis=1) == y).mean())
+    print(f"data-parallel SGD over {len(devices)} device(s): "
+          f"{t.seconds:.1f}s, train accuracy={acc:.3f}")
+
+
+if __name__ == "__main__":
+    main()

@@ -233,11 +233,6 @@ class Booster:
         if hist_impl == "auto":
             hist_impl = ("pallas" if sharding is None and pallas_available()
                          else "xla")
-        elif hist_impl == "pallas" and not pallas_available():
-            raise ValueError(
-                "histogram_impl='pallas' needs a TPU backend; use 'auto' "
-                "(selects the right engine) or 'pallas_interpret' for "
-                "CPU debugging")
         elif hist_impl != "xla" and sharding is not None:
             # the pallas kernel has no GSPMD partitioning rule; sharded
             # fits always take the XLA path (its reductions become psums)
@@ -245,6 +240,11 @@ class Booster:
             warnings.warn("histogram_impl='pallas' is single-device only; "
                           "falling back to 'xla' for the sharded fit")
             hist_impl = "xla"
+        elif hist_impl == "pallas" and not pallas_available():
+            raise ValueError(
+                "histogram_impl='pallas' needs a TPU backend; use 'auto' "
+                "(selects the right engine) or 'pallas_interpret' for "
+                "CPU debugging")
         grower = TreeGrower(mapper, params.growth(), bins_np.shape[1], n_bins,
                             hist_impl=hist_impl, tree_learner=tree_learner,
                             mesh=sharding.mesh if sharding is not None else None,

@@ -281,6 +281,8 @@ class VerifyFaces(CognitiveServiceBase):
         if value is None:
             return None
         f1, f2 = value
+        if f1 is None or f2 is None:  # null skip, like every other binding
+            return None
         return HTTPRequestData.post_json(
             self.url, {"faceId1": str(f1), "faceId2": str(f2)},
             self._headers())

@@ -74,12 +74,21 @@ def _find_or_build(name: str) -> str:
             os.path.getmtime(built) >= os.path.getmtime(s) for s in sources):
         return built
     os.makedirs(_BUILD_DIR, exist_ok=True)
+    # compile to a private temp name, then atomically publish: concurrent
+    # builders (pytest-xdist, two cold-starting services) must never see
+    # a half-written .so
+    tmp = f"{built}.tmp.{os.getpid()}"
     cmd = ["g++", "-O2", "-std=c++17", "-shared", "-fPIC", "-pthread",
-           *sources, "-o", built, *_LINK_FLAGS.get(name, [])]
+           *sources, "-o", tmp, *_LINK_FLAGS.get(name, [])]
     proc = subprocess.run(cmd, capture_output=True, text=True)
     if proc.returncode != 0:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
         raise RuntimeError(
             f"native build of {name} failed:\n{proc.stderr[-2000:]}")
+    os.replace(tmp, built)
     return built
 
 

@@ -93,6 +93,10 @@ bool expand_zip(const std::string& zip_path, const std::vector<uint8_t>& blob,
     uint16_t method = rd16(&blob[p + 10]);
     uint32_t csize = rd32(&blob[p + 20]);
     uint32_t usize = rd32(&blob[p + 24]);
+    if (csize == 0xFFFFFFFFu || usize == 0xFFFFFFFFu) {
+      *err = "zip64 archives are not supported";
+      return false;
+    }
     uint16_t name_len = rd16(&blob[p + 28]);
     uint16_t extra_len = rd16(&blob[p + 30]);
     uint16_t comment_len = rd16(&blob[p + 32]);
@@ -113,7 +117,9 @@ bool expand_zip(const std::string& zip_path, const std::vector<uint8_t>& blob,
       rec.data.assign(blob.begin() + data_off, blob.begin() + data_off + csize);
     } else if (method == 8) {  // deflate
       rec.data.resize(usize);
-      if (!inflate_raw(&blob[data_off], csize, &rec.data)) {
+      // empty members: zlib rejects a null next_out, and there is
+      // nothing to inflate anyway
+      if (usize > 0 && !inflate_raw(&blob[data_off], csize, &rec.data)) {
         *err = "zip: inflate failed for " + name;
         return false;
       }
@@ -239,7 +245,18 @@ class Reader {
         if (stop_) return;
         idx = next_to_read_++;
       }
-      FileResult res = read_one(idx);
+      // any escape (bad_alloc on a huge file, filesystem surprise) must
+      // surface as a record error, not std::terminate the host process
+      FileResult res;
+      try {
+        res = read_one(idx);
+      } catch (const std::exception& e) {
+        res.records.clear();
+        res.error = std::string("native reader exception: ") + e.what();
+      } catch (...) {
+        res.records.clear();
+        res.error = "native reader exception";
+      }
       {
         std::lock_guard<std::mutex> lk(mu_);
         done_[idx] = std::move(res);

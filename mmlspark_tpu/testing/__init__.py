@@ -1,0 +1,10 @@
+"""Test-infrastructure components shipped with the framework.
+
+Parity: the reference packages its test harness as library code under
+`core/test/` (TestBase, Benchmarks, datagen) so downstream modules and
+users regression-gate their own models the same way.
+"""
+
+from mmlspark_tpu.testing.benchmarks import Benchmarks
+
+__all__ = ["Benchmarks"]

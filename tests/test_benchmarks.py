@@ -1,0 +1,100 @@
+"""CSV-gated quality benchmarks (reference Benchmarks.scala pattern).
+
+The committed `tests/resources/benchmarks_gbdt.csv` is the gate: each
+entry is a model-quality metric across boosting modes/objectives on
+deterministic sklearn datasets, compared within per-entry precision.
+On drift, `new_benchmarks_gbdt.csv` appears next to it with the
+measured values for review (parity: `Benchmarks.scala:35-113`,
+`benchmarks_VerifyLightGBMClassifier.csv`).
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+from mmlspark_tpu.gbdt import Booster, BoosterParams
+from mmlspark_tpu.testing import Benchmarks
+
+RESOURCES = os.path.join(os.path.dirname(__file__), "resources")
+
+
+def _split(X, y, seed=0):
+    rng = np.random.default_rng(seed)
+    perm = rng.permutation(len(X))
+    X, y = X[perm], y[perm]
+    n = int(0.8 * len(X))
+    return X[:n], y[:n], X[n:], y[n:]
+
+
+def _auc(y, s):
+    from sklearn.metrics import roc_auc_score
+    return float(roc_auc_score(y, s))
+
+
+@pytest.mark.slow
+def test_gbdt_quality_gates():
+    from sklearn.datasets import load_breast_cancer, load_diabetes, load_wine
+    bench = Benchmarks(RESOURCES, "gbdt")
+
+    Xtr, ytr, Xte, yte = _split(*load_breast_cancer(return_X_y=True))
+    for mode in ("gbdt", "rf", "dart", "goss"):
+        p = BoosterParams(objective="binary", boosting_type=mode,
+                          num_iterations=40, num_leaves=15,
+                          min_data_in_leaf=5, bagging_fraction=0.8,
+                          bagging_freq=1, seed=0)
+        b = Booster.train(p, Xtr, ytr)
+        bench.add(f"breast_cancer_{mode}_auc", _auc(yte, b.predict(Xte)))
+
+    Xtr, ytr, Xte, yte = _split(*load_wine(return_X_y=True))
+    p = BoosterParams(objective="multiclass", num_class=3,
+                      num_iterations=40, num_leaves=7, min_data_in_leaf=3,
+                      seed=0)
+    b = Booster.train(p, Xtr, ytr)
+    acc = float((np.argmax(b.predict(Xte), axis=1) == yte).mean())
+    bench.add("wine_multiclass_accuracy", acc)
+
+    Xtr, ytr, Xte, yte = _split(*load_diabetes(return_X_y=True))
+    for obj in ("regression", "regression_l1", "quantile", "poisson"):
+        p = BoosterParams(objective=obj, num_iterations=60, num_leaves=15,
+                          min_data_in_leaf=10, learning_rate=0.08, seed=0)
+        b = Booster.train(p, Xtr, np.abs(ytr))
+        rmse = float(np.sqrt(np.mean((b.predict(Xte) - np.abs(yte)) ** 2)))
+        bench.add(f"diabetes_{obj}_rmse", rmse)
+
+    bench.verify()
+
+
+class TestHarness:
+    """The harness itself (drift detection, new-CSV emission)."""
+
+    def test_pass_and_drift(self, tmp_path):
+        path = tmp_path / "benchmarks_demo.csv"
+        path.write_text("name,value,precision\nm1,1.0,0.1\nm2,5.0,0.5\n")
+        ok = Benchmarks(str(tmp_path), "demo")
+        ok.add("m1", 1.05)
+        ok.add("m2", 4.8)
+        ok.verify()  # within precision
+
+        bad = Benchmarks(str(tmp_path), "demo")
+        bad.add("m1", 1.5)
+        bad.add("m2", 4.8)
+        with pytest.raises(AssertionError, match="m1"):
+            bad.verify()
+        assert (tmp_path / "new_benchmarks_demo.csv").exists()
+
+    def test_missing_and_extra_entries(self, tmp_path):
+        (tmp_path / "benchmarks_d2.csv").write_text(
+            "name,value,precision\nm1,1.0,0.1\n")
+        b = Benchmarks(str(tmp_path), "d2")
+        b.add("m_new", 2.0)
+        with pytest.raises(AssertionError) as e:
+            b.verify()
+        assert "m_new" in str(e.value) and "m1" in str(e.value)
+
+    def test_first_run_writes_csv(self, tmp_path):
+        b = Benchmarks(str(tmp_path), "fresh")
+        b.add("m", 3.0)
+        with pytest.raises(AssertionError, match="no committed"):
+            b.verify()
+        assert (tmp_path / "new_benchmarks_fresh.csv").exists()

@@ -318,9 +318,10 @@ class TestServices:
         assert out["result"][0]["personGroupId"] == "g"
 
         out = VerifyFaces(url=url).transform(
-            DataFrame({"face_id1": ["x"], "face_id2": ["y"]}))
+            DataFrame({"face_id1": ["x", None], "face_id2": ["y", "z"]}))
         assert out["result"][0]["faceId1"] == "x"
         assert out["result"][0]["faceId2"] == "y"
+        assert out["result"][1] is None  # null id -> row skipped
         assert "__verify_pair__" not in out.columns
 
     def test_vision_extras_protocols(self, echo_server):

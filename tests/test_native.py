@@ -91,6 +91,18 @@ class TestNativeReader:
         recs = list(native_read_records(str(tree / "b.txt")))
         assert len(recs) == 1 and recs[0][1] == b"hello world"
 
+    def test_empty_deflated_member(self, tmp_path):
+        """Empty members compressed with deflate must parse as b''."""
+        from mmlspark_tpu.io.binary import read_binary_files
+        with zipfile.ZipFile(tmp_path / "e.zip", "w",
+                             compression=zipfile.ZIP_DEFLATED) as zf:
+            zf.writestr("empty.txt", b"")
+            zf.writestr("full.txt", b"data")
+        nat = read_binary_files(str(tmp_path), engine="native")
+        py = read_binary_files(str(tmp_path), engine="python")
+        assert list(nat["path"]) == list(py["path"])
+        assert list(nat["bytes"]) == list(py["bytes"])
+
     def test_missing_path_raises_like_python(self, tmp_path):
         from mmlspark_tpu.io.binary import read_binary_files
         for engine in ("native", "python"):
