@@ -91,14 +91,28 @@ class NNModel(Model, HasInputCol, HasOutputCol):
         bs = max(self.batch_size, n_shards)
         bs -= bs % n_shards  # static per-device shapes
 
+        # bounded async pipeline: JAX dispatch is asynchronous, so keeping
+        # a few minibatches in flight overlaps host->device transfer,
+        # compute, and device->host readback instead of serializing them
+        # (the np.asarray readback is the only sync point)
+        from collections import deque
+        inflight: deque = deque()
         outs = []
+
+        def drain_one():
+            out, n = inflight.popleft()
+            outs.append(np.asarray(unpad(out, n)))
+
         for start in range(0, len(x), bs):
             chunk = x[start:start + bs]
             padded, n = pad_to_multiple(chunk, bs)
             if in_sharding is not None:
                 padded = jax.device_put(padded, in_sharding)
-            out = self._jitted(params, padded)
-            outs.append(np.asarray(unpad(out, n)))
+            inflight.append((self._jitted(params, padded), n))
+            if len(inflight) >= 3:
+                drain_one()
+        while inflight:
+            drain_one()
         if outs:
             result = np.concatenate(outs)
         else:

@@ -83,6 +83,12 @@ bool expand_zip(const std::string& zip_path, const std::vector<uint8_t>& blob,
   if (eocd == std::string::npos) { *err = "zip: no EOCD"; return false; }
   uint16_t n_entries = rd16(&blob[eocd + 10]);
   uint32_t cd_off = rd32(&blob[eocd + 16]);
+  // zip64 sentinels in the EOCD: >65535 members or a 64-bit directory
+  // offset would silently truncate the member list if parsed as zip32
+  if (n_entries == 0xFFFFu || cd_off == 0xFFFFFFFFu) {
+    *err = "zip64 archives are not supported";
+    return false;
+  }
 
   size_t p = cd_off;
   for (uint16_t e = 0; e < n_entries; ++e) {

@@ -17,10 +17,12 @@ from mmlspark_tpu.parallel.ring_attention import (
     ring_attention,
     ring_attention_local,
 )
+from mmlspark_tpu.parallel.pallas_attention import flash_block_attn
 
 __all__ = [
     "MeshSpec",
     "dense_attention",
+    "flash_block_attn",
     "ring_attention",
     "ring_attention_local",
     "build_mesh",

@@ -1,0 +1,163 @@
+"""Pallas flash-attention kernel for the ring-attention block step.
+
+Drop-in replacement for ``ring_attention._block_attn`` (same
+``(m, l, o)`` streaming-softmax partials contract) that never
+materializes the (Sq × Sk) score matrix in HBM: the KV dimension is the
+innermost grid axis, with the running max / normalizer / unnormalized
+accumulator carried in VMEM scratch across KV tiles (the canonical TPU
+flash pattern — see the pallas guide's grid/scratch sections). QK^T and
+P·V run on the MXU per (128 × 128) tile.
+
+Masking uses *global position* operands rather than block indices so the
+one kernel serves every ring step: each device's local Q block carries
+its global positions, the rotating KV block carries the origin rank's,
+and the causal rule ``q_pos >= k_pos`` reproduces full visibility /
+no visibility / the diagonal automatically. Sequence padding rides the
+same mechanism (padded keys get the INT32-max sentinel position, masked
+out even in bidirectional mode).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+Q_TILE = 128
+KV_TILE = 128
+LANE = 128           # pad head_dim to the lane width
+_NEG_INF = -1e30
+_PAD_POS = np.iinfo(np.int32).max  # sentinel: padded key, always masked
+
+
+def _round_up(x: int, m: int) -> int:
+    return ((x + m - 1) // m) * m
+
+
+def _vma(x):
+    """Varying-manual-axes of ``x`` (empty outside shard_map)."""
+    return getattr(jax.typeof(x), "vma", frozenset()) or frozenset()
+
+
+def _flash_kernel(qpos_ref, kpos_ref, q_ref, k_ref, v_ref,
+                  o_ref, m_ref, l_ref,
+                  acc, m_scr, l_scr, *, scale: float, causal: bool):
+    """One (batch*head, q-tile, kv-tile) step of streaming attention."""
+    kv_idx = pl.program_id(2)
+
+    @pl.when(kv_idx == 0)
+    def _():
+        acc[:] = jnp.zeros_like(acc)
+        m_scr[:] = jnp.full_like(m_scr, _NEG_INF)
+        l_scr[:] = jnp.zeros_like(l_scr)
+
+    q = q_ref[0]                                       # (TQ, D)
+    s = jax.lax.dot_general(q, k_ref[0],
+                            (((1,), (1,)), ((), ())),
+                            preferred_element_type=jnp.float32) * scale
+    qpos = qpos_ref[0]                                 # (TQ,)
+    kpos = kpos_ref[0]                                 # (TK,)
+    mask = (kpos != _PAD_POS)[None, :]
+    if causal:
+        mask = mask & (qpos[:, None] >= kpos[None, :])
+    s = jnp.where(mask, s, _NEG_INF)
+
+    m_prev = m_scr[:]                                  # (TQ, 1)
+    m_new = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
+    p = jnp.exp(s - m_new)
+    # fully-masked rows: m_new == -1e30 makes exp(s - m_new) = exp(0);
+    # kill those ones so l stays 0 and the ring merge sees "no data"
+    p = jnp.where(mask, p, 0.0)
+    alpha = jnp.exp(m_prev - m_new)                    # (TQ, 1)
+    l_scr[:] = l_scr[:] * alpha + jnp.sum(p, axis=1, keepdims=True)
+    acc[:] = acc[:] * alpha + jnp.dot(
+        p, v_ref[0], preferred_element_type=jnp.float32)
+    m_scr[:] = m_new
+
+    @pl.when(kv_idx == pl.num_programs(2) - 1)
+    def _():
+        o_ref[0] = acc[:]                              # unnormalized
+        m_ref[0] = m_scr[:]                            # (TQ, 1)
+        l_ref[0] = l_scr[:]
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("scale", "causal", "interpret"))
+def _flash_call(q, k, v, q_pos, k_pos, scale: float, causal: bool,
+                interpret: bool):
+    """q (BH, Sq, D), k/v (BH, Sk, D), positions (1, S*) int32 (padded)."""
+    bh, sq, d = q.shape
+    sk = k.shape[1]
+    grid = (bh, sq // Q_TILE, sk // KV_TILE)
+    kernel = functools.partial(_flash_kernel, scale=scale, causal=causal)
+    return pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, Q_TILE), lambda b, i, j: (0, i)),
+            pl.BlockSpec((1, KV_TILE), lambda b, i, j: (0, j)),
+            pl.BlockSpec((1, Q_TILE, d), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, KV_TILE, d), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((1, KV_TILE, d), lambda b, i, j: (b, j, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, Q_TILE, d), lambda b, i, j: (b, i, 0)),
+            # stats as (.., TQ, 1) blocks: a trailing dim equal to the
+            # full array dim satisfies the TPU (8, 128) tiling rule
+            pl.BlockSpec((1, Q_TILE, 1), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, Q_TILE, 1), lambda b, i, j: (b, i, 0)),
+        ],
+        out_shape=[
+            # propagate the varying-manual-axes type so the kernel also
+            # composes inside VMA-checked shard_map (the ring body)
+            jax.ShapeDtypeStruct((bh, sq, d), jnp.float32, vma=_vma(q)),
+            jax.ShapeDtypeStruct((bh, sq, 1), jnp.float32, vma=_vma(q)),
+            jax.ShapeDtypeStruct((bh, sq, 1), jnp.float32, vma=_vma(q)),
+        ],
+        scratch_shapes=[
+            # acc / running-max / normalizer live across KV tiles
+            pltpu.VMEM((Q_TILE, d), jnp.float32),
+            pltpu.VMEM((Q_TILE, 1), jnp.float32),
+            pltpu.VMEM((Q_TILE, 1), jnp.float32),
+        ],
+        interpret=interpret,
+    )(q_pos, k_pos, q, k, v)
+
+
+def flash_block_attn(q, k, v, scale, q_pos, k_pos, causal: bool,
+                     interpret: bool = False):
+    """``_block_attn`` twin: returns (m (B,H,Sq), l (B,H,Sq),
+    o (B,Sq,H,Dh) unnormalized) for the online-softmax ring merge.
+
+    q (B, Sq, H, Dh); k, v (B, Sk, H, Dh); *_pos (S*,) int32 global
+    positions. Handles arbitrary (unaligned) Sq/Sk/Dh by padding to the
+    (128, 128) flash tiles; padded keys carry a sentinel position and
+    can never contribute.
+    """
+    b, sq, h, d = q.shape
+    sk = k.shape[1]
+    sq_p, sk_p, d_p = (_round_up(sq, Q_TILE), _round_up(sk, KV_TILE),
+                       _round_up(d, LANE))
+
+    def to_bh(x, s, s_pad):                    # (B,S,H,D) -> (B*H, S_p, D_p)
+        x = jnp.swapaxes(x, 1, 2).reshape(b * h, s, d)
+        return jnp.pad(x, ((0, 0), (0, s_pad - s), (0, d_p - d)))
+
+    qpos_p = jnp.pad(jnp.asarray(q_pos, jnp.int32), (0, sq_p - sq))[None]
+    kpos_p = jnp.pad(jnp.asarray(k_pos, jnp.int32), (0, sk_p - sk),
+                     constant_values=_PAD_POS)[None]
+    o, m, l = _flash_call(to_bh(q, sq, sq_p), to_bh(k, sk, sk_p),
+                          to_bh(v, sk, sk_p), qpos_p, kpos_p,
+                          float(scale), causal, interpret)
+    o = o[:, :sq, :d].reshape(b, h, sq, d).swapaxes(1, 2)  # (B,Sq,H,Dh)
+    m = m[:, :sq, 0].reshape(b, h, sq)
+    l = l[:, :sq, 0].reshape(b, h, sq)
+    return m.astype(q.dtype), l.astype(q.dtype), o.astype(q.dtype)
+
+
+def flash_available() -> bool:
+    return jax.default_backend() == "tpu"

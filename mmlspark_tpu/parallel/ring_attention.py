@@ -49,7 +49,8 @@ def _block_attn(q, k, v, scale, q_pos, k_pos, causal):
 
 
 def ring_attention_local(q, k, v, axis_name: str, causal: bool = True,
-                         scale: Optional[float] = None):
+                         scale: Optional[float] = None,
+                         block_impl: str = "dense"):
     """Exact attention with sequence sharded over ``axis_name`` (per-device).
 
     Must run inside ``shard_map``. ``q/k/v``: [B, S_local, H, Dh] — the
@@ -57,7 +58,27 @@ def ring_attention_local(q, k, v, axis_name: str, causal: bool = True,
     a rank holds the block that started ``t`` ranks behind it. Replaces
     nothing in the reference (no analogue); designed per the blockwise
     ring-attention recipe so context length scales with the ``seq`` axis.
+
+    ``block_impl``: the per-step block attention. ``dense`` (default)
+    materializes the (Sq × Sk_local) scores in XLA and is
+    differentiable — training uses it; ``flash`` is the Pallas
+    streaming kernel (``pallas_attention.py``) that never does
+    (forward-only: no VJP yet — use for scoring/serving);
+    ``flash_interpret`` runs it interpreted (CPU debugging; requires
+    ``check_vma=False`` on the enclosing shard_map); ``auto`` picks
+    flash on TPU backends.
     """
+    if block_impl == "auto":
+        from mmlspark_tpu.parallel.pallas_attention import flash_available
+        block_impl = "flash" if flash_available() else "dense"
+    if block_impl in ("flash", "flash_interpret"):
+        from mmlspark_tpu.parallel.pallas_attention import flash_block_attn
+        block_fn = functools.partial(
+            flash_block_attn, interpret=(block_impl == "flash_interpret"))
+    elif block_impl == "dense":
+        block_fn = _block_attn
+    else:
+        raise ValueError(f"unknown block_impl {block_impl!r}")
     n = jax.lax.axis_size(axis_name)
     idx = jax.lax.axis_index(axis_name)
     b, s_local, h, dh = q.shape
@@ -70,7 +91,7 @@ def ring_attention_local(q, k, v, axis_name: str, causal: bool = True,
         m, l, o, k_t, v_t = carry
         src = (idx - t) % n                               # origin rank of block
         k_pos = src * s_local + jnp.arange(s_local)
-        bm, bl, bo = _block_attn(q, k_t, v_t, scale, q_pos, k_pos, causal)
+        bm, bl, bo = block_fn(q, k_t, v_t, scale, q_pos, k_pos, causal)
         m_new = jnp.maximum(m, bm)
         c_old = jnp.exp(m - m_new)                        # rescale old state
         c_blk = jnp.exp(bm - m_new)
@@ -109,17 +130,23 @@ def dense_attention(q, k, v, causal: bool = True,
 
 
 def ring_attention(q, k, v, mesh, axis_name: str = "seq",
-                   causal: bool = True):
+                   causal: bool = True, block_impl: str = "dense"):
     """Standalone sharded ring attention over ``mesh`` (convenience).
 
     q/k/v: full arrays [B, S, H, Dh]; batch over ``data`` if that axis
-    exists in the mesh, sequence over ``axis_name``.
+    exists in the mesh, sequence over ``axis_name``. ``block_impl`` as
+    in :func:`ring_attention_local` (``flash*`` variants are
+    forward-only and run with VMA checking off).
     """
     from jax.sharding import PartitionSpec as P
+    from mmlspark_tpu.parallel.collectives import shard_map_fn
 
     batch_axis = "data" if "data" in mesh.axis_names else None
     spec = P(batch_axis, axis_name)
-    fn = jax.shard_map(
-        lambda q_, k_, v_: ring_attention_local(q_, k_, v_, axis_name, causal),
-        mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec)
+    fn = shard_map_fn(
+        lambda q_, k_, v_: ring_attention_local(q_, k_, v_, axis_name,
+                                                causal,
+                                                block_impl=block_impl),
+        mesh, in_specs=(spec, spec, spec), out_specs=spec,
+        check_vma=(block_impl == "dense"))
     return fn(q, k, v)

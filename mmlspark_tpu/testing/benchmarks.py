@@ -52,8 +52,13 @@ class Benchmarks:
             w = csv.writer(f)
             w.writerow(["name", "value", "precision"])
             for entry, value in self.entries:
+                # entries without a committed precision get a
+                # scale-relative default (5%), not an absolute one — a
+                # copied-over gate must tolerate normal numeric jitter
+                # on any metric scale
+                default = max(abs(value) * 0.05, 1e-3)
                 w.writerow([entry, f"{value:.6g}",
-                            precisions.get(entry, 0.01)])
+                            f"{precisions.get(entry, default):.4g}"])
 
     def verify(self) -> None:
         """Raise AssertionError on drift; write ``new_benchmarks_*.csv``.
