@@ -1,0 +1,38 @@
+"""Namespaced logger factory.
+
+Parity: `core/env/src/main/scala/Logging.scala:14-22` — per-namespace
+log4j2 loggers under one root. Here stdlib logging under the
+``mmlspark_tpu`` root, with the level configurable via the ``logging``
+config namespace (``MMLSPARK_TPU_LOGGING_LEVEL=DEBUG`` or the config
+file — see ``core/config.py``).
+"""
+
+from __future__ import annotations
+
+import logging as _logging
+
+_ROOT = "mmlspark_tpu"
+_configured = False
+
+
+def _ensure_root() -> None:
+    global _configured
+    if _configured:
+        return
+    from mmlspark_tpu.core.config import MMLConfig
+    root = _logging.getLogger(_ROOT)
+    if not root.handlers:
+        handler = _logging.StreamHandler()
+        handler.setFormatter(_logging.Formatter(
+            "%(asctime)s %(name)s %(levelname)s: %(message)s"))
+        root.addHandler(handler)
+        root.propagate = False
+    level = str(MMLConfig.get("logging").get("level", "INFO")).upper()
+    root.setLevel(getattr(_logging, level, _logging.INFO))
+    _configured = True
+
+
+def get_logger(namespace: str) -> _logging.Logger:
+    """Logger at ``mmlspark_tpu.<namespace>`` (created on first use)."""
+    _ensure_root()
+    return _logging.getLogger(f"{_ROOT}.{namespace}")

@@ -1,0 +1,45 @@
+"""Profiling hooks: stage timing + device traces.
+
+The reference's observability is wall-clock stage timing (`Timer` stage,
+`pipeline-stages/Timer.scala:14-90`; suite timing in `TestBase.scala`).
+The TPU build keeps that parity (the ``Timer`` stage in
+``stages/basic.py``) and adds what the platform does natively: XLA
+device traces viewable in TensorBoard/Perfetto via the jax profiler.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import time
+from typing import Iterator, Optional
+
+
+@contextlib.contextmanager
+def device_trace(log_dir: str) -> Iterator[None]:
+    """Capture a jax profiler trace (TensorBoard/Perfetto) around a block::
+
+        with device_trace("/tmp/trace"):
+            model.transform(df)
+    """
+    import jax
+    with jax.profiler.trace(log_dir):
+        yield
+
+
+@contextlib.contextmanager
+def timed_span(name: str, logger=None) -> Iterator[dict]:
+    """Wall-clock span that also annotates the device trace.
+
+    Yields a dict whose ``seconds`` key is filled on exit; logs through
+    the framework logger when ``logger`` is None.
+    """
+    import jax
+    out = {"name": name, "seconds": None}
+    t0 = time.perf_counter()
+    with jax.profiler.TraceAnnotation(name):
+        yield out
+    out["seconds"] = time.perf_counter() - t0
+    if logger is None:
+        from mmlspark_tpu.core.logs import get_logger
+        logger = get_logger("profiling")
+    logger.info("%s: %.3fs", name, out["seconds"])

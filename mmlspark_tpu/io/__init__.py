@@ -1,5 +1,6 @@
 from mmlspark_tpu.io.binary import read_binary_files
 from mmlspark_tpu.io.images import read_images, decode_image, encode_image
+from mmlspark_tpu.io.streaming import FileStreamSource
 from mmlspark_tpu.io.http import (
     HTTPRequestData, HTTPResponseData, HTTPClient, HTTPTransformer,
     SimpleHTTPTransformer, JSONInputParser, JSONOutputParser,
@@ -8,6 +9,7 @@ from mmlspark_tpu.io.http import (
 )
 
 __all__ = [
+    "FileStreamSource",
     "read_binary_files", "read_images", "decode_image", "encode_image",
     "HTTPRequestData", "HTTPResponseData", "HTTPClient", "HTTPTransformer",
     "SimpleHTTPTransformer", "JSONInputParser", "JSONOutputParser",

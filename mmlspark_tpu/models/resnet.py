@@ -20,6 +20,16 @@ import jax.numpy as jnp
 from mmlspark_tpu.models.function import LayeredModel, NNFunction
 
 
+def _group_norm(channels: int) -> nn.GroupNorm:
+    """GroupNorm with the largest group count <= 32 that divides channels
+    (num_groups must divide evenly; widths like 12 -> 48 channels would
+    otherwise crash at init)."""
+    g = min(32, channels)
+    while channels % g:
+        g -= 1
+    return nn.GroupNorm(num_groups=g)
+
+
 class ResNetBlock(nn.Module):
     """Pre-activation residual block (GroupNorm + ReLU)."""
 
@@ -30,11 +40,11 @@ class ResNetBlock(nn.Module):
     @nn.compact
     def __call__(self, x):
         residual = x
-        y = nn.GroupNorm(num_groups=min(32, x.shape[-1]))(x)
+        y = _group_norm(x.shape[-1])(x)
         y = nn.relu(y)
         y = nn.Conv(self.features, (3, 3), strides=(self.stride, self.stride),
                     use_bias=False, dtype=self.dtype)(y)
-        y = nn.GroupNorm(num_groups=min(32, self.features))(y)
+        y = _group_norm(self.features)(y)
         y = nn.relu(y)
         y = nn.Conv(self.features, (3, 3), use_bias=False, dtype=self.dtype)(y)
         if residual.shape != y.shape:
@@ -49,12 +59,14 @@ class _BlockGroup(nn.Module):
     n_blocks: int
     stride: int
     dtype: Any = jnp.float32
+    block_cls: Callable[..., nn.Module] = ResNetBlock
 
     @nn.compact
     def __call__(self, x):
         for i in range(self.n_blocks):
-            x = ResNetBlock(self.features, stride=self.stride if i == 0 else 1,
-                            dtype=self.dtype)(x)
+            x = self.block_cls(self.features,
+                               stride=self.stride if i == 0 else 1,
+                               dtype=self.dtype)(x)
         return x
 
 
@@ -84,6 +96,75 @@ def cifar_resnet(depth: int = 20, num_classes: int = 10,
         ("z", nn.Dense(num_classes)),
     )
     return LayeredModel(layers=layers)
+
+
+class BottleneckBlock(nn.Module):
+    """Pre-activation 1-3-1 bottleneck (ResNet-50-family)."""
+
+    features: int                 # inner width; output is 4x
+    stride: int = 1
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x):
+        residual = x
+        out_f = 4 * self.features
+        y = _group_norm(x.shape[-1])(x)
+        y = nn.relu(y)
+        y = nn.Conv(self.features, (1, 1), use_bias=False, dtype=self.dtype)(y)
+        y = _group_norm(self.features)(y)
+        y = nn.relu(y)
+        y = nn.Conv(self.features, (3, 3),
+                    strides=(self.stride, self.stride),
+                    use_bias=False, dtype=self.dtype)(y)
+        y = _group_norm(self.features)(y)
+        y = nn.relu(y)
+        y = nn.Conv(out_f, (1, 1), use_bias=False, dtype=self.dtype)(y)
+        if residual.shape != y.shape:
+            residual = nn.Conv(out_f, (1, 1),
+                               strides=(self.stride, self.stride),
+                               use_bias=False, dtype=self.dtype)(residual)
+        return y + residual
+
+
+_IMAGENET_LAYOUTS = {
+    18: ((2, 2, 2, 2), ResNetBlock),
+    34: ((3, 4, 6, 3), ResNetBlock),
+    50: ((3, 4, 6, 3), BottleneckBlock),
+    101: ((3, 4, 23, 3), BottleneckBlock),
+}
+
+
+@NNFunction.register_builder("imagenet_resnet")
+def imagenet_resnet(depth: int = 50, num_classes: int = 1000,
+                    width: int = 64, dtype: str = "float32") -> nn.Module:
+    """ImageNet-class ResNet (18/34/50/101) — the model-zoo ResNet parity
+    (`ModelDownloader` nets like ResNet50, `Schema.scala:54-74`).
+
+    7x7/2 stem + maxpool, four groups (stride 2 between), global pool,
+    logits. ``pool`` is the transfer-learning feature layer (2048-dim at
+    depth 50), as in the reference's ImageFeaturizer cut.
+    """
+    if depth not in _IMAGENET_LAYOUTS:
+        raise ValueError(f"depth must be one of {sorted(_IMAGENET_LAYOUTS)}")
+    blocks, block_cls = _IMAGENET_LAYOUTS[depth]
+    dt = jnp.bfloat16 if dtype == "bfloat16" else jnp.float32
+
+    def stem_pool(x):
+        return nn.max_pool(x, (3, 3), strides=(2, 2), padding="SAME")
+
+    layers = [
+        ("conv_in", nn.Conv(width, (7, 7), strides=(2, 2),
+                            use_bias=False, dtype=dt)),
+        ("stem_pool", stem_pool),
+    ]
+    for g, n_blocks in enumerate(blocks):
+        layers.append((f"group{g + 1}",
+                       _BlockGroup(width * (2 ** g), n_blocks,
+                                   1 if g == 0 else 2, dt,
+                                   block_cls=block_cls)))
+    layers += [("pool", _global_pool), ("z", nn.Dense(num_classes))]
+    return LayeredModel(layers=tuple(layers))
 
 
 @NNFunction.register_builder("cifar_convnet")

@@ -83,9 +83,13 @@ bool expand_zip(const std::string& zip_path, const std::vector<uint8_t>& blob,
   if (eocd == std::string::npos) { *err = "zip: no EOCD"; return false; }
   uint16_t n_entries = rd16(&blob[eocd + 10]);
   uint32_t cd_off = rd32(&blob[eocd + 16]);
-  // zip64 sentinels in the EOCD: >65535 members or a 64-bit directory
-  // offset would silently truncate the member list if parsed as zip32
-  if (n_entries == 0xFFFFu || cd_off == 0xFFFFFFFFu) {
+  // zip64: a sentinel field alone is not proof (a legal zip32 archive can
+  // hold exactly 65535 members) — the discriminator is the zip64 EOCD
+  // locator record (sig 0x07064b50, 20 bytes) directly before the EOCD
+  bool has_z64_locator =
+      eocd >= 20 && rd32(&blob[eocd - 20]) == 0x07064b50u;
+  if (has_z64_locator &&
+      (n_entries == 0xFFFFu || cd_off == 0xFFFFFFFFu)) {
     *err = "zip64 archives are not supported";
     return false;
   }

@@ -141,6 +141,10 @@ def ring_attention(q, k, v, mesh, axis_name: str = "seq",
     from jax.sharding import PartitionSpec as P
     from mmlspark_tpu.parallel.collectives import shard_map_fn
 
+    if block_impl == "auto":  # resolve BEFORE wiring check_vma so the
+        # dense resolution keeps VMA type-checking enabled
+        from mmlspark_tpu.parallel.pallas_attention import flash_available
+        block_impl = "flash" if flash_available() else "dense"
     batch_axis = "data" if "data" in mesh.axis_names else None
     spec = P(batch_axis, axis_name)
     fn = shard_map_fn(

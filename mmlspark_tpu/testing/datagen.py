@@ -1,0 +1,80 @@
+"""Synthetic frame generation for property tests.
+
+Parity: `core/test/datagen/src/main/scala/GenerateDataset.scala` +
+``DatasetOptions`` — random DataFrames with constrained schemas and
+controlled missing values, so stage property tests can sweep input
+shapes without hand-writing fixtures.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, Optional, Sequence
+
+import numpy as np
+
+from mmlspark_tpu.core.dataframe import DataFrame, obj_col
+
+
+@dataclasses.dataclass
+class ColumnOptions:
+    """Constraints for one generated column."""
+
+    kind: str = "double"        # double | int | bool | string | vector | categorical
+    missing_ratio: float = 0.0  # NaN (numeric) / None (object) injection
+    low: float = -100.0
+    high: float = 100.0
+    dim: int = 4                # vector width
+    levels: Sequence[str] = ("a", "b", "c")
+    string_len: int = 8
+
+
+def generate_column(rng: np.random.Generator, n: int,
+                    opt: ColumnOptions) -> np.ndarray:
+    if opt.kind == "double":
+        col = rng.uniform(opt.low, opt.high, n)
+        if opt.missing_ratio > 0:
+            col[rng.random(n) < opt.missing_ratio] = np.nan
+        return col
+    if opt.kind == "int":
+        return rng.integers(int(opt.low), int(opt.high) + 1, n)
+    if opt.kind == "bool":
+        return rng.random(n) < 0.5
+    if opt.kind == "vector":
+        return rng.normal(size=(n, opt.dim))
+    if opt.kind == "categorical":
+        vals = rng.choice(list(opt.levels), size=n)
+        out = obj_col(list(vals))
+    elif opt.kind == "string":
+        letters = np.array(list("abcdefghijklmnopqrstuvwxyz"))
+        out = obj_col(["".join(rng.choice(letters, opt.string_len))
+                       for _ in range(n)])
+    else:
+        raise ValueError(f"unknown column kind {opt.kind!r}")
+    if opt.missing_ratio > 0:
+        mask = rng.random(n) < opt.missing_ratio
+        out[mask] = None
+    return out
+
+
+def generate_dataframe(schema: Dict[str, ColumnOptions], n_rows: int,
+                       seed: int = 0,
+                       rng: Optional[np.random.Generator] = None
+                       ) -> DataFrame:
+    """A random frame matching ``schema`` (name -> ColumnOptions)."""
+    rng = rng or np.random.default_rng(seed)
+    return DataFrame({name: generate_column(rng, n_rows, opt)
+                      for name, opt in schema.items()})
+
+
+def basic_mixed_frame(n_rows: int = 64, seed: int = 0,
+                      missing_ratio: float = 0.0) -> DataFrame:
+    """A ready-made mixed-type frame (the GenerateDataset default)."""
+    return generate_dataframe({
+        "doubles": ColumnOptions("double", missing_ratio=missing_ratio),
+        "ints": ColumnOptions("int", low=0, high=50),
+        "bools": ColumnOptions("bool"),
+        "strings": ColumnOptions("string", missing_ratio=missing_ratio),
+        "cats": ColumnOptions("categorical", missing_ratio=missing_ratio),
+        "vecs": ColumnOptions("vector", dim=3),
+    }, n_rows, seed=seed)

@@ -66,6 +66,25 @@ class TestNNFunction:
         with pytest.raises(KeyError):
             NNFunction(arch={"builder": "nope"}, params={}).module()
 
+    def test_imagenet_resnet_odd_width(self):
+        """GroupNorm groups must divide channels for any width (e.g. 12)."""
+        m = NNFunction.init({"builder": "imagenet_resnet", "depth": 50,
+                             "num_classes": 3, "width": 12},
+                            input_shape=(32, 32, 3), seed=0)
+        assert np.asarray(
+            m.apply(np.zeros((1, 32, 32, 3), np.float32))).shape == (1, 3)
+
+    @pytest.mark.parametrize("depth,pool_dim", [(18, 64), (50, 256)])
+    def test_imagenet_resnet(self, depth, pool_dim):
+        """Zoo ResNet50-family parity: stem+4 groups, pool feature cut."""
+        m = NNFunction.init({"builder": "imagenet_resnet", "depth": depth,
+                             "num_classes": 5, "width": 8},
+                            input_shape=(64, 64, 3), seed=0)
+        x = np.zeros((2, 64, 64, 3), np.float32)
+        assert np.asarray(m.apply(x)).shape == (2, 5)
+        feats = np.asarray(m.apply(x, output_layer="pool"))
+        assert feats.shape == (2, pool_dim)
+
 
 class TestNNModel:
     def test_transform_scores(self, convnet, images):

@@ -1,0 +1,24 @@
+#!/usr/bin/env bash
+# Tag-gated test driver.
+#
+# Parity: the reference selects scalatest tags via $TESTS
+# (`src/project/build.scala:119-131`, `tools/tests/tags.sh`:
+# "-extended", "+linuxonly", ...). Here the same contract over pytest
+# markers:
+#
+#   TESTS="-slow"   ./tools/run_tests.sh     # skip the slow quality gates
+#   TESTS="+slow"   ./tools/run_tests.sh     # only the slow quality gates
+#   ./tools/run_tests.sh tests/test_gbdt.py  # extra args pass through
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+MARKER_ARGS=()
+for tag in ${TESTS:-}; do
+  case "$tag" in
+    -*) MARKER_ARGS+=(-m "not ${tag:1}") ;;
+    +*) MARKER_ARGS+=(-m "${tag:1}") ;;
+    *)  echo "unknown tag spec '$tag' (use +name / -name)" >&2; exit 2 ;;
+  esac
+done
+
+exec python -m pytest tests/ -q "${MARKER_ARGS[@]}" "$@"
