@@ -1,0 +1,46 @@
+"""Text analytics pipeline: TextFeaturizer -> TrainClassifier.
+
+Mirrors the reference's text-analytics notebooks (tokenize -> TF-IDF ->
+classifier over document labels).
+"""
+
+import numpy as np
+
+from _common import setup_devices, timed
+
+
+def main():
+    setup_devices()
+    from mmlspark_tpu.core.dataframe import DataFrame
+    from mmlspark_tpu.featurize.text import TextFeaturizer
+    from mmlspark_tpu.automl.train import TrainClassifier
+    from mmlspark_tpu.gbdt import GBDTClassifier
+
+    rng = np.random.default_rng(0)
+    pos_words = ["great", "excellent", "love", "wonderful", "amazing"]
+    neg_words = ["terrible", "awful", "hate", "broken", "waste"]
+    filler = ["the", "product", "it", "was", "very", "quite", "device"]
+
+    def doc(label):
+        src = pos_words if label else neg_words
+        words = list(rng.choice(filler, 6)) + list(rng.choice(src, 3))
+        rng.shuffle(words)
+        return " ".join(words)
+
+    y = rng.integers(0, 2, 400)
+    df = DataFrame({"text": [doc(int(l)) for l in y], "label": y})
+
+    with timed() as t:
+        feats_model = TextFeaturizer(input_col="text", output_col="feats",
+                                     num_features=256).fit(df)
+        feats = feats_model.transform(df)
+        model = TrainClassifier(
+            model=GBDTClassifier(num_iterations=20, num_leaves=7),
+            label_col="label").fit(feats.select(["feats", "label"]))
+    scored = model.transform(feats.select(["feats", "label"]))
+    acc = float((np.asarray(scored["prediction"]) == y).mean())
+    print(f"text classification: {t.seconds:.1f}s, accuracy={acc:.3f}")
+
+
+if __name__ == "__main__":
+    main()

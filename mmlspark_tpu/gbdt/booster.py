@@ -515,6 +515,10 @@ class Booster:
 
     @staticmethod
     def from_string(s: str) -> "Booster":
+        from mmlspark_tpu.gbdt.lgbm_compat import (
+            from_lightgbm_text, is_lightgbm_text)
+        if is_lightgbm_text(s):
+            return from_lightgbm_text(s)
         d = json.loads(s)
         params = BoosterParams(**d["params"])
         mapper = BinMapper.from_json(d["mapper"])

@@ -1,0 +1,165 @@
+"""Import genuine LightGBM text-format model files.
+
+Migration path for users of the reference: a model trained there is
+saved with ``LightGBMBooster.saveNativeModel``
+(`LightGBMBooster.scala:104` → LightGBM's ``SaveModelToString`` text
+dump) and loads here unchanged. This parses the documented v2/v3 text
+layout — header key=value lines, then per-tree blocks::
+
+    Tree=0
+    num_leaves=3
+    split_feature=1 0
+    threshold=0.5 1.25
+    decision_type=2 0
+    left_child=1 -1
+    right_child=-1 -2
+    leaf_value=0.1 -0.2 0.3
+
+Node encoding: internal nodes are 0..num_leaves-2; a negative child
+``c`` is leaf ``~c``. ``decision_type`` bit 0 = categorical split,
+bit 1 = NaN defaults left. Numerical rule: ``x <= threshold`` goes
+left. Leaf values already include shrinkage, and there is no separate
+init score (LightGBM bakes boost-from-average into the leaves), so the
+imported booster reproduces ``PredictForMat`` outputs exactly.
+
+Categorical (many-vs-many bitset) splits are not imported yet and raise.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List
+
+import numpy as np
+
+from mmlspark_tpu.gbdt.binning import BinMapper
+from mmlspark_tpu.gbdt.tree import Tree
+
+_OBJECTIVE_MAP = {
+    "binary": "binary",
+    "regression": "regression",
+    "regression_l2": "regression",
+    "l2": "regression",
+    "mean_squared_error": "regression",
+    "regression_l1": "regression_l1",
+    "l1": "regression_l1",
+    "mae": "regression_l1",
+    "multiclass": "multiclass",
+    "softmax": "multiclass",
+    "quantile": "quantile",
+    "poisson": "poisson",
+    "tweedie": "tweedie",
+}
+
+
+def is_lightgbm_text(s: str) -> bool:
+    head = s.lstrip()[:64]
+    return head.startswith("tree") and "Tree=" in s
+
+
+def _parse_blocks(s: str) -> (Dict[str, str], List[Dict[str, str]]):
+    header: Dict[str, str] = {}
+    trees: List[Dict[str, str]] = []
+    current = header
+    for line in s.splitlines():
+        line = line.strip()
+        if not line or line in ("tree", "end of trees") \
+                or line.startswith(("pandas_categorical", "parameters",
+                                    "feature_importances")):
+            continue
+        if "=" not in line:
+            continue
+        key, _, value = line.partition("=")
+        if key == "Tree":
+            current = {}
+            trees.append(current)
+            continue
+        current[key] = value
+    return header, trees
+
+
+def _ints(v: str) -> np.ndarray:
+    return np.array([int(x) for x in v.split()], dtype=np.int32)
+
+
+def _floats(v: str) -> np.ndarray:
+    return np.array([float(x) for x in v.split()], dtype=np.float64)
+
+
+def _convert_tree(blk: Dict[str, str]) -> Tree:
+    n_leaves = int(blk["num_leaves"])
+    if int(blk.get("num_cat", "0")) > 0:
+        raise NotImplementedError(
+            "categorical (bitset) splits in LightGBM model files are not "
+            "supported by the importer yet")
+    leaf_value = _floats(blk["leaf_value"])
+    n_internal = n_leaves - 1
+    n_nodes = n_internal + n_leaves
+
+    feature = np.full(n_nodes, -1, np.int32)
+    threshold = np.zeros(n_nodes, np.float64)
+    missing_left = np.zeros(n_nodes, bool)
+    left = np.zeros(n_nodes, np.int32)
+    right = np.zeros(n_nodes, np.int32)
+    value = np.zeros(n_nodes, np.float32)
+    value[n_internal:] = leaf_value.astype(np.float32)
+
+    if n_internal:
+        split_feature = _ints(blk["split_feature"])
+        thr = _floats(blk["threshold"])
+        decision = _ints(blk["decision_type"])
+        lc = _ints(blk["left_child"])
+        rc = _ints(blk["right_child"])
+
+        def node_id(c: int) -> int:
+            return c if c >= 0 else n_internal + (~c)
+
+        for i in range(n_internal):
+            if decision[i] & 1:
+                raise NotImplementedError(
+                    "categorical decision_type in LightGBM model file")
+            feature[i] = split_feature[i]
+            threshold[i] = thr[i]
+            missing_left[i] = bool(decision[i] & 2)
+            left[i] = node_id(int(lc[i]))
+            right[i] = node_id(int(rc[i]))
+
+    return Tree(feature=feature, threshold=threshold,
+                threshold_bin=np.zeros(n_nodes, np.int32),
+                missing_left=missing_left,
+                categorical=np.zeros(n_nodes, bool),
+                cat_mask=np.zeros((n_nodes, 1), bool),
+                left=left, right=right, value=value,
+                gain=np.zeros(n_nodes, np.float32), n_nodes=n_nodes)
+
+
+def from_lightgbm_text(s: str):
+    """Parse a LightGBM model dump into a scoring-ready :class:`Booster`."""
+    from mmlspark_tpu.gbdt.booster import Booster, BoosterParams
+    from mmlspark_tpu.gbdt.objectives import get_objective
+
+    header, blocks = _parse_blocks(s)
+    obj_spec = header.get("objective", "regression").split()
+    obj_name = _OBJECTIVE_MAP.get(obj_spec[0])
+    if obj_name is None:
+        raise ValueError(f"unsupported LightGBM objective {obj_spec[0]!r}")
+    num_class = int(header.get("num_class", "1"))
+    per_iter = int(header.get("num_tree_per_iteration", "1"))
+    n_features = int(header["max_feature_idx"]) + 1
+    names = header.get("feature_names", "").split() \
+        or [f"f{j}" for j in range(n_features)]
+
+    params = BoosterParams(objective=obj_name,
+                           num_class=max(num_class, 2)
+                           if obj_name == "multiclass" else 2)
+    obj = get_objective(obj_name, max(num_class, 2))
+    mapper = BinMapper(max_bin=255,
+                       upper_bounds=[np.zeros(0)] * n_features,
+                       categorical=[False] * n_features, cat_levels={})
+    booster = Booster(params, mapper, obj, names)
+    booster.init_score = np.zeros(obj.num_model_outputs)
+
+    trees = [_convert_tree(b) for b in blocks]
+    booster.trees = [trees[i:i + per_iter]
+                     for i in range(0, len(trees), per_iter)]
+    booster.best_iteration = len(booster.trees) - 1
+    return booster

@@ -89,15 +89,27 @@ class FileStreamSource:
             fresh = self._scan()
             if fresh:
                 frames = []
+                keys = []
                 for full, key in fresh:
-                    frames.append(read_binary_files(
-                        full, inspect_zip=self.inspect_zip,
-                        engine=self.engine))
-                    self._seen.add(key)
-                self._checkpoint()
+                    try:
+                        frames.append(read_binary_files(
+                            full, inspect_zip=self.inspect_zip,
+                            engine=self.engine))
+                    except (OSError, FileNotFoundError):
+                        # vanished between scan and read (write-then-move
+                        # producers); not journaled, re-examined next poll
+                        continue
+                    keys.append(key)
+                if not frames:
+                    continue
                 batch = DataFrame.concat(frames) if len(frames) > 1 \
                     else frames[0]
                 yield batch
+                # journal only AFTER the consumer finished the batch (it
+                # asked for the next one): at-least-once on crash, like
+                # Spark's checkpointLocation
+                self._seen.update(keys)
+                self._checkpoint()
                 yielded += 1
                 last_new = time.monotonic()
                 if max_batches is not None and yielded >= max_batches:

@@ -31,6 +31,10 @@ class ColumnOptions:
 
 def generate_column(rng: np.random.Generator, n: int,
                     opt: ColumnOptions) -> np.ndarray:
+    if opt.missing_ratio > 0 and opt.kind in ("int", "bool", "vector"):
+        raise ValueError(
+            f"missing_ratio is not representable for kind={opt.kind!r} "
+            f"(use 'double'/'string'/'categorical', which carry NaN/None)")
     if opt.kind == "double":
         col = rng.uniform(opt.low, opt.high, n)
         if opt.missing_ratio > 0:

@@ -1,6 +1,7 @@
 """Config namespaces, logger factory, datagen, tag-gated test driver."""
 
 import json
+import os
 import subprocess
 import sys
 
@@ -69,12 +70,15 @@ class TestDatagen:
         assert out.num_rows > 0
 
 
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
 class TestRunTestsDriver:
     def test_tag_spec_rejected(self):
         proc = subprocess.run(
             ["bash", "tools/run_tests.sh", "--collect-only"],
             env={"TESTS": "badtag", "PATH": "/usr/bin:/bin"},
-            capture_output=True, text=True, cwd=".")
+            capture_output=True, text=True, cwd=REPO_ROOT)
         assert proc.returncode == 2
         assert "unknown tag spec" in proc.stderr
 

@@ -355,6 +355,105 @@ class TestBoosterMechanics:
                                    rtol=1e-4, atol=1e-5)
 
 
+LGBM_BINARY_MODEL = """tree
+version=v3
+num_class=1
+num_tree_per_iteration=1
+label_index=0
+max_feature_idx=2
+objective=binary sigmoid:1
+feature_names=a b c
+feature_infos=none none none
+
+Tree=0
+num_leaves=3
+num_cat=0
+split_feature=1 0
+split_gain=10 5
+threshold=0.5 1.25
+decision_type=2 0
+left_child=1 -1
+right_child=-3 -2
+leaf_value=0.2 -0.3 0.4
+leaf_weight=1 1 1
+leaf_count=10 10 10
+internal_value=0 0
+internal_weight=0 0
+internal_count=30 20
+shrinkage=1
+
+Tree=1
+num_leaves=2
+num_cat=0
+split_feature=2
+split_gain=3
+threshold=10
+decision_type=0
+left_child=-1
+right_child=-2
+leaf_value=-0.1 0.05
+leaf_weight=1 1
+leaf_count=15 15
+internal_value=0
+internal_weight=0
+internal_count=30
+shrinkage=1
+
+end of trees
+
+feature_importances:
+a=1
+b=1
+c=1
+
+parameters:
+[boosting: gbdt]
+end of parameters
+"""
+
+
+class TestLightGBMImport:
+    """Genuine LightGBM text-dump interop (lgbm_compat.py)."""
+
+    def test_predictions_match_hand_computation(self):
+        b = Booster.from_string(LGBM_BINARY_MODEL)
+        # tree0: b<=0.5 -> (a<=1.25 ? 0.2 : -0.3); else 0.4
+        # tree1: c<=10 -> -0.1 ; else 0.05     raw summed, sigmoid applied
+        X = np.array([
+            [1.0, 0.0, 5.0],    # 0.2 + -0.1 = 0.1
+            [2.0, 0.0, 20.0],   # -0.3 + 0.05 = -0.25
+            [0.0, 1.0, 20.0],   # 0.4 + 0.05 = 0.45
+            [np.nan, 0.0, 5.0],  # NaN on a: dt bit1=0 on that node -> right
+        ])
+        expect_raw = np.array([0.1, -0.25, 0.45, -0.3 - 0.1])
+        got = b.predict(X)
+        np.testing.assert_allclose(got, 1 / (1 + np.exp(-expect_raw)),
+                                   rtol=1e-6)
+
+    def test_nan_default_left(self):
+        b = Booster.from_string(LGBM_BINARY_MODEL)
+        # root of tree0 has decision_type=2 -> NaN goes LEFT
+        X = np.array([[0.0, np.nan, 20.0]])  # left -> a<=1.25 -> 0.2; +0.05
+        np.testing.assert_allclose(
+            b.predict(X), 1 / (1 + np.exp(-(0.2 + 0.05))), rtol=1e-6)
+
+    def test_stage_loader_and_importances(self, tmp_path):
+        p = tmp_path / "model.txt"
+        p.write_text(LGBM_BINARY_MODEL)
+        stage = load_native_model(str(p), is_classifier=True)
+        out = stage.transform(DataFrame(
+            {"features": np.zeros((3, 3)), "label": np.zeros(3)}))
+        assert "probability" in out.columns
+        imp = stage.booster.feature_importances("split")
+        assert list(imp) == [1.0, 1.0, 1.0]
+
+    def test_roundtrip_through_own_format(self):
+        b = Booster.from_string(LGBM_BINARY_MODEL)
+        again = Booster.from_string(b.model_to_string())
+        X = np.random.default_rng(0).normal(size=(16, 3))
+        np.testing.assert_allclose(b.predict(X), again.predict(X))
+
+
 class TestStages:
     def _df(self, X, y):
         return DataFrame({"features": X, "label": y})

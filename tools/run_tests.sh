@@ -12,13 +12,19 @@
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
-MARKER_ARGS=()
+# pytest keeps only the LAST -m flag, so all tag specs must be joined
+# into one marker expression
+EXPR=""
 for tag in ${TESTS:-}; do
   case "$tag" in
-    -*) MARKER_ARGS+=(-m "not ${tag:1}") ;;
-    +*) MARKER_ARGS+=(-m "${tag:1}") ;;
+    -*) part="not ${tag:1}" ;;
+    +*) part="${tag:1}" ;;
     *)  echo "unknown tag spec '$tag' (use +name / -name)" >&2; exit 2 ;;
   esac
+  if [ -n "$EXPR" ]; then EXPR="$EXPR and $part"; else EXPR="$part"; fi
 done
 
-exec python -m pytest tests/ -q "${MARKER_ARGS[@]}" "$@"
+if [ -n "$EXPR" ]; then
+  exec python -m pytest tests/ -q -m "$EXPR" "$@"
+fi
+exec python -m pytest tests/ -q "$@"
