@@ -144,20 +144,47 @@ def split_gain_matrix(hist, is_categorical, params: GrowthParams):
     return jnp.stack([gain_left, gain_right]), order    # (2, F, B), (F, B)
 
 
-@partial(jax.jit, static_argnames=("params",))
 def find_best_split(hist, is_categorical, params: GrowthParams,
                     feat_mask=None):
     """Best split over all (feature, bin) cut points of one leaf.
 
-    hist: (F, B, 3). is_categorical: (F,) bool. feat_mask: optional (F,)
-    bool — features outside the mask (feature_fraction sampling) are
-    excluded without touching the bin matrix.
-    Numeric features scan bins in index order twice — once sending the
-    missing bin left, once right (learned default direction). Categorical
-    features scan bins in G/H-sorted order (LightGBM's many-vs-many).
+    Convenience dict view over :func:`eval_leaf` (the grower uses the
+    packed form directly). Numeric features scan bins in index order
+    twice — once sending the missing bin left, once right (learned
+    default direction); categorical features scan bins in G/H-sorted
+    order (LightGBM's many-vs-many).
+    """
+    packed_dev, order = eval_leaf(hist, is_categorical, params, feat_mask)
+    packed = np.asarray(packed_dev)
+    feat = int(packed[EV_FEATURE])
+    return {
+        "gain": float(packed[EV_GAIN]),
+        "feature": feat,
+        "cut_pos": int(packed[EV_CUT_POS]),
+        "missing_left": bool(packed[EV_MISSING_LEFT]),
+        "order": order[feat],
+        "threshold_bin": int(packed[EV_THRESHOLD_BIN]),
+        "leaf_value": float(packed[EV_VALUE]),
+        "stats": (float(packed[EV_G]), float(packed[EV_H]),
+                  float(packed[EV_COUNT])),
+    }
 
-    Returns dict with gain/feature/threshold index info + the sorted bin
-    order used (to reconstruct categorical subsets).
+
+# packed layout of eval_leaf's scalar vector (single host fetch per leaf)
+EV_GAIN, EV_FEATURE, EV_CUT_POS, EV_MISSING_LEFT, EV_THRESHOLD_BIN, \
+    EV_G, EV_H, EV_COUNT, EV_VALUE = range(9)
+
+
+@partial(jax.jit, static_argnames=("params",))
+def eval_leaf(hist, is_categorical, params: GrowthParams, feat_mask=None):
+    """Everything the grower needs about one leaf, in ONE device program:
+    best split (gain/feature/cut/missing-direction/threshold-bin), leaf
+    totals, and the leaf value — packed into a 9-float vector so the
+    host pays a single fetch per leaf instead of ~8 scalar syncs (the
+    driver of fit latency when dispatch round-trips are expensive).
+
+    Returns (packed (9,) f32, order (F, B) int32 — stays on device; only
+    categorical splits ever materialize a row of it).
     """
     F, B, _ = hist.shape
     both, order = split_gain_matrix(hist, is_categorical, params)
@@ -166,32 +193,26 @@ def find_best_split(hist, is_categorical, params: GrowthParams,
     flat = both.reshape(2, -1)
     best_flat = jnp.argmax(flat, axis=1)
     best_gain_lr = jnp.take_along_axis(flat, best_flat[:, None], axis=1)[:, 0]
-    direction = jnp.argmax(best_gain_lr)                # 0: missing left
-    best_gain = best_gain_lr[direction]
+    direction = jnp.argmax(best_gain_lr)              # 0: missing left
     best_idx = best_flat[direction]
     feat = best_idx // B
-    cut_pos = best_idx % B                              # position in order
+    cut_pos = best_idx % B
 
-    return {
-        "gain": best_gain,
-        "feature": feat,
-        "cut_pos": cut_pos,
-        "missing_left": (direction == 0),
-        "order": order[feat],
-        "threshold_bin": order[feat, cut_pos],
-    }
+    c_tot = jnp.sum(hist[:, :, 2], axis=1)
+    src = jnp.argmax(c_tot)
+    g, h, c = (jnp.sum(hist[src, :, 0]), jnp.sum(hist[src, :, 1]),
+               c_tot[src])
+    value = _leaf_value(g, h, params.lambda_l1, params.lambda_l2)
 
-
-@jax.jit
-def leaf_stats(hist):
-    """(G, H, count) totals of a leaf from one feature's histogram.
-
-    Uses the count-richest feature so voting-mode histograms (exact only
-    on the voted subset, zero elsewhere) still yield the true totals.
-    """
-    c = jnp.sum(hist[:, :, 2], axis=1)
-    src = jnp.argmax(c)
-    return (jnp.sum(hist[src, :, 0]), jnp.sum(hist[src, :, 1]), c[src])
+    packed = jnp.stack([
+        best_gain_lr[direction],
+        feat.astype(jnp.float32),
+        cut_pos.astype(jnp.float32),
+        (direction == 0).astype(jnp.float32),
+        order[feat, cut_pos].astype(jnp.float32),     # threshold bin
+        g, h, c, value,
+    ])
+    return packed, order
 
 
 # ---------------------------------------------------------------------------
@@ -373,62 +394,71 @@ class TreeGrower:
         # row -> node assignment, only rows in sample_mask participate
         node_of_row = jnp.where(sample_mask, 0, -1).astype(jnp.int32)
 
-        root_hist = self._hist(bins, grad, hess, node_of_row == 0, feat_mask)
-        g0, h0, c0 = (float(x) for x in leaf_stats(root_hist))
-        value[0] = float(_leaf_value(jnp.float32(g0), jnp.float32(h0),
-                                     p.lambda_l1, p.lambda_l2))
+        fm = jnp.asarray(feat_mask) if feat_mask is not None else None
 
-        # frontier: leaf id -> (hist, split-candidate dict, count)
+        def evaluate(hist):
+            """One fused device program + ONE host fetch per leaf."""
+            packed_dev, order = eval_leaf(hist, self.is_categorical, p, fm)
+            return np.asarray(packed_dev), order
+
+        root_hist = self._hist(bins, grad, hess, node_of_row == 0, feat_mask)
+        root_packed, root_order = evaluate(root_hist)
+        value[0] = root_packed[EV_VALUE]
+
+        # frontier: leaf id -> (hist, packed scalars, device order)
         frontier: Dict[int, Dict[str, Any]] = {}
 
-        def consider(leaf_id, hist, count):
-            if count < 2 * p.min_data_in_leaf:
+        def consider(leaf_id, hist, packed, order):
+            if packed[EV_COUNT] < 2 * p.min_data_in_leaf:
                 return
             if 0 <= p.max_depth <= depth[leaf_id]:
                 return
-            cand = find_best_split(hist, self.is_categorical, p, feat_mask)
-            if float(cand["gain"]) > max(p.min_gain_to_split, 0.0):
-                frontier[leaf_id] = {"hist": hist, "cand": cand,
-                                     "count": count}
+            if packed[EV_GAIN] > max(p.min_gain_to_split, 0.0):
+                frontier[leaf_id] = {"hist": hist, "packed": packed,
+                                     "order": order}
 
-        consider(0, root_hist, c0)
+        consider(0, root_hist, root_packed, root_order)
         n_nodes = 1
         n_leaves = 1
 
         while n_leaves < p.num_leaves and frontier:
             # split the leaf with the globally best gain (leaf-wise policy)
-            leaf_id = max(frontier, key=lambda k: float(frontier[k]["cand"]["gain"]))
+            leaf_id = max(frontier,
+                          key=lambda k: frontier[k]["packed"][EV_GAIN])
             entry = frontier.pop(leaf_id)
-            cand = entry["cand"]
-            feat = int(cand["feature"])
-            is_cat = bool(self.mapper.categorical[feat])
+            packed = entry["packed"]
+            feat = int(packed[EV_FEATURE])
+            cut_pos = int(packed[EV_CUT_POS])
+            is_cat = bool(self.mapper.categorical[feat]) \
+                if feat < len(self.mapper.categorical) else False
 
             li, ri = n_nodes, n_nodes + 1
             n_nodes += 2
             n_leaves += 1
 
             feature[leaf_id] = feat
-            threshold_bin[leaf_id] = int(cand["threshold_bin"])
-            missing_left[leaf_id] = bool(cand["missing_left"])
+            threshold_bin[leaf_id] = int(packed[EV_THRESHOLD_BIN])
+            missing_left[leaf_id] = bool(packed[EV_MISSING_LEFT])
             categorical[leaf_id] = is_cat
-            gain_arr[leaf_id] = float(cand["gain"])
+            gain_arr[leaf_id] = packed[EV_GAIN]
             left[leaf_id], right[leaf_id] = li, ri
             depth[li] = depth[ri] = depth[leaf_id] + 1
+            order_row = entry["order"][feat]          # device (B,) int32
             if is_cat:
-                order = np.asarray(cand["order"])
-                cut = int(cand["cut_pos"])
-                cat_mask[leaf_id, order[:cut + 1]] = True
+                # the only path that materializes an order row on host
+                order_np = np.asarray(order_row)
+                cat_mask[leaf_id, order_np[:cut_pos + 1]] = True
             else:
                 threshold[leaf_id] = self.mapper.threshold_value(
-                    feat, int(cand["threshold_bin"]))
+                    feat, threshold_bin[leaf_id])
 
             # route rows
             go_left = _route_left(bins[:, feat],
                                   jnp.int32(threshold_bin[leaf_id]),
                                   jnp.asarray(bool(missing_left[leaf_id])),
                                   jnp.asarray(is_cat),
-                                  jnp.asarray(cand["order"], dtype=jnp.int32),
-                                  jnp.int32(cand["cut_pos"]))
+                                  order_row,
+                                  jnp.int32(cut_pos))
             in_leaf = node_of_row == leaf_id
             node_of_row = jnp.where(in_leaf & go_left, li,
                                     jnp.where(in_leaf, ri, node_of_row))
@@ -437,14 +467,15 @@ class TreeGrower:
             lhist = self._hist(bins, grad, hess, node_of_row == li, feat_mask)
             rhist = (self._hist(bins, grad, hess, node_of_row == ri, feat_mask)
                      if self._no_subtract else entry["hist"] - lhist)
-            gl, hl, cl = (float(x) for x in leaf_stats(lhist))
-            gr, hr, cr = (float(x) for x in leaf_stats(rhist))
-            value[li] = float(_leaf_value(jnp.float32(gl), jnp.float32(hl),
-                                          p.lambda_l1, p.lambda_l2))
-            value[ri] = float(_leaf_value(jnp.float32(gr), jnp.float32(hr),
-                                          p.lambda_l1, p.lambda_l2))
-            consider(li, lhist, cl)
-            consider(ri, rhist, cr)
+            # dispatch BOTH children before fetching either: the fetches
+            # overlap the other child's device work (one round-trip/split)
+            lp_dev, lorder = eval_leaf(lhist, self.is_categorical, p, fm)
+            rp_dev, rorder = eval_leaf(rhist, self.is_categorical, p, fm)
+            lpacked, rpacked = np.asarray(lp_dev), np.asarray(rp_dev)
+            value[li] = lpacked[EV_VALUE]
+            value[ri] = rpacked[EV_VALUE]
+            consider(li, lhist, lpacked, lorder)
+            consider(ri, rhist, rpacked, rorder)
 
         value_arr = (value * shrinkage).astype(np.float32)
         tree = Tree(feature=feature[:n_nodes], threshold=threshold[:n_nodes],

@@ -18,7 +18,9 @@ from typing import Any, Dict, Optional
 import numpy as np
 
 from mmlspark_tpu.core.dataframe import DataFrame
-from mmlspark_tpu.core.params import Param, HasInputCol, HasOutputCol
+from mmlspark_tpu.core.params import (
+    Param, HasInputCol, HasOutputCol, in_set,
+)
 from mmlspark_tpu.core.stage import Model
 from mmlspark_tpu.core import schema
 from mmlspark_tpu.models.function import NNFunction
@@ -47,8 +49,24 @@ class NNModel(Model, HasInputCol, HasOutputCol):
                               ptype=int)
     data_parallel = Param(True, "shard minibatches over all local devices",
                           ptype=bool)
+    input_dtype = Param("auto", "host-side cast before transfer: auto casts "
+                        "to bfloat16 for bfloat16 models (halves host->HBM "
+                        "bytes; the first layer casts activations anyway) | "
+                        "float32 | bfloat16",
+                        validator=in_set("auto", "float32", "bfloat16"))
 
     # -- execution ----------------------------------------------------------
+
+    def _transfer_dtype(self):
+        mode = self.input_dtype
+        if mode == "auto":
+            arch = getattr(self.model, "arch", None) or {}
+            mode = ("bfloat16" if arch.get("dtype") == "bfloat16"
+                    else "float32")
+        if mode == "bfloat16":
+            import ml_dtypes
+            return np.dtype(ml_dtypes.bfloat16)
+        return np.float32
 
     def _resolve_output_layer(self) -> Optional[str]:
         if self.output_layer is not None:
@@ -87,6 +105,7 @@ class NNModel(Model, HasInputCol, HasOutputCol):
     def transform(self, df: DataFrame) -> DataFrame:
         import jax
         x = _stack_column(df[self.input_col])
+        x = x.astype(self._transfer_dtype(), copy=False)
         params, in_sharding, n_shards = self._device_setup
         bs = max(self.batch_size, n_shards)
         bs -= bs % n_shards  # static per-device shapes
@@ -119,8 +138,11 @@ class NNModel(Model, HasInputCol, HasOutputCol):
             # empty input: score one dummy row to learn the output width so
             # downstream consumers still see (0, num_outputs)
             if x.ndim > 1:
+                # same dtype as real batches, or this compiles a second
+                # (float32-input) variant of the forward just for width
                 dummy, _ = pad_to_multiple(
-                    np.zeros((1, *x.shape[1:]), np.float32), max(n_shards, 1))
+                    np.zeros((1, *x.shape[1:]), self._transfer_dtype()),
+                    max(n_shards, 1))
                 if in_sharding is not None:
                     dummy = jax.device_put(dummy, in_sharding)
                 width_out = np.asarray(self._jitted(params, dummy))
