@@ -450,14 +450,21 @@ class Booster:
         raw = np.broadcast_to(self.init_score[None, :], (n, K)).copy()
         if n == 0 or not self.trees:
             return raw
+        # bucket the row count: serving feeds arbitrary micro-batch sizes,
+        # and every distinct shape is a fresh compile of the jitted
+        # traversal; bucketing keeps the set of compiled shapes small
+        # (bin BEFORE padding — transform is per-row CPU work)
+        from mmlspark_tpu.parallel import pad_to_bucket
         cat_bins = self._cat_bins(X)
+        X, _ = pad_to_bucket(X)
+        cat_bins, _ = pad_to_bucket(cat_bins)
         X_dev = jnp.asarray(X)
-        acc = jnp.zeros((n, K), dtype=jnp.float32)
+        acc = jnp.zeros((X.shape[0], K), dtype=jnp.float32)
         for iteration in self._tree_arrays(cat_bins)[:stop]:
             for k, arrs in enumerate(iteration):
                 acc = acc.at[:, k].add(
                     predict_tree_raw(arrs, X_dev, self._max_depth_cache()))
-        raw = raw + np.asarray(acc, dtype=np.float64)
+        raw = raw + np.asarray(acc, dtype=np.float64)[:n]
         if self.params.boosting_type == "rf":
             raw = (self.init_score[None, :]
                    + (raw - self.init_score[None, :]) / max(stop, 1))

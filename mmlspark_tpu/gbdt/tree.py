@@ -262,10 +262,13 @@ class Tree:
         return out
 
 
+@partial(jax.jit, static_argnames=("max_depth",))
 def predict_tree_raw(tree_arrays, X, max_depth: int):
     """Batched raw-feature traversal: X (n, F) float -> (n,) leaf values.
 
-    tree_arrays: dict of jnp arrays mirroring Tree fields.
+    tree_arrays: dict of jnp arrays mirroring Tree fields. Jitted with a
+    shape cache — callers bucket the row count (see Booster.predict_raw)
+    so serving micro-batches of assorted sizes reuse one executable.
     """
     feature = tree_arrays["feature"]
     threshold = tree_arrays["threshold"]

@@ -8,6 +8,7 @@ from mmlspark_tpu.parallel.sharding import (
     batch_sharding,
     replicated_sharding,
     named_sharding,
+    pad_to_bucket,
     pad_to_multiple,
     shard_batch,
     unpad,
@@ -31,6 +32,7 @@ __all__ = [
     "batch_sharding",
     "replicated_sharding",
     "named_sharding",
+    "pad_to_bucket",
     "pad_to_multiple",
     "shard_batch",
     "unpad",

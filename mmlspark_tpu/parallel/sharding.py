@@ -46,6 +46,28 @@ def pad_to_multiple(arr: np.ndarray, multiple: int,
     return np.pad(arr, widths, constant_values=pad_value), n
 
 
+def pad_to_bucket(arr: np.ndarray, cap: int = 1024,
+                  axis: int = 0, pad_value=0) -> Tuple[np.ndarray, int]:
+    """Pad ``axis`` to a bounded shape bucket for jit shape-cache reuse.
+
+    Small inputs round up to the next power of two (few distinct compiled
+    shapes for serving micro-batches of assorted sizes); inputs past
+    ``cap`` pad to a multiple of ``cap`` instead, bounding the waste for
+    large offline batches at ``cap - 1`` rows.
+    """
+    n = arr.shape[axis]
+    if n > cap:
+        return pad_to_multiple(arr, cap, axis=axis, pad_value=pad_value)
+    target = 1
+    while target < max(n, 1):
+        target *= 2
+    if target == n:
+        return arr, n
+    widths = [(0, 0)] * arr.ndim
+    widths[axis] = (0, target - n)
+    return np.pad(arr, widths, constant_values=pad_value), n
+
+
 def unpad(arr, n: int, axis: int = 0):
     """Slice padding back off (host- or device-side)."""
     index = [slice(None)] * arr.ndim

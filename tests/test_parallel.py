@@ -50,6 +50,18 @@ class TestSharding:
         same, n2 = pad_to_multiple(np.arange(16.0), 8)
         assert same.shape == (16,) and n2 == 16
 
+    def test_pad_to_bucket(self):
+        from mmlspark_tpu.parallel import pad_to_bucket
+        # small: next power of two
+        for n, want in [(1, 1), (3, 4), (16, 16), (17, 32), (1000, 1024)]:
+            padded, orig = pad_to_bucket(np.zeros((n, 2)))
+            assert padded.shape[0] == want and orig == n
+        # large: multiple of the cap, not the next power of two
+        padded, orig = pad_to_bucket(np.zeros((1025, 2)), cap=1024)
+        assert padded.shape[0] == 2048 and orig == 1025
+        padded, _ = pad_to_bucket(np.zeros((5000, 2)), cap=1024)
+        assert padded.shape[0] == 5120  # 5*1024, not 8192
+
     def test_shard_batch(self):
         mesh = build_mesh()
         batch = {"x": np.random.randn(13, 4), "y": np.arange(13)}
