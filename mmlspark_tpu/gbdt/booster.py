@@ -29,7 +29,7 @@ import jax.numpy as jnp
 from mmlspark_tpu.gbdt.binning import BinMapper
 from mmlspark_tpu.gbdt.objectives import Objective, get_objective
 from mmlspark_tpu.gbdt.tree import (
-    GrowthParams, Tree, TreeGrower, predict_tree_raw,
+    GrowthParams, Tree, TreeGrower, depth_bucket, predict_tree_raw,
 )
 
 
@@ -373,7 +373,8 @@ class Booster:
                 raw = raw + new_contrib
 
             booster.trees.append(iter_trees)
-            booster.__dict__.pop("_mdc", None)  # tree set changed
+            booster.__dict__.pop("_mdc", None)       # tree set changed
+            booster.__dict__.pop("_tree_dev", None)  # (incl. dart rescale)
             if is_dart:
                 tree_raw_contribs.append(new_contrib)
 
@@ -410,7 +411,7 @@ class Booster:
 
     # -- prediction ---------------------------------------------------------
 
-    def _tree_to_arrays(self, t: Tree, cat_bins_dev) -> Dict[str, Any]:
+    def _tree_to_arrays(self, t: Tree) -> Dict[str, Any]:
         B = self.mapper.max_bins_total
         cm = t.cat_mask
         if cm.shape[1] < B:
@@ -424,13 +425,17 @@ class Booster:
             "left": jnp.asarray(t.left),
             "right": jnp.asarray(t.right),
             "value": jnp.asarray(t.value),
-            "cat_bins": cat_bins_dev,
         }
 
-    def _tree_arrays(self, X_cat_bins: np.ndarray) -> List[List[Dict[str, Any]]]:
-        cat_bins_dev = jnp.asarray(X_cat_bins)
-        return [[self._tree_to_arrays(t, cat_bins_dev) for t in iteration]
-                for iteration in self.trees]
+    def _tree_arrays(self) -> List[List[Dict[str, Any]]]:
+        """Device-resident tree constants, uploaded ONCE per tree set —
+        per-call uploads would dominate serving micro-batch latency.
+        Invalidated (with ``_mdc``) wherever the tree set or leaf values
+        change."""
+        if not hasattr(self, "_tree_dev"):
+            self._tree_dev = [[self._tree_to_arrays(t) for t in iteration]
+                              for iteration in self.trees]
+        return self._tree_dev
 
     def _cat_bins(self, X: np.ndarray) -> np.ndarray:
         """Bin-space values for categorical features (0 elsewhere)."""
@@ -460,10 +465,12 @@ class Booster:
         cat_bins, _ = pad_to_bucket(cat_bins)
         X_dev = jnp.asarray(X)
         acc = jnp.zeros((X.shape[0], K), dtype=jnp.float32)
-        for iteration in self._tree_arrays(cat_bins)[:stop]:
+        cat_bins_dev = jnp.asarray(cat_bins)
+        for iteration in self._tree_arrays()[:stop]:
             for k, arrs in enumerate(iteration):
                 acc = acc.at[:, k].add(
-                    predict_tree_raw(arrs, X_dev, self._max_depth_cache()))
+                    predict_tree_raw(arrs, X_dev, cat_bins_dev,
+                                     depth_bucket(self._max_depth_cache())))
         raw = raw + np.asarray(acc, dtype=np.float64)[:n]
         if self.params.boosting_type == "rf":
             raw = (self.init_score[None, :]
@@ -542,6 +549,7 @@ class Booster:
         self.trees.extend(other.trees)
         self.best_iteration = len(self.trees) - 1
         self.__dict__.pop("_mdc", None)
+        self.__dict__.pop("_tree_dev", None)
         return self
 
 
@@ -570,9 +578,10 @@ class _ValidEval:
             return b.predict(self.vx, num_iteration=len(b.trees))
         for iteration in b.trees[self.done:]:
             for k, t in enumerate(iteration):
-                arrs = b._tree_to_arrays(t, self.cat_bins_dev)
+                arrs = b._tree_to_arrays(t)
                 self.acc = self.acc.at[:, k].add(
-                    predict_tree_raw(arrs, self.X_dev, t.max_depth()))
+                    predict_tree_raw(arrs, self.X_dev, self.cat_bins_dev,
+                                     depth_bucket(t.max_depth())))
         self.done = len(b.trees)
         raw = np.asarray(self.acc, dtype=np.float64) + b.init_score[None, :]
         if b.params.boosting_type == "rf":

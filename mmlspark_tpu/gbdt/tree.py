@@ -262,20 +262,29 @@ class Tree:
         return out
 
 
+def depth_bucket(d: int) -> int:
+    """Round a traversal depth up to a multiple of 8 so the jitted
+    traversal compiles for a handful of depth keys, not one per tree."""
+    return max(8, (d + 7) // 8 * 8)
+
+
 @partial(jax.jit, static_argnames=("max_depth",))
-def predict_tree_raw(tree_arrays, X, max_depth: int):
+def predict_tree_raw(tree_arrays, X, cat_bins, max_depth: int):
     """Batched raw-feature traversal: X (n, F) float -> (n,) leaf values.
 
-    tree_arrays: dict of jnp arrays mirroring Tree fields. Jitted with a
-    shape cache — callers bucket the row count (see Booster.predict_raw)
-    so serving micro-batches of assorted sizes reuse one executable.
+    tree_arrays: dict of jnp arrays mirroring Tree fields (immutable per
+    tree — cacheable on device); cat_bins: (n, F) int32 bin-space values
+    for categorical features (zeros when unused). Jitted with a shape
+    cache — callers bucket the row count (Booster.predict_raw) and the
+    depth (:func:`depth_bucket`) so serving micro-batches of assorted
+    sizes reuse a few executables.
     """
     feature = tree_arrays["feature"]
     threshold = tree_arrays["threshold"]
     missing_left = tree_arrays["missing_left"]
     categorical = tree_arrays["categorical"]
     cat_mask = tree_arrays["cat_mask"]
-    bins_for_cat = tree_arrays["cat_bins"]  # (n, F) int32 (0 if not needed)
+    bins_for_cat = cat_bins               # (n, F) int32 (0 if not needed)
     left, right = tree_arrays["left"], tree_arrays["right"]
     value = tree_arrays["value"]
 

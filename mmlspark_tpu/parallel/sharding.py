@@ -61,11 +61,7 @@ def pad_to_bucket(arr: np.ndarray, cap: int = 1024,
     target = 1
     while target < max(n, 1):
         target *= 2
-    if target == n:
-        return arr, n
-    widths = [(0, 0)] * arr.ndim
-    widths[axis] = (0, target - n)
-    return np.pad(arr, widths, constant_values=pad_value), n
+    return pad_to_multiple(arr, target, axis=axis, pad_value=pad_value)
 
 
 def unpad(arr, n: int, axis: int = 0):
