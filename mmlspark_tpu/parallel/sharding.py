@@ -59,8 +59,12 @@ def pad_to_bucket(arr: np.ndarray, cap: int = 1024,
     if n > cap:
         return pad_to_multiple(arr, cap, axis=axis, pad_value=pad_value)
     target = 1
-    while target < max(n, 1):
+    while target < n:
         target *= 2
+    if n == 0:  # empty inputs still bucket to one row (a real jit shape)
+        widths = [(0, 0)] * arr.ndim
+        widths[axis] = (0, 1)
+        return np.pad(arr, widths, constant_values=pad_value), 0
     return pad_to_multiple(arr, target, axis=axis, pad_value=pad_value)
 
 
