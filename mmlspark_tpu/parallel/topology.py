@@ -99,11 +99,17 @@ def use_cpu_devices(n: int = 8) -> None:
     sitecustomize that pins a TPU platform — because backends init lazily.
     This is how the distributed code paths run unchanged from laptop to pod.
     """
+    import re
+
     import jax
     flags = os.environ.get("XLA_FLAGS", "")
-    if "xla_force_host_platform_device_count" not in flags:
-        os.environ["XLA_FLAGS"] = \
-            (flags + f" --xla_force_host_platform_device_count={n}").strip()
+    m = re.search(r"xla_force_host_platform_device_count=(\d+)", flags)
+    if m is None:
+        flags = (flags + f" --xla_force_host_platform_device_count={n}").strip()
+    elif int(m.group(1)) < n:
+        flags = re.sub(r"xla_force_host_platform_device_count=\d+",
+                       f"xla_force_host_platform_device_count={n}", flags)
+    os.environ["XLA_FLAGS"] = flags
     os.environ["JAX_PLATFORMS"] = "cpu"
     jax.config.update("jax_platforms", "cpu")
 

@@ -1,0 +1,76 @@
+"""Driver entry-point contract tests (``__graft_entry__.py``).
+
+The round-1 failure mode: the driver's harness touched ``jax.devices()``
+on the real (1-chip) platform before calling ``dryrun_multichip(8)``,
+so the CPU flip was a silent no-op and the dryrun raised. The entry
+point must now self-heal by re-exec'ing in a fresh CPU subprocess
+(parity in spirit with the reference exercising its distributed path
+inside one JVM, `LightGBMUtils.scala:147-155`).
+"""
+
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _clean_env(**overrides):
+    env = dict(os.environ)
+    # start from a 1-device CPU platform with no force-count flag
+    env.pop("MMLSPARK_TPU_DRYRUN_CHILD", None)
+    env.pop("MMLSPARK_TPU_DRYRUN_PLATFORM", None)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = ""
+    env.update(overrides)
+    return env
+
+
+@pytest.mark.slow
+def test_dryrun_self_heals_after_backend_init():
+    """Backend already initialized with too few devices → re-exec works."""
+    script = (
+        "import sys; sys.path.insert(0, %r)\n"
+        "import jax\n"
+        "assert len(jax.devices()) < 8, 'precondition: small platform'\n"
+        "import __graft_entry__ as e\n"
+        "e.dryrun_multichip(8)\n" % REPO)
+    proc = subprocess.run(
+        [sys.executable, "-c", script], env=_clean_env(), cwd=REPO,
+        capture_output=True, text=True, timeout=900)
+    assert proc.returncode == 0, proc.stderr
+    assert "dryrun_multichip(8): ok" in proc.stdout
+
+
+@pytest.mark.slow
+def test_dryrun_fresh_process_flips_platform_inline():
+    """No backend yet → the flip happens in-process (no re-exec needed)."""
+    script = (
+        "import sys; sys.path.insert(0, %r)\n"
+        "import __graft_entry__ as e\n"
+        "e.dryrun_multichip(8)\n"
+        # the backend this process ended up with must BE the 8-cpu mesh
+        "import jax; assert len(jax.devices()) >= 8\n" % REPO)
+    proc = subprocess.run(
+        [sys.executable, "-c", script], env=_clean_env(), cwd=REPO,
+        capture_output=True, text=True, timeout=900)
+    assert proc.returncode == 0, proc.stderr
+    assert "dryrun_multichip(8): ok" in proc.stdout
+
+
+def test_force_cpu_env_rewrites_existing_count():
+    import __graft_entry__ as e
+    env = {"XLA_FLAGS": "--xla_force_host_platform_device_count=2 --foo"}
+    e._force_cpu_env(env, 8)
+    assert "xla_force_host_platform_device_count=8" in env["XLA_FLAGS"]
+    assert "--foo" in env["XLA_FLAGS"]
+    assert env["JAX_PLATFORMS"] == "cpu"
+    env2 = {}
+    e._force_cpu_env(env2, 4)
+    assert "xla_force_host_platform_device_count=4" in env2["XLA_FLAGS"]
+    # an existing LARGER count is preserved, not shrunk
+    env3 = {"XLA_FLAGS": "--xla_force_host_platform_device_count=16"}
+    e._force_cpu_env(env3, 8)
+    assert "xla_force_host_platform_device_count=16" in env3["XLA_FLAGS"]
