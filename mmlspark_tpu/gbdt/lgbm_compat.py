@@ -17,12 +17,17 @@ layout — header key=value lines, then per-tree blocks::
 
 Node encoding: internal nodes are 0..num_leaves-2; a negative child
 ``c`` is leaf ``~c``. ``decision_type`` bit 0 = categorical split,
-bit 1 = NaN defaults left. Numerical rule: ``x <= threshold`` goes
-left. Leaf values already include shrinkage, and there is no separate
-init score (LightGBM bakes boost-from-average into the leaves), so the
-imported booster reproduces ``PredictForMat`` outputs exactly.
+bit 1 = default-left, bits 2-3 = missing_type (0 = None, 1 = Zero,
+2 = NaN). Numerical rule: ``x <= threshold`` goes left. Leaf values
+already include shrinkage, and there is no separate init score
+(LightGBM bakes boost-from-average into the leaves).
 
-Categorical (many-vs-many bitset) splits are not imported yet and raise.
+Parity scope: models with missing_type None or NaN (the defaults) and
+any ``sigmoid`` coefficient reproduce ``PredictForMat`` outputs on
+finite and NaN inputs; missing_type Zero (``zero_as_missing=true``)
+routes zeros specially in LightGBM and cannot be represented by this
+tree format, so it raises. Categorical (many-vs-many bitset) splits are
+not imported yet and raise.
 """
 
 from __future__ import annotations
@@ -117,9 +122,20 @@ def _convert_tree(blk: Dict[str, str]) -> Tree:
             if decision[i] & 1:
                 raise NotImplementedError(
                     "categorical decision_type in LightGBM model file")
+            missing_type = (int(decision[i]) >> 2) & 3
             feature[i] = split_feature[i]
             threshold[i] = thr[i]
-            missing_left[i] = bool(decision[i] & 2)
+            if missing_type == 0:
+                # None: LightGBM coerces NaN to 0.0 at predict time, then
+                # applies the numerical rule — route NaN where 0.0 goes
+                missing_left[i] = bool(0.0 <= thr[i])
+            elif missing_type == 1:
+                raise NotImplementedError(
+                    "missing_type=Zero (zero_as_missing=true) routes zeros "
+                    "to the default side, which this tree format cannot "
+                    "represent")
+            else:  # NaN: missing goes to the default-left side
+                missing_left[i] = bool(decision[i] & 2)
             left[i] = node_id(int(lc[i]))
             right[i] = node_id(int(rc[i]))
 
@@ -152,6 +168,18 @@ def from_lightgbm_text(s: str):
                            num_class=max(num_class, 2)
                            if obj_name == "multiclass" else 2)
     obj = get_objective(obj_name, max(num_class, 2))
+    if obj_name == "binary":
+        # the objective spec line carries the trained sigmoid coefficient,
+        # e.g. "objective=binary sigmoid:1"; predict = 1/(1+exp(-k*raw))
+        sigmoid = 1.0
+        for tok in obj_spec[1:]:
+            if tok.startswith("sigmoid:"):
+                sigmoid = float(tok.split(":", 1)[1])
+        if sigmoid != 1.0:
+            import dataclasses
+            from mmlspark_tpu.gbdt.objectives import jax_sigmoid
+            obj = dataclasses.replace(
+                obj, transform=lambda raw, k=sigmoid: jax_sigmoid(k * raw))
     mapper = BinMapper(max_bin=255,
                        upper_bounds=[np.zeros(0)] * n_features,
                        categorical=[False] * n_features, cat_levels={})

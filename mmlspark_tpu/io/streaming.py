@@ -16,6 +16,8 @@ import json
 import os
 import threading
 import time
+import zipfile
+import zlib
 from typing import Callable, Iterator, Optional, Set
 
 from mmlspark_tpu.core.dataframe import DataFrame
@@ -42,6 +44,9 @@ class FileStreamSource:
         self.engine = engine
         self.checkpoint_location = checkpoint_location
         self._seen: Set[str] = set()
+        self._fail_counts: dict = {}
+        self._quarantined: Set[str] = set()
+        self.max_read_failures = 3
         self._stop = threading.Event()
         if checkpoint_location and os.path.exists(checkpoint_location):
             with open(checkpoint_location) as f:
@@ -71,7 +76,7 @@ class FileStreamSource:
                 except OSError:
                     continue
                 key = f"{full}:{st.st_mtime_ns}:{st.st_size}"
-                if key not in self._seen:
+                if key not in self._seen and key not in self._quarantined:
                     out.append((full, key))
         return out
 
@@ -95,12 +100,31 @@ class FileStreamSource:
                         frames.append(read_binary_files(
                             full, inspect_zip=self.inspect_zip,
                             engine=self.engine))
-                    except (OSError, FileNotFoundError):
+                    except FileNotFoundError:
                         # vanished between scan and read (write-then-move
-                        # producers); not journaled, re-examined next poll
+                        # producers): not counted, re-examined next poll
                         continue
+                    except (zipfile.BadZipFile, zlib.error, IOError) as exc:
+                        # unreadable content (truncated/corrupt zip, EIO).
+                        # Retried a few polls — transient I/O heals — then
+                        # quarantined IN MEMORY so one bad file can't wedge
+                        # the stream. Not journaled: a restart retries it.
+                        n = self._fail_counts.get(key, 0) + 1
+                        self._fail_counts[key] = n
+                        if n >= self.max_read_failures:
+                            from mmlspark_tpu.core.logs import get_logger
+                            get_logger("io.streaming").warning(
+                                "quarantining %s after %d failed reads: %s",
+                                full, n, exc)
+                            self._quarantined.add(key)
+                            self._fail_counts.pop(key, None)
+                        continue
+                    self._fail_counts.pop(key, None)
                     keys.append(key)
                 if not frames:
+                    # every fresh file failed this cycle — wait out the
+                    # poll interval instead of rescanning in a tight loop
+                    self._stop.wait(self.poll_interval)
                     continue
                 batch = DataFrame.concat(frames) if len(frames) > 1 \
                     else frames[0]

@@ -99,19 +99,27 @@ def use_cpu_devices(n: int = 8) -> None:
     sitecustomize that pins a TPU platform — because backends init lazily.
     This is how the distributed code paths run unchanged from laptop to pod.
     """
-    import re
-
     import jax
-    flags = os.environ.get("XLA_FLAGS", "")
-    m = re.search(r"xla_force_host_platform_device_count=(\d+)", flags)
-    if m is None:
-        flags = (flags + f" --xla_force_host_platform_device_count={n}").strip()
-    elif int(m.group(1)) < n:
-        flags = re.sub(r"xla_force_host_platform_device_count=\d+",
-                       f"xla_force_host_platform_device_count={n}", flags)
-    os.environ["XLA_FLAGS"] = flags
+    os.environ["XLA_FLAGS"] = bump_host_device_count(
+        os.environ.get("XLA_FLAGS", ""), n)
     os.environ["JAX_PLATFORMS"] = "cpu"
     jax.config.update("jax_platforms", "cpu")
+
+
+def bump_host_device_count(flags: str, n: int) -> str:
+    """Return ``flags`` with ``xla_force_host_platform_device_count >= n``.
+
+    A missing count is appended; a smaller one is raised; a larger one is
+    preserved (a caller prepping a bigger mesh keeps it).
+    """
+    import re
+    m = re.search(r"xla_force_host_platform_device_count=(\d+)", flags)
+    if m is None:
+        return (flags + f" --xla_force_host_platform_device_count={n}").strip()
+    if int(m.group(1)) < n:
+        return re.sub(r"xla_force_host_platform_device_count=\d+",
+                      f"xla_force_host_platform_device_count={n}", flags)
+    return flags
 
 
 def build_mesh(spec: Optional[MeshSpec] = None, devices=None):

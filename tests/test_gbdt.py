@@ -411,6 +411,38 @@ parameters:
 end of parameters
 """
 
+# One-feature, one-split binary model with a templated decision_type
+# ("DTYPE") for exercising missing_type bits: x<=1.25 -> 0.2 else -0.3.
+LGBM_MISSING_NAN_MODEL = """tree
+version=v3
+num_class=1
+num_tree_per_iteration=1
+label_index=0
+max_feature_idx=0
+objective=binary sigmoid:1
+feature_names=a
+feature_infos=none
+
+Tree=0
+num_leaves=2
+num_cat=0
+split_feature=0
+split_gain=1
+threshold=1.25
+decision_type=DTYPE
+left_child=-1
+right_child=-2
+leaf_value=0.2 -0.3
+leaf_weight=1 1
+leaf_count=10 10
+internal_value=0
+internal_weight=0
+internal_count=20
+shrinkage=1
+
+end of trees
+"""
+
 
 class TestLightGBMImport:
     """Genuine LightGBM text-dump interop (lgbm_compat.py)."""
@@ -423,19 +455,51 @@ class TestLightGBMImport:
             [1.0, 0.0, 5.0],    # 0.2 + -0.1 = 0.1
             [2.0, 0.0, 20.0],   # -0.3 + 0.05 = -0.25
             [0.0, 1.0, 20.0],   # 0.4 + 0.05 = 0.45
-            [np.nan, 0.0, 5.0],  # NaN on a: dt bit1=0 on that node -> right
+            # missing_type bits are 0 (None) on every node, so LightGBM
+            # coerces NaN to 0.0 at predict time: 0<=1.25 -> left -> 0.2
+            [np.nan, 0.0, 5.0],
         ])
-        expect_raw = np.array([0.1, -0.25, 0.45, -0.3 - 0.1])
+        expect_raw = np.array([0.1, -0.25, 0.45, 0.2 - 0.1])
         got = b.predict(X)
         np.testing.assert_allclose(got, 1 / (1 + np.exp(-expect_raw)),
                                    rtol=1e-6)
 
-    def test_nan_default_left(self):
+    def test_nan_missing_type_none_routes_as_zero(self):
         b = Booster.from_string(LGBM_BINARY_MODEL)
-        # root of tree0 has decision_type=2 -> NaN goes LEFT
-        X = np.array([[0.0, np.nan, 20.0]])  # left -> a<=1.25 -> 0.2; +0.05
+        # root of tree0 has missing_type None -> NaN behaves like 0.0:
+        # 0<=0.5 -> left -> a<=1.25 -> 0.2; tree1 c=20 -> 0.05
+        X = np.array([[0.0, np.nan, 20.0]])
         np.testing.assert_allclose(
             b.predict(X), 1 / (1 + np.exp(-(0.2 + 0.05))), rtol=1e-6)
+
+    def test_missing_type_nan_honors_default_direction(self):
+        # decision_type 8 = NaN missing, default RIGHT (bit1 clear);
+        # decision_type 10 = NaN missing, default LEFT (bit1 set)
+        for dt, expect_raw in ((8, -0.3), (10, 0.2)):
+            model = LGBM_MISSING_NAN_MODEL.replace("DTYPE", str(dt))
+            b = Booster.from_string(model)
+            # threshold 1.25: without missing handling NaN would never
+            # reach a deterministic side; the default direction decides
+            np.testing.assert_allclose(
+                b.predict(np.array([[np.nan]])),
+                1 / (1 + np.exp(-expect_raw)), rtol=1e-6)
+            # finite values still route numerically
+            np.testing.assert_allclose(
+                b.predict(np.array([[0.0]])),
+                1 / (1 + np.exp(-0.2)), rtol=1e-6)
+
+    def test_missing_type_zero_raises(self):
+        model = LGBM_MISSING_NAN_MODEL.replace("DTYPE", "4")  # Zero missing
+        with pytest.raises(NotImplementedError):
+            Booster.from_string(model)
+
+    def test_nondefault_sigmoid_coefficient(self):
+        model = LGBM_MISSING_NAN_MODEL.replace("DTYPE", "0") \
+            .replace("sigmoid:1", "sigmoid:2.5")
+        b = Booster.from_string(model)
+        np.testing.assert_allclose(
+            b.predict(np.array([[0.0]])),
+            1 / (1 + np.exp(-2.5 * 0.2)), rtol=1e-6)
 
     def test_stage_loader_and_importances(self, tmp_path):
         p = tmp_path / "model.txt"

@@ -29,6 +29,27 @@ class TestFileStreamSource:
         batches = list(src.batches(idle_timeout=0.3))
         assert len(batches) == 1  # then timed out
 
+    def test_corrupt_zip_quarantined_not_busy_loop(self, tmp_path):
+        """A persistently unreadable file must neither kill the stream
+        nor pin the poller in a rescan busy loop; after
+        ``max_read_failures`` attempts it is quarantined and good files
+        keep flowing."""
+        bad = tmp_path / "bad.zip"
+        bad.write_bytes(b"PK\x03\x04 this is not really a zip")
+        src = FileStreamSource(str(tmp_path), poll_interval=0.01,
+                               inspect_zip=True)
+        # all-failed cycles: generator stays alive and honors idle_timeout
+        t0 = time.monotonic()
+        batches = list(src.batches(idle_timeout=0.25))
+        assert batches == []
+        assert time.monotonic() - t0 >= 0.25  # waited, didn't spin/raise
+        assert not src._fail_counts  # quarantined (moved into _seen)
+        # a good file arriving afterwards still flows
+        (tmp_path / "good.bin").write_bytes(b"ok")
+        out = next(src.batches())
+        assert list(out["bytes"]) == [b"ok"]
+        src.stop()
+
     def test_checkpoint_resume(self, tmp_path):
         data_dir = tmp_path / "data"
         data_dir.mkdir()
