@@ -309,6 +309,152 @@ def predict_tree_raw(tree_arrays, X, cat_bins, max_depth: int):
 
 
 # ---------------------------------------------------------------------------
+# Leaf-wise grower — on-device program
+# ---------------------------------------------------------------------------
+
+@partial(jax.jit,
+         static_argnames=("params", "n_features", "n_bins", "hist_impl"))
+def grow_tree_device(bins, bins_t, grad, hess, sample_mask, is_categorical,
+                     feat_mask, params: GrowthParams, n_features: int,
+                     n_bins: int, hist_impl: str):
+    """Grow one whole tree as a single ``lax.while_loop`` device program.
+
+    The reference's hot loop is fully native (`TrainUtils.scala:95-146`,
+    one `LGBM_BoosterUpdateOneIter` per iteration); the TPU equivalent
+    keeps the entire leaf-wise frontier — per-node split records, leaf
+    histograms (a slot pool using the parent-minus-child subtraction
+    trick), and the row→leaf assignment — in device arrays, so a tree
+    costs ONE dispatch and the host pays one fetch per tree instead of
+    two round-trips per leaf. Sharded inputs turn the histogram
+    reductions into ICI psums exactly as in the per-leaf path.
+
+    Returns the final state dict (node arrays sized ``2*num_leaves-1``,
+    ``n_nodes`` counter, per-row assignment).
+    """
+    L = params.num_leaves
+    max_nodes = 2 * L - 1
+    B, F = n_bins, n_features
+
+    def hist_fn(in_leaf):
+        if hist_impl == "xla":
+            return build_histogram(bins, grad, hess, in_leaf, F, B)
+        from mmlspark_tpu.gbdt import pallas_hist
+        return pallas_hist.build_histogram_pallas(
+            bins_t, grad, hess, in_leaf, F, B,
+            interpret=(hist_impl == "pallas_interpret"))
+
+    gate = max(params.min_gain_to_split, 0.0)
+
+    def eligible(packed, depth_val):
+        ok = packed[EV_COUNT] >= 2 * params.min_data_in_leaf
+        if params.max_depth >= 0:
+            ok = ok & (depth_val < params.max_depth)
+        return ok & (packed[EV_GAIN] > gate)
+
+    node_of_row = jnp.where(sample_mask, 0, -1).astype(jnp.int32)
+    root_hist = hist_fn(node_of_row == 0)
+    root_packed, _ = eval_leaf(root_hist, is_categorical, params, feat_mask)
+
+    state = dict(
+        feature=jnp.full(max_nodes, -1, jnp.int32),
+        threshold_bin=jnp.zeros(max_nodes, jnp.int32),
+        missing_left=jnp.zeros(max_nodes, dtype=bool),
+        categorical=jnp.zeros(max_nodes, dtype=bool),
+        cat_mask=jnp.zeros((max_nodes, B), dtype=bool),
+        left=jnp.zeros(max_nodes, jnp.int32),
+        right=jnp.zeros(max_nodes, jnp.int32),
+        value=jnp.zeros(max_nodes, jnp.float32).at[0]
+            .set(root_packed[EV_VALUE]),
+        gain=jnp.zeros(max_nodes, jnp.float32),
+        depth=jnp.zeros(max_nodes, jnp.int32),
+        fr_packed=jnp.zeros((max_nodes, 9), jnp.float32).at[0]
+            .set(root_packed),
+        fr_gain=jnp.full(max_nodes, -jnp.inf, jnp.float32).at[0].set(
+            jnp.where(eligible(root_packed, 0), root_packed[EV_GAIN],
+                      -jnp.inf)),
+        slot=jnp.zeros(max_nodes, jnp.int32),
+        pool=jnp.zeros((L, F, B, 3), jnp.float32).at[0].set(root_hist),
+        node_of_row=node_of_row,
+        n_nodes=jnp.int32(1),
+        n_leaves=jnp.int32(1),
+    )
+
+    def cond(s):
+        return (s["n_leaves"] < L) & jnp.isfinite(jnp.max(s["fr_gain"]))
+
+    def body(s):
+        leaf = jnp.argmax(s["fr_gain"]).astype(jnp.int32)
+        packed = s["fr_packed"][leaf]
+        feat = packed[EV_FEATURE].astype(jnp.int32)
+        cut_pos = packed[EV_CUT_POS].astype(jnp.int32)
+        thr_bin = packed[EV_THRESHOLD_BIN].astype(jnp.int32)
+        m_left = packed[EV_MISSING_LEFT] > 0.5
+        is_cat = is_categorical[feat]
+        pslot = s["slot"][leaf]
+        phist = s["pool"][pslot]
+
+        # ordering of the split feature's bins (same math as
+        # split_gain_matrix: numeric = index order, categorical = G/H
+        # sorted with empty bins last)
+        hrow = phist[feat]                                   # (B, 3)
+        ratio = hrow[:, 0] / (hrow[:, 1] + 1e-12)
+        cat_key = jnp.where(hrow[:, 2] < 0.5, jnp.inf, ratio)
+        order_row = jnp.where(is_cat, jnp.argsort(cat_key),
+                              jnp.arange(B, dtype=jnp.int32))
+        pos_of_bin = jnp.zeros(B, jnp.int32).at[order_row].set(
+            jnp.arange(B, dtype=jnp.int32))
+        cat_row = pos_of_bin <= cut_pos          # bins going LEFT (cat)
+
+        li = s["n_nodes"]
+        ri = s["n_nodes"] + 1
+
+        bins_col = jnp.take(bins, feat, axis=1)
+        num_left = jnp.where(bins_col == MISSING_BIN, m_left,
+                             (bins_col <= thr_bin)
+                             & (bins_col != MISSING_BIN))
+        go_left = jnp.where(is_cat, cat_row[bins_col], num_left)
+        in_leaf = s["node_of_row"] == leaf
+        new_assign = jnp.where(in_leaf & go_left, li,
+                               jnp.where(in_leaf, ri, s["node_of_row"]))
+
+        # child histograms: build left, subtract for right
+        lhist = hist_fn(new_assign == li)
+        rhist = phist - lhist
+        lp, _ = eval_leaf(lhist, is_categorical, params, feat_mask)
+        rp, _ = eval_leaf(rhist, is_categorical, params, feat_mask)
+        dch = s["depth"][leaf] + 1
+
+        rslot = s["n_leaves"]  # slots allocated sequentially: one per leaf
+        return dict(
+            feature=s["feature"].at[leaf].set(feat),
+            threshold_bin=s["threshold_bin"].at[leaf].set(thr_bin),
+            missing_left=s["missing_left"].at[leaf].set(m_left),
+            categorical=s["categorical"].at[leaf].set(is_cat),
+            cat_mask=s["cat_mask"].at[leaf].set(
+                jnp.where(is_cat, cat_row, jnp.zeros(B, dtype=bool))),
+            left=s["left"].at[leaf].set(li),
+            right=s["right"].at[leaf].set(ri),
+            value=s["value"].at[li].set(lp[EV_VALUE])
+                .at[ri].set(rp[EV_VALUE]),
+            gain=s["gain"].at[leaf].set(packed[EV_GAIN]),
+            depth=s["depth"].at[li].set(dch).at[ri].set(dch),
+            fr_packed=s["fr_packed"].at[li].set(lp).at[ri].set(rp),
+            fr_gain=s["fr_gain"].at[leaf].set(-jnp.inf)
+                .at[li].set(jnp.where(eligible(lp, dch), lp[EV_GAIN],
+                                      -jnp.inf))
+                .at[ri].set(jnp.where(eligible(rp, dch), rp[EV_GAIN],
+                                      -jnp.inf)),
+            slot=s["slot"].at[li].set(pslot).at[ri].set(rslot),
+            pool=s["pool"].at[pslot].set(lhist).at[rslot].set(rhist),
+            node_of_row=new_assign,
+            n_nodes=s["n_nodes"] + 2,
+            n_leaves=s["n_leaves"] + 1,
+        )
+
+    return jax.lax.while_loop(cond, body, state)
+
+
+# ---------------------------------------------------------------------------
 # Leaf-wise grower
 # ---------------------------------------------------------------------------
 
@@ -371,13 +517,19 @@ class TreeGrower:
             return build_histogram(bins, grad, hess, in_leaf,
                                    self.n_features, self.n_bins)
         from mmlspark_tpu.gbdt import pallas_hist
-        if self._bins_src is not bins:   # one transpose per fit, reused
-            self._bins_t = pallas_hist.prepare_bins_t(bins)
-            self._bins_src = bins
         return pallas_hist.build_histogram_pallas(
-            self._bins_t, grad, hess, in_leaf,
+            self._get_bins_t(bins), grad, hess, in_leaf,
             self.n_features, self.n_bins,
             interpret=(self.hist_impl == "pallas_interpret"))
+
+    def _get_bins_t(self, bins):
+        """Pallas layout of ``bins``, transposed once per fit and reused
+        (identity-keyed cache shared by the host and device growers)."""
+        if self._bins_src is not bins:
+            from mmlspark_tpu.gbdt import pallas_hist
+            self._bins_t = pallas_hist.prepare_bins_t(bins)
+            self._bins_src = bins
+        return self._bins_t
 
     def grow(self, bins, grad, hess, sample_mask,
              shrinkage: float, feat_mask=None) -> Tuple[Tree, jnp.ndarray]:
@@ -386,7 +538,61 @@ class TreeGrower:
         bins (n, F) int32 / grad,hess (n,) f32 / sample_mask (n,) bool —
         all may be sharded over the data axis; everything here is jitted
         calls over them, so GSPMD handles cross-device reduction.
+
+        The ``data`` tree learner grows the whole tree in one device
+        program (:func:`grow_tree_device` — one dispatch + one host fetch
+        per tree); the feature/voting learners keep the per-leaf host
+        loop, whose shard_map histogram programs aren't nested inside a
+        ``while_loop``.
         """
+        if self.tree_learner == "data" and self._voting_fn is None:
+            return self._grow_device(bins, grad, hess, sample_mask,
+                                     shrinkage, feat_mask)
+        return self._grow_host(bins, grad, hess, sample_mask,
+                               shrinkage, feat_mask)
+
+    def _grow_device(self, bins, grad, hess, sample_mask,
+                     shrinkage: float, feat_mask=None
+                     ) -> Tuple[Tree, jnp.ndarray]:
+        p = self.params
+        bins_t = self._get_bins_t(bins) if self.hist_impl != "xla" else None
+        s = grow_tree_device(bins, bins_t, grad, hess, sample_mask,
+                             self.is_categorical, feat_mask, p,
+                             self.n_features, self.n_bins, self.hist_impl)
+        # ONE host fetch for the whole tree
+        (feature, threshold_bin, missing_left, categorical, cat_mask,
+         left, right, value, gain_arr, n_nodes) = jax.device_get(
+            (s["feature"], s["threshold_bin"], s["missing_left"],
+             s["categorical"], s["cat_mask"], s["left"], s["right"],
+             s["value"], s["gain"], s["n_nodes"]))
+        n_nodes = int(n_nodes)
+        value_arr = (value * shrinkage).astype(np.float32)
+
+        threshold = np.zeros(len(feature), np.float64)
+        n_mapped = len(self.mapper.categorical)
+        for i in range(n_nodes):
+            if feature[i] >= 0 and not categorical[i] \
+                    and feature[i] < n_mapped:
+                threshold[i] = self.mapper.threshold_value(
+                    int(feature[i]), int(threshold_bin[i]))
+
+        tree = Tree(feature=feature[:n_nodes], threshold=threshold[:n_nodes],
+                    threshold_bin=threshold_bin[:n_nodes],
+                    missing_left=missing_left[:n_nodes],
+                    categorical=categorical[:n_nodes],
+                    cat_mask=cat_mask[:n_nodes],
+                    left=left[:n_nodes], right=right[:n_nodes],
+                    value=value_arr[:n_nodes], gain=gain_arr[:n_nodes],
+                    n_nodes=n_nodes)
+
+        node_of_row = s["node_of_row"]
+        row_vals = jnp.where(
+            node_of_row >= 0,
+            (s["value"] * shrinkage)[jnp.maximum(node_of_row, 0)], 0.0)
+        return tree, row_vals
+
+    def _grow_host(self, bins, grad, hess, sample_mask,
+                   shrinkage: float, feat_mask=None) -> Tuple[Tree, jnp.ndarray]:
         p = self.params
         max_nodes = 2 * p.num_leaves - 1
         B = self.n_bins

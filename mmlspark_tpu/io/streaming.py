@@ -92,40 +92,39 @@ class FileStreamSource:
         last_new = time.monotonic()
         while not self._stop.is_set():
             fresh = self._scan()
-            if fresh:
-                frames = []
-                keys = []
-                for full, key in fresh:
-                    try:
-                        frames.append(read_binary_files(
-                            full, inspect_zip=self.inspect_zip,
-                            engine=self.engine))
-                    except FileNotFoundError:
-                        # vanished between scan and read (write-then-move
-                        # producers): not counted, re-examined next poll
-                        continue
-                    except (zipfile.BadZipFile, zlib.error, IOError) as exc:
-                        # unreadable content (truncated/corrupt zip, EIO).
-                        # Retried a few polls — transient I/O heals — then
-                        # quarantined IN MEMORY so one bad file can't wedge
-                        # the stream. Not journaled: a restart retries it.
-                        n = self._fail_counts.get(key, 0) + 1
-                        self._fail_counts[key] = n
-                        if n >= self.max_read_failures:
-                            from mmlspark_tpu.core.logs import get_logger
-                            get_logger("io.streaming").warning(
-                                "quarantining %s after %d failed reads: %s",
-                                full, n, exc)
-                            self._quarantined.add(key)
-                            self._fail_counts.pop(key, None)
-                        continue
-                    self._fail_counts.pop(key, None)
-                    keys.append(key)
-                if not frames:
-                    # every fresh file failed this cycle — wait out the
-                    # poll interval instead of rescanning in a tight loop
-                    self._stop.wait(self.poll_interval)
+            frames, keys = [], []
+            for full, key in fresh:
+                try:
+                    frames.append(read_binary_files(
+                        full, inspect_zip=self.inspect_zip,
+                        engine=self.engine))
+                except OSError:
+                    # vanished between scan and read (write-then-move
+                    # producers) or transient I/O (EACCES/EIO while a
+                    # producer settles): not counted, re-examined next
+                    # poll — the sleep below keeps this from spinning
                     continue
+                except (zipfile.BadZipFile, zlib.error) as exc:
+                    # corrupt content. Retried a few polls — a partial
+                    # write heals once complete — then quarantined IN
+                    # MEMORY so one bad file can't wedge the stream.
+                    # Not journaled: a restart retries it.
+                    n = self._fail_counts.get(key, 0) + 1
+                    self._fail_counts[key] = n
+                    if n >= self.max_read_failures:
+                        from mmlspark_tpu.core.logs import get_logger
+                        get_logger("io.streaming").warning(
+                            "quarantining %s after %d failed reads: %s",
+                            full, n, exc)
+                        self._quarantined.add(key)
+                    continue
+                keys.append(key)
+            # drop stale fail counts (rewritten files get fresh keys every
+            # poll; without pruning the dict grows without bound)
+            live = {key for _, key in fresh}
+            self._fail_counts = {k: v for k, v in self._fail_counts.items()
+                                 if k in live and k not in self._quarantined}
+            if frames:
                 batch = DataFrame.concat(frames) if len(frames) > 1 \
                     else frames[0]
                 yield batch
@@ -138,11 +137,13 @@ class FileStreamSource:
                 last_new = time.monotonic()
                 if max_batches is not None and yielded >= max_batches:
                     return
-            elif (idle_timeout is not None
-                  and time.monotonic() - last_new > idle_timeout):
+                continue
+            # no batch this cycle (nothing new, or every read failed):
+            # honor idle_timeout, then wait out the poll interval
+            if (idle_timeout is not None
+                    and time.monotonic() - last_new > idle_timeout):
                 return
-            else:
-                self._stop.wait(self.poll_interval)
+            self._stop.wait(self.poll_interval)
 
     def foreach_batch(self, fn: Callable[[DataFrame], None],
                       **kwargs) -> threading.Thread:

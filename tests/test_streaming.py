@@ -43,7 +43,7 @@ class TestFileStreamSource:
         batches = list(src.batches(idle_timeout=0.25))
         assert batches == []
         assert time.monotonic() - t0 >= 0.25  # waited, didn't spin/raise
-        assert not src._fail_counts  # quarantined (moved into _seen)
+        assert not src._fail_counts  # moved into _quarantined (in-memory)
         # a good file arriving afterwards still flows
         (tmp_path / "good.bin").write_bytes(b"ok")
         out = next(src.batches())
