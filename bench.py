@@ -1,17 +1,24 @@
-"""Headline benchmark: CIFAR-10 ResNet scoring throughput per chip.
+"""Benchmarks for all five BASELINE configs — one JSON line each.
 
-BASELINE config 3 ("CNTKModel.transform CIFAR10 ResNet scoring"). The
-reference publishes no absolute number — its CIFAR10 notebook times
-`CNTKModel.transform` over the 10k test images on a GPU VM without
-committing the result (BASELINE.md). We use 1000 images/sec/chip as the
-GPU-VM *peak-throughput* parity proxy (10k images in ~10s, the era's
-CNTK-on-Spark ballpark including per-partition JNI marshalling); the
-measurement is the fastest of three warm passes — host<->device link
-jitter dominates run variance — and ``vs_baseline`` = measured / proxy,
-so >= 1.0 means at-or-above parity in sustained peak throughput.
+The reference publishes no absolute numbers (BASELINE.md: its only perf
+claims are relative — "10-30% faster" GBDT, "sub-millisecond" serving —
+and its CIFAR notebook times a transform without committing the result).
+Each config therefore carries an explicit GPU-VM/Spark-era *proxy*
+baseline, documented per bench below; ``vs_baseline`` >= 1.0 means
+at-or-above parity. Wall-clock benches report the MEDIAN of warm passes
+(and carry best-of-N alongside — the tunneled dev chip's host<->device
+link jitter dominates run variance; metric names are versioned _v2 since
+r01 reported best-of-3 as the headline value).
 
-Prints exactly one JSON line:
-  {"metric": ..., "value": N, "unit": "images/sec/chip", "vs_baseline": N}
+Configs (BASELINE.md "Target configs"):
+  1. gbdt_quantile_fit_v2        — drug-discovery-shape quantile fit wall-clock
+  2. adult_census_fit_v2         — census-shape binary fit (data-parallel learner)
+  3. cifar10_scoring_v2          — ResNet-20 scoring images/sec/chip (+ device-only)
+  4. transfer_learning_e2e_v2    — ImageFeaturizer + TrainClassifier end-to-end
+  5. distributed_sgd_step_v2     — sharded train-step throughput (steps/sec)
+
+Every line carries chip metadata (platform/device kind/count) so the
+numbers are interpretable across hosts.
 """
 
 from __future__ import annotations
@@ -21,48 +28,259 @@ import time
 
 import numpy as np
 
-BASELINE_IMAGES_PER_SEC = 1000.0  # GPU-VM wall-clock parity proxy (see above)
-BATCH = 1024
-N_IMAGES = 10_240  # ~ the notebook's 10k CIFAR test set
+
+def _chip():
+    import jax
+    d = jax.devices()[0]
+    return {"platform": jax.default_backend(),
+            "device_kind": getattr(d, "device_kind", str(d)),
+            "n_devices": len(jax.devices())}
 
 
-def main() -> None:
+def _timed_passes(fn, n_passes: int = 3):
+    """Median + best of ``n_passes`` warm wall-clock runs (fn must block)."""
+    times = []
+    for _ in range(n_passes):
+        t0 = time.perf_counter()
+        fn()
+        times.append(time.perf_counter() - t0)
+    return float(np.median(times)), float(min(times))
+
+
+def bench_gbdt_quantile():
+    """Config 1: LightGBMRegressor quantile fit (drug-discovery notebook
+    shape: ~4k rows x 100 molecular descriptors, 40 iterations).
+
+    Proxy baseline: 60 s — a Spark-cluster LightGBM fit of this scale in
+    the reference's era spent tens of seconds on scheduling + JNI row
+    marshalling + socket rendezvous before native training (the docs
+    claim only "10-30% faster" than SparkML GBT, `docs/lightgbm.md:17`).
+    """
+    from mmlspark_tpu.gbdt.booster import Booster, BoosterParams
+    rng = np.random.default_rng(0)
+    n, f = 4096, 100
+    X = rng.normal(size=(n, f))
+    y = X[:, :5].sum(axis=1) + 0.3 * rng.normal(size=n) + 5.0
+    p = BoosterParams(objective="quantile", alpha=0.9,
+                      num_iterations=40, num_leaves=15)
+    Booster.train(p, X, y)  # warm: bin + compile
+    median, best = _timed_passes(lambda: Booster.train(p, X, y))
+    baseline = 60.0
+    return {"metric": "gbdt_quantile_fit_v2", "value": round(median, 2),
+            "unit": "seconds", "best": round(best, 2),
+            "baseline": baseline, "vs_baseline": round(baseline / median, 3),
+            "chip": _chip()}
+
+
+def bench_adult_census():
+    """Config 2: LightGBMClassifier binary fit, census shape (32k rows x
+    14 mixed columns, 100 iterations, 31 leaves — LightGBM defaults),
+    data-parallel tree learner over all local devices.
+
+    Proxy baseline: 60 s — same Spark-era reasoning as config 1, at
+    Adult Census scale with the distributed learner's socket allreduce.
+    """
+    import jax
+    from mmlspark_tpu.gbdt.booster import Booster, BoosterParams
+    from mmlspark_tpu.parallel import build_mesh, batch_sharding
+
+    rng = np.random.default_rng(0)
+    n, f = 32768, 14
+    X = rng.normal(size=(n, f))
+    X[:, 10] = rng.integers(0, 16, n)   # categorical-ish columns
+    X[:, 11] = rng.integers(0, 14, n)
+    logit = X[:, 0] + 0.5 * X[:, 1] - 0.3 * X[:, 2] + 0.2 * (X[:, 10] > 8)
+    y = (logit + rng.logistic(size=n) > 0).astype(np.float64)
+    p = BoosterParams(objective="binary", num_iterations=100, num_leaves=31)
+    sharding = (batch_sharding(build_mesh())
+                if len(jax.devices()) > 1 else None)
+
+    def fit():
+        Booster.train(p, X, y, categorical_features=[10, 11],
+                      sharding=sharding)
+    fit()  # warm
+    median, best = _timed_passes(fit, n_passes=2)
+    baseline = 60.0
+    return {"metric": "adult_census_fit_v2", "value": round(median, 2),
+            "unit": "seconds", "best": round(best, 2),
+            "baseline": baseline, "vs_baseline": round(baseline / median, 3),
+            "chip": _chip()}
+
+
+def bench_cifar10_scoring():
+    """Config 3: CNTKModel.transform parity — ResNet-20 scoring over a
+    CIFAR-sized set, through the full NNModel batching/padding pipeline.
+
+    Proxy baseline: 1000 images/sec/chip — the era's GPU-VM ballpark for
+    10k CIFAR images in ~10 s through CNTK-on-Spark including
+    per-partition JNI marshalling (the notebook commits no number).
+    Also reports pure device throughput (host transfers excluded) from a
+    chained on-device loop.
+    """
     import jax
     from mmlspark_tpu.models.function import NNFunction
     from mmlspark_tpu.models.nn import NNModel
     from mmlspark_tpu.core.dataframe import DataFrame
 
+    batch, n_images = 1024, 10_240
     model = NNFunction.init(
         {"builder": "cifar_resnet", "depth": 20, "dtype": "bfloat16"},
         input_shape=(32, 32, 3), seed=0)
     rng = np.random.default_rng(0)
-    images = rng.uniform(0, 1, size=(N_IMAGES, 32, 32, 3)).astype(np.float32)
+    images = rng.uniform(0, 1, size=(n_images, 32, 32, 3)).astype(np.float32)
     df = DataFrame({"image": images})
-
     scorer = NNModel(model=model, input_col="image", output_col="scores",
-                     batch_size=BATCH)
+                     batch_size=batch)
+    scorer.transform(df.head(batch))  # warm: compile + first dispatch
 
-    # warmup: compile + first dispatch
-    scorer.transform(df.head(BATCH))
+    out = {}
 
-    # several passes, keep the fastest: host<->device link jitter (the
-    # tunneled dev chip especially) dominates run-to-run variance, and
-    # peak throughput is the capability being measured
-    elapsed = float("inf")
-    for _ in range(3):
-        t0 = time.perf_counter()
-        out = scorer.transform(df)
-        elapsed = min(elapsed, time.perf_counter() - t0)
-    assert out["scores"].shape == (N_IMAGES, 10)
-
+    def run():
+        out["scores"] = scorer.transform(df)["scores"]
+    median, best = _timed_passes(run, n_passes=3)
+    assert out["scores"].shape == (n_images, 10)
     n_chips = max(len(jax.devices()), 1)
-    images_per_sec_per_chip = N_IMAGES / elapsed / n_chips
-    print(json.dumps({
-        "metric": "cifar10_resnet20_scoring_throughput",
-        "value": round(images_per_sec_per_chip, 1),
-        "unit": "images/sec/chip",
-        "vs_baseline": round(images_per_sec_per_chip / BASELINE_IMAGES_PER_SEC, 3),
-    }))
+    med_tput = n_images / median / n_chips
+    best_tput = n_images / best / n_chips
+
+    # pure device throughput: chained jitted forwards on device-resident
+    # data, one block at the end (no host<->device transfer in the loop)
+    import jax.numpy as jnp
+    module = model.module()
+    fwd = jax.jit(lambda p, x: module.apply(p, x))
+    x_dev = jnp.asarray(images[:batch])
+    p_dev = jax.device_put(model.params)
+    fwd(p_dev, x_dev).block_until_ready()  # warm
+    reps = 20
+    t0 = time.perf_counter()
+    acc = None
+    for _ in range(reps):
+        acc = fwd(p_dev, x_dev)
+    acc.block_until_ready()
+    dev_elapsed = time.perf_counter() - t0
+    # the chained loop runs on a single device by construction, so this
+    # is already a per-chip number — no division by n_chips
+    dev_tput = reps * batch / dev_elapsed
+
+    baseline = 1000.0
+    return {"metric": "cifar10_scoring_v2", "value": round(med_tput, 1),
+            "unit": "images/sec/chip", "best": round(best_tput, 1),
+            "device_only": round(dev_tput, 1),
+            "baseline": baseline, "vs_baseline": round(med_tput / baseline, 3),
+            "chip": _chip()}
+
+
+def bench_transfer_learning():
+    """Config 4: ImageFeaturizer (truncated ResNet backbone) +
+    TrainClassifier end-to-end over 2048 images.
+
+    Proxy baseline: 40 s — the reference's example-9 path featurized at
+    GPU-VM CNTK speed (~100 img/s era with JNI row plumbing, so ~20 s
+    for 2k images) plus a distributed LR fit of comparable cost.
+    """
+    from mmlspark_tpu.core.dataframe import DataFrame
+    from mmlspark_tpu.models.function import NNFunction
+    from mmlspark_tpu.models.featurizer import ImageFeaturizer
+    from mmlspark_tpu.automl.train import TrainClassifier
+    from mmlspark_tpu.gbdt import GBDTClassifier
+
+    backbone = NNFunction.init(
+        {"builder": "cifar_resnet", "depth": 14, "dtype": "bfloat16"},
+        input_shape=(32, 32, 3), seed=0)
+    rng = np.random.default_rng(0)
+    n = 2048
+    y = rng.integers(0, 2, n)
+    images = (rng.uniform(0, 1, (n, 32, 32, 3)) * 0.5
+              + y[:, None, None, None] * 0.45).astype(np.float32)
+    df = DataFrame({"image": images, "label": y})
+
+    # one featurizer across passes: its NNModel caches the compiled
+    # truncated forward per instance, so the timed passes are truly warm
+    featurizer = ImageFeaturizer(model=backbone, input_col="image",
+                                 output_col="embedding",
+                                 cut_output_layers=1)
+
+    def run():
+        feats = featurizer.transform(df)
+        TrainClassifier(
+            model=GBDTClassifier(num_iterations=20, num_leaves=7),
+            label_col="label").fit(feats.select(["embedding", "label"]))
+    run()  # warm
+    median, best = _timed_passes(run, n_passes=2)
+    baseline = 40.0
+    return {"metric": "transfer_learning_e2e_v2", "value": round(median, 2),
+            "unit": "seconds", "best": round(best, 2),
+            "baseline": baseline, "vs_baseline": round(baseline / median, 3),
+            "chip": _chip()}
+
+
+def bench_distributed_sgd():
+    """Config 5: the cntk-train replacement — one jitted data-parallel
+    train step (ResNet-20, batch 256 CIFAR shape) over the device mesh,
+    20 chained steps, blocked once (sustained device throughput).
+
+    Proxy baseline: 10 steps/sec — the era's CNTK-on-K80 data-parallel
+    SGD rate for ResNet-20/batch-256 once MPI/ssh overhead amortized.
+    """
+    import jax
+    import jax.numpy as jnp
+    from mmlspark_tpu.models.function import NNFunction
+    from mmlspark_tpu.models.trainer import (
+        NNLearner, make_loss, make_optimizer)
+    from mmlspark_tpu.parallel import (
+        MeshSpec, build_mesh, batch_sharding, replicated_sharding)
+
+    n_dev = len(jax.devices())
+    mesh = build_mesh(MeshSpec.from_dict({"data": n_dev}))
+    model = NNFunction.init({"builder": "cifar_resnet", "depth": 20},
+                            input_shape=(32, 32, 3), seed=0)
+    learner = NNLearner(arch=model.arch, learning_rate=0.1)
+    tx = make_optimizer("momentum", 0.1)
+    loss_fn = make_loss("softmax_cross_entropy")
+    step = jax.jit(learner.build_train_step(model.module(), tx, loss_fn))
+
+    batch = 256
+    repl, shard = replicated_sharding(mesh), batch_sharding(mesh)
+    rng = np.random.default_rng(0)
+    params = jax.device_put(model.params, repl)
+    opt_state = jax.device_put(tx.init(params), repl)
+    x = jax.device_put(
+        rng.uniform(0, 1, (batch, 32, 32, 3)).astype(np.float32), shard)
+    y = jax.device_put(rng.integers(0, 10, batch).astype(np.int32), shard)
+    w = jax.device_put(np.ones(batch, np.float32), shard)
+
+    params, opt_state, loss = step(params, opt_state, x, y, w)  # warm
+    jax.block_until_ready(loss)
+    reps = 20
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        params, opt_state, loss = step(params, opt_state, x, y, w)
+    jax.block_until_ready(loss)
+    elapsed = time.perf_counter() - t0
+    steps_per_sec = reps / elapsed
+    baseline = 10.0
+    return {"metric": "distributed_sgd_step_v2",
+            "value": round(steps_per_sec, 2), "unit": "steps/sec",
+            "ms_per_step": round(1000 * elapsed / reps, 1),
+            "batch_size": batch, "baseline": baseline,
+            "vs_baseline": round(steps_per_sec / baseline, 3),
+            "chip": _chip()}
+
+
+BENCHES = [bench_gbdt_quantile, bench_adult_census, bench_cifar10_scoring,
+           bench_transfer_learning, bench_distributed_sgd]
+
+
+def main() -> None:
+    import sys
+    only = sys.argv[1] if len(sys.argv) > 1 else None
+    selected = [fn for fn in BENCHES
+                if only is None or only in fn.__name__]
+    if not selected:
+        names = ", ".join(fn.__name__ for fn in BENCHES)
+        raise SystemExit(f"no benchmark matches {only!r}; choose from: {names}")
+    for fn in selected:
+        print(json.dumps(fn()), flush=True)
 
 
 if __name__ == "__main__":

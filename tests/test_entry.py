@@ -60,6 +60,26 @@ def test_dryrun_fresh_process_flips_platform_inline():
     assert "dryrun_multichip(8): ok" in proc.stdout
 
 
+@pytest.mark.slow
+def test_bench_emits_json_line_per_config():
+    """bench.py's driver contract: each config prints one JSON line with
+    metric/value/unit/vs_baseline (+ chip metadata). Smoke-run the
+    cheapest config on a CPU mesh."""
+    import json
+    env = _clean_env()
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=8"
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "bench.py"), "gbdt_quantile"],
+        env=env, cwd=REPO, capture_output=True, text=True, timeout=900)
+    assert proc.returncode == 0, proc.stderr
+    lines = [l for l in proc.stdout.splitlines() if l.startswith("{")]
+    assert len(lines) == 1
+    rec = json.loads(lines[0])
+    for key in ("metric", "value", "unit", "vs_baseline", "baseline", "chip"):
+        assert key in rec, f"missing {key}"
+    assert rec["chip"]["n_devices"] >= 1
+
+
 def test_force_cpu_env_rewrites_existing_count():
     import __graft_entry__ as e
     env = {"XLA_FLAGS": "--xla_force_host_platform_device_count=2 --foo"}
