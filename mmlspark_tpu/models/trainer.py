@@ -170,6 +170,14 @@ class NNLearner(Estimator, HasLabelCol, HasFeaturesCol):
 
         rng = np.random.default_rng(self.seed)
         global_step = 0
+        # bound the number of dispatched-but-unfinished steps: an
+        # unthrottled loop queues every step at once, and XLA:CPU's
+        # cross-device collective rendezvous can deadlock when executions
+        # from many run_ids oversubscribe the shared thread pool (the
+        # virtual 8-device test mesh hits this). A window of 2 keeps
+        # host/device pipelining on real chips while serializing enough.
+        from collections import deque
+        inflight: deque = deque()
         for epoch in range(self.epochs):
             order = rng.permutation(len(x))
             for s in range(steps_per_epoch):
@@ -189,6 +197,9 @@ class NNLearner(Estimator, HasLabelCol, HasFeaturesCol):
                 yb = jax.device_put(yp, shard)
                 wb = jax.device_put(wp, shard)
                 params, opt_state, loss = step(params, opt_state, xb, yb, wb)
+                inflight.append(loss)
+                if len(inflight) > 2:
+                    inflight.popleft().block_until_ready()
                 if self.log_every and global_step % self.log_every == 0:
                     print(f"[NNLearner] step {global_step} "
                           f"epoch {epoch + 1}/{self.epochs} "

@@ -83,7 +83,17 @@ class ModelRepo:
             return {}
         with open(self._manifest_path()) as f:
             entries = json.load(f)
-        return {e["name"]: ModelSchema.from_json(e) for e in entries}
+        out = {}
+        for e in entries:
+            meta = ModelSchema.from_json(e)
+            # manifests store repo-relative uris so a zoo directory is
+            # portable (committed checkpoints work from any clone path);
+            # absolute uris (e.g. a mount) pass through untouched
+            if not os.path.isabs(meta.uri):
+                meta = dataclasses.replace(
+                    meta, uri=os.path.join(self.root, meta.uri))
+            out[meta.name] = meta
+        return out
 
     def publish(self, name: str, fn: NNFunction, dataset: str = "",
                 model_type: str = "", input_shape: Optional[List[int]] = None,
@@ -93,16 +103,23 @@ class ModelRepo:
         fn.save(model_dir)
         meta = ModelSchema(
             name=name, dataset=dataset, model_type=model_type,
-            uri=model_dir, hash=_dir_sha256(model_dir),
+            uri=name,  # repo-relative: the manifest stays portable
+            hash=_dir_sha256(model_dir),
             input_shape=list(input_shape or []),
             layer_names=fn.layer_names,
             num_classes=num_classes)
-        entries = [m.to_json() for m in self.models().values() if m.name != name]
+        # rewrite from the RAW manifest: models() resolves uris against
+        # self.root, and re-serializing resolved paths would bake this
+        # machine's absolute paths into the portable manifest
+        entries = []
+        if os.path.exists(self._manifest_path()):
+            with open(self._manifest_path()) as f:
+                entries = [e for e in json.load(f) if e["name"] != name]
         entries.append(meta.to_json())
         os.makedirs(self.root, exist_ok=True)
         with open(self._manifest_path(), "w") as f:
             json.dump(entries, f, indent=2)
-        return meta
+        return dataclasses.replace(meta, uri=model_dir)  # resolved for use
 
 
 class ModelDownloader:

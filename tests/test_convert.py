@@ -1,0 +1,148 @@
+"""Trained-weight importers (models/convert.py).
+
+The torch tests build a torch twin of the ``cifar_resnet`` architecture
+and assert the converted NNFunction reproduces torch's own forward
+outputs — external-implementation parity, the NN analogue of the
+LightGBM model-file import tests.
+"""
+
+import numpy as np
+import pytest
+
+torch = pytest.importorskip("torch")
+import torch.nn as tnn  # noqa: E402
+import torch.nn.functional as F  # noqa: E402
+
+from mmlspark_tpu.models.convert import (  # noqa: E402
+    import_flax_params, import_torch_state_dict,
+)
+from mmlspark_tpu.models.function import NNFunction  # noqa: E402
+
+
+def _groups(ch: int) -> int:
+    g = min(32, ch)
+    while ch % g:
+        g -= 1
+    return g
+
+
+class TorchBlock(tnn.Module):
+    """Forward-call-order twin of resnet.ResNetBlock (pre-act GroupNorm).
+
+    flax ``Conv`` uses SAME padding: symmetric for 3x3 stride 1, but
+    asymmetric (0 before, 1 after) for 3x3 stride 2 on even inputs —
+    hence the explicit F.pad on the strided conv.
+    """
+
+    def __init__(self, in_ch: int, out_ch: int, stride: int):
+        super().__init__()
+        self.gn1 = tnn.GroupNorm(_groups(in_ch), in_ch, eps=1e-6)
+        self.conv1 = tnn.Conv2d(in_ch, out_ch, 3, stride=stride,
+                                padding=1 if stride == 1 else 0, bias=False)
+        self.gn2 = tnn.GroupNorm(_groups(out_ch), out_ch, eps=1e-6)
+        self.conv2 = tnn.Conv2d(out_ch, out_ch, 3, padding=1, bias=False)
+        self.shortcut = (tnn.Conv2d(in_ch, out_ch, 1, stride=stride,
+                                    bias=False)
+                         if stride != 1 or in_ch != out_ch else None)
+        self.stride = stride
+
+    def forward(self, x):
+        y = F.relu(self.gn1(x))
+        if self.stride != 1:
+            y = F.pad(y, (0, 1, 0, 1))
+        y = self.conv1(y)
+        y = F.relu(self.gn2(y))
+        y = self.conv2(y)
+        r = self.shortcut(x) if self.shortcut is not None else x
+        return y + r
+
+
+class TorchCifarResNet(tnn.Module):
+    def __init__(self, depth=8, width=8, num_classes=10, in_ch=3):
+        super().__init__()
+        n = (depth - 2) // 6
+        self.conv_in = tnn.Conv2d(in_ch, width, 3, padding=1, bias=False)
+
+        def group(cin, cout, stride):
+            blocks = [TorchBlock(cin, cout, stride)]
+            blocks += [TorchBlock(cout, cout, 1) for _ in range(n - 1)]
+            return tnn.Sequential(*blocks)
+
+        self.group1 = group(width, width, 1)
+        self.group2 = group(width, 2 * width, 2)
+        self.group3 = group(2 * width, 4 * width, 2)
+        self.fc = tnn.Linear(4 * width, num_classes)
+
+    def forward(self, x):
+        x = self.conv_in(x)
+        x = self.group3(self.group2(self.group1(x)))
+        x = x.mean(dim=(2, 3))
+        return self.fc(x)
+
+
+ARCH = {"builder": "cifar_resnet", "depth": 8, "width": 8}
+
+
+class TestTorchImport:
+    def test_outputs_match_torch(self):
+        torch.manual_seed(0)
+        tm = TorchCifarResNet(depth=8, width=8).eval()
+        fn = import_torch_state_dict(tm.state_dict(), ARCH,
+                                     input_shape=(32, 32, 3))
+        rng = np.random.default_rng(0)
+        x = rng.normal(size=(4, 32, 32, 3)).astype(np.float32)
+        with torch.no_grad():
+            want = tm(torch.from_numpy(x).permute(0, 3, 1, 2)).numpy()
+        got = np.asarray(fn.apply(x))
+        np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-4)
+
+    def test_truncated_features_match_torch(self):
+        """The transfer-learning cut (pool features) must match too."""
+        torch.manual_seed(1)
+        tm = TorchCifarResNet(depth=8, width=8).eval()
+        fn = import_torch_state_dict(tm.state_dict(), ARCH,
+                                     input_shape=(32, 32, 3))
+        rng = np.random.default_rng(1)
+        x = rng.normal(size=(2, 32, 32, 3)).astype(np.float32)
+        with torch.no_grad():
+            h = tm.conv_in(torch.from_numpy(x).permute(0, 3, 1, 2))
+            h = tm.group3(tm.group2(tm.group1(h)))
+            want = h.mean(dim=(2, 3)).numpy()
+        got = np.asarray(fn.apply(x, output_layer="pool"))
+        np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-4)
+
+    def test_batchnorm_rejected(self):
+        sd = {"conv.weight": torch.zeros(8, 3, 3, 3),
+              "bn.running_mean": torch.zeros(8),
+              "bn.running_var": torch.ones(8)}
+        with pytest.raises(ValueError, match="BatchNorm"):
+            import_torch_state_dict(sd, ARCH, input_shape=(32, 32, 3))
+
+    def test_count_mismatch_rejected(self):
+        with pytest.raises(ValueError, match="tensor count"):
+            import_torch_state_dict({"w": torch.zeros(3)}, ARCH,
+                                    input_shape=(32, 32, 3))
+
+    def test_shape_mismatch_rejected(self):
+        torch.manual_seed(0)
+        tm = TorchCifarResNet(depth=8, width=8)
+        sd = tm.state_dict()
+        first = next(iter(sd))
+        sd[first] = torch.zeros(9, 9, 9, 9)
+        with pytest.raises(ValueError, match="shape mismatch"):
+            import_torch_state_dict(sd, ARCH, input_shape=(32, 32, 3))
+
+
+class TestFlaxImport:
+    def test_adopts_external_tree(self):
+        src = NNFunction.init(ARCH, input_shape=(32, 32, 3), seed=7)
+        fn = import_flax_params(src.params, ARCH, input_shape=(32, 32, 3))
+        rng = np.random.default_rng(0)
+        x = rng.normal(size=(2, 32, 32, 3)).astype(np.float32)
+        np.testing.assert_allclose(np.asarray(fn.apply(x)),
+                                   np.asarray(src.apply(x)), rtol=1e-6)
+
+    def test_tree_mismatch_rejected(self):
+        src = NNFunction.init(ARCH, input_shape=(32, 32, 3), seed=0)
+        with pytest.raises(ValueError, match="param tree mismatch"):
+            import_flax_params({"params": {}}, ARCH, input_shape=(32, 32, 3))

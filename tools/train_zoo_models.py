@@ -1,0 +1,83 @@
+"""Train and publish the in-repo model zoo (offline, one-time).
+
+The reference's ModelDownloader serves *trained* CNTK nets
+(`ModelDownloader.scala:54,124`); this is the offline converter/trainer
+that fills the same role here (SURVEY §7 step 4). It trains
+``digits_resnet8`` — a ResNet-8 on sklearn's real 8x8 digits dataset,
+classes 0-7 ONLY (8/9 are held out so the transfer-learning example is
+genuine: its features were never trained on the target classes) — then
+publishes the checkpoint + manifest into ``zoo/`` and writes the
+golden-output fixture used by tests/test_zoo.py.
+
+Run from the repo root:  python tools/train_zoo_models.py
+"""
+
+import os
+import sys
+
+import numpy as np
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from mmlspark_tpu.parallel.topology import use_cpu_devices  # noqa: E402
+
+use_cpu_devices(8)
+
+ZOO = os.path.join(REPO, "zoo")
+GOLDEN = os.path.join(REPO, "tests", "resources", "golden_digits_resnet8.npz")
+ARCH = {"builder": "cifar_resnet", "depth": 8, "width": 8, "num_classes": 8}
+
+
+def load_digits_pretrain_split():
+    """Digits 0-7, deterministic train/test split (8/9 left for transfer)."""
+    from sklearn.datasets import load_digits
+    d = load_digits()
+    images = (d.images / 16.0).astype(np.float32)[..., None]  # (n, 8, 8, 1)
+    labels = d.target.astype(np.int64)
+    keep = labels < 8
+    images, labels = images[keep], labels[keep]
+    rng = np.random.default_rng(0)
+    order = rng.permutation(len(images))
+    images, labels = images[order], labels[order]
+    n_test = 200
+    return (images[n_test:], labels[n_test:],
+            images[:n_test], labels[:n_test])
+
+
+def main() -> None:
+    from mmlspark_tpu.core.dataframe import DataFrame
+    from mmlspark_tpu.models.trainer import NNLearner
+    from mmlspark_tpu.models.zoo import ModelRepo
+
+    Xtr, ytr, Xte, yte = load_digits_pretrain_split()
+    print(f"digits pretrain split: {len(Xtr)} train / {len(Xte)} test")
+
+    learner = NNLearner(arch=ARCH, epochs=40, batch_size=256,
+                        learning_rate=0.05, log_every=0, seed=0)
+    model = learner.fit(DataFrame({"features": Xtr, "label": ytr}))
+
+    scored = model.transform(DataFrame({"features": Xte, "label": yte}))
+    acc = float((np.asarray(scored["scores"]).argmax(axis=1) == yte).mean())
+    print(f"test accuracy (classes 0-7): {acc:.4f}")
+    if acc < 0.95:
+        raise SystemExit(f"refusing to publish a weak model (acc={acc:.3f})")
+
+    fn = model.model  # the trained NNFunction
+    meta = ModelRepo(ZOO).publish(
+        "digits_resnet8", fn, dataset="sklearn-digits(0-7)",
+        model_type="cifar_resnet/8", input_shape=[8, 8, 1], num_classes=8)
+    print(f"published {meta.name}: hash={meta.hash[:12]}... -> {meta.uri}")
+
+    # golden fixture: deterministic input -> logits from the published
+    # weights (tests compare the zoo-loaded model against this)
+    rng = np.random.default_rng(123)
+    x = rng.uniform(0, 1, size=(8, 8, 8, 1)).astype(np.float32)
+    logits = np.asarray(fn.apply(x), dtype=np.float32)
+    os.makedirs(os.path.dirname(GOLDEN), exist_ok=True)
+    np.savez(GOLDEN, x=x, logits=logits, test_accuracy=acc)
+    print(f"golden fixture -> {GOLDEN}")
+
+
+if __name__ == "__main__":
+    main()
