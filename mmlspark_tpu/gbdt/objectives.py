@@ -26,6 +26,10 @@ class Objective:
     init_score: Callable  # (y, w) -> scalar or (K,) init raw score
     transform: Callable  # raw scores -> user-facing prediction
     is_classification: bool = False
+    # constant-hessian objectives renew each leaf's output to this
+    # residual quantile after growth (LightGBM RenewTreeOutput,
+    # `regression_objective.hpp`): 0.5 for L1, alpha for quantile
+    renew_quantile: Optional[float] = None
 
 
 def _weighted_mean(y, w):
@@ -51,7 +55,8 @@ def make_regression(alpha: float = 0.9, tweedie_p: float = 1.5,
         def init(y, w):
             return float(np.median(np.asarray(y)))
 
-        return Objective("regression_l1", 1, gh, init, lambda raw: raw)
+        return Objective("regression_l1", 1, gh, init, lambda raw: raw,
+                         renew_quantile=0.5)
 
     if kind == "quantile":
         def gh(pred, y, w, aux=None):
@@ -62,7 +67,8 @@ def make_regression(alpha: float = 0.9, tweedie_p: float = 1.5,
         def init(y, w):
             return float(np.quantile(np.asarray(y), alpha))
 
-        return Objective("quantile", 1, gh, init, lambda raw: raw)
+        return Objective("quantile", 1, gh, init, lambda raw: raw,
+                         renew_quantile=alpha)
 
     if kind == "poisson":
         def gh(pred, y, w, aux=None):

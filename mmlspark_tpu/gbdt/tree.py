@@ -351,8 +351,11 @@ def grow_tree_device(bins, bins_t, grad, hess, sample_mask, is_categorical,
             ok = ok & (depth_val < params.max_depth)
         return ok & (packed[EV_GAIN] > gate)
 
-    node_of_row = jnp.where(sample_mask, 0, -1).astype(jnp.int32)
-    root_hist = hist_fn(node_of_row == 0)
+    # ALL rows are routed through the tree (their raw scores must receive
+    # every tree's contribution — LightGBM adds predictions to the full
+    # score vector, not just the bag); only sampled rows enter histograms.
+    node_of_row = jnp.zeros(bins.shape[0], jnp.int32)
+    root_hist = hist_fn(sample_mask)
     root_packed, _ = eval_leaf(root_hist, is_categorical, params, feat_mask)
 
     state = dict(
@@ -418,7 +421,7 @@ def grow_tree_device(bins, bins_t, grad, hess, sample_mask, is_categorical,
                                jnp.where(in_leaf, ri, s["node_of_row"]))
 
         # child histograms: build left, subtract for right
-        lhist = hist_fn(new_assign == li)
+        lhist = hist_fn((new_assign == li) & sample_mask)
         rhist = phist - lhist
         lp, _ = eval_leaf(lhist, is_categorical, params, feat_mask)
         rp, _ = eval_leaf(rhist, is_categorical, params, feat_mask)
@@ -532,8 +535,9 @@ class TreeGrower:
         return self._bins_t
 
     def grow(self, bins, grad, hess, sample_mask,
-             shrinkage: float, feat_mask=None) -> Tuple[Tree, jnp.ndarray]:
-        """Returns (tree, per-row raw value of the new tree).
+             shrinkage: float, feat_mask=None
+             ) -> Tuple[Tree, jnp.ndarray, jnp.ndarray]:
+        """Returns (tree, per-row raw value of the new tree, row→node ids).
 
         bins (n, F) int32 / grad,hess (n,) f32 / sample_mask (n,) bool —
         all may be sharded over the data axis; everything here is jitted
@@ -553,7 +557,7 @@ class TreeGrower:
 
     def _grow_device(self, bins, grad, hess, sample_mask,
                      shrinkage: float, feat_mask=None
-                     ) -> Tuple[Tree, jnp.ndarray]:
+                     ) -> Tuple[Tree, jnp.ndarray, jnp.ndarray]:
         p = self.params
         bins_t = self._get_bins_t(bins) if self.hist_impl != "xla" else None
         s = grow_tree_device(bins, bins_t, grad, hess, sample_mask,
@@ -586,13 +590,12 @@ class TreeGrower:
                     n_nodes=n_nodes)
 
         node_of_row = s["node_of_row"]
-        row_vals = jnp.where(
-            node_of_row >= 0,
-            (s["value"] * shrinkage)[jnp.maximum(node_of_row, 0)], 0.0)
-        return tree, row_vals
+        row_vals = (s["value"] * shrinkage)[node_of_row]
+        return tree, row_vals, node_of_row
 
     def _grow_host(self, bins, grad, hess, sample_mask,
-                   shrinkage: float, feat_mask=None) -> Tuple[Tree, jnp.ndarray]:
+                   shrinkage: float, feat_mask=None
+                   ) -> Tuple[Tree, jnp.ndarray, jnp.ndarray]:
         p = self.params
         max_nodes = 2 * p.num_leaves - 1
         B = self.n_bins
@@ -609,8 +612,10 @@ class TreeGrower:
         gain_arr = np.zeros(max_nodes, np.float32)
         depth = np.zeros(max_nodes, np.int32)
 
-        # row -> node assignment, only rows in sample_mask participate
-        node_of_row = jnp.where(sample_mask, 0, -1).astype(jnp.int32)
+        # ALL rows are routed (every row's raw score receives the tree's
+        # contribution, as LightGBM's score updater does); only rows in
+        # sample_mask contribute to histograms/split decisions
+        node_of_row = jnp.zeros(bins.shape[0], jnp.int32)
 
         fm = jnp.asarray(feat_mask) if feat_mask is not None else None
 
@@ -619,7 +624,7 @@ class TreeGrower:
             packed_dev, order = eval_leaf(hist, self.is_categorical, p, fm)
             return np.asarray(packed_dev), order
 
-        root_hist = self._hist(bins, grad, hess, node_of_row == 0, feat_mask)
+        root_hist = self._hist(bins, grad, hess, sample_mask, feat_mask)
         root_packed, root_order = evaluate(root_hist)
         value[0] = root_packed[EV_VALUE]
 
@@ -682,8 +687,10 @@ class TreeGrower:
                                     jnp.where(in_leaf, ri, node_of_row))
 
             # child histograms: build smaller side, subtract for the other
-            lhist = self._hist(bins, grad, hess, node_of_row == li, feat_mask)
-            rhist = (self._hist(bins, grad, hess, node_of_row == ri, feat_mask)
+            lhist = self._hist(bins, grad, hess,
+                               (node_of_row == li) & sample_mask, feat_mask)
+            rhist = (self._hist(bins, grad, hess,
+                                (node_of_row == ri) & sample_mask, feat_mask)
                      if self._no_subtract else entry["hist"] - lhist)
             # dispatch BOTH children before fetching either: the fetches
             # overlap the other child's device work (one round-trip/split)
@@ -707,6 +714,57 @@ class TreeGrower:
 
         # training-time prediction of this tree: gather leaf values
         val_dev = jnp.asarray(value_arr)
-        row_vals = jnp.where(node_of_row >= 0,
-                             val_dev[jnp.maximum(node_of_row, 0)], 0.0)
-        return tree, row_vals
+        row_vals = val_dev[node_of_row]
+        return tree, row_vals, node_of_row
+
+
+# ---------------------------------------------------------------------------
+# Leaf-output renewal (L1 / quantile objectives)
+# ---------------------------------------------------------------------------
+
+@partial(jax.jit, static_argnames=("max_nodes", "q"))
+def renew_leaf_values(node_of_row, residual, weights, sample_mask,
+                      max_nodes: int, q: float):
+    """Per-leaf weighted ``q``-quantile of residuals, on device.
+
+    LightGBM renews L1/quantile leaf outputs to the residual percentile
+    over the leaf's bagged rows before shrinkage (`RenewTreeOutput` in
+    `regression_objective.hpp`; invoked from `GBDT::Train`) — the
+    constant-hessian Newton step alone converges far off the optimum.
+
+    One device program per tree, O(n log n) work and O(n + max_nodes)
+    memory: rows are sorted by residual then stably regrouped by leaf,
+    so each leaf is a contiguous residual-ascending segment; the global
+    weight cumsum minus each segment's base gives within-leaf cumulative
+    weights, and a scatter-min picks the first row reaching the target
+    quantile weight.  Returns ``(values (max_nodes,) f32, counts
+    (max_nodes,) f32)``; leaves with zero sampled rows keep their
+    caller-side value (count==0 flags them).
+    """
+    n = residual.shape[0]
+    w = jnp.where(sample_mask, weights, 0.0).astype(jnp.float32)
+    by_res = jnp.argsort(residual)
+    regroup = jnp.argsort(node_of_row[by_res], stable=True)
+    order = by_res[regroup]
+    sorted_leaf = node_of_row[order]
+    sorted_w = w[order]
+    sorted_res = residual[order].astype(jnp.float32)
+
+    cumw = jnp.cumsum(sorted_w)                       # nondecreasing
+    # weight cumsum just before each leaf segment starts, forward-filled
+    # (cummax forward-fills because cumw is nondecreasing)
+    starts = jnp.concatenate([jnp.array([True]),
+                              sorted_leaf[1:] != sorted_leaf[:-1]])
+    cumw_prev = jnp.concatenate([jnp.zeros(1, cumw.dtype), cumw[:-1]])
+    seg_base = jax.lax.cummax(jnp.where(starts, cumw_prev, 0.0))
+    cw_in = cumw - seg_base                           # within-leaf cumsum
+
+    tot = jnp.zeros(max_nodes, jnp.float32).at[sorted_leaf].add(sorted_w)
+    target = jnp.maximum(q * tot[sorted_leaf], 1e-12)
+    pos = jnp.arange(n, dtype=jnp.int32)
+    idx = jnp.full(max_nodes, n, jnp.int32).at[sorted_leaf].min(
+        jnp.where(cw_in >= target, pos, n))
+    values = sorted_res[jnp.minimum(idx, n - 1)]
+    counts = jnp.zeros(max_nodes, jnp.float32).at[sorted_leaf].add(
+        (sorted_w > 0).astype(jnp.float32))
+    return values, counts

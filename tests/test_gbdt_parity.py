@@ -1,0 +1,120 @@
+"""External-implementation parity gates for the GBDT engine.
+
+The round-1 gates (`benchmarks_gbdt.csv`) compare the engine against its
+own past self — drift detection, not quality evidence. These gates anchor
+the same deterministic sklearn datasets against an *independent*
+histogram-GBDT implementation, ``sklearn.ensemble.HistGradientBoosting*``
+(the closest in-image analogue of gating against LightGBM itself, which
+the reference does: `benchmarks_VerifyLightGBMClassifier.csv:1-33`,
+`Benchmarks.scala:35-113`).
+
+Two layers of assertion per config:
+
+1. A hard floor: ours >= external - eps (higher-better metrics), or
+   ours <= external + eps (lower-better) — the engine may not quietly
+   fall behind an independent implementation.
+2. The committed `benchmarks_gbdt_parity.csv` gates the *delta*
+   (ours - external) within tight precision, so a regression in either
+   direction of the gap is visible even while the floor still holds.
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+from mmlspark_tpu.gbdt import Booster, BoosterParams
+from mmlspark_tpu.testing import Benchmarks
+
+RESOURCES = os.path.join(os.path.dirname(__file__), "resources")
+
+# Floor epsilons: how far behind the external implementation we tolerate.
+AUC_EPS = 0.02
+ACC_EPS = 0.04
+RMSE_EPS = 0.05  # relative: ours <= external * (1 + eps)
+
+
+def _split(X, y, seed=0):
+    rng = np.random.default_rng(seed)
+    perm = rng.permutation(len(X))
+    X, y = X[perm], y[perm]
+    n = int(0.8 * len(X))
+    return X[:n], y[:n], X[n:], y[n:]
+
+
+def _auc(y, s):
+    from sklearn.metrics import roc_auc_score
+    return float(roc_auc_score(y, s))
+
+
+def _rmse(y, p):
+    return float(np.sqrt(np.mean((p - y) ** 2)))
+
+
+@pytest.mark.slow
+def test_gbdt_external_parity():
+    from sklearn.datasets import load_breast_cancer, load_diabetes, load_wine
+    from sklearn.ensemble import (HistGradientBoostingClassifier,
+                                  HistGradientBoostingRegressor)
+
+    bench = Benchmarks(RESOURCES, "gbdt_parity")
+    floors = []  # (name, ok, detail) — asserted together at the end
+
+    # -- binary classification ------------------------------------------
+    Xtr, ytr, Xte, yte = _split(*load_breast_cancer(return_X_y=True))
+    sk = HistGradientBoostingClassifier(
+        max_iter=40, max_leaf_nodes=15, min_samples_leaf=5,
+        learning_rate=0.1, early_stopping=False, random_state=0,
+    ).fit(Xtr, ytr)
+    sk_auc = _auc(yte, sk.predict_proba(Xte)[:, 1])
+    p = BoosterParams(objective="binary", num_iterations=40, num_leaves=15,
+                      min_data_in_leaf=5, seed=0)
+    ours_auc = _auc(yte, Booster.train(p, Xtr, ytr).predict(Xte))
+    floors.append(("breast_cancer_auc", ours_auc >= sk_auc - AUC_EPS,
+                   f"ours={ours_auc:.4f} sklearn={sk_auc:.4f}"))
+    bench.add("breast_cancer_auc_delta", ours_auc - sk_auc)
+
+    # -- multiclass ------------------------------------------------------
+    Xtr, ytr, Xte, yte = _split(*load_wine(return_X_y=True))
+    sk = HistGradientBoostingClassifier(
+        max_iter=40, max_leaf_nodes=7, min_samples_leaf=3,
+        learning_rate=0.1, early_stopping=False, random_state=0,
+    ).fit(Xtr, ytr)
+    sk_acc = float((sk.predict(Xte) == yte).mean())
+    p = BoosterParams(objective="multiclass", num_class=3, num_iterations=40,
+                      num_leaves=7, min_data_in_leaf=3, seed=0)
+    b = Booster.train(p, Xtr, ytr)
+    ours_acc = float((np.argmax(b.predict(Xte), axis=1) == yte).mean())
+    floors.append(("wine_accuracy", ours_acc >= sk_acc - ACC_EPS,
+                   f"ours={ours_acc:.4f} sklearn={sk_acc:.4f}"))
+    bench.add("wine_accuracy_delta", ours_acc - sk_acc)
+
+    # -- regression objectives ------------------------------------------
+    Xtr, ytr, Xte, yte = _split(*load_diabetes(return_X_y=True))
+    ytr, yte = np.abs(ytr), np.abs(yte)
+    sk_losses = {"regression": "squared_error",
+                 "regression_l1": "absolute_error",
+                 "quantile": "quantile",
+                 "poisson": "poisson"}
+    for obj, sk_loss in sk_losses.items():
+        # compare quantile at the median so RMSE is a meaningful metric
+        # for both implementations (our default alpha is LightGBM's 0.9)
+        kw = {"quantile": 0.5} if sk_loss == "quantile" else {}
+        sk = HistGradientBoostingRegressor(
+            loss=sk_loss, max_iter=60, max_leaf_nodes=15,
+            min_samples_leaf=10, learning_rate=0.08,
+            early_stopping=False, random_state=0, **kw,
+        ).fit(Xtr, ytr)
+        sk_rmse = _rmse(yte, sk.predict(Xte))
+        p = BoosterParams(objective=obj, num_iterations=60, num_leaves=15,
+                          min_data_in_leaf=10, learning_rate=0.08, seed=0,
+                          alpha=0.5 if obj == "quantile" else 0.9)
+        ours_rmse = _rmse(yte, Booster.train(p, Xtr, ytr).predict(Xte))
+        floors.append((f"diabetes_{obj}_rmse",
+                       ours_rmse <= sk_rmse * (1 + RMSE_EPS),
+                       f"ours={ours_rmse:.2f} sklearn={sk_rmse:.2f}"))
+        bench.add(f"diabetes_{obj}_rmse_delta", ours_rmse - sk_rmse)
+
+    failed = [f"{n}: {d}" for n, ok, d in floors if not ok]
+    assert not failed, "engine fell behind sklearn floor:\n" + "\n".join(failed)
+    bench.verify()
