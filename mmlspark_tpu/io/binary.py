@@ -4,18 +4,16 @@ Capability parity with the reference's custom Hadoop FileFormat
 (`io/binary/src/main/scala/BinaryFileFormat.scala:114`,
 `BinaryRecordReader.scala:34`): read a directory tree as rows of
 ``(path, bytes)``, with zip-archive inspection (members become rows) and
-record-level subsampling — here against the local/NFS filesystem that
-backs TPU VMs.
+record-level subsampling — against the local/NFS filesystem that backs
+TPU VMs, or any ``gs://``-style remote URL through the fsspec layer
+(`io/fs.py`; parity: the reference reads wasb/HDFS via `HadoopUtils`).
 """
 
 from __future__ import annotations
 
-import fnmatch
-import io as _io
-import os
 import random
 import zipfile
-from typing import Iterator, List, Optional, Tuple
+from typing import List, Optional
 
 import numpy as np
 
@@ -23,25 +21,6 @@ from mmlspark_tpu.core.dataframe import DataFrame
 
 PATH_COL = "path"
 BYTES_COL = "bytes"
-
-
-def _iter_files(path: str, recursive: bool, pattern: Optional[str]) -> Iterator[str]:
-    """Matching files in global sorted-path order (same as the native reader)."""
-    if os.path.isfile(path):
-        yield path
-        return
-    out: List[str] = []
-    if recursive:
-        for root, _, files in os.walk(path):
-            for f in files:
-                if pattern is None or fnmatch.fnmatch(f, pattern):
-                    out.append(os.path.join(root, f))
-    else:
-        for f in os.listdir(path):
-            full = os.path.join(path, f)
-            if os.path.isfile(full) and (pattern is None or fnmatch.fnmatch(f, pattern)):
-                out.append(full)
-    yield from sorted(out)
 
 
 def read_binary_files(path: str,
@@ -66,16 +45,24 @@ def read_binary_files(path: str,
     """
     if engine not in ("auto", "native", "python"):
         raise ValueError(f"unknown engine {engine!r}")
-    if not os.path.exists(path):
+    from mmlspark_tpu.io import fs
+    if not fs.exists(path):
         # both engines would otherwise silently yield an empty frame
         # (os.walk and the native scanner both swallow missing roots)
         raise FileNotFoundError(path)
     use_native = False
     if engine in ("auto", "native"):
-        from mmlspark_tpu.native import native_available
-        use_native = native_available()
-        if engine == "native" and not use_native:
-            raise RuntimeError("native reader unavailable (no g++/zlib?)")
+        if fs.is_remote(path):
+            # the C++ reader only scans the local filesystem
+            if engine == "native":
+                raise ValueError(
+                    f"engine='native' cannot read remote path {path!r}")
+        else:
+            from mmlspark_tpu.native import native_available
+            use_native = native_available()
+            if engine == "native" and not use_native:
+                raise RuntimeError(
+                    "native reader unavailable (no g++/zlib?)")
 
     paths: List[str] = []
     blobs: List[bytes] = []
@@ -95,16 +82,17 @@ def read_binary_files(path: str,
                 paths.append(p)
                 blobs.append(data)
 
-        for fp in _iter_files(path, recursive, pattern):
+        for fp in fs.find_files(path, recursive, pattern):
             if inspect_zip and fp.lower().endswith(".zip"):
-                with zipfile.ZipFile(fp) as zf:
+                # both local and fsspec file objects are seekable
+                with fs.open_file(fp, "rb") as fh, \
+                        zipfile.ZipFile(fh) as zf:
                     for name in zf.namelist():
                         if name.endswith("/"):
                             continue
                         emit(f"{fp}/{name}", zf.read(name))
             else:
-                with open(fp, "rb") as f:
-                    emit(fp, f.read())
+                emit(fp, fs.read_bytes(fp))
 
     return DataFrame({
         PATH_COL: np.array(paths, dtype=object),

@@ -18,6 +18,7 @@ import shutil
 import time
 from typing import Any, Dict, List, Optional
 
+from mmlspark_tpu.io import fs as _fs
 from mmlspark_tpu.models.function import NNFunction
 
 
@@ -44,13 +45,11 @@ class ModelSchema:
 
 def _dir_sha256(path: str) -> str:
     h = hashlib.sha256()
-    for root, _, files in sorted(os.walk(path)):
-        for f in sorted(files):
-            rel = os.path.relpath(os.path.join(root, f), path)
-            h.update(rel.encode())
-            with open(os.path.join(root, f), "rb") as fh:
-                for chunk in iter(lambda: fh.read(1 << 20), b""):
-                    h.update(chunk)
+    for rel, full in _fs.walk_rel_files(path):
+        h.update(rel.encode())
+        with _fs.open_file(full, "rb") as fh:
+            for chunk in iter(lambda: fh.read(1 << 20), b""):
+                h.update(chunk)
     return h.hexdigest()
 
 
@@ -76,22 +75,22 @@ class ModelRepo:
         self.root = root
 
     def _manifest_path(self) -> str:
-        return os.path.join(self.root, self.MANIFEST)
+        return _fs.join(self.root, self.MANIFEST)
 
     def models(self) -> Dict[str, ModelSchema]:
-        if not os.path.exists(self._manifest_path()):
+        if not _fs.exists(self._manifest_path()):
             return {}
-        with open(self._manifest_path()) as f:
-            entries = json.load(f)
+        entries = json.loads(_fs.read_text(self._manifest_path()))
         out = {}
         for e in entries:
             meta = ModelSchema.from_json(e)
             # manifests store repo-relative uris so a zoo directory is
-            # portable (committed checkpoints work from any clone path);
-            # absolute uris (e.g. a mount) pass through untouched
-            if not os.path.isabs(meta.uri):
+            # portable (committed checkpoints work from any clone path;
+            # the same manifest works from a gs:// bucket); absolute
+            # uris/URLs (e.g. a mount) pass through untouched
+            if not _fs.isabs(meta.uri):
                 meta = dataclasses.replace(
-                    meta, uri=os.path.join(self.root, meta.uri))
+                    meta, uri=_fs.join(self.root, meta.uri))
             out[meta.name] = meta
         return out
 
@@ -99,12 +98,26 @@ class ModelRepo:
                 model_type: str = "", input_shape: Optional[List[int]] = None,
                 num_classes: Optional[int] = None) -> ModelSchema:
         """Add a checkpoint to the repo and record its manifest entry."""
-        model_dir = os.path.join(self.root, name)
-        fn.save(model_dir)
+        model_dir = _fs.join(self.root, name)
+        if _fs.is_remote(self.root):
+            # NNFunction.save writes local files; stage locally, upload.
+            # Hash the staged copy — walk_rel_files yields the same
+            # rel-sorted order either side, and hashing the remote tree
+            # would re-download every byte just published.
+            import tempfile
+            with tempfile.TemporaryDirectory() as tmp:
+                staged = os.path.join(tmp, name)
+                fn.save(staged)
+                _fs.rm_tree(model_dir)
+                _fs.copy_tree(staged, model_dir)
+                tree_hash = _dir_sha256(staged)
+        else:
+            fn.save(model_dir)
+            tree_hash = _dir_sha256(model_dir)
         meta = ModelSchema(
             name=name, dataset=dataset, model_type=model_type,
             uri=name,  # repo-relative: the manifest stays portable
-            hash=_dir_sha256(model_dir),
+            hash=tree_hash,
             input_shape=list(input_shape or []),
             layer_names=fn.layer_names,
             num_classes=num_classes)
@@ -112,13 +125,13 @@ class ModelRepo:
         # self.root, and re-serializing resolved paths would bake this
         # machine's absolute paths into the portable manifest
         entries = []
-        if os.path.exists(self._manifest_path()):
-            with open(self._manifest_path()) as f:
-                entries = [e for e in json.load(f) if e["name"] != name]
+        if _fs.exists(self._manifest_path()):
+            entries = [e for e in
+                       json.loads(_fs.read_text(self._manifest_path()))
+                       if e["name"] != name]
         entries.append(meta.to_json())
-        os.makedirs(self.root, exist_ok=True)
-        with open(self._manifest_path(), "w") as f:
-            json.dump(entries, f, indent=2)
+        _fs.makedirs(self.root)
+        _fs.write_text(self._manifest_path(), json.dumps(entries, indent=2))
         return dataclasses.replace(meta, uri=model_dir)  # resolved for use
 
 
@@ -126,8 +139,9 @@ class ModelDownloader:
     """Fetch models from a repo into a local cache, verifying hashes.
 
     Parity: `ModelDownloader.scala` (downloadByName/downloadModel with
-    retry + hash check). "Remote" here is any mounted/NFS path — this
-    framework has no Azure dependency.
+    retry + hash check; HDFS repo analogue = any ``gs://``-style fsspec
+    URL, `Schema.scala` HDFSRepo). The repo may be a local/NFS path or
+    a remote URL; the cache is always local.
     """
 
     def __init__(self, local_cache: str, repo: Optional[str] = None):
@@ -155,7 +169,7 @@ class ModelDownloader:
             if os.path.exists(dest):
                 shutil.rmtree(dest)
             os.makedirs(self.cache_dir, exist_ok=True)
-            shutil.copytree(meta.uri, dest)
+            _fs.copy_tree(meta.uri, dest)  # local or gs://-style source
 
         retry_with_timeout(fetch)
         actual = _dir_sha256(dest)
