@@ -91,28 +91,97 @@ class _TextAnalyticsBase(CognitiveServiceBase):
         return HTTPRequestData.post_json(
             self.url, {"documents": [doc]}, self._headers())
 
+    def _shape_doc(self, doc: Dict[str, Any]) -> Any:
+        """Per-service payload extraction from a response document;
+        subclasses each mirror their reference response schema
+        (`schemas/TextAnalyticsSchemas.scala`)."""
+        return doc
+
     def _output_parser(self) -> Transformer:
-        return JSONOutputParser(data_field="documents")
+        from mmlspark_tpu.io.http import CustomOutputParser
+
+        def parse(resp):
+            try:
+                body = resp.json()
+            except (ValueError, AttributeError):
+                return None
+            if not isinstance(body, dict):
+                return None
+            docs = body.get("documents") or []
+            if not docs:
+                # TAResponse.errors: surface the per-document message
+                errs = body.get("errors") or []
+                if errs and isinstance(errs[0], dict):
+                    return {"error": errs[0].get("message", "")}
+                return None
+            return self._shape_doc(docs[0])
+
+        return CustomOutputParser(udf=parse)
 
 
 class TextSentiment(_TextAnalyticsBase):
-    """Parity: `TextAnalytics.scala:184` (TextSentiment)."""
+    """Sentiment score in [0, 1] per row (0 = negative, 1 = positive).
+
+    Output column holds the float score alone — the distinct
+    ``SentimentScore(id, score)`` schema of the reference
+    (`TextAnalytics.scala:184`, `TextAnalyticsSchemas.scala`
+    SentimentResponse).
+    """
+
+    def _shape_doc(self, doc: Dict[str, Any]) -> Any:
+        return doc.get("score")
 
 
 class LanguageDetector(_TextAnalyticsBase):
-    """Parity: `TextAnalytics.scala` LanguageDetector."""
+    """Detected language per row: best guess + full candidate list.
+
+    Output: ``{"language", "iso6391Name", "score", "detectedLanguages"}``
+    (reference `DetectLanguageScore.detectedLanguages` with
+    ``DetectedLanguage(name, iso6391Name, score)``).
+    """
+
+    def _shape_doc(self, doc: Dict[str, Any]) -> Any:
+        langs = doc.get("detectedLanguages") or []
+        best = max(langs, key=lambda d: d.get("score", 0.0)) if langs else {}
+        return {"language": best.get("name"),
+                "iso6391Name": best.get("iso6391Name"),
+                "score": best.get("score"),
+                "detectedLanguages": langs}
 
 
 class EntityDetector(_TextAnalyticsBase):
-    """Parity: `TextAnalytics.scala` EntityDetector."""
+    """Linked (wikipedia) entities per row.
+
+    Output: the ``entities`` list — reference ``Entity(name, matches,
+    wikipediaLanguage, wikipediaId, wikipediaUrl, bingId)``
+    (DetectEntitiesResponse).
+    """
+
+    def _shape_doc(self, doc: Dict[str, Any]) -> Any:
+        return doc.get("entities") or []
 
 
 class NER(_TextAnalyticsBase):
-    """Parity: `TextAnalytics.scala` NER."""
+    """Named entities with type/subtype per row.
+
+    Output: the ``entities`` list — reference ``NEREntity(name, matches,
+    type, subtype, ...)`` (NERResponse); distinct from
+    :class:`EntityDetector`'s wikipedia-linking schema.
+    """
+
+    def _shape_doc(self, doc: Dict[str, Any]) -> Any:
+        return doc.get("entities") or []
 
 
 class KeyPhraseExtractor(_TextAnalyticsBase):
-    """Parity: `TextAnalytics.scala` KeyPhraseExtractor."""
+    """Key phrases per row as a list of strings.
+
+    Output: ``keyPhrases`` (reference ``KeyPhraseScore.keyPhrases``,
+    KeyPhraseResponse).
+    """
+
+    def _shape_doc(self, doc: Dict[str, Any]) -> Any:
+        return doc.get("keyPhrases") or []
 
 
 class _ImageServiceBase(CognitiveServiceBase):
@@ -337,6 +406,69 @@ class BingImageSearch(CognitiveServiceBase):
 
     def _output_parser(self) -> Transformer:
         return JSONOutputParser(data_field="value")
+
+
+class BingImageSource:
+    """Streaming image-search source: page through results for a set of
+    search terms, one frame of ``(search_term, image)`` rows per batch.
+
+    Parity: `BingImageSource.scala:83` — the reference pairs a counting
+    streaming source with a vector-param BingImageSearch and explodes
+    each response's image array; here each :meth:`batches` step queries
+    every term at the current offset through :class:`BingImageSearch`,
+    explodes the ``value`` arrays into rows, and advances the offset by
+    ``imgs_per_batch``. The stream ends when every term comes back
+    empty (results exhausted), mirroring `FileStreamSource.batches`.
+    """
+
+    def __init__(self, search_terms: List[str], url: str,
+                 subscription_key: Optional[str] = None,
+                 imgs_per_batch: int = 10,
+                 concurrency: int = 4,
+                 timeout: float = 60.0):
+        if not search_terms:
+            raise ValueError("search_terms must be non-empty")
+        self.search_terms = list(search_terms)
+        self.url = url
+        self.subscription_key = subscription_key
+        self.imgs_per_batch = int(imgs_per_batch)
+        self.concurrency = concurrency
+        self.timeout = timeout
+        self._offset = 0
+
+    def batches(self, max_batches: Optional[int] = None):
+        """Yield frames of ``search_term`` / ``image`` (one row per image
+        object) until exhausted or ``max_batches``."""
+        yielded = 0
+        while max_batches is None or yielded < max_batches:
+            stage = BingImageSearch(
+                url=self.url, subscription_key=self.subscription_key,
+                count=self.imgs_per_batch, offset=self._offset,
+                concurrency=self.concurrency, timeout=self.timeout)
+            out = stage.transform(
+                DataFrame({"query": np.array(self.search_terms,
+                                             dtype=object)}))
+            terms: List[str] = []
+            images: List[Any] = []
+            for term, imgs in zip(out["query"], out["result"]):
+                for img in imgs or []:
+                    terms.append(str(term))
+                    images.append(img)
+            if not terms:
+                # empty page != failed page: if every term errored, this
+                # is an outage, not exhaustion — don't silently drop the
+                # remaining pages
+                errs = [e for e in out[stage.error_col] if e is not None]
+                if errs and len(errs) == len(self.search_terms):
+                    raise IOError(
+                        f"image-search batch failed for all "
+                        f"{len(errs)} terms at offset {self._offset}: "
+                        f"{errs[0]}")
+                return
+            self._offset += self.imgs_per_batch
+            yielded += 1
+            yield DataFrame({"search_term": np.array(terms, dtype=object),
+                             "image": obj_col(images)})
 
 
 def _post_batches(url: str, payloads: List[Any],

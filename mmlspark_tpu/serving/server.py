@@ -19,9 +19,10 @@ import json
 import threading
 import time
 import uuid
+from collections import OrderedDict
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from queue import Empty, Queue
-from typing import Any, Dict, List, Optional
+from typing import Any, Dict, List, Optional, Tuple
 
 import numpy as np
 
@@ -41,8 +42,8 @@ class _Server(ThreadingHTTPServer):
 class _PendingRequest:
     __slots__ = ("rid", "payload", "event", "reply", "status")
 
-    def __init__(self, payload: Any):
-        self.rid = uuid.uuid4().hex
+    def __init__(self, payload: Any, rid: Optional[str] = None):
+        self.rid = rid or uuid.uuid4().hex
         self.payload = payload
         self.event = threading.Event()
         self.reply: Optional[bytes] = None
@@ -61,7 +62,8 @@ class ServingServer:
                  port: int = 0, api_path: str = "/predict",
                  max_batch_size: int = 64, max_latency_ms: float = 10.0,
                  reply_cols: Optional[List[str]] = None,
-                 request_timeout: float = 30.0):
+                 request_timeout: float = 30.0,
+                 journal_size: int = 4096):
         self.model = model
         self.api_path = api_path
         self.max_batch_size = int(max_batch_size)
@@ -75,6 +77,17 @@ class ServingServer:
         self._threads: List[threading.Thread] = []
         self.n_requests = 0
         self.n_batches = 0
+        # exactly-once reply semantics (parity: the continuous reader's
+        # per-epoch offset commits, `HTTPSourceV2.scala:272,312`): a
+        # client-supplied X-Request-Id keys a committed-reply journal, so
+        # a retried/re-submitted request returns the SAME reply without
+        # re-running inference; retries racing the original join its
+        # in-flight entry instead of enqueuing a second compute
+        self.journal_size = int(journal_size)
+        self._journal: "OrderedDict[str, Tuple[int, bytes]]" = OrderedDict()
+        self._inflight: Dict[str, _PendingRequest] = {}
+        self._commit_lock = threading.Lock()
+        self.n_replayed = 0
 
     # -- HTTP side -----------------------------------------------------------
 
@@ -82,6 +95,15 @@ class ServingServer:
         serving = self
 
         class Handler(BaseHTTPRequestHandler):
+            def _reply(self, status: int, body: bytes, replayed=False):
+                self.send_response(status)
+                self.send_header("Content-Type", "application/json")
+                if replayed:
+                    self.send_header("X-Replayed", "1")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
             def do_POST(self):
                 if self.path != serving.api_path:
                     self.send_error(404)
@@ -92,17 +114,36 @@ class ServingServer:
                 except ValueError:
                     self.send_error(400, "invalid JSON")
                     return
-                pending = _PendingRequest(payload)
-                serving._queue.put(pending)
+
+                rid = self.headers.get("X-Request-Id")
+                if rid:
+                    with serving._commit_lock:
+                        committed = serving._journal.get(rid)
+                        pending = (serving._inflight.get(rid)
+                                   if committed is None else None)
+                        if committed is None and pending is None:
+                            pending = _PendingRequest(payload, rid)
+                            serving._inflight[rid] = pending
+                            enqueue = True
+                        else:
+                            enqueue = False
+                    if committed is not None:
+                        serving.n_replayed += 1
+                        self._reply(*committed, replayed=True)
+                        return
+                else:
+                    pending, enqueue = _PendingRequest(payload), True
+
+                if enqueue:
+                    serving._queue.put(pending)
                 if not pending.event.wait(serving.request_timeout):
                     self.send_error(504, "inference timed out")
                     return
-                body = pending.reply or b"{}"
-                self.send_response(pending.status)
-                self.send_header("Content-Type", "application/json")
-                self.send_header("Content-Length", str(len(body)))
-                self.end_headers()
-                self.wfile.write(body)
+                # a joined duplicate is only "replayed" if the reply was
+                # actually committed — errors are never journaled, so
+                # they must not carry the committed-replay marker
+                self._reply(pending.status, pending.reply or b"{}",
+                            replayed=not enqueue and pending.status == 200)
 
             def log_message(self, *args):  # quiet
                 pass
@@ -146,15 +187,27 @@ class ServingServer:
                 replies.append(json.dumps(_jsonify(row)).encode())
             for p, r in zip(batch, replies):
                 p.reply = r
-                p.event.set()
+                self._commit(p)
         except Exception as e:  # noqa: BLE001 — any model failure -> 500s
             err = json.dumps({"error": str(e)}).encode()
             for p in batch:
                 p.status = 500
                 p.reply = err
-                p.event.set()
+                self._commit(p)
         self.n_batches += 1
         self.n_requests += len(batch)
+
+    def _commit(self, p: _PendingRequest) -> None:
+        """Commit a reply, then release waiters. Successful replies are
+        journaled under the client request id (exactly-once); errors are
+        not journaled, so a client may retry them."""
+        with self._commit_lock:
+            if self._inflight.pop(p.rid, None) is not None \
+                    and p.status == 200:
+                self._journal[p.rid] = (p.status, p.reply or b"{}")
+                while len(self._journal) > self.journal_size:
+                    self._journal.popitem(last=False)
+        p.event.set()
 
     def _batch_loop(self):
         while not self._stop.is_set():
@@ -206,7 +259,7 @@ class ServingCoordinator:
 
         class Handler(BaseHTTPRequestHandler):
             def do_POST(self):
-                if self.path != "/register":
+                if self.path not in ("/register", "/deregister"):
                     self.send_error(404)
                     return
                 length = int(self.headers.get("Content-Length", 0))
@@ -216,7 +269,13 @@ class ServingCoordinator:
                     self.send_error(400, "invalid JSON")
                     return
                 with coordinator._lock:
-                    coordinator._services.append(info)
+                    if self.path == "/register":
+                        coordinator._services.append(info)
+                    else:
+                        coordinator._services = [
+                            s for s in coordinator._services
+                            if (s.get("host"), s.get("port"))
+                            != (info.get("host"), info.get("port"))]
                 self.send_response(200)
                 self.send_header("Content-Length", "2")
                 self.end_headers()
@@ -268,3 +327,60 @@ class ServingCoordinator:
 
     def __exit__(self, *exc):
         self.stop()
+
+
+class ServingClient:
+    """Round-robin client over a coordinator's worker list, with
+    failover and idempotent retries.
+
+    Every logical request carries a generated ``X-Request-Id``; a retry
+    (after a dropped connection or worker death) reuses the id, so a
+    worker that already computed the reply returns its journaled copy
+    instead of re-running inference (see :class:`ServingServer`).
+    Workers that refuse connections are skipped until the next
+    :meth:`refresh`. Parity: the reference's clients round-robin the
+    `/services` list of `DriverServiceUtils` (`HTTPSourceV2.scala:111`).
+    """
+
+    def __init__(self, coordinator_url: str, api_path: str = "/predict",
+                 timeout: float = 15.0):
+        self.coordinator_url = coordinator_url.rstrip("/")
+        self.api_path = api_path
+        self.timeout = timeout
+        self._workers: List[str] = []
+        self._dead: set = set()
+        self._rr = 0
+        self.refresh()
+
+    def refresh(self) -> List[str]:
+        import requests
+        services = requests.get(self.coordinator_url + "/services",
+                                timeout=self.timeout).json()
+        self._workers = [f"http://{s['host']}:{s['port']}{self.api_path}"
+                         for s in services]
+        self._dead.clear()
+        return list(self._workers)
+
+    def predict(self, payload: Any, request_id: Optional[str] = None) -> Any:
+        import requests
+        rid = request_id or uuid.uuid4().hex
+        alive = [w for w in self._workers if w not in self._dead] \
+            or self.refresh()
+        if not alive:
+            raise RuntimeError("no serving workers registered")
+        last_err: Optional[Exception] = None
+        for _ in range(len(alive)):
+            url = alive[self._rr % len(alive)]
+            self._rr += 1
+            try:
+                r = requests.post(url, json=payload, timeout=self.timeout,
+                                  headers={"X-Request-Id": rid})
+                r.raise_for_status()
+                return r.json()
+            except (requests.ConnectionError, requests.Timeout) as e:
+                # worker unreachable: fail over to the next one (the
+                # shared request id makes the retry idempotent)
+                self._dead.add(url)
+                last_err = e
+        raise RuntimeError(
+            f"all {len(alive)} serving workers unreachable") from last_err

@@ -25,7 +25,8 @@ from mmlspark_tpu.io.http import (
 )
 from mmlspark_tpu.io.services import (
     AzureSearchWriter, BingImageSearch, DetectAnomalies, DetectFace,
-    FindSimilarFace, GenerateThumbnails, GroupFaces, IdentifyFaces,
+    EntityDetector, FindSimilarFace, GenerateThumbnails, GroupFaces,
+    IdentifyFaces, KeyPhraseExtractor, LanguageDetector, NER,
     PowerBIWriter, SpeechToText, TextSentiment, VerifyFaces,
 )
 from mmlspark_tpu.serving import (
@@ -274,14 +275,17 @@ class TestConsolidator:
 
 class TestServices:
     def test_text_sentiment_protocol(self, echo_server):
-        url, _ = echo_server
+        url, handler = echo_server
         df = DataFrame({"text": ["great product", None]})
         out = TextSentiment(url=url, subscription_key="k",
                             language="en").transform(df)
-        doc = out["result"][0][0]  # parser extracted the documents array
+        # request protocol: documents array with id/text/language
+        doc = handler.last_payload["documents"][0]
         assert doc["text"] == "great product"
         assert doc["language"] == "en"
-        assert out["result"][1] is None  # null passthrough
+        # echoed docs carry no "score": shaped output is None, nulls pass
+        assert out["result"][0] is None
+        assert out["result"][1] is None
 
     def test_anomaly_protocol(self, echo_server):
         url, _ = echo_server
@@ -385,3 +389,298 @@ class TestReviewRegressions:
             r = requests.post(f"http://{coord.host}:{coord.port}/register",
                               data=b"{bad", timeout=10)
             assert r.status_code == 400
+
+
+class TestExactlyOnce:
+    """Reply-commit semantics (parity: HTTPSourceV2.scala:272,312)."""
+
+    def _counting_model(self):
+        calls = []
+
+        class Doubler(Transformer):
+            def transform(self, df):
+                calls.append(df.num_rows)
+                return df.with_column(
+                    "y", np.asarray(df["x"], dtype=np.float64) * 2)
+
+        return Doubler(), calls
+
+    def test_resubmitted_request_replays_committed_reply(self):
+        model, calls = self._counting_model()
+        with ServingServer(model, max_latency_ms=5) as srv:
+            h = {"X-Request-Id": "req-1"}
+            r1 = requests.post(srv.address, json={"x": 7}, headers=h,
+                               timeout=10)
+            r2 = requests.post(srv.address, json={"x": 7}, headers=h,
+                               timeout=10)
+            assert r1.status_code == r2.status_code == 200
+            assert r1.json() == r2.json() == {"y": 14.0}
+            assert "X-Replayed" not in r1.headers
+            assert r2.headers.get("X-Replayed") == "1"
+            assert sum(calls) == 1          # inference ran exactly once
+            assert srv.n_replayed == 1
+
+    def test_errors_are_not_journaled(self):
+        class Boom(Transformer):
+            def transform(self, df):
+                raise RuntimeError("kaput")
+
+        with ServingServer(Boom(), max_latency_ms=5) as srv:
+            h = {"X-Request-Id": "req-err"}
+            r1 = requests.post(srv.address, json={"x": 1}, headers=h,
+                               timeout=10)
+            r2 = requests.post(srv.address, json={"x": 1}, headers=h,
+                               timeout=10)
+            assert r1.status_code == r2.status_code == 500
+            # the retry re-ran the model instead of replaying the error
+            assert "X-Replayed" not in r2.headers
+
+    def test_concurrent_duplicates_join_inflight_compute(self):
+        gate = threading.Event()
+        calls = []
+
+        class SlowDoubler(Transformer):
+            def transform(self, df):
+                calls.append(df.num_rows)
+                gate.wait(5)
+                return df.with_column(
+                    "y", np.asarray(df["x"], dtype=np.float64) * 2)
+
+        with ServingServer(SlowDoubler(), max_latency_ms=5) as srv:
+            h = {"X-Request-Id": "req-dup"}
+            out = {}
+
+            def hit(key):
+                out[key] = requests.post(srv.address, json={"x": 5},
+                                         headers=h, timeout=10)
+
+            t1 = threading.Thread(target=hit, args=("a",))
+            t2 = threading.Thread(target=hit, args=("b",))
+            t1.start()
+            time.sleep(0.2)   # first request is now in flight
+            t2.start()
+            time.sleep(0.2)
+            gate.set()
+            t1.join()
+            t2.join()
+            assert out["a"].json() == out["b"].json() == {"y": 10.0}
+            assert sum(calls) == 1   # the duplicate joined, not re-ran
+
+    def test_journal_is_bounded(self):
+        model, _ = self._counting_model()
+        with ServingServer(model, max_latency_ms=5,
+                           journal_size=4) as srv:
+            for i in range(10):
+                requests.post(srv.address, json={"x": i},
+                              headers={"X-Request-Id": f"r{i}"}, timeout=10)
+            assert len(srv._journal) <= 4
+
+
+WORKER_SCRIPT = """
+import sys, time
+from mmlspark_tpu.serving.server import ServingServer, ServingCoordinator
+from mmlspark_tpu.core.stage import Transformer
+import numpy as np
+
+class Doubler(Transformer):
+    def transform(self, df):
+        return df.with_column("y", np.asarray(df["x"], dtype=np.float64) * 2)
+
+srv = ServingServer(Doubler(), max_latency_ms=5).start()
+ServingCoordinator.register_worker(sys.argv[1], srv.host, srv.port)
+print(srv.port, flush=True)
+while True:
+    time.sleep(1)
+"""
+
+
+class TestDistributedServing:
+    """Real multi-process workers + coordinator + failover (parity:
+    DistributedHTTPSource.scala:89,244 — server per executor JVM)."""
+
+    @pytest.mark.slow
+    def test_multiprocess_workers_survive_kill(self):
+        import os
+        import subprocess
+        import sys as _sys
+
+        from mmlspark_tpu.serving.server import ServingClient
+
+        env = dict(os.environ)
+        env["PYTHONPATH"] = os.path.dirname(os.path.dirname(__file__))
+        with ServingCoordinator() as coord:
+            base = f"http://{coord.host}:{coord.port}"
+            procs = [subprocess.Popen(
+                [_sys.executable, "-c", WORKER_SCRIPT, base],
+                stdout=subprocess.PIPE, env=env, text=True)
+                for _ in range(3)]
+            try:
+                ports = [int(p.stdout.readline()) for p in procs]
+                assert len(set(ports)) == 3
+                client = ServingClient(base)
+                assert len(client._workers) == 3
+
+                for i in range(12):
+                    assert client.predict({"x": i}) == {"y": 2.0 * i}
+
+                # kill one worker; the client must fail over and every
+                # subsequent request must still be answered
+                procs[0].kill()
+                procs[0].wait()
+                for i in range(12, 36):
+                    assert client.predict({"x": i}) == {"y": 2.0 * i}
+                assert len(client._dead) == 1
+            finally:
+                for p in procs:
+                    p.kill()
+                    p.wait()
+
+
+@pytest.fixture
+def canned_server():
+    """Serves a canned JSON body (set ``Handler.body``) and records the
+    last request payload — for response-shaping tests."""
+    class Handler(BaseHTTPRequestHandler):
+        body: dict = {}
+        last_payload = None
+
+        def do_POST(self):
+            length = int(self.headers.get("Content-Length", 0))
+            type(self).last_payload = json.loads(
+                self.rfile.read(length) or b"null")
+            data = json.dumps(type(self).body).encode()
+            self.send_response(200)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(data)))
+            self.end_headers()
+            self.wfile.write(data)
+
+        def log_message(self, *args):
+            pass
+
+    server = ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+    threading.Thread(target=server.serve_forever, daemon=True).start()
+    yield f"http://127.0.0.1:{server.server_address[1]}", Handler
+    server.shutdown()
+    server.server_close()
+
+
+class TestTextAnalyticsShaping:
+    """Per-service response shaping (parity: TextAnalytics.scala:184-248
+    response schemas in schemas/TextAnalyticsSchemas.scala)."""
+
+    def test_sentiment_score_column(self, canned_server):
+        url, handler = canned_server
+        handler.body = {"documents": [{"id": "0", "score": 0.93}],
+                        "errors": []}
+        out = TextSentiment(url=url).transform(
+            DataFrame({"text": ["nice"]}))
+        assert out["result"][0] == 0.93
+
+    def test_language_detector_best_plus_candidates(self, canned_server):
+        url, handler = canned_server
+        handler.body = {"documents": [{"id": "0", "detectedLanguages": [
+            {"name": "French", "iso6391Name": "fr", "score": 0.2},
+            {"name": "English", "iso6391Name": "en", "score": 0.8},
+        ]}]}
+        out = LanguageDetector(url=url).transform(
+            DataFrame({"text": ["hello"]}))
+        r = out["result"][0]
+        assert r["language"] == "English"
+        assert r["iso6391Name"] == "en"
+        assert r["score"] == 0.8
+        assert len(r["detectedLanguages"]) == 2
+
+    def test_entity_detector_entities(self, canned_server):
+        url, handler = canned_server
+        handler.body = {"documents": [{"id": "0", "entities": [
+            {"name": "Seattle", "wikipediaId": "Seattle",
+             "wikipediaUrl": "https://en.wikipedia.org/wiki/Seattle",
+             "matches": [{"text": "Seattle", "offset": 0, "length": 7}]},
+        ]}]}
+        out = EntityDetector(url=url).transform(
+            DataFrame({"text": ["Seattle is rainy"]}))
+        assert out["result"][0][0]["wikipediaId"] == "Seattle"
+
+    def test_ner_typed_entities(self, canned_server):
+        url, handler = canned_server
+        handler.body = {"documents": [{"id": "0", "entities": [
+            {"name": "Satya", "type": "Person", "subtype": None,
+             "matches": [{"text": "Satya", "offset": 0, "length": 5}]},
+        ]}]}
+        out = NER(url=url).transform(DataFrame({"text": ["Satya spoke"]}))
+        assert out["result"][0][0]["type"] == "Person"
+
+    def test_key_phrases_list(self, canned_server):
+        url, handler = canned_server
+        handler.body = {"documents": [
+            {"id": "0", "keyPhrases": ["wonderful trip", "hotel"]}]}
+        out = KeyPhraseExtractor(url=url).transform(
+            DataFrame({"text": ["wonderful trip to a hotel"]}))
+        assert out["result"][0] == ["wonderful trip", "hotel"]
+
+    def test_ta_error_surfaced(self, canned_server):
+        url, handler = canned_server
+        handler.body = {"documents": [],
+                        "errors": [{"id": "0", "message": "bad language"}]}
+        out = TextSentiment(url=url).transform(DataFrame({"text": ["x"]}))
+        assert out["result"][0] == {"error": "bad language"}
+
+
+class TestBingImageSource:
+    """Streaming paging source (parity: BingImageSource.scala:83)."""
+
+    @pytest.fixture
+    def paging_server(self):
+        """Serves 2 pages of image results per query, then empty."""
+        from urllib.parse import parse_qs, urlparse
+
+        class Handler(BaseHTTPRequestHandler):
+            offsets = []
+
+            def do_GET(self):
+                q = parse_qs(urlparse(self.path).query)
+                offset = int(q.get("offset", ["0"])[0])
+                count = int(q.get("count", ["10"])[0])
+                term = q.get("q", [""])[0]
+                type(self).offsets.append(offset)
+                value = ([{"name": f"{term}-{offset + i}",
+                           "contentUrl": f"http://img/{term}/{offset + i}"}
+                          for i in range(count)]
+                         if offset < 2 * count else [])
+                body = json.dumps({"value": value}).encode()
+                self.send_response(200)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def log_message(self, *args):
+                pass
+
+        server = ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+        threading.Thread(target=server.serve_forever, daemon=True).start()
+        yield f"http://127.0.0.1:{server.server_address[1]}", Handler
+        server.shutdown()
+        server.server_close()
+
+    def test_pages_until_exhausted(self, paging_server):
+        from mmlspark_tpu.io.services import BingImageSource
+        url, handler = paging_server
+        src = BingImageSource(["cats", "dogs"], url=url, imgs_per_batch=3)
+        frames = list(src.batches())
+        # 2 pages of 3 per term, then the empty page stops the stream
+        assert len(frames) == 2
+        for i, f in enumerate(frames):
+            assert f.num_rows == 6   # 2 terms x 3 images
+            assert set(f["search_term"]) == {"cats", "dogs"}
+            assert all(img["contentUrl"].startswith("http://img/")
+                       for img in f["image"])
+        # offsets advanced per batch: 0,0 then 3,3 then 6,6 (empty)
+        assert sorted(set(handler.offsets)) == [0, 3, 6]
+
+    def test_max_batches_bound(self, paging_server):
+        from mmlspark_tpu.io.services import BingImageSource
+        url, _ = paging_server
+        src = BingImageSource(["x"], url=url, imgs_per_batch=2)
+        assert len(list(src.batches(max_batches=1))) == 1
