@@ -30,11 +30,13 @@ import numpy as np
 
 
 def _chip():
-    import jax
-    d = jax.devices()[0]
-    return {"platform": jax.default_backend(),
-            "device_kind": getattr(d, "device_kind", str(d)),
-            "n_devices": len(jax.devices())}
+    from mmlspark_tpu.core.environment import environment_info
+    info = environment_info()
+    chip = {k: info[k] for k in ("platform", "device_kind", "n_devices")}
+    mem = info.get("memory")
+    if mem and "bytes_limit" in mem:
+        chip["hbm_gib"] = round(mem["bytes_limit"] / 2**30, 1)
+    return chip
 
 
 def _timed_passes(fn, n_passes: int = 3):

@@ -15,6 +15,9 @@ arrays; the reference's per-partition native C++ calls become per-host sharded
 from mmlspark_tpu.version import __version__
 
 from mmlspark_tpu.core.dataframe import DataFrame
+from mmlspark_tpu.core.environment import (
+    accelerator_count, describe, environment_info,
+)
 from mmlspark_tpu.core.params import Param
 from mmlspark_tpu.core.stage import Transformer, Estimator, Model, Evaluator, PipelineStage
 from mmlspark_tpu.core.pipeline import Pipeline, PipelineModel

@@ -5,9 +5,12 @@ Capability parity with `src/tune-hyperparameters`
 `ParamSpace.scala:25,34`, `HyperparamBuilder.scala:17-98`) is evaluated
 with k-fold cross-validation; trials run concurrently on a driver thread
 pool (`TuneHyperparameters.scala:80-94`). On TPU the thread pool overlaps
-host-side featurization/binning with device steps; device work serializes
-per chip, so the win comes from pipelining rather than oversubscription —
-the same reason the reference caps ``parallelism``.
+host-side featurization/binning with device steps; with
+``trial_devices=True`` each trial is additionally pinned to its own chip
+(round-robin over ``jax.local_devices()``), so single-chip fits run
+device-parallel across the mesh instead of contending for one device —
+the TPU-first upgrade of the reference's driver-side thread pool
+(SURVEY §2.9 row 6).
 """
 
 from __future__ import annotations
@@ -178,6 +181,11 @@ class TuneHyperparameters(Estimator, HasLabelCol):
                         validator=in_range(lo=1))
     search_mode = Param("random", "random | grid", ptype=str)
     seed = Param(0, "sampling/fold seed", ptype=int)
+    trial_devices = Param(False, "assign each trial its own chip "
+                          "(round-robin over jax.local_devices()) so "
+                          "trials run device-parallel instead of "
+                          "contending for one; parallelism should be "
+                          ">= the device count", ptype=bool)
 
     def _spaces(self) -> List[Dict[str, Any]]:
         models = self.models or []
@@ -215,22 +223,50 @@ class TuneHyperparameters(Estimator, HasLabelCol):
         evaluator = ComputeModelStatistics(label_col=self.label_col,
                                            evaluation_metric="all")
 
-        def run_trial(trial: Tuple[int, Dict[str, Any]]) -> float:
-            mi, pm = trial
-            vals = []
-            for f in range(self.num_folds):
-                test_idx = folds[f]
-                train_idx = np.concatenate(
-                    [folds[j] for j in range(self.num_folds) if j != f])
-                est = _apply_params(models[mi], pm)
-                fitted = est.fit(df.take(train_idx))
-                scored = fitted.transform(df.take(test_idx))
-                m = evaluator.evaluate(scored)
-                vals.append(float(m[metric][0]))
+        # per-trial device assignment (SURVEY §2.9 row 6: the reference's
+        # driver thread pool contends for shared executors; the TPU-first
+        # version gives each trial its own chip so single-chip fits run
+        # device-parallel across the mesh)
+        devices = None
+        if self.trial_devices:
+            import jax
+            devices = jax.local_devices()
+
+        def run_trial(ti_trial: Tuple[int, Tuple[int, Dict[str, Any]]]
+                      ) -> float:
+            ti, (mi, pm) = ti_trial
+            from contextlib import ExitStack
+            with ExitStack() as stack:
+                if devices is not None:
+                    import jax
+                    from mmlspark_tpu.parallel.topology import \
+                        single_device_scope
+                    stack.enter_context(
+                        jax.default_device(devices[ti % len(devices)]))
+                    # framework estimators must not build full-mesh
+                    # shardings inside a pinned trial: concurrent
+                    # threads interleaving multi-device collective
+                    # launches can deadlock on real chips
+                    stack.enter_context(single_device_scope())
+                vals = []
+                for f in range(self.num_folds):
+                    test_idx = folds[f]
+                    train_idx = np.concatenate(
+                        [folds[j] for j in range(self.num_folds) if j != f])
+                    est = _apply_params(models[mi], pm)
+                    fitted = est.fit(df.take(train_idx))
+                    scored = fitted.transform(df.take(test_idx))
+                    m = evaluator.evaluate(scored)
+                    vals.append(float(m[metric][0]))
             return float(np.mean(vals))
 
-        with ThreadPoolExecutor(max_workers=self.parallelism) as pool:
-            results = list(pool.map(run_trial, trials))
+        # the user's parallelism cap is respected in both modes (trials
+        # can dominate host RAM; silently raising it to the device count
+        # could OOM the host) — set parallelism >= len(devices) to keep
+        # every chip busy
+        workers = max(1, min(self.parallelism, len(trials)))
+        with ThreadPoolExecutor(max_workers=workers) as pool:
+            results = list(pool.map(run_trial, enumerate(trials)))
 
         best_i = int(np.argmax(results) if higher else np.argmin(results))
         best_mi, best_pm = trials[best_i]

@@ -1,0 +1,86 @@
+"""Platform introspection: chip type, topology, memory, host info.
+
+Capability parity with `core/env/src/main/scala/EnvironmentUtils.scala:41-51`
+(GPU discovery by shelling out to ``nvidia-smi -L``; OS detection) — the
+TPU equivalent reads everything from the jax backend: device kind,
+counts, process topology, per-device HBM stats when the runtime exposes
+them. Used to stamp benchmark output and logs so recorded numbers are
+interpretable (which chip, how many, which platform).
+"""
+
+from __future__ import annotations
+
+import os
+import platform as _platform
+from typing import Any, Dict, Optional
+
+
+def environment_info() -> Dict[str, Any]:
+    """One JSON-able dict describing the accelerator + host environment.
+
+    Safe to call before or after backend init; initializes the backend.
+    """
+    import jax
+
+    devices = jax.devices()
+    info: Dict[str, Any] = {
+        "platform": devices[0].platform if devices else "none",
+        "device_kind": devices[0].device_kind if devices else None,
+        "n_devices": len(devices),
+        "n_local_devices": jax.local_device_count(),
+        "process_index": jax.process_index(),
+        "process_count": jax.process_count(),
+        "jax_version": jax.__version__,
+        "host": {
+            "os": _platform.system(),
+            "machine": _platform.machine(),
+            "python": _platform.python_version(),
+            "cpu_count": os.cpu_count(),
+        },
+    }
+    hbm = device_memory_stats(devices[0]) if devices else None
+    if hbm:
+        info["memory"] = hbm
+    return info
+
+
+def device_memory_stats(device=None) -> Optional[Dict[str, int]]:
+    """Per-device memory stats (bytes) when the runtime exposes them
+    (TPU/GPU runtimes do; CPU returns None)."""
+    import jax
+
+    dev = device if device is not None else jax.devices()[0]
+    stats = getattr(dev, "memory_stats", None)
+    if stats is None:
+        return None
+    try:
+        raw = stats()
+    except Exception:  # noqa: BLE001 - backend without stats support
+        return None
+    if not raw:
+        return None
+    keep = ("bytes_in_use", "bytes_limit", "peak_bytes_in_use",
+            "bytes_reserved", "largest_free_block_bytes")
+    return {k: int(raw[k]) for k in keep if k in raw}
+
+
+def accelerator_count() -> int:
+    """Parity: `EnvironmentUtils.GPUCount` — the number of accelerator
+    devices visible to this process (0 on CPU-only hosts)."""
+    import jax
+
+    return sum(1 for d in jax.devices() if d.platform != "cpu")
+
+
+def describe() -> str:
+    """Human-readable one-liner for logs: platform/kind/counts/memory."""
+    info = environment_info()
+    parts = [f"{info['platform']}:{info['device_kind']}",
+             f"{info['n_devices']} device(s)"]
+    if info["process_count"] > 1:
+        parts.append(f"process {info['process_index']}/"
+                     f"{info['process_count']}")
+    mem = info.get("memory")
+    if mem and "bytes_limit" in mem:
+        parts.append(f"{mem['bytes_limit'] / 2**30:.1f} GiB/device")
+    return ", ".join(parts)

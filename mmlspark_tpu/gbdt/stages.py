@@ -118,7 +118,9 @@ class _GBDTParams(HasFeaturesCol, HasLabelCol, HasWeightCol):
 
     def _sharding(self):
         import jax
-        if self.parallelism == "serial" or len(jax.devices()) == 1:
+        from mmlspark_tpu.parallel.topology import in_single_device_scope
+        if self.parallelism == "serial" or len(jax.devices()) == 1 \
+                or in_single_device_scope():
             return None
         from mmlspark_tpu.parallel import build_mesh, batch_sharding
         return batch_sharding(build_mesh())

@@ -144,8 +144,16 @@ class NNLearner(Estimator, HasLabelCol, HasFeaturesCol):
                                            seed=self.seed)
         module = fn.module()
 
-        mesh = build_mesh(MeshSpec.from_dict(self.mesh_shape)
-                          if self.mesh_shape else None)
+        from mmlspark_tpu.parallel.topology import in_single_device_scope
+        if in_single_device_scope():
+            # pinned-trial context (TuneHyperparameters trial_devices):
+            # train on the thread's default device only
+            dev = jax.config.jax_default_device or jax.local_devices()[0]
+            mesh = build_mesh(MeshSpec.from_dict({"data": 1}),
+                              devices=[dev])
+        else:
+            mesh = build_mesh(MeshSpec.from_dict(self.mesh_shape)
+                              if self.mesh_shape else None)
         n_data = mesh.shape.get("data", 1)
         bs = max(self.batch_size - self.batch_size % n_data, n_data)
         steps_per_epoch = max(len(x) // bs, 1)

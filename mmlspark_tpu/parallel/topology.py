@@ -23,6 +23,7 @@ from __future__ import annotations
 import dataclasses
 import math
 import os
+import threading
 from typing import Dict, List, Optional, Sequence, Tuple
 
 AXIS_DATA = "data"
@@ -120,6 +121,38 @@ def bump_host_device_count(flags: str, n: int) -> str:
         return re.sub(r"xla_force_host_platform_device_count=\d+",
                       f"xla_force_host_platform_device_count={n}", flags)
     return flags
+
+
+_scope_state = threading.local()
+
+
+def single_device_scope():
+    """Context manager confining framework estimators to one device.
+
+    Inside the scope, :func:`in_single_device_scope` is True and
+    framework estimators (GBDT stages, NNLearner) skip building
+    multi-device mesh shardings — their fits stay on the thread's
+    default device. Used by ``TuneHyperparameters(trial_devices=True)``
+    so concurrently dispatched trials can't interleave full-mesh
+    collectives across threads (which deadlocks on real chips). The
+    flag is thread-local: other threads keep their sharded behavior.
+    """
+    from contextlib import contextmanager
+
+    @contextmanager
+    def scope():
+        prev = getattr(_scope_state, "single", False)
+        _scope_state.single = True
+        try:
+            yield
+        finally:
+            _scope_state.single = prev
+
+    return scope()
+
+
+def in_single_device_scope() -> bool:
+    return getattr(_scope_state, "single", False)
 
 
 def build_mesh(spec: Optional[MeshSpec] = None, devices=None):

@@ -1,0 +1,36 @@
+"""Platform introspection (parity: EnvironmentUtils.scala:41-51)."""
+
+import json
+
+from mmlspark_tpu.core.environment import (
+    accelerator_count, describe, device_memory_stats, environment_info,
+)
+
+
+def test_environment_info_shape():
+    info = environment_info()
+    assert info["n_devices"] >= 1
+    assert info["n_local_devices"] >= 1
+    assert info["platform"] in ("cpu", "tpu", "gpu")
+    assert info["process_count"] >= 1
+    assert info["host"]["cpu_count"] >= 1
+    json.dumps(info)  # must be JSON-able for bench metadata
+
+
+def test_accelerator_count_cpu_mesh():
+    # conftest pins the 8-device CPU mesh: no accelerators visible
+    import jax
+    if jax.devices()[0].platform == "cpu":
+        assert accelerator_count() == 0
+    else:
+        assert accelerator_count() >= 1
+
+
+def test_memory_stats_optional():
+    stats = device_memory_stats()
+    assert stats is None or all(isinstance(v, int) for v in stats.values())
+
+
+def test_describe_one_liner():
+    s = describe()
+    assert "device(s)" in s and "\n" not in s

@@ -102,3 +102,37 @@ class TestNNLearner:
                             epochs=3, batch_size=64, log_every=0)
         model = learner.fit(blobs)
         assert _accuracy(model, blobs) > 0.9
+
+
+class TestSingleDeviceScope:
+    def test_nnlearner_confined_to_one_device(self, blobs):
+        # pinned-trial context (TuneHyperparameters trial_devices): the
+        # learner must train on the thread's default device only, not
+        # build a full-mesh data-parallel sharding
+        import jax
+        from mmlspark_tpu.parallel.topology import single_device_scope
+        learner = NNLearner(arch={"builder": "mlp", "hidden": [8],
+                                  "num_outputs": 2},
+                            loss="softmax_cross_entropy", optimizer="adam",
+                            learning_rate=0.01, epochs=4, batch_size=64,
+                            log_every=0)
+        import mmlspark_tpu.models.trainer as trainer_mod
+        seen = {}
+        orig = trainer_mod.build_mesh
+
+        def spy(spec=None, devices=None):
+            mesh = orig(spec, devices)
+            seen["shape"] = dict(mesh.shape)
+            seen["devices"] = list(mesh.devices.flat)
+            return mesh
+
+        dev = jax.devices()[5]
+        trainer_mod.build_mesh = spy
+        try:
+            with jax.default_device(dev), single_device_scope():
+                model = learner.fit(blobs)
+        finally:
+            trainer_mod.build_mesh = orig
+        assert seen["shape"] == {"data": 1}
+        assert seen["devices"] == [dev]
+        assert _accuracy(model, blobs) > 0.8
