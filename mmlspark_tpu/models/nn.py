@@ -96,7 +96,9 @@ class NNModel(Model, HasInputCol, HasOutputCol):
     def _device_setup(self):
         """One-time placement: (device params, batch sharding, n shards)."""
         import jax
-        if self.data_parallel and len(jax.devices()) > 1:
+        from mmlspark_tpu.parallel.topology import in_single_device_scope
+        if self.data_parallel and len(jax.devices()) > 1 \
+                and not in_single_device_scope():
             mesh = build_mesh()
             return (jax.device_put(self.model.params, replicated_sharding(mesh)),
                     batch_sharding(mesh), mesh.shape["data"])

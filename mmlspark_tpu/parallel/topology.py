@@ -20,6 +20,7 @@ API change — SURVEY.md §7 "hard parts"):
 
 from __future__ import annotations
 
+import contextlib
 import dataclasses
 import math
 import os
@@ -126,29 +127,25 @@ def bump_host_device_count(flags: str, n: int) -> str:
 _scope_state = threading.local()
 
 
+@contextlib.contextmanager
 def single_device_scope():
-    """Context manager confining framework estimators to one device.
+    """Context manager confining framework stages to one device.
 
     Inside the scope, :func:`in_single_device_scope` is True and
-    framework estimators (GBDT stages, NNLearner) skip building
-    multi-device mesh shardings — their fits stay on the thread's
-    default device. Used by ``TuneHyperparameters(trial_devices=True)``
-    so concurrently dispatched trials can't interleave full-mesh
-    collectives across threads (which deadlocks on real chips). The
-    flag is thread-local: other threads keep their sharded behavior.
+    framework stages (GBDT stages, NNLearner, NNModel scoring) skip
+    building multi-device mesh shardings — their device work stays on
+    the thread's default device. Used by
+    ``TuneHyperparameters(trial_devices=True)`` so concurrently
+    dispatched trials can't interleave full-mesh collectives across
+    threads (which deadlocks on real chips). The flag is thread-local:
+    other threads keep their sharded behavior.
     """
-    from contextlib import contextmanager
-
-    @contextmanager
-    def scope():
-        prev = getattr(_scope_state, "single", False)
-        _scope_state.single = True
-        try:
-            yield
-        finally:
-            _scope_state.single = prev
-
-    return scope()
+    prev = getattr(_scope_state, "single", False)
+    _scope_state.single = True
+    try:
+        yield
+    finally:
+        _scope_state.single = prev
 
 
 def in_single_device_scope() -> bool:

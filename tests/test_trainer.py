@@ -135,4 +135,9 @@ class TestSingleDeviceScope:
             trainer_mod.build_mesh = orig
         assert seen["shape"] == {"data": 1}
         assert seen["devices"] == [dev]
+        # scoring inside the scope must not build a full mesh either
+        # (NNModel.transform consults the scope in _device_setup)
+        with jax.default_device(dev), single_device_scope():
+            setup = model._device_setup
+        assert setup[1] is None  # no batch sharding => single device
         assert _accuracy(model, blobs) > 0.8
