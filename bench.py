@@ -1,4 +1,5 @@
-"""Benchmarks for all five BASELINE configs — one JSON line each.
+"""Benchmarks for the five BASELINE configs plus chip utilization —
+one JSON line each.
 
 The reference publishes no absolute numbers (BASELINE.md: its only perf
 claims are relative — "10-30% faster" GBDT, "sub-millisecond" serving —
@@ -16,6 +17,9 @@ Configs (BASELINE.md "Target configs"):
   3. cifar10_scoring_v2          — ResNet-20 scoring images/sec/chip (+ device-only)
   4. transfer_learning_e2e_v2    — ImageFeaturizer + TrainClassifier end-to-end
   5. distributed_sgd_step_v2     — sharded train-step throughput (steps/sec)
+
+Plus (no era analogue, utilization evidence):
+  6. imagenet_scoring_v1         — ResNet-50 bf16 device scoring + MFU
 
 Every line carries chip metadata (platform/device kind/count) so the
 numbers are interpretable across hosts.

@@ -30,7 +30,6 @@ from mmlspark_tpu.gbdt.binning import BinMapper
 from mmlspark_tpu.gbdt.objectives import Objective, get_objective
 from mmlspark_tpu.gbdt.tree import (
     GrowthParams, Tree, TreeGrower, depth_bucket, predict_tree_raw,
-    renew_leaf_values,
 )
 
 
@@ -350,28 +349,21 @@ class Booster:
                     # the bin matrix is never copied per iteration
                     fm_dev = jnp.asarray(np.pad(
                         feat_mask, (0, bins.shape[1] - len(feat_mask))))
-                tree, row_vals, node_of_row = grower.grow(
-                    bins, gk, hk, sample_dev, shrink, feat_mask=fm_dev)
+                renew = None
                 if obj.renew_quantile is not None:
-                    # L1/quantile: renew leaf outputs to the residual
-                    # quantile over the leaf's sampled rows (LightGBM
-                    # RenewTreeOutput), then re-apply shrinkage. The
+                    # L1/quantile: the grower renews leaf outputs to the
+                    # residual quantile over each leaf's sampled rows
+                    # (LightGBM RenewTreeOutput) before shrinkage. The
                     # residual is taken against the same scores the
                     # gradients used (RF trees fit y - init, not the
                     # accumulated ensemble).
                     scores = base if is_rf else raw_for_grad
-                    renew_vals, renew_cnt = renew_leaf_values(
-                        node_of_row, y_dev - _squeeze(scores, K),
-                        w, sample_dev, 2 * params.num_leaves - 1,
-                        obj.renew_quantile)
-                    n_nodes = tree.n_nodes
-                    vals_np, cnt_np = jax.device_get(
-                        (renew_vals[:n_nodes], renew_cnt[:n_nodes]))
-                    is_leaf = (tree.feature < 0) & (cnt_np > 0)
-                    tree.value = np.where(
-                        is_leaf, vals_np * shrink, tree.value
-                    ).astype(np.float32)
-                    row_vals = jnp.asarray(tree.value)[node_of_row]
+                    renew = {"q": obj.renew_quantile,
+                             "residual": y_dev - _squeeze(scores, K),
+                             "weights": w}
+                tree, row_vals, _ = grower.grow(
+                    bins, gk, hk, sample_dev, shrink, feat_mask=fm_dev,
+                    renew=renew)
                 iter_trees.append(tree)
                 new_contrib = new_contrib.at[:, k].add(row_vals)
 

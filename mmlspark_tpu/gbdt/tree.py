@@ -535,9 +535,15 @@ class TreeGrower:
         return self._bins_t
 
     def grow(self, bins, grad, hess, sample_mask,
-             shrinkage: float, feat_mask=None
+             shrinkage: float, feat_mask=None, renew=None
              ) -> Tuple[Tree, jnp.ndarray, jnp.ndarray]:
         """Returns (tree, per-row raw value of the new tree, row→node ids).
+
+        ``renew``: optional ``{"q", "residual", "weights"}`` — L1/quantile
+        leaf-output renewal (:func:`renew_leaf_values`) computed inside
+        the grower so the device grower still pays ONE host fetch per
+        tree (a separate renewal fetch would double the per-tree
+        round-trips, which dominate on high-latency links).
 
         bins (n, F) int32 / grad,hess (n,) f32 / sample_mask (n,) bool —
         all may be sharded over the data axis; everything here is jitted
@@ -551,24 +557,30 @@ class TreeGrower:
         """
         if self.tree_learner == "data" and self._voting_fn is None:
             return self._grow_device(bins, grad, hess, sample_mask,
-                                     shrinkage, feat_mask)
+                                     shrinkage, feat_mask, renew)
         return self._grow_host(bins, grad, hess, sample_mask,
-                               shrinkage, feat_mask)
+                               shrinkage, feat_mask, renew)
 
     def _grow_device(self, bins, grad, hess, sample_mask,
-                     shrinkage: float, feat_mask=None
+                     shrinkage: float, feat_mask=None, renew=None
                      ) -> Tuple[Tree, jnp.ndarray, jnp.ndarray]:
         p = self.params
         bins_t = self._get_bins_t(bins) if self.hist_impl != "xla" else None
         s = grow_tree_device(bins, bins_t, grad, hess, sample_mask,
                              self.is_categorical, feat_mask, p,
                              self.n_features, self.n_bins, self.hist_impl)
-        # ONE host fetch for the whole tree
+        val_dev = s["value"]
+        if renew is not None:
+            rv, rc = renew_leaf_values(
+                s["node_of_row"], renew["residual"], renew["weights"],
+                sample_mask, 2 * p.num_leaves - 1, renew["q"])
+            val_dev = jnp.where((s["feature"] < 0) & (rc > 0), rv, val_dev)
+        # ONE host fetch for the whole tree (renewed values included)
         (feature, threshold_bin, missing_left, categorical, cat_mask,
          left, right, value, gain_arr, n_nodes) = jax.device_get(
             (s["feature"], s["threshold_bin"], s["missing_left"],
              s["categorical"], s["cat_mask"], s["left"], s["right"],
-             s["value"], s["gain"], s["n_nodes"]))
+             val_dev, s["gain"], s["n_nodes"]))
         n_nodes = int(n_nodes)
         value_arr = (value * shrinkage).astype(np.float32)
 
@@ -590,11 +602,11 @@ class TreeGrower:
                     n_nodes=n_nodes)
 
         node_of_row = s["node_of_row"]
-        row_vals = (s["value"] * shrinkage)[node_of_row]
+        row_vals = (val_dev * shrinkage)[node_of_row]
         return tree, row_vals, node_of_row
 
     def _grow_host(self, bins, grad, hess, sample_mask,
-                   shrinkage: float, feat_mask=None
+                   shrinkage: float, feat_mask=None, renew=None
                    ) -> Tuple[Tree, jnp.ndarray, jnp.ndarray]:
         p = self.params
         max_nodes = 2 * p.num_leaves - 1
@@ -702,6 +714,12 @@ class TreeGrower:
             consider(li, lhist, lpacked, lorder)
             consider(ri, rhist, rpacked, rorder)
 
+        if renew is not None:
+            rv, rc = jax.device_get(renew_leaf_values(
+                node_of_row, renew["residual"], renew["weights"],
+                sample_mask, max_nodes, renew["q"]))
+            is_leaf = (feature < 0) & (rc > 0)
+            value = np.where(is_leaf, rv, value)
         value_arr = (value * shrinkage).astype(np.float32)
         tree = Tree(feature=feature[:n_nodes], threshold=threshold[:n_nodes],
                     threshold_bin=threshold_bin[:n_nodes],

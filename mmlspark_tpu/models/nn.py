@@ -78,7 +78,8 @@ class NNModel(Model, HasInputCol, HasOutputCol):
     def _set_param(self, name, value):
         # param changes invalidate the compiled forward and device placement
         self.__dict__.pop("_jitted", None)
-        self.__dict__.pop("_device_setup", None)
+        self.__dict__.pop("_setup_sharded", None)
+        self.__dict__.pop("_setup_single", None)
         super()._set_param(name, value)
 
     @functools.cached_property
@@ -93,16 +94,33 @@ class NNModel(Model, HasInputCol, HasOutputCol):
         return jax.jit(forward)
 
     @functools.cached_property
+    def _setup_sharded(self):
+        import jax
+        mesh = build_mesh()
+        return (jax.device_put(self.model.params, replicated_sharding(mesh)),
+                batch_sharding(mesh), mesh.shape["data"])
+
+    @functools.cached_property
+    def _setup_single(self):
+        import jax
+        return jax.device_put(self.model.params), None, 1
+
+    @property
     def _device_setup(self):
-        """One-time placement: (device params, batch sharding, n shards)."""
+        """Placement: (device params, batch sharding, n shards).
+
+        The sharded/single decision is re-made per call (the
+        single-device scope is a dynamic thread-local — freezing it in
+        one cache would either leak full-mesh collectives into pinned
+        tuning trials or pin a shared model single-device forever);
+        each variant's actual placement is cached.
+        """
         import jax
         from mmlspark_tpu.parallel.topology import in_single_device_scope
         if self.data_parallel and len(jax.devices()) > 1 \
                 and not in_single_device_scope():
-            mesh = build_mesh()
-            return (jax.device_put(self.model.params, replicated_sharding(mesh)),
-                    batch_sharding(mesh), mesh.shape["data"])
-        return jax.device_put(self.model.params), None, 1
+            return self._setup_sharded
+        return self._setup_single
 
     def transform(self, df: DataFrame) -> DataFrame:
         import jax
