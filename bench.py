@@ -18,8 +18,9 @@ Configs (BASELINE.md "Target configs"):
   4. transfer_learning_e2e_v2    — ImageFeaturizer + TrainClassifier end-to-end
   5. distributed_sgd_step_v2     — sharded train-step throughput (steps/sec)
 
-Plus (no era analogue, utilization evidence):
+Plus (no era analogue, utilization/latency evidence):
   6. imagenet_scoring_v1         — ResNet-50 bf16 device scoring + MFU
+  7. serving_latency_v1          — serving-stack p50/p99 request latency
 
 Every line carries chip metadata (platform/device kind/count) so the
 numbers are interpretable across hosts.
@@ -246,15 +247,30 @@ def bench_distributed_sgd():
     y = jax.device_put(rng.integers(0, 10, batch).astype(np.int32), shard)
     w = jax.device_put(np.ones(batch, np.float32), shard)
 
+    # step chains are data-dependent (params/opt_state thread through),
+    # and the scalar loss fetch forces completion — block_until_ready
+    # alone returns early on the tunneled backend (see
+    # _device_seconds_per_batch); the long/short chain slope cancels the
+    # fetch round-trip
     params, opt_state, loss = step(params, opt_state, x, y, w)  # warm
-    jax.block_until_ready(loss)
-    reps = 20
-    t0 = time.perf_counter()
-    for _ in range(reps):
-        params, opt_state, loss = step(params, opt_state, x, y, w)
-    jax.block_until_ready(loss)
-    elapsed = time.perf_counter() - t0
-    steps_per_sec = reps / elapsed
+    float(loss)
+    times = {}
+    for reps in (2, 22):
+        best = float("inf")
+        for _ in range(3):  # min-of-3 rejects GC/scheduler hiccups
+            t0 = time.perf_counter()
+            for _ in range(reps):
+                params, opt_state, loss = step(params, opt_state, x, y, w)
+            float(loss)
+            best = min(best, time.perf_counter() - t0)
+        times[reps] = best
+    slope = (times[22] - times[2]) / 20
+    # a non-positive slope means noise swamped the measurement: fall
+    # back to the long chain including its fetch RTT (conservative)
+    # rather than manufacturing an absurd rate from a clamp
+    sec_per_step = slope if slope > 0 else times[22] / 22
+    steps_per_sec = 1.0 / sec_per_step
+    elapsed, reps = sec_per_step * 20, 20
     baseline = 10.0
     return {"metric": "distributed_sgd_step_v2",
             "value": round(steps_per_sec, 2), "unit": "steps/sec",
@@ -357,9 +373,62 @@ def bench_imagenet_scoring():
     return out
 
 
+def bench_serving_latency():
+    """Serving-stack request latency (reference headline: "sub-ms";
+    "latencies as low as 1 ms", README.md:19, mmlspark-serving.md:10).
+
+    Measures the serving machinery itself — HTTP loopback, batching
+    queue, frame assembly, reply routing — with a trivial host-side
+    model, so the number is the stack overhead a model's own device time
+    adds onto (through the tunneled dev chip any device fetch costs a
+    ~100 ms RTT that says nothing about the serving layer). Baseline:
+    the reference's 1 ms claim; vs_baseline = baseline / p50.
+    """
+    from mmlspark_tpu.core.stage import Transformer
+    from mmlspark_tpu.serving import ServingServer
+
+    class Identity(Transformer):
+        def transform(self, df):
+            return df.with_column(
+                "y", np.asarray(df["x"], dtype=np.float64))
+
+    # raw http.client on a kept-alive socket: the requests library adds
+    # 1-2 ms of client-side machinery that is not serving-stack latency
+    import http.client
+
+    lat = []
+    with ServingServer(Identity(), max_latency_ms=0) as srv:
+        conn = http.client.HTTPConnection(srv.host, srv.port, timeout=10)
+
+        def post(i):
+            body = json.dumps({"x": i}).encode()
+            conn.request("POST", srv.api_path, body,
+                         {"Content-Type": "application/json"})
+            resp = conn.getresponse()
+            data = resp.read()
+            return resp.status, data
+
+        for i in range(50):  # warm sockets + code paths
+            post(i)
+        for i in range(300):
+            t0 = time.perf_counter()
+            status, _ = post(i)
+            lat.append(time.perf_counter() - t0)
+            assert status == 200
+        conn.close()
+    p50 = float(np.percentile(lat, 50)) * 1000
+    p99 = float(np.percentile(lat, 99)) * 1000
+    baseline = 1.0
+    return {"metric": "serving_latency_v1", "value": round(p50, 3),
+            "unit": "ms p50", "p99_ms": round(p99, 3),
+            "baseline": baseline,
+            "vs_baseline": round(baseline / max(p50, 1e-9), 3),
+            "chip": _chip()}
+
+
 BENCHES = [bench_gbdt_quantile, bench_adult_census, bench_cifar10_scoring,
            bench_imagenet_scoring, bench_transfer_learning,
-           bench_distributed_sgd]
+           bench_distributed_sgd, bench_serving_latency]
 
 
 def main() -> None:

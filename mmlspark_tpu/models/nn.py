@@ -79,7 +79,7 @@ class NNModel(Model, HasInputCol, HasOutputCol):
         # param changes invalidate the compiled forward and device placement
         self.__dict__.pop("_jitted", None)
         self.__dict__.pop("_setup_sharded", None)
-        self.__dict__.pop("_setup_single", None)
+        self.__dict__.pop("_setup_single_cache", None)
         super()._set_param(name, value)
 
     @functools.cached_property
@@ -101,9 +101,8 @@ class NNModel(Model, HasInputCol, HasOutputCol):
                 batch_sharding(mesh), mesh.shape["data"])
 
     @functools.cached_property
-    def _setup_single(self):
-        import jax
-        return jax.device_put(self.model.params), None, 1
+    def _setup_single_cache(self):
+        return {}  # device -> (params-on-device, None, 1)
 
     @property
     def _device_setup(self):
@@ -112,15 +111,22 @@ class NNModel(Model, HasInputCol, HasOutputCol):
         The sharded/single decision is re-made per call (the
         single-device scope is a dynamic thread-local — freezing it in
         one cache would either leak full-mesh collectives into pinned
-        tuning trials or pin a shared model single-device forever);
-        each variant's actual placement is cached.
+        tuning trials or pin a shared model single-device forever).
+        Single-device placement is cached PER DEVICE: Stage.copy is
+        shallow, so trial copies pinned to different chips share this
+        instance's cache, and a single cached tuple would silently route
+        every pinned trial's forward to the first caller's chip.
         """
         import jax
         from mmlspark_tpu.parallel.topology import in_single_device_scope
         if self.data_parallel and len(jax.devices()) > 1 \
                 and not in_single_device_scope():
             return self._setup_sharded
-        return self._setup_single
+        dev = jax.config.jax_default_device or jax.local_devices()[0]
+        cache = self._setup_single_cache
+        if dev not in cache:
+            cache[dev] = (jax.device_put(self.model.params, dev), None, 1)
+        return cache[dev]
 
     def transform(self, df: DataFrame) -> DataFrame:
         import jax

@@ -95,6 +95,15 @@ class ServingServer:
         serving = self
 
         class Handler(BaseHTTPRequestHandler):
+            # HTTP/1.1: keep-alive sockets (every reply carries an
+            # explicit Content-Length) — per-request TCP connects would
+            # dominate the latency the server exists to minimize.
+            # Nagle must go with it: status/headers/body are separate
+            # writes, and Nagle + delayed ACK turns each keep-alive
+            # response into a 40 ms stall.
+            protocol_version = "HTTP/1.1"
+            disable_nagle_algorithm = True
+
             def _reply(self, status: int, body: bytes, replayed=False):
                 self.send_response(status)
                 self.send_header("Content-Type", "application/json")
@@ -158,6 +167,15 @@ class ServingServer:
         except Empty:
             return []
         batch = [first]
+        if self.max_latency_ms <= 0:
+            # latency-first mode: take whatever is already queued and
+            # serve immediately — no added wait for batch-mates
+            while len(batch) < self.max_batch_size:
+                try:
+                    batch.append(self._queue.get_nowait())
+                except Empty:
+                    break
+            return batch
         deadline = time.monotonic() + self.max_latency_ms / 1000.0
         while len(batch) < self.max_batch_size:
             remaining = deadline - time.monotonic()

@@ -684,3 +684,35 @@ class TestBingImageSource:
         url, _ = paging_server
         src = BingImageSource(["x"], url=url, imgs_per_batch=2)
         assert len(list(src.batches(max_batches=1))) == 1
+
+
+class TestLatencyFirstMode:
+    def test_zero_latency_serves_immediately_and_still_batches(self):
+        barrier = threading.Barrier(9, timeout=5)
+
+        class Count(Transformer):
+            batches = []
+
+            def transform(self, df):
+                type(self).batches.append(df.num_rows)
+                return df.with_column(
+                    "y", np.asarray(df["x"], dtype=np.float64))
+
+        with ServingServer(Count(), max_latency_ms=0) as srv:
+            r = requests.post(srv.address, json={"x": 1}, timeout=10)
+            assert r.status_code == 200 and r.json() == {"y": 1.0}
+            assert Count.batches[0] == 1  # served alone, no batch wait
+
+            # burst: already-queued requests still coalesce
+            def hit(i):
+                barrier.wait()
+                requests.post(srv.address, json={"x": i}, timeout=10)
+
+            threads = [threading.Thread(target=hit, args=(i,))
+                       for i in range(8)]
+            for t in threads:
+                t.start()
+            barrier.wait()
+            for t in threads:
+                t.join()
+            assert sum(Count.batches) == 9
