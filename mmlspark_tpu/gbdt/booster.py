@@ -277,6 +277,45 @@ class Booster:
         valid_eval: Optional[_ValidEval] = None  # incremental valid scorer
 
         start_iter = len(booster.trees)
+
+        # -- fully-fused fit: the whole boosting loop as ONE device scan
+        # (the TPU shape of the reference's native hot loop,
+        # `TrainUtils.scala:95-146`) — eligible when nothing in the loop
+        # needs the host: plain gbdt, single model output, no
+        # row/feature sampling, no validation/early-stopping/logging
+        fused = (params.boosting_type == "gbdt" and K == 1
+                 and tree_learner == "data" and grower._voting_fn is None
+                 and params.bagging_fraction >= 1.0
+                 and params.feature_fraction >= 1.0
+                 and not valid_sets and not log_every)
+        if fused:
+            from mmlspark_tpu.gbdt.tree import boost_loop_device
+            bins_t = (grower._get_bins_t(bins)
+                      if grower.hist_impl != "xla" else None)
+
+            _, stacked = boost_loop_device(
+                bins, bins_t, y_dev, w, put(valid_rows),
+                _squeeze(raw, K).astype(jnp.float32),
+                obj.grad_hess,  # cached objective => stable jit cache key
+                params.num_iterations, params.growth(),
+                grower.is_categorical, None, grower.n_features,
+                grower.n_bins, grower.hist_impl, shrink,
+                obj.renew_quantile)
+            host = jax.device_get(stacked)  # ONE fetch for the whole fit
+            from mmlspark_tpu.gbdt.tree import tree_from_arrays
+            for it in range(params.num_iterations):
+                tree = tree_from_arrays(
+                    mapper, host["feature"][it], host["threshold_bin"][it],
+                    host["missing_left"][it], host["categorical"][it],
+                    host["cat_mask"][it], host["left"][it],
+                    host["right"][it], host["value"][it], host["gain"][it],
+                    int(host["n_nodes"][it]))
+                booster.trees.append([tree])
+            booster.best_iteration = len(booster.trees) - 1
+            booster.__dict__.pop("_mdc", None)
+            booster.__dict__.pop("_tree_dev", None)
+            return booster
+
         for it in range(start_iter, start_iter + params.num_iterations):
             # -- dart: drop trees for this round's gradient computation
             # (drop indices are relative to THIS run's trees,

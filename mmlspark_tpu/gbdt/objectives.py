@@ -10,6 +10,7 @@ multiclass, regression, quantile, tweedie; plus poisson/mae used by its
 from __future__ import annotations
 
 import dataclasses
+import functools
 from typing import Callable, Optional, Tuple
 
 import jax.numpy as jnp
@@ -142,8 +143,13 @@ def make_multiclass(num_class: int) -> Objective:
                      is_classification=True)
 
 
+@functools.lru_cache(maxsize=64)
 def get_objective(name: str, num_class: int = 2, alpha: float = 0.9,
                   tweedie_p: float = 1.5) -> Objective:
+    """Objectives are frozen and stateless, so instances are cached —
+    a stable ``grad_hess`` identity lets repeated fits with the same
+    config hit jit caches (the fused device loop keys on it) instead of
+    re-tracing the whole boosting program per fit."""
     name = name.lower()
     if name == "binary":
         return make_binary()

@@ -100,9 +100,12 @@ class ServingServer:
             # dominate the latency the server exists to minimize.
             # Nagle must go with it: status/headers/body are separate
             # writes, and Nagle + delayed ACK turns each keep-alive
-            # response into a 40 ms stall.
+            # response into a 40 ms stall. The idle timeout reaps
+            # keep-alive connections so parked clients can't pin
+            # handler threads forever.
             protocol_version = "HTTP/1.1"
             disable_nagle_algorithm = True
+            timeout = 60.0
 
             def _reply(self, status: int, body: bytes, replayed=False):
                 self.send_response(status)
