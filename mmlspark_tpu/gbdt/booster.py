@@ -281,36 +281,39 @@ class Booster:
         # -- fully-fused fit: the whole boosting loop as ONE device scan
         # (the TPU shape of the reference's native hot loop,
         # `TrainUtils.scala:95-146`) — eligible when nothing in the loop
-        # needs the host: plain gbdt, single model output, no
+        # needs the host: plain gbdt (any small K; the scan body unrolls
+        # K tree growers, so huge class counts would balloon compile
+        # time and keep the cached per-tree path instead), no
         # row/feature sampling, no validation/early-stopping/logging
-        fused = (params.boosting_type == "gbdt" and K == 1
+        fused = (params.boosting_type == "gbdt" and K <= 16
                  and tree_learner == "data" and grower._voting_fn is None
                  and params.bagging_fraction >= 1.0
                  and params.feature_fraction >= 1.0
                  and not valid_sets and not log_every)
         if fused:
-            from mmlspark_tpu.gbdt.tree import boost_loop_device
+            from mmlspark_tpu.gbdt.tree import (boost_loop_device,
+                                                tree_from_arrays)
             bins_t = (grower._get_bins_t(bins)
                       if grower.hist_impl != "xla" else None)
 
             _, stacked = boost_loop_device(
                 bins, bins_t, y_dev, w, put(valid_rows),
-                _squeeze(raw, K).astype(jnp.float32),
+                raw.astype(jnp.float32),
                 obj.grad_hess,  # cached objective => stable jit cache key
-                params.num_iterations, params.growth(),
+                params.num_iterations, K, params.growth(),
                 grower.is_categorical, None, grower.n_features,
                 grower.n_bins, grower.hist_impl, shrink,
                 obj.renew_quantile)
             host = jax.device_get(stacked)  # ONE fetch for the whole fit
-            from mmlspark_tpu.gbdt.tree import tree_from_arrays
             for it in range(params.num_iterations):
-                tree = tree_from_arrays(
-                    mapper, host["feature"][it], host["threshold_bin"][it],
-                    host["missing_left"][it], host["categorical"][it],
-                    host["cat_mask"][it], host["left"][it],
-                    host["right"][it], host["value"][it], host["gain"][it],
-                    int(host["n_nodes"][it]))
-                booster.trees.append([tree])
+                booster.trees.append([tree_from_arrays(
+                    mapper, host["feature"][it][k],
+                    host["threshold_bin"][it][k],
+                    host["missing_left"][it][k], host["categorical"][it][k],
+                    host["cat_mask"][it][k], host["left"][it][k],
+                    host["right"][it][k], host["value"][it][k],
+                    host["gain"][it][k], int(host["n_nodes"][it][k]))
+                    for k in range(K)])
             booster.best_iteration = len(booster.trees) - 1
             booster.__dict__.pop("_mdc", None)
             booster.__dict__.pop("_tree_dev", None)

@@ -801,12 +801,12 @@ def tree_from_arrays(mapper, feature, threshold_bin, missing_left,
 # ---------------------------------------------------------------------------
 
 @partial(jax.jit, static_argnames=(
-    "grad_hess", "n_iters", "params", "n_features", "n_bins", "hist_impl",
-    "shrinkage", "renew_q"))
+    "grad_hess", "n_iters", "n_outputs", "params", "n_features", "n_bins",
+    "hist_impl", "shrinkage", "renew_q"))
 def boost_loop_device(bins, bins_t, y, w, valid_mask, init_raw, grad_hess,
-                      n_iters: int, params: GrowthParams, is_categorical,
-                      feat_mask, n_features: int, n_bins: int,
-                      hist_impl: str, shrinkage: float,
+                      n_iters: int, n_outputs: int, params: GrowthParams,
+                      is_categorical, feat_mask, n_features: int,
+                      n_bins: int, hist_impl: str, shrinkage: float,
                       renew_q: Optional[float]):
     """The ENTIRE boosting fit as one scanned device program.
 
@@ -818,29 +818,40 @@ def boost_loop_device(bins, bins_t, y, w, valid_mask, init_raw, grad_hess,
     the per-tree dispatch + fetch round-trips that dominate wall-clock on
     high-latency host<->device links.
 
-    Per scan step: gradients from the carried raw scores, one
-    :func:`grow_tree_device` tree, optional L1/quantile leaf renewal,
-    raw update. Emits stacked per-iteration node arrays.
-    Returns (final raw, stacked dict with arrays of shape (n_iters, ...)).
+    Per scan step: gradients from the carried ``(n, K)`` raw scores, one
+    :func:`grow_tree_device` tree per model output (K trees for
+    multiclass), optional L1/quantile leaf renewal, raw update. Emits
+    per-iteration node arrays stacked as ``(n_iters, K, ...)``.
+    Returns (final raw, stacked dict).
     """
+    K = n_outputs
     max_nodes = 2 * params.num_leaves - 1
     emit_keys = ("feature", "threshold_bin", "missing_left", "categorical",
                  "cat_mask", "left", "right", "gain", "n_nodes")
 
     def iteration(raw, _):
-        g, h = grad_hess(raw, y, w)
-        s = grow_tree_device(bins, bins_t, g, h, valid_mask,
-                             is_categorical, feat_mask, params,
-                             n_features, n_bins, hist_impl)
-        val = s["value"]
-        if renew_q is not None:
-            rv, rc = renew_leaf_values(s["node_of_row"], y - raw, w,
-                                       valid_mask, max_nodes, renew_q)
-            val = jnp.where((s["feature"] < 0) & (rc > 0), rv, val)
-        shrunk = (val * shrinkage).astype(jnp.float32)
-        raw = raw + shrunk[s["node_of_row"]]
-        emit = {k: s[k] for k in emit_keys}
-        emit["value"] = shrunk
-        return raw, emit
+        pred = raw[:, 0] if K == 1 else raw
+        g, h = grad_hess(pred, y, w)
+        g = g if g.ndim == 2 else g[:, None]
+        h = h if h.ndim == 2 else h[:, None]
+        emits = []
+        for k in range(K):  # static unroll: one tree per model output
+            s = grow_tree_device(bins, bins_t, g[:, k], h[:, k],
+                                 valid_mask, is_categorical, feat_mask,
+                                 params, n_features, n_bins, hist_impl)
+            val = s["value"]
+            if renew_q is not None:  # renewal objectives are all K == 1
+                rv, rc = renew_leaf_values(
+                    s["node_of_row"], y - raw[:, 0], w,
+                    valid_mask, max_nodes, renew_q)
+                val = jnp.where((s["feature"] < 0) & (rc > 0), rv, val)
+            shrunk = (val * shrinkage).astype(jnp.float32)
+            raw = raw.at[:, k].add(shrunk[s["node_of_row"]])
+            emit = {kk: s[kk] for kk in emit_keys}
+            emit["value"] = shrunk
+            emits.append(emit)
+        stacked = {kk: jnp.stack([e[kk] for e in emits])
+                   for kk in emits[0]}
+        return raw, stacked
 
     return jax.lax.scan(iteration, init_raw, None, length=n_iters)
