@@ -582,6 +582,12 @@ class Booster:
             "trees": [[t.to_json() for t in it] for it in self.trees],
         })
 
+    def to_lightgbm_string(self) -> str:
+        """Export as LightGBM's text model format (the reverse of the
+        importer; reference `LightGBMBooster.saveNativeModel`)."""
+        from mmlspark_tpu.gbdt.lgbm_compat import to_lightgbm_text
+        return to_lightgbm_text(self)
+
     @staticmethod
     def from_string(s: str) -> "Booster":
         from mmlspark_tpu.gbdt.lgbm_compat import (

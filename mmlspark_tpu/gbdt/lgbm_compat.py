@@ -168,10 +168,10 @@ def from_lightgbm_text(s: str):
                            num_class=max(num_class, 2)
                            if obj_name == "multiclass" else 2)
     obj = get_objective(obj_name, max(num_class, 2))
+    sigmoid = 1.0
     if obj_name == "binary":
         # the objective spec line carries the trained sigmoid coefficient,
         # e.g. "objective=binary sigmoid:1"; predict = 1/(1+exp(-k*raw))
-        sigmoid = 1.0
         for tok in obj_spec[1:]:
             if tok.startswith("sigmoid:"):
                 sigmoid = float(tok.split(":", 1)[1])
@@ -185,9 +185,118 @@ def from_lightgbm_text(s: str):
                        categorical=[False] * n_features, cat_levels={})
     booster = Booster(params, mapper, obj, names)
     booster.init_score = np.zeros(obj.num_model_outputs)
+    if obj_name == "binary":
+        booster.lgbm_sigmoid = sigmoid  # preserved on re-export
 
     trees = [_convert_tree(b) for b in blocks]
     booster.trees = [trees[i:i + per_iter]
                      for i in range(0, len(trees), per_iter)]
     booster.best_iteration = len(booster.trees) - 1
     return booster
+
+
+def _export_tree(tree: Tree, idx: int, init_shift: float) -> str:
+    """One ``Tree=`` block in LightGBM's node encoding (internal nodes
+    indexed 0.., leaves referenced as ``~leaf_idx``)."""
+    if bool(np.any(tree.categorical[:tree.n_nodes])):
+        raise NotImplementedError(
+            "categorical (bitset) splits cannot be exported to the "
+            "LightGBM text format yet; use save_native_model(path, "
+            "format='json') for models with categorical splits")
+    internal: List[int] = []
+    leaves: List[int] = []
+    order: List[int] = [0]
+    while order:  # preorder: root gets internal index 0
+        n = order.pop()
+        if tree.feature[n] < 0:
+            leaves.append(n)
+        else:
+            internal.append(n)
+            order.append(int(tree.right[n]))
+            order.append(int(tree.left[n]))
+    int_idx = {n: i for i, n in enumerate(internal)}
+    leaf_idx = {n: i for i, n in enumerate(leaves)}
+
+    def child_ref(c: int) -> int:
+        return int_idx[c] if tree.feature[c] >= 0 else ~leaf_idx[c]
+
+    lines = [f"Tree={idx}",
+             f"num_leaves={len(leaves)}",
+             "num_cat=0"]
+    if internal:
+        # decision_type: bit0=0 numerical, bit1=default-left,
+        # bits 2-3 = missing_type NaN (2) — our missing bin holds NaN
+        dt = [8 | (2 if tree.missing_left[n] else 0) for n in internal]
+        lines += [
+            "split_feature=" + " ".join(str(int(tree.feature[n]))
+                                        for n in internal),
+            "split_gain=" + " ".join(f"{float(tree.gain[n]):.17g}"
+                                     for n in internal),
+            "threshold=" + " ".join(f"{float(tree.threshold[n]):.17g}"
+                                    for n in internal),
+            "decision_type=" + " ".join(str(d) for d in dt),
+            "left_child=" + " ".join(str(child_ref(int(tree.left[n])))
+                                     for n in internal),
+            "right_child=" + " ".join(str(child_ref(int(tree.right[n])))
+                                      for n in internal),
+        ]
+    lines += [
+        "leaf_value=" + " ".join(f"{float(tree.value[n]) + init_shift:.17g}"
+                                 for n in leaves),
+        "shrinkage=1",
+        "",
+    ]
+    return "\n".join(lines)
+
+
+def to_lightgbm_text(booster) -> str:
+    """Export a trained :class:`Booster` as a LightGBM text model dump.
+
+    The reverse of :func:`from_lightgbm_text` — the reference's
+    ``saveNativeModel`` direction (`LightGBMBooster.scala:104`): a model
+    trained here can be loaded by LightGBM tooling (and by this
+    importer). LightGBM files carry no separate init score, so the
+    booster's init score is folded into the first tree's leaf values,
+    exactly how LightGBM bakes boost-from-average into leaves.
+    """
+    params = booster.params
+    obj = booster.obj
+    K = obj.num_model_outputs
+    sigmoid = getattr(booster, "lgbm_sigmoid", 1.0)
+    spec = {
+        "binary": f"binary sigmoid:{sigmoid:g}",
+        "regression": "regression",
+        "regression_l1": "regression_l1",
+        "quantile": f"quantile alpha:{params.alpha}",
+        "poisson": "poisson",
+        "tweedie":
+            f"tweedie tweedie_variance_power:{params.tweedie_variance_power}",
+        "multiclass": f"multiclass num_class:{K}",
+    }.get(obj.name)
+    if spec is None:
+        raise ValueError(f"objective {obj.name!r} has no LightGBM "
+                         f"text-format spelling")
+    n_features = len(booster.feature_names)
+    head = [
+        "tree",
+        "version=v3",
+        f"num_class={K if obj.name == 'multiclass' else 1}",
+        f"num_tree_per_iteration={K}",
+        "label_index=0",
+        f"max_feature_idx={n_features - 1}",
+        f"objective={spec}",
+        "feature_names=" + " ".join(booster.feature_names),
+        "feature_infos=" + " ".join(["none"] * n_features),
+        "",
+    ]
+    init = np.asarray(booster.init_score, dtype=np.float64)
+    # export only the trees predict() uses: early-stopped models must
+    # reload (here or in LightGBM tooling) with identical predictions
+    n_iters = (booster.best_iteration + 1
+               if booster.best_iteration >= 0 else len(booster.trees))
+    blocks = []
+    for it, iter_trees in enumerate(booster.trees[:n_iters]):
+        for k, tree in enumerate(iter_trees):
+            shift = float(init[k]) if it == 0 and k < len(init) else 0.0
+            blocks.append(_export_tree(tree, it * K + k, shift))
+    return "\n".join(head) + "\n" + "\n".join(blocks) + "\nend of trees\n"

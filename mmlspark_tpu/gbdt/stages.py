@@ -181,10 +181,19 @@ class _GBDTModelBase(Model, HasFeaturesCol):
     def feature_importances(self, importance_type: str = "split") -> np.ndarray:
         return self.booster.feature_importances(importance_type)
 
-    def save_native_model(self, path: str) -> None:
-        """Parity: LightGBMBooster.saveNativeModel."""
-        with open(path, "w") as f:
-            f.write(self.booster.model_to_string())
+    def save_native_model(self, path: str, format: str = "lightgbm") -> None:
+        """Parity: LightGBMBooster.saveNativeModel (`LightGBMBooster.scala:104`).
+
+        ``format="lightgbm"`` writes LightGBM's text model format, loadable
+        by LightGBM tooling and by :func:`load_native_model`;
+        ``format="json"`` writes this framework's own model string.
+        """
+        if format not in ("lightgbm", "json"):
+            raise ValueError(f"unknown format {format!r}")
+        from mmlspark_tpu.io import fs as _fs
+        text = (self.booster.to_lightgbm_string() if format == "lightgbm"
+                else self.booster.model_to_string())
+        _fs.write_text(path, text)
 
     def _save_extra(self, path, arrays):
         import os

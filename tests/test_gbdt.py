@@ -644,3 +644,110 @@ class TestLeafRenewal:
         b = Booster.train(p, X, y)
         from sklearn.metrics import roc_auc_score
         assert roc_auc_score(y, b.predict(X)) > 0.97
+
+
+class TestLightGBMExport:
+    """LightGBM text-format EXPORT (reverse of the importer; parity:
+    saveNativeModel, `LightGBMBooster.scala:104`)."""
+
+    def _roundtrip(self, p, X, y, **fit_kw):
+        b = Booster.train(p, X, y, **fit_kw)
+        text = b.to_lightgbm_string()
+        from mmlspark_tpu.gbdt.lgbm_compat import is_lightgbm_text
+        assert is_lightgbm_text(text)
+        b2 = Booster.from_string(text)  # auto-detects LightGBM format
+        return b, b2
+
+    def test_regression_roundtrip_exact(self):
+        rng = np.random.default_rng(0)
+        X = rng.normal(size=(600, 8))
+        y = X[:, 0] * 2 - X[:, 1] + 0.1 * rng.normal(size=600)
+        p = BoosterParams(objective="regression", num_iterations=20,
+                          num_leaves=15, seed=0)
+        b, b2 = self._roundtrip(p, X, y)
+        np.testing.assert_allclose(b2.predict(X), b.predict(X),
+                                   rtol=1e-5, atol=1e-6)
+
+    def test_binary_with_nans_roundtrip(self):
+        rng = np.random.default_rng(1)
+        X = rng.normal(size=(600, 6))
+        X[rng.random(X.shape) < 0.1] = np.nan
+        y = (np.nan_to_num(X[:, 0]) + np.nan_to_num(X[:, 1]) > 0
+             ).astype(np.float64)
+        p = BoosterParams(objective="binary", num_iterations=15,
+                          num_leaves=15, min_data_in_leaf=5, seed=0)
+        b, b2 = self._roundtrip(p, X, y)
+        np.testing.assert_allclose(b2.predict(X), b.predict(X),
+                                   rtol=1e-5, atol=1e-6)
+
+    def test_multiclass_roundtrip(self):
+        from sklearn.datasets import load_wine
+        X, y = load_wine(return_X_y=True)
+        p = BoosterParams(objective="multiclass", num_class=3,
+                          num_iterations=10, num_leaves=7,
+                          min_data_in_leaf=3, seed=0)
+        b, b2 = self._roundtrip(p, X, y)
+        np.testing.assert_allclose(b2.predict(X), b.predict(X),
+                                   rtol=1e-4, atol=1e-5)
+
+    def test_categorical_split_export_rejected(self):
+        rng = np.random.default_rng(2)
+        X = rng.normal(size=(400, 4))
+        X[:, 2] = rng.integers(0, 6, 400)
+        y = (X[:, 2] > 2).astype(np.float64)
+        p = BoosterParams(objective="binary", num_iterations=5,
+                          num_leaves=7, min_data_in_leaf=5, seed=0)
+        b = Booster.train(p, X, y, categorical_features=[2])
+        with pytest.raises(NotImplementedError, match="categorical"):
+            b.to_lightgbm_string()
+
+    def test_stage_save_native_model_formats(self, tmp_path):
+        rng = np.random.default_rng(3)
+        X = rng.normal(size=(300, 5))
+        y = (X[:, 0] > 0).astype(np.int64)
+        from mmlspark_tpu.core.dataframe import DataFrame, obj_col
+        df = DataFrame({"features": obj_col([r for r in X]), "label": y})
+        model = GBDTClassifier(num_iterations=8, num_leaves=7,
+                               min_data_in_leaf=5).fit(df)
+        lgb_path = str(tmp_path / "model.txt")
+        model.save_native_model(lgb_path)
+        head = open(lgb_path).read(64)
+        assert head.startswith("tree")
+        from mmlspark_tpu.gbdt import load_native_model
+        loaded = load_native_model(lgb_path, is_classifier=True)
+        out = loaded.transform(df)
+        np.testing.assert_allclose(
+            np.asarray(out["probability"], dtype=np.float64)
+            if "probability" in out.columns else out["prediction"],
+            np.asarray(model.transform(df)["probability"], dtype=np.float64)
+            if "probability" in model.transform(df).columns
+            else model.transform(df)["prediction"], rtol=1e-5, atol=1e-6)
+
+    def test_early_stopped_export_matches_predict(self):
+        rng = np.random.default_rng(4)
+        X = rng.normal(size=(500, 6))
+        y = X[:, 0] + 0.05 * rng.normal(size=500)
+        p = BoosterParams(objective="regression", num_iterations=200,
+                          num_leaves=7, early_stopping_round=3, seed=0)
+        b = Booster.train(p, X[:400], y[:400],
+                          valid_sets=[(X[400:], y[400:])])
+        assert 0 <= b.best_iteration < 199  # actually stopped early
+        b2 = Booster.from_string(b.to_lightgbm_string())
+        np.testing.assert_allclose(b2.predict(X), b.predict(X),
+                                   rtol=1e-5, atol=1e-6)
+
+    def test_imported_sigmoid_survives_reexport(self):
+        rng = np.random.default_rng(5)
+        X = rng.normal(size=(300, 4))
+        y = (X[:, 0] > 0).astype(np.float64)
+        p = BoosterParams(objective="binary", num_iterations=8,
+                          num_leaves=7, min_data_in_leaf=5, seed=0)
+        b = Booster.train(p, X, y)
+        text = b.to_lightgbm_string().replace(
+            "objective=binary sigmoid:1", "objective=binary sigmoid:2")
+        imported = Booster.from_string(text)
+        reexported = Booster.from_string(imported.to_lightgbm_string())
+        np.testing.assert_allclose(reexported.predict(X),
+                                   imported.predict(X),
+                                   rtol=1e-5, atol=1e-6)
+        assert "sigmoid:2" in imported.to_lightgbm_string()
