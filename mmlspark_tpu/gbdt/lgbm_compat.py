@@ -72,6 +72,8 @@ def _parse_blocks(s: str) -> (Dict[str, str], List[Dict[str, str]]):
                                     "feature_importances")):
             continue
         if "=" not in line:
+            if line == "average_output":  # rf marker: a bare header line
+                header["average_output"] = "1"
             continue
         key, _, value = line.partition("=")
         if key == "Tree":
@@ -164,10 +166,19 @@ def from_lightgbm_text(s: str):
     names = header.get("feature_names", "").split() \
         or [f"f{j}" for j in range(n_features)]
 
+    alpha, tweedie_p = 0.9, 1.5
+    for tok in obj_spec[1:]:
+        if tok.startswith("alpha:"):
+            alpha = float(tok.split(":", 1)[1])
+        elif tok.startswith("tweedie_variance_power:"):
+            tweedie_p = float(tok.split(":", 1)[1])
     params = BoosterParams(objective=obj_name,
                            num_class=max(num_class, 2)
-                           if obj_name == "multiclass" else 2)
-    obj = get_objective(obj_name, max(num_class, 2))
+                           if obj_name == "multiclass" else 2,
+                           alpha=alpha, tweedie_variance_power=tweedie_p,
+                           boosting_type="rf" if "average_output" in header
+                           else "gbdt")
+    obj = get_objective(obj_name, max(num_class, 2), alpha, tweedie_p)
     sigmoid = 1.0
     if obj_name == "binary":
         # the objective spec line carries the trained sigmoid coefficient,
@@ -280,6 +291,9 @@ def to_lightgbm_text(booster) -> str:
     head = [
         "tree",
         "version=v3",
+        # rf boosters average tree outputs; LightGBM records this so
+        # scoring sums become means on reload
+        *(["average_output"] if params.boosting_type == "rf" else []),
         f"num_class={K if obj.name == 'multiclass' else 1}",
         f"num_tree_per_iteration={K}",
         "label_index=0",
@@ -294,9 +308,16 @@ def to_lightgbm_text(booster) -> str:
     # reload (here or in LightGBM tooling) with identical predictions
     n_iters = (booster.best_iteration + 1
                if booster.best_iteration >= 0 else len(booster.trees))
+    is_rf = params.boosting_type == "rf"
     blocks = []
     for it, iter_trees in enumerate(booster.trees[:n_iters]):
         for k, tree in enumerate(iter_trees):
-            shift = float(init[k]) if it == 0 and k < len(init) else 0.0
+            # gbdt: fold the init score into the FIRST tree's leaves
+            # (how LightGBM bakes boost-from-average); rf: scores are
+            # AVERAGED, so the init must ride every tree to survive
+            # the division
+            shift = 0.0
+            if k < len(init) and (is_rf or it == 0):
+                shift = float(init[k])
             blocks.append(_export_tree(tree, it * K + k, shift))
     return "\n".join(head) + "\n" + "\n".join(blocks) + "\nend of trees\n"

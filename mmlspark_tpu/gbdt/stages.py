@@ -184,9 +184,11 @@ class _GBDTModelBase(Model, HasFeaturesCol):
     def save_native_model(self, path: str, format: str = "lightgbm") -> None:
         """Parity: LightGBMBooster.saveNativeModel (`LightGBMBooster.scala:104`).
 
-        ``format="lightgbm"`` writes LightGBM's text model format, loadable
-        by LightGBM tooling and by :func:`load_native_model`;
-        ``format="json"`` writes this framework's own model string.
+        ``format="lightgbm"`` (the default since round 2 — previously the
+        json string was written) writes LightGBM's text model format,
+        loadable by LightGBM tooling and by :func:`load_native_model`;
+        models with categorical splits cannot be represented in it and
+        must use ``format="json"`` (this framework's own model string).
         """
         if format not in ("lightgbm", "json"):
             raise ValueError(f"unknown format {format!r}")
@@ -292,9 +294,10 @@ class GBDTRegressionModel(_GBDTModelBase):
 
 def load_native_model(path: str, is_classifier: bool = True,
                       **stage_params):
-    """Parity: python LightGBM*.loadNativeModelFromFile."""
-    with open(path) as f:
-        booster = Booster.from_string(f.read())
+    """Parity: python LightGBM*.loadNativeModelFromFile. Accepts local
+    paths or remote URLs (the save/load pair both go through io.fs)."""
+    from mmlspark_tpu.io import fs as _fs
+    booster = Booster.from_string(_fs.read_text(path))
     cls = GBDTClassificationModel if is_classifier else GBDTRegressionModel
     return cls(booster=booster, **stage_params)
 

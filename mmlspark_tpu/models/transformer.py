@@ -203,24 +203,35 @@ def _rope(x, pos):
     return out
 
 
+def _compute_dtype(cfg: TransformerConfig):
+    return jnp.bfloat16 if cfg.dtype == "bfloat16" else jnp.float32
+
+
 def _attention(bp, x, cfg: TransformerConfig, ax: _Axes, pos):
-    h = _rmsnorm(x, bp["ln1"])
-    q = jnp.einsum("bsd,dhk->bshk", h, bp["wq"])
-    k = jnp.einsum("bsd,dhk->bshk", h, bp["wk"])
-    v = jnp.einsum("bsd,dhk->bshk", h, bp["wv"])
+    # mixed precision: the heavy projections run in cfg.dtype (bf16 hits
+    # the MXU's fast path); rope/softmax and the residual stream stay f32
+    dt = _compute_dtype(cfg)
+    h = _rmsnorm(x, bp["ln1"]).astype(dt)
+    q = jnp.einsum("bsd,dhk->bshk", h, bp["wq"].astype(dt)).astype(jnp.float32)
+    k = jnp.einsum("bsd,dhk->bshk", h, bp["wk"].astype(dt)).astype(jnp.float32)
+    v = jnp.einsum("bsd,dhk->bshk", h, bp["wv"].astype(dt)).astype(jnp.float32)
     q, k = _rope(q, pos), _rope(k, pos)
     if ax.seq:
         a = ring_attention_local(q, k, v, ax.seq, causal=True)
     else:
         a = dense_attention(q, k, v, causal=True)
-    o = jnp.einsum("bshk,hkd->bsd", a, bp["wo"])
+    o = jnp.einsum("bshk,hkd->bsd", a.astype(dt),
+                   bp["wo"].astype(dt)).astype(jnp.float32)
     return _psum_if(o, ax.model)
 
 
-def _mlp(bp, x, ax: _Axes):
-    h = _rmsnorm(x, bp["ln2"])
-    z = jax.nn.relu(jnp.einsum("bsd,df->bsf", h, bp["w1"]) + bp["b1"])
-    y = jnp.einsum("bsf,fd->bsd", z, bp["w2"])
+def _mlp(bp, x, ax: _Axes, cfg: TransformerConfig):
+    dt = _compute_dtype(cfg)
+    h = _rmsnorm(x, bp["ln2"]).astype(dt)
+    z = jax.nn.relu(jnp.einsum("bsd,df->bsf", h, bp["w1"].astype(dt))
+                    + bp["b1"].astype(dt))
+    y = jnp.einsum("bsf,fd->bsd", z,
+                   bp["w2"].astype(dt)).astype(jnp.float32)
     return _psum_if(y, ax.model) + bp["b2"]
 
 
@@ -230,19 +241,25 @@ def _moe(bp, x, cfg: TransformerConfig, ax: _Axes):
     gate selects exactly one expert somewhere on the axis). Dense
     dispatch — production capacity-based all_to_all routing slots in
     here without touching the surrounding sharding."""
+    dt = _compute_dtype(cfg)
     h = _rmsnorm(x, bp["ln2"])
+    # router stays f32 (softmax + argmax routing decisions); the expert
+    # matmuls — the MoE's dominant FLOPs — run in cfg.dtype
     logits = jnp.einsum("bsd,de->bse", h, bp["router"])
     probs = jax.nn.softmax(logits, axis=-1)
     top = jnp.argmax(probs, axis=-1)                     # [b, s]
     topp = jnp.max(probs, axis=-1)
     e_size, e_rank = _size(ax.expert), _index(ax.expert)
     e_local = cfg.n_experts // e_size
+    h_c = h.astype(dt)
     y = jnp.zeros_like(x)
     for e in range(e_local):
         gid = e_rank * e_local + e
         sel = (top == gid).astype(x.dtype) * topp        # [b, s]
-        z = jax.nn.relu(jnp.einsum("bsd,df->bsf", h, bp["ew1"][e]))
-        z = jnp.einsum("bsf,fd->bsd", z, bp["ew2"][e])
+        z = jax.nn.relu(jnp.einsum("bsd,df->bsf", h_c,
+                                   bp["ew1"][e].astype(dt)))
+        z = jnp.einsum("bsf,fd->bsd", z,
+                       bp["ew2"][e].astype(dt)).astype(jnp.float32)
         y = y + z * sel[..., None]
     return _psum_if(y, ax.expert)
 
@@ -254,7 +271,7 @@ def _stage(stage_blocks, x, cfg: TransformerConfig, ax: _Axes, pos):
         if cfg.n_experts:
             x = x + _moe(bp, x, cfg, ax)
         else:
-            x = x + _mlp(bp, x, ax)
+            x = x + _mlp(bp, x, ax, cfg)
     return x
 
 
@@ -295,6 +312,8 @@ def local_loss(params, tokens, labels, mask, cfg: TransformerConfig,
             state = jax.lax.ppermute(state, ax.pipe, perm)
 
     h = _rmsnorm(out.reshape(b_loc, s_loc, cfg.d_model), params["final_norm"])
+    # the vocab head stays f32: casting it saves matmul time but pays
+    # more in up-casting the [b, s, vocab] logits for the softmax
     logits = jnp.einsum("bsd,dv->bsv", h, params["head"])
     logp = jax.nn.log_softmax(logits, axis=-1)
     ce = -jnp.take_along_axis(logp, labels[..., None], axis=-1)[..., 0]

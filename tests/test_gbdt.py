@@ -751,3 +751,46 @@ class TestLightGBMExport:
                                    imported.predict(X),
                                    rtol=1e-5, atol=1e-6)
         assert "sigmoid:2" in imported.to_lightgbm_string()
+
+    def test_rf_export_preserves_averaging(self):
+        rng = np.random.default_rng(6)
+        X = rng.normal(size=(500, 5))
+        y = X[:, 0] * 2 + 0.1 * rng.normal(size=500)
+        p = BoosterParams(objective="regression", boosting_type="rf",
+                          num_iterations=10, num_leaves=7,
+                          bagging_fraction=0.7, bagging_freq=1, seed=0)
+        b = Booster.train(p, X, y)
+        b2 = Booster.from_string(b.to_lightgbm_string())
+        assert b2.params.boosting_type == "rf"
+        np.testing.assert_allclose(b2.predict(X), b.predict(X),
+                                   rtol=1e-5, atol=1e-6)
+
+    def test_quantile_alpha_roundtrips(self):
+        rng = np.random.default_rng(7)
+        X = rng.normal(size=(400, 4))
+        y = X[:, 0] + rng.standard_exponential(400)
+        p = BoosterParams(objective="quantile", alpha=0.5,
+                          num_iterations=8, num_leaves=7, seed=0)
+        b = Booster.train(p, X, y)
+        b2 = Booster.from_string(b.to_lightgbm_string())
+        assert b2.params.alpha == 0.5
+        assert "alpha:0.5" in b2.to_lightgbm_string()
+
+    def test_remote_save_load_native_model(self):
+        import fsspec
+        m = fsspec.filesystem("memory")
+        for k in list(m.store):
+            m.store.pop(k, None)
+        rng = np.random.default_rng(8)
+        X = rng.normal(size=(300, 4))
+        y = (X[:, 0] > 0).astype(np.int64)
+        from mmlspark_tpu.core.dataframe import DataFrame, obj_col
+        df = DataFrame({"features": obj_col([r for r in X]), "label": y})
+        model = GBDTClassifier(num_iterations=5, num_leaves=7,
+                               min_data_in_leaf=5).fit(df)
+        from mmlspark_tpu.gbdt import load_native_model
+        model.save_native_model("memory://models/m.txt")
+        loaded = load_native_model("memory://models/m.txt")
+        out_a = np.asarray(loaded.transform(df)["prediction"])
+        out_b = np.asarray(model.transform(df)["prediction"])
+        np.testing.assert_array_equal(out_a, out_b)
