@@ -54,6 +54,30 @@ def _timed_passes(fn, n_passes: int = 3):
         times.append(time.perf_counter() - t0)
     return float(np.median(times)), float(min(times))
 
+def _chain_slope_seconds(run_chain, n_short: int, n_long: int,
+                         repeats: int = 3) -> float:
+    """Seconds per iteration from dependent-chain timing.
+
+    ``run_chain(n)`` must execute n data-dependent iterations and block
+    on a real value fetch. min-of-N rejects contention hiccups; the
+    long/short slope cancels the fetch round-trip. A non-positive slope
+    means noise swamped the measurement: fall back to the long chain
+    including its fetch RTT (conservative) rather than manufacturing an
+    absurd rate from a clamp.
+    """
+    times = {}
+    for n in (n_short, n_long):
+        run_chain(n)  # warm + compile
+        best = float("inf")
+        for _ in range(repeats):
+            t0 = time.perf_counter()
+            run_chain(n)
+            best = min(best, time.perf_counter() - t0)
+        times[n] = best
+    slope = (times[n_long] - times[n_short]) / (n_long - n_short)
+    return slope if slope > 0 else times[n_long] / n_long
+
+
 
 def bench_gbdt_quantile():
     """Config 1: LightGBMRegressor quantile fit (drug-discovery notebook
@@ -253,23 +277,18 @@ def bench_distributed_sgd():
     # alone returns early on the tunneled backend (see
     # _device_seconds_per_batch); the long/short chain slope cancels the
     # fetch round-trip
-    params, opt_state, loss = step(params, opt_state, x, y, w)  # warm
-    float(loss)
-    times = {}
-    for reps in (2, 22):
-        best = float("inf")
-        for _ in range(3):  # min-of-3 rejects GC/scheduler hiccups
-            t0 = time.perf_counter()
-            for _ in range(reps):
-                params, opt_state, loss = step(params, opt_state, x, y, w)
-            float(loss)
-            best = min(best, time.perf_counter() - t0)
-        times[reps] = best
-    slope = (times[22] - times[2]) / 20
-    # a non-positive slope means noise swamped the measurement: fall
-    # back to the long chain including its fetch RTT (conservative)
-    # rather than manufacturing an absurd rate from a clamp
-    sec_per_step = slope if slope > 0 else times[22] / 22
+    state = {"p": params, "o": opt_state}
+
+    def run_chain(n):
+        for _ in range(n):
+            state["p"], state["o"], loss = step(state["p"], state["o"],
+                                                x, y, w)
+        float(loss)
+
+    # step chains are data-dependent (params/opt_state thread through),
+    # and the scalar loss fetch forces completion — block_until_ready
+    # alone returns early on the tunneled backend
+    sec_per_step = _chain_slope_seconds(run_chain, 2, 22)
     steps_per_sec = 1.0 / sec_per_step
     baseline = 10.0
     return {"metric": "distributed_sgd_step_v2",
@@ -311,14 +330,8 @@ def _device_seconds_per_batch(module, params, x, n_long: int = 22,
         _, sums = jax.lax.scan(body, x, None, length=n)
         return jnp.sum(sums)
 
-    times = {}
-    for n in (n_short, n_long):
-        float(scan_fwd(params, x, n))  # warm + compile
-        t0 = time.perf_counter()
-        for _ in range(repeats):
-            float(scan_fwd(params, x, n))
-        times[n] = (time.perf_counter() - t0) / repeats
-    return max((times[n_long] - times[n_short]) / (n_long - n_short), 1e-9)
+    return _chain_slope_seconds(
+        lambda n: float(scan_fwd(params, x, n)), n_short, n_long, repeats)
 
 
 def bench_imagenet_scoring():
@@ -459,21 +472,15 @@ def bench_transformer_train():
                       mask).compile().cost_analysis() or {}
     flops_per_step = float(cost.get("flops", 0.0))
 
-    params, velocity, loss = step(params, velocity, tokens, labels, mask)
-    float(loss)  # force compile + completion
-    times = {}
-    for reps in (2, 12):
-        best = float("inf")
-        for _ in range(3):
-            t0 = time.perf_counter()
-            for _ in range(reps):
-                params, velocity, loss = step(params, velocity, tokens,
-                                              labels, mask)
-            float(loss)
-            best = min(best, time.perf_counter() - t0)
-        times[reps] = best
-    slope = (times[12] - times[2]) / 10
-    sec_per_step = slope if slope > 0 else times[12] / 12
+    state = {"p": params, "v": velocity}
+
+    def run_chain(n):
+        for _ in range(n):
+            state["p"], state["v"], loss = step(state["p"], state["v"],
+                                                tokens, labels, mask)
+        float(loss)
+
+    sec_per_step = _chain_slope_seconds(run_chain, 2, 12)
 
     tput = batch * seq / sec_per_step
     chip = _chip()
