@@ -274,9 +274,7 @@ def bench_distributed_sgd():
 
     # step chains are data-dependent (params/opt_state thread through),
     # and the scalar loss fetch forces completion — block_until_ready
-    # alone returns early on the tunneled backend (see
-    # _device_seconds_per_batch); the long/short chain slope cancels the
-    # fetch round-trip
+    # alone returns early on the tunneled backend
     state = {"p": params, "o": opt_state}
 
     def run_chain(n):
@@ -285,9 +283,6 @@ def bench_distributed_sgd():
                                                 x, y, w)
         float(loss)
 
-    # step chains are data-dependent (params/opt_state thread through),
-    # and the scalar loss fetch forces completion — block_until_ready
-    # alone returns early on the tunneled backend
     sec_per_step = _chain_slope_seconds(run_chain, 2, 22)
     steps_per_sec = 1.0 / sec_per_step
     baseline = 10.0

@@ -63,13 +63,15 @@ class ServingServer:
                  max_batch_size: int = 64, max_latency_ms: float = 10.0,
                  reply_cols: Optional[List[str]] = None,
                  request_timeout: float = 30.0,
-                 journal_size: int = 4096):
+                 journal_size: int = 4096,
+                 idle_timeout: float = 60.0):
         self.model = model
         self.api_path = api_path
         self.max_batch_size = int(max_batch_size)
         self.max_latency_ms = float(max_latency_ms)
         self.reply_cols = reply_cols
         self.request_timeout = request_timeout
+        self.idle_timeout = float(idle_timeout)
         self._queue: "Queue[_PendingRequest]" = Queue()
         self._stop = threading.Event()
         self._server = _Server((host, port), self._handler_class())
@@ -105,7 +107,10 @@ class ServingServer:
             # handler threads forever.
             protocol_version = "HTTP/1.1"
             disable_nagle_algorithm = True
-            timeout = 60.0
+            # 0/negative means "no reap"; a literal 0 would set a
+            # NON-BLOCKING socket and kill every connection instantly
+            timeout = (serving.idle_timeout
+                       if serving.idle_timeout > 0 else None)
 
             def _reply(self, status: int, body: bytes, replayed=False):
                 self.send_response(status)

@@ -716,3 +716,62 @@ class TestLatencyFirstMode:
             for t in threads:
                 t.join()
             assert sum(Count.batches) == 9
+
+
+class TestKeepAliveReaping:
+    def test_idle_connection_is_reaped(self):
+        import http.client
+        with ServingServer(DoubleIt(), max_latency_ms=0,
+                           idle_timeout=0.3) as srv:
+            conn = http.client.HTTPConnection(srv.host, srv.port, timeout=5)
+            body = json.dumps({"x": 1}).encode()
+            conn.request("POST", srv.api_path, body,
+                         {"Content-Type": "application/json"})
+            assert conn.getresponse().read() == b'{"y": 2.0}'
+            # park the connection past the idle timeout: the server
+            # reaps it, so reusing the old socket fails — proof the
+            # parked handler thread was released
+            time.sleep(0.8)
+            import http.client as hc
+            with pytest.raises((BrokenPipeError, ConnectionError,
+                                hc.RemoteDisconnected, hc.BadStatusLine)):
+                conn.request("POST", srv.api_path, body,
+                             {"Content-Type": "application/json"})
+                conn.getresponse()
+            conn.close()
+            # a fresh connection serves normally
+            conn2 = hc.HTTPConnection(srv.host, srv.port, timeout=5)
+            conn2.request("POST", srv.api_path, body,
+                          {"Content-Type": "application/json"})
+            assert conn2.getresponse().status == 200
+            conn2.close()
+
+    def test_keepalive_reuses_one_connection(self):
+        import http.client
+        with ServingServer(DoubleIt(), max_latency_ms=0) as srv:
+            conn = http.client.HTTPConnection(srv.host, srv.port, timeout=5)
+            for i in range(5):
+                conn.request("POST", srv.api_path,
+                             json.dumps({"x": i}).encode(),
+                             {"Content-Type": "application/json"})
+                resp = conn.getresponse()
+                assert resp.status == 200
+                assert json.loads(resp.read())["y"] == 2.0 * i
+                # HTTP/1.1 + Content-Length => server keeps the socket
+                assert resp.getheader("Connection") != "close"
+            conn.close()
+
+    def test_idle_timeout_zero_disables_reaping(self):
+        import http.client
+        with ServingServer(DoubleIt(), max_latency_ms=0,
+                           idle_timeout=0) as srv:
+            conn = http.client.HTTPConnection(srv.host, srv.port, timeout=5)
+            body = json.dumps({"x": 3}).encode()
+            conn.request("POST", srv.api_path, body,
+                         {"Content-Type": "application/json"})
+            assert conn.getresponse().read() == b'{"y": 6.0}'
+            time.sleep(0.4)  # would be reaped under a short timeout
+            conn.request("POST", srv.api_path, body,
+                         {"Content-Type": "application/json"})
+            assert conn.getresponse().status == 200
+            conn.close()
