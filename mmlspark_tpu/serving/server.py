@@ -64,14 +64,16 @@ class ServingServer:
                  reply_cols: Optional[List[str]] = None,
                  request_timeout: float = 30.0,
                  journal_size: int = 4096,
-                 idle_timeout: float = 60.0):
+                 idle_timeout: Optional[float] = 60.0):
         self.model = model
         self.api_path = api_path
         self.max_batch_size = int(max_batch_size)
         self.max_latency_ms = float(max_latency_ms)
         self.reply_cols = reply_cols
         self.request_timeout = request_timeout
-        self.idle_timeout = float(idle_timeout)
+        # None (stdlib idiom) and <= 0 both mean "no keep-alive reap"
+        self.idle_timeout = (float(idle_timeout)
+                             if idle_timeout is not None else 0.0)
         self._queue: "Queue[_PendingRequest]" = Queue()
         self._stop = threading.Event()
         self._server = _Server((host, port), self._handler_class())

@@ -720,10 +720,10 @@ class TestLatencyFirstMode:
 
 class TestKeepAliveReaping:
     def test_idle_connection_is_reaped(self):
-        import http.client
+        import http.client as hc
         with ServingServer(DoubleIt(), max_latency_ms=0,
                            idle_timeout=0.3) as srv:
-            conn = http.client.HTTPConnection(srv.host, srv.port, timeout=5)
+            conn = hc.HTTPConnection(srv.host, srv.port, timeout=5)
             body = json.dumps({"x": 1}).encode()
             conn.request("POST", srv.api_path, body,
                          {"Content-Type": "application/json"})
@@ -732,7 +732,6 @@ class TestKeepAliveReaping:
             # reaps it, so reusing the old socket fails — proof the
             # parked handler thread was released
             time.sleep(0.8)
-            import http.client as hc
             with pytest.raises((BrokenPipeError, ConnectionError,
                                 hc.RemoteDisconnected, hc.BadStatusLine)):
                 conn.request("POST", srv.api_path, body,
