@@ -181,20 +181,35 @@ class _GBDTModelBase(Model, HasFeaturesCol):
     def feature_importances(self, importance_type: str = "split") -> np.ndarray:
         return self.booster.feature_importances(importance_type)
 
-    def save_native_model(self, path: str, format: str = "lightgbm") -> None:
+    def save_native_model(self, path: str, format: Optional[str] = None) -> None:
         """Parity: LightGBMBooster.saveNativeModel (`LightGBMBooster.scala:104`).
 
-        ``format="lightgbm"`` (the default since round 2 — previously the
-        json string was written) writes LightGBM's text model format,
+        ``format="lightgbm"`` writes LightGBM's text model format,
         loadable by LightGBM tooling and by :func:`load_native_model`;
-        models with categorical splits cannot be represented in it and
-        must use ``format="json"`` (this framework's own model string).
+        ``format="json"`` writes this framework's own model string (also
+        loadable by :func:`load_native_model`). By default (``format=None``)
+        the LightGBM format is written, but models with categorical splits —
+        which that format cannot represent — fall back to json with a
+        warning instead of raising; an explicit ``format="lightgbm"``
+        request on such a model still raises ``NotImplementedError``.
         """
-        if format not in ("lightgbm", "json"):
+        if format not in (None, "lightgbm", "json"):
             raise ValueError(f"unknown format {format!r}")
         from mmlspark_tpu.io import fs as _fs
-        text = (self.booster.to_lightgbm_string() if format == "lightgbm"
-                else self.booster.model_to_string())
+        if format == "json":
+            text = self.booster.model_to_string()
+        else:
+            try:
+                text = self.booster.to_lightgbm_string()
+            except NotImplementedError:
+                if format == "lightgbm":
+                    raise
+                import warnings
+                warnings.warn(
+                    "model has categorical splits, which LightGBM's text "
+                    "format cannot represent; saving format='json' instead "
+                    "(loadable by load_native_model)", stacklevel=2)
+                text = self.booster.model_to_string()
         _fs.write_text(path, text)
 
     def _save_extra(self, path, arrays):

@@ -737,18 +737,27 @@ def renew_leaf_values(node_of_row, residual, weights, sample_mask,
     constant-hessian Newton step alone converges far off the optimum.
 
     One device program per tree, O(n log n) work and O(n + max_nodes)
-    memory: rows are sorted by residual then stably regrouped by leaf,
-    so each leaf is a contiguous residual-ascending segment; the global
-    weight cumsum minus each segment's base gives within-leaf cumulative
-    weights, and a scatter-min picks the first row reaching the target
-    quantile weight.  Returns ``(values (max_nodes,) f32, counts
-    (max_nodes,) f32)``; leaves with zero sampled rows keep their
-    caller-side value (count==0 flags them).
+    memory: rows are sorted by residual then stably regrouped by leaf
+    (zero-weight rows pushed to each segment's tail), so each leaf is a
+    contiguous residual-ascending segment of its weighted rows; the
+    global weight cumsum minus each segment's base gives within-leaf
+    cumulative weights, and a scatter-min picks the first row reaching
+    the target quantile weight. Like LightGBM's ``PercentileFun`` /
+    ``WeightedPercentileFun``, when the target weight falls strictly
+    between two rows' cumulative weights the value is linearly
+    interpolated between the bracketing sorted residuals (a pure
+    ceiling pick drifts high on small leaves). Returns ``(values
+    (max_nodes,) f32, counts (max_nodes,) f32)``; leaves with zero
+    sampled rows keep their caller-side value (count==0 flags them).
     """
     n = residual.shape[0]
     w = jnp.where(sample_mask, weights, 0.0).astype(jnp.float32)
     by_res = jnp.argsort(residual)
-    regroup = jnp.argsort(node_of_row[by_res], stable=True)
+    # key = leaf*2 + (weight==0): zero-weight (unsampled) rows regroup to
+    # the END of their leaf's segment, so a crossing row's predecessor is
+    # always a genuine weighted order statistic of the same leaf
+    zero_tail = (w[by_res] <= 0.0).astype(node_of_row.dtype)
+    regroup = jnp.argsort(node_of_row[by_res] * 2 + zero_tail, stable=True)
     order = by_res[regroup]
     sorted_leaf = node_of_row[order]
     sorted_w = w[order]
@@ -764,11 +773,23 @@ def renew_leaf_values(node_of_row, residual, weights, sample_mask,
     cw_in = cumw - seg_base                           # within-leaf cumsum
 
     tot = jnp.zeros(max_nodes, jnp.float32).at[sorted_leaf].add(sorted_w)
-    target = jnp.maximum(q * tot[sorted_leaf], 1e-12)
+    target_leaf = jnp.maximum(q * tot, 1e-12)
     pos = jnp.arange(n, dtype=jnp.int32)
     idx = jnp.full(max_nodes, n, jnp.int32).at[sorted_leaf].min(
-        jnp.where(cw_in >= target, pos, n))
-    values = sorted_res[jnp.minimum(idx, n - 1)]
+        jnp.where(cw_in >= target_leaf[sorted_leaf], pos, n))
+    first = jnp.full(max_nodes, n, jnp.int32).at[sorted_leaf].min(pos)
+    idx_c = jnp.minimum(idx, n - 1)
+    v_hi = sorted_res[idx_c]
+    # interpolate toward the previous order statistic when the target
+    # falls between the two rows' cumulative weights; the segment's
+    # first row has no predecessor and is returned as-is
+    prev = jnp.maximum(idx_c - 1, 0)
+    has_prev = idx_c > first
+    cw_lo = jnp.where(has_prev, cw_in[prev], 0.0)
+    v_lo = jnp.where(has_prev, sorted_res[prev], v_hi)
+    denom = jnp.maximum(cw_in[idx_c] - cw_lo, 1e-12)
+    bias = jnp.clip((target_leaf - cw_lo) / denom, 0.0, 1.0)
+    values = v_lo + bias * (v_hi - v_lo)
     counts = jnp.zeros(max_nodes, jnp.float32).at[sorted_leaf].add(
         (sorted_w > 0).astype(jnp.float32))
     return values, counts

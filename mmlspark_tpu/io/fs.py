@@ -93,9 +93,9 @@ def write_bytes(path: str, data: bytes) -> None:
 
 
 def read_text(path: str) -> str:
-    with open_file(path, "r" if not is_remote(path) else "rb") as f:
-        data = f.read()
-    return data.decode() if isinstance(data, bytes) else data
+    # Always read binary + decode UTF-8 so read_text/write_text are
+    # symmetric regardless of the host locale.
+    return read_bytes(path).decode("utf-8")
 
 
 def write_text(path: str, text: str) -> None:

@@ -455,15 +455,17 @@ class BingImageSource:
                     terms.append(str(term))
                     images.append(img)
             if not terms:
-                # empty page != failed page: if every term errored, this
-                # is an outage, not exhaustion — don't silently drop the
-                # remaining pages
+                # empty page != failed page: exhaustion is only when every
+                # term came back empty WITHOUT error. Any errored term on a
+                # zero-row page means remaining pages may exist — raise
+                # rather than silently dropping them (partial outages
+                # previously masqueraded as end-of-stream).
                 errs = [e for e in out[stage.error_col] if e is not None]
-                if errs and len(errs) == len(self.search_terms):
+                if errs:
                     raise IOError(
-                        f"image-search batch failed for all "
-                        f"{len(errs)} terms at offset {self._offset}: "
-                        f"{errs[0]}")
+                        f"image-search batch failed for {len(errs)}/"
+                        f"{len(self.search_terms)} terms at offset "
+                        f"{self._offset}: {errs[0]}")
                 return
             self._offset += self.imgs_per_batch
             yielded += 1

@@ -27,8 +27,11 @@ from typing import Any, Dict, List, Optional, Tuple
 import numpy as np
 
 from mmlspark_tpu.core.dataframe import DataFrame
+from mmlspark_tpu.core.logs import get_logger
 from mmlspark_tpu.core.serialize import _jsonify
 from mmlspark_tpu.core.stage import Transformer
+
+logger = get_logger("serving")
 
 
 class _Server(ThreadingHTTPServer):
@@ -64,6 +67,7 @@ class ServingServer:
                  reply_cols: Optional[List[str]] = None,
                  request_timeout: float = 30.0,
                  journal_size: int = 4096,
+                 journal_ttl: Optional[float] = None,
                  idle_timeout: Optional[float] = 60.0):
         self.model = model
         self.api_path = api_path
@@ -86,12 +90,30 @@ class ServingServer:
         # client-supplied X-Request-Id keys a committed-reply journal, so
         # a retried/re-submitted request returns the SAME reply without
         # re-running inference; retries racing the original join its
-        # in-flight entry instead of enqueuing a second compute
+        # in-flight entry instead of enqueuing a second compute.
+        #
+        # The journal is a bounded window, not an infinite log: entries
+        # are evicted beyond ``journal_size`` commits (LRU) or after
+        # ``journal_ttl`` seconds. A retry landing AFTER its entry was
+        # evicted cannot be deduplicated — it re-executes. To make that
+        # window *observable* rather than silent, evicted ids are kept in
+        # a cheap id-only ring (16x journal_size); a rid seen there is a
+        # detected past-window retry: it re-executes with a warning log,
+        # an ``X-Replay-Window-Missed: 1`` response header, and the
+        # ``n_window_missed`` counter (surfaced via ``GET /status``).
         self.journal_size = int(journal_size)
-        self._journal: "OrderedDict[str, Tuple[int, bytes]]" = OrderedDict()
+        # 0/negative means "no age-out", matching idle_timeout's idiom
+        self.journal_ttl = (float(journal_ttl)
+                            if journal_ttl is not None and journal_ttl > 0
+                            else None)
+        self._journal: "OrderedDict[str, Tuple[int, bytes, float]]" = \
+            OrderedDict()
+        self._evicted: "OrderedDict[str, None]" = OrderedDict()
         self._inflight: Dict[str, _PendingRequest] = {}
         self._commit_lock = threading.Lock()
         self.n_replayed = 0
+        self.n_journal_evicted = 0
+        self.n_window_missed = 0
 
     # -- HTTP side -----------------------------------------------------------
 
@@ -114,14 +136,34 @@ class ServingServer:
             timeout = (serving.idle_timeout
                        if serving.idle_timeout > 0 else None)
 
-            def _reply(self, status: int, body: bytes, replayed=False):
+            def _reply(self, status: int, body: bytes, replayed=False,
+                       window_missed=False):
                 self.send_response(status)
                 self.send_header("Content-Type", "application/json")
                 if replayed:
                     self.send_header("X-Replayed", "1")
+                if window_missed:
+                    self.send_header("X-Replay-Window-Missed", "1")
                 self.send_header("Content-Length", str(len(body)))
                 self.end_headers()
                 self.wfile.write(body)
+
+            def do_GET(self):
+                if self.path != "/status":
+                    self.send_error(404)
+                    return
+                with serving._commit_lock:
+                    status = {
+                        "n_requests": serving.n_requests,
+                        "n_batches": serving.n_batches,
+                        "n_replayed": serving.n_replayed,
+                        "n_journal_evicted": serving.n_journal_evicted,
+                        "n_window_missed": serving.n_window_missed,
+                        "journal_entries": len(serving._journal),
+                        "journal_size": serving.journal_size,
+                        "journal_ttl": serving.journal_ttl,
+                    }
+                self._reply(200, json.dumps(status).encode())
 
             def do_POST(self):
                 if self.path != serving.api_path:
@@ -135,21 +177,39 @@ class ServingServer:
                     return
 
                 rid = self.headers.get("X-Request-Id")
+                window_missed = False
                 if rid:
                     with serving._commit_lock:
+                        serving._reap_expired_locked()
                         committed = serving._journal.get(rid)
                         pending = (serving._inflight.get(rid)
                                    if committed is None else None)
                         if committed is None and pending is None:
+                            # request ids are unique per logical request,
+                            # so a rid in the evicted ring can only be a
+                            # retry that outlived the replay window —
+                            # detected, warned, and re-executed (the
+                            # documented past-window semantics)
+                            window_missed = rid in serving._evicted
+                            if window_missed:
+                                serving.n_window_missed += 1
                             pending = _PendingRequest(payload, rid)
                             serving._inflight[rid] = pending
                             enqueue = True
                         else:
                             enqueue = False
+                        if committed is not None:
+                            serving.n_replayed += 1
                     if committed is not None:
-                        serving.n_replayed += 1
-                        self._reply(*committed, replayed=True)
+                        self._reply(committed[0], committed[1],
+                                    replayed=True)
                         return
+                    if window_missed:
+                        logger.warning(
+                            "request id %s retried after its journal "
+                            "entry was evicted (journal_size=%d, "
+                            "journal_ttl=%s); re-executing", rid,
+                            serving.journal_size, serving.journal_ttl)
                 else:
                     pending, enqueue = _PendingRequest(payload), True
 
@@ -162,7 +222,8 @@ class ServingServer:
                 # actually committed — errors are never journaled, so
                 # they must not carry the committed-replay marker
                 self._reply(pending.status, pending.reply or b"{}",
-                            replayed=not enqueue and pending.status == 200)
+                            replayed=not enqueue and pending.status == 200,
+                            window_missed=window_missed)
 
             def log_message(self, *args):  # quiet
                 pass
@@ -225,6 +286,28 @@ class ServingServer:
         self.n_batches += 1
         self.n_requests += len(batch)
 
+    def _evict_locked(self, rid: str) -> None:
+        # remember the id (not the reply) so a past-window retry is
+        # detectable; ids are ~64 bytes vs whole reply bodies, so the
+        # ring can be much deeper than the journal. pop-then-insert so a
+        # re-evicted id restarts its ring lifetime at the tail
+        self._evicted.pop(rid, None)
+        self._evicted[rid] = None
+        self.n_journal_evicted += 1
+        while len(self._evicted) > 16 * self.journal_size:
+            self._evicted.popitem(last=False)
+
+    def _reap_expired_locked(self) -> None:
+        if self.journal_ttl is None:
+            return
+        horizon = time.monotonic() - self.journal_ttl
+        while self._journal:
+            rid, entry = next(iter(self._journal.items()))
+            if entry[2] >= horizon:
+                break
+            self._journal.popitem(last=False)
+            self._evict_locked(rid)
+
     def _commit(self, p: _PendingRequest) -> None:
         """Commit a reply, then release waiters. Successful replies are
         journaled under the client request id (exactly-once); errors are
@@ -232,9 +315,12 @@ class ServingServer:
         with self._commit_lock:
             if self._inflight.pop(p.rid, None) is not None \
                     and p.status == 200:
-                self._journal[p.rid] = (p.status, p.reply or b"{}")
+                self._journal[p.rid] = (p.status, p.reply or b"{}",
+                                        time.monotonic())
                 while len(self._journal) > self.journal_size:
-                    self._journal.popitem(last=False)
+                    old_rid, _ = self._journal.popitem(last=False)
+                    self._evict_locked(old_rid)
+            self._reap_expired_locked()
         p.event.set()
 
     def _batch_loop(self):
@@ -368,6 +454,14 @@ class ServingClient:
     Workers that refuse connections are skipped until the next
     :meth:`refresh`. Parity: the reference's clients round-robin the
     `/services` list of `DriverServiceUtils` (`HTTPSourceV2.scala:111`).
+
+    Dedup scope: the reply journal lives in each worker, so replay
+    dedup is **per worker** — a retry that lands on a *different* worker
+    re-runs inference there. To keep the common slow-worker case
+    exactly-once, a ``requests.Timeout`` is retried once on the SAME
+    worker (whose journal can replay the reply) before failing over;
+    only connection failures (worker dead) fail over immediately, where
+    re-execution on a new worker is the intended at-least-once fallback.
     """
 
     def __init__(self, coordinator_url: str, api_path: str = "/predict",
@@ -400,15 +494,21 @@ class ServingClient:
         for _ in range(len(alive)):
             url = alive[self._rr % len(alive)]
             self._rr += 1
-            try:
-                r = requests.post(url, json=payload, timeout=self.timeout,
-                                  headers={"X-Request-Id": rid})
-                r.raise_for_status()
-                return r.json()
-            except (requests.ConnectionError, requests.Timeout) as e:
-                # worker unreachable: fail over to the next one (the
-                # shared request id makes the retry idempotent)
-                self._dead.add(url)
-                last_err = e
+            # attempt 0, plus one same-worker retry after a timeout: the
+            # worker may be alive-but-slow, and only ITS journal can
+            # replay the reply without re-running inference
+            for attempt in range(2):
+                try:
+                    r = requests.post(url, json=payload,
+                                      timeout=self.timeout,
+                                      headers={"X-Request-Id": rid})
+                    r.raise_for_status()
+                    return r.json()
+                except requests.ConnectionError as e:
+                    last_err = e
+                    break  # worker dead: fail over immediately
+                except requests.Timeout as e:
+                    last_err = e
+            self._dead.add(url)
         raise RuntimeError(
             f"all {len(alive)} serving workers unreachable") from last_err

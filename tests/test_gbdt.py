@@ -607,9 +607,17 @@ class TestLeafRenewal:
                 continue
             r, ww = res[m], w[m]
             o = np.argsort(r)
-            cw = np.cumsum(ww[o])
-            expect = r[o][np.searchsorted(cw, q * cw[-1])]
-            np.testing.assert_allclose(vals[leaf], expect, rtol=1e-5)
+            rs, cw = r[o], np.cumsum(ww[o])
+            t = q * cw[-1]
+            pos = int(np.searchsorted(cw, t))
+            if pos == 0:
+                expect = rs[0]
+            else:  # linear interpolation between bracketing order stats
+                bias = np.clip((t - cw[pos - 1]) / (cw[pos] - cw[pos - 1]),
+                               0.0, 1.0)
+                expect = rs[pos - 1] + bias * (rs[pos] - rs[pos - 1])
+            np.testing.assert_allclose(vals[leaf], expect,
+                                       rtol=1e-4, atol=1e-5)
 
     def test_quantile_coverage_calibrated(self):
         rng = np.random.default_rng(0)
@@ -722,6 +730,34 @@ class TestLightGBMExport:
             np.asarray(model.transform(df)["probability"], dtype=np.float64)
             if "probability" in model.transform(df).columns
             else model.transform(df)["prediction"], rtol=1e-5, atol=1e-6)
+
+    def test_default_save_falls_back_to_json_for_categorical(self, tmp_path):
+        # ADVICE r2: categorical-split models must not raise under the
+        # DEFAULT save format — they fall back to json with a warning;
+        # an explicit format="lightgbm" request still raises
+        rng = np.random.default_rng(6)
+        X = rng.normal(size=(300, 4))
+        X[:, 2] = rng.integers(0, 5, 300)
+        y = (X[:, 2] > 2).astype(np.int64)
+        from mmlspark_tpu.core.dataframe import DataFrame, obj_col
+        df = DataFrame({"features": obj_col([r for r in X]), "label": y})
+        model = GBDTClassifier(num_iterations=5, num_leaves=7,
+                               min_data_in_leaf=5,
+                               categorical_feature_indexes=[2]).fit(df)
+        assert any(t.categorical[:t.n_nodes].any()
+                   for it in model.booster.trees
+                   for t in it), "no categorical split"
+        path = str(tmp_path / "cat_model.txt")
+        with pytest.warns(UserWarning, match="categorical"):
+            model.save_native_model(path)          # default format
+        from mmlspark_tpu.gbdt import load_native_model
+        loaded = load_native_model(path, is_classifier=True)
+        np.testing.assert_allclose(
+            np.asarray(loaded.transform(df)["probability"], np.float64),
+            np.asarray(model.transform(df)["probability"], np.float64),
+            rtol=1e-6)
+        with pytest.raises(NotImplementedError, match="categorical"):
+            model.save_native_model(path, format="lightgbm")
 
     def test_early_stopped_export_matches_predict(self):
         rng = np.random.default_rng(4)

@@ -474,6 +474,55 @@ class TestExactlyOnce:
                 requests.post(srv.address, json={"x": i},
                               headers={"X-Request-Id": f"r{i}"}, timeout=10)
             assert len(srv._journal) <= 4
+            assert srv.n_journal_evicted == 6
+
+    def test_retry_beyond_window_is_detected_and_reexecuted(self):
+        # a retry whose journal entry was LRU-evicted cannot be replayed;
+        # it must RE-EXECUTE but be *detected* (header + counter), never
+        # silently treated as a fresh request
+        model, calls = self._counting_model()
+        with ServingServer(model, max_latency_ms=5,
+                           journal_size=2) as srv:
+            requests.post(srv.address, json={"x": 1},
+                          headers={"X-Request-Id": "old"}, timeout=10)
+            for i in range(4):   # push "old" out of the window
+                requests.post(srv.address, json={"x": i},
+                              headers={"X-Request-Id": f"new{i}"},
+                              timeout=10)
+            r = requests.post(srv.address, json={"x": 1},
+                              headers={"X-Request-Id": "old"}, timeout=10)
+            assert r.status_code == 200 and r.json() == {"y": 2.0}
+            assert "X-Replayed" not in r.headers
+            assert r.headers.get("X-Replay-Window-Missed") == "1"
+            assert srv.n_window_missed == 1
+            assert sum(calls) == 6          # old ran twice — documented
+
+    def test_journal_ttl_expires_entries(self):
+        model, calls = self._counting_model()
+        with ServingServer(model, max_latency_ms=5,
+                           journal_ttl=0.2) as srv:
+            h = {"X-Request-Id": "ttl-1"}
+            requests.post(srv.address, json={"x": 3}, headers=h, timeout=10)
+            time.sleep(0.4)
+            r = requests.post(srv.address, json={"x": 3}, headers=h,
+                              timeout=10)
+            assert r.headers.get("X-Replay-Window-Missed") == "1"
+            assert sum(calls) == 2
+
+    def test_status_endpoint_surfaces_counters(self):
+        model, _ = self._counting_model()
+        with ServingServer(model, max_latency_ms=5,
+                           journal_size=2) as srv:
+            for i in range(5):
+                requests.post(srv.address, json={"x": i},
+                              headers={"X-Request-Id": f"s{i}"}, timeout=10)
+            base = srv.address.rsplit("/", 1)[0]
+            s = requests.get(f"{base}/status", timeout=10).json()
+            assert s["n_requests"] == 5
+            assert s["journal_entries"] <= 2
+            assert s["n_journal_evicted"] == 3
+            assert s["journal_size"] == 2
+            assert "n_window_missed" in s and "n_replayed" in s
 
 
 WORKER_SCRIPT = """
@@ -684,6 +733,40 @@ class TestBingImageSource:
         url, _ = paging_server
         src = BingImageSource(["x"], url=url, imgs_per_batch=2)
         assert len(list(src.batches(max_batches=1))) == 1
+
+    def test_partial_failure_raises_not_exhausts(self):
+        # ADVICE r2: a zero-row page where only SOME terms errored must
+        # raise (remaining pages may exist), not end the stream
+        from mmlspark_tpu.io.services import BingImageSource
+
+        class Handler(BaseHTTPRequestHandler):
+            def do_GET(self):
+                from urllib.parse import parse_qs, urlparse
+                term = parse_qs(urlparse(self.path).query).get("q", [""])[0]
+                if term == "bad":
+                    self.send_error(500, "boom")
+                    return
+                body = json.dumps({"value": []}).encode()
+                self.send_response(200)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def log_message(self, *args):
+                pass
+
+        server = ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+        t = threading.Thread(target=server.serve_forever, daemon=True)
+        t.start()
+        try:
+            url = f"http://127.0.0.1:{server.server_address[1]}/images"
+            src = BingImageSource(["ok", "bad"], url=url, imgs_per_batch=2)
+            with pytest.raises(IOError, match="1/2 terms"):
+                list(src.batches())
+        finally:
+            server.shutdown()
+            server.server_close()
 
 
 class TestLatencyFirstMode:
