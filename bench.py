@@ -15,6 +15,7 @@ Configs (BASELINE.md "Target configs"):
   1. gbdt_quantile_fit_v2        — drug-discovery-shape quantile fit wall-clock
   2. adult_census_fit_v2         — census-shape binary fit (data-parallel learner)
   3. cifar10_scoring_v2          — ResNet-20 scoring images/sec/chip (+ device-only)
+     cifar10_scoring_u8_v1       — same pipeline on uint8 images, on-device normalize
   4. transfer_learning_e2e_v2    — ImageFeaturizer + TrainClassifier end-to-end
   5. distributed_sgd_step_v2     — sharded train-step throughput (steps/sec)
 
@@ -189,7 +190,68 @@ def bench_cifar10_scoring():
     return {"metric": "cifar10_scoring_v2", "value": round(med_tput, 1),
             "unit": "images/sec/chip", "best": round(best_tput, 1),
             "device_only": round(dev_tput, 1),
+            "uplink_mb_per_s": _uplink_mb_per_s(),
             "baseline": baseline, "vs_baseline": round(med_tput / baseline, 3),
+            "chip": _chip()}
+
+
+def _uplink_mb_per_s(nbytes: int = 16 << 20) -> float:
+    """Measured host->device link bandwidth (MB/s), reported alongside
+    transfer-bound metrics: on a tunneled dev chip the link (not the
+    framework) sets the pipeline ceiling — e.g. 10k CIFAR images as bf16
+    are 60 MB, so a 5 MB/s link caps the full pipeline at ~850 img/s no
+    matter how the chip performs."""
+    import jax.numpy as jnp
+    x = np.random.default_rng(0).integers(
+        0, 255, size=nbytes, dtype=np.uint8)
+    d = jnp.asarray(x[:1024]); float(d[0])          # warm path
+    t0 = time.perf_counter()
+    d = jnp.asarray(x)
+    float(d[0])                                     # force completion
+    return round(nbytes / 1e6 / (time.perf_counter() - t0), 2)
+
+
+def bench_cifar10_scoring_uint8():
+    """Config 3b: the same ResNet-20 scoring pipeline fed what CIFAR
+    actually is — uint8 RGB images — with normalization fused into the
+    jitted forward (``NNModel(input_dtype="uint8")``). The reference
+    pipeline also ingests byte images and normalizes inside the
+    pipeline (`ImageTransformer` -> `CNTKModel`); shipping bytes and
+    dequantizing on device is the TPU-first shape of that stage, and it
+    cuts link traffic 2x vs bf16 / 4x vs f32. Same model, batching, and
+    median-of-3 methodology as ``cifar10_scoring_v2``; baseline is the
+    same 1000 img/s GPU-VM ballpark."""
+    import jax
+    from mmlspark_tpu.models.function import NNFunction
+    from mmlspark_tpu.models.nn import NNModel
+    from mmlspark_tpu.core.dataframe import DataFrame
+
+    batch, n_images = 1024, 10_240
+    model = NNFunction.init(
+        {"builder": "cifar_resnet", "depth": 20, "dtype": "bfloat16"},
+        input_shape=(32, 32, 3), seed=0)
+    rng = np.random.default_rng(0)
+    images = rng.integers(0, 256, size=(n_images, 32, 32, 3),
+                          dtype=np.uint8)
+    df = DataFrame({"image": images})
+    scorer = NNModel(model=model, input_col="image", output_col="scores",
+                     batch_size=batch, input_dtype="uint8")
+    scorer.transform(df.head(batch))  # warm: compile + first dispatch
+
+    out = {}
+
+    def run():
+        out["scores"] = scorer.transform(df)["scores"]
+    median, best = _timed_passes(run, n_passes=3)
+    assert out["scores"].shape == (n_images, 10)
+    n_chips = max(len(jax.devices()), 1)
+    baseline = 1000.0
+    med_tput = n_images / median / n_chips
+    return {"metric": "cifar10_scoring_u8_v1", "value": round(med_tput, 1),
+            "unit": "images/sec/chip",
+            "best": round(n_images / best / n_chips, 1),
+            "baseline": baseline,
+            "vs_baseline": round(med_tput / baseline, 3),
             "chip": _chip()}
 
 
@@ -463,9 +525,21 @@ def bench_transformer_train():
     tokens, labels, mask = T.make_batch(rng, cfg, batch, seq)
     step = T.build_spmd_train_step(cfg, mesh, learning_rate=0.01)
 
-    cost = step.lower(params, velocity, tokens, labels,
-                      mask).compile().cost_analysis() or {}
-    flops_per_step = float(cost.get("flops", 0.0))
+    # analytic train FLOPs (PaLM-appendix style): 6 x matmul-params x
+    # tokens + 12 x L x b x s^2 x d_attn for attention. XLA's
+    # cost_analysis matches this within ~1% on the all-XLA graph but
+    # cannot see inside pallas_call, so with the flash kernel in the
+    # path it would under-count; the analytic number is dtype- and
+    # kernel-independent (it is cross-checked against cost_analysis in
+    # tests/test_entry.py-adjacent benches when the path is pure XLA).
+    L = cfg.n_stages * cfg.layers_per_stage
+    d_attn = cfg.n_heads * cfg.d_head
+    n_matmul = (cfg.d_model * cfg.vocab                  # vocab head
+                + L * (4 * cfg.d_model * d_attn          # qkv + o proj
+                       + 2 * cfg.d_model * cfg.d_ff))    # mlp
+    tokens_per_step = batch * seq
+    flops_per_step = (6.0 * n_matmul * tokens_per_step
+                      + 12.0 * L * batch * seq * seq * d_attn)
 
     state = {"p": params, "v": velocity}
 
@@ -497,9 +571,9 @@ def bench_transformer_train():
 
 
 BENCHES = [bench_gbdt_quantile, bench_adult_census, bench_cifar10_scoring,
-           bench_imagenet_scoring, bench_transfer_learning,
-           bench_distributed_sgd, bench_serving_latency,
-           bench_transformer_train]
+           bench_cifar10_scoring_uint8, bench_imagenet_scoring,
+           bench_transfer_learning, bench_distributed_sgd,
+           bench_serving_latency, bench_transformer_train]
 
 
 def main() -> None:

@@ -1,0 +1,69 @@
+"""Plain-regression pipeline: Featurize -> TrainRegressor -> statistics.
+
+Reference pipeline: `notebooks/samples/Regression - Flight Delays.ipynb`
+— read the flight-delays table, `TrainRegressor` with an auto-featurized
+regressor, score, and `ComputeModelStatistics`/`ComputePerInstance
+Statistics` on the predictions. Here the table is a synthetic
+flight-delays-shaped frame (carrier/origin/dest categoricals + schedule
+numerics), the regressor is the TPU GBDT, and featurization (value
+indexing + assembly) happens inside TrainRegressor exactly like the
+reference's `TrainRegressor` does.
+"""
+
+import numpy as np
+
+from _common import setup_devices, timed
+
+
+def main():
+    setup_devices()
+    from mmlspark_tpu.core.dataframe import DataFrame
+    from mmlspark_tpu.gbdt import GBDTRegressor
+    from mmlspark_tpu.automl.train import TrainRegressor
+    from mmlspark_tpu.automl.metrics import (
+        ComputeModelStatistics, ComputePerInstanceStatistics)
+
+    rng = np.random.default_rng(0)
+    n = 6000
+    carriers = np.array(["AA", "DL", "UA", "WN", "B6"])
+    airports = np.array([f"AP{i}" for i in range(12)])
+    carrier = rng.choice(carriers, n)
+    origin = rng.choice(airports, n)
+    dest = rng.choice(airports, n)
+    dep_hour = rng.integers(5, 23, n).astype(np.float64)
+    distance = rng.uniform(150, 2500, n)
+    day_of_week = rng.integers(1, 8, n).astype(np.float64)
+    # delays: evening rush + long-haul + carrier effects + noise
+    delay = (4.0 * np.maximum(dep_hour - 15, 0)
+             + 0.006 * distance
+             + 10.0 * (carrier == "B6")
+             + 5.0 * np.isin(day_of_week, [5, 7])
+             + rng.gamma(2.0, 4.0, n) - 8.0)
+    df = DataFrame({"carrier": carrier, "origin": origin, "dest": dest,
+                    "dep_hour": dep_hour, "distance": distance,
+                    "day_of_week": day_of_week, "arr_delay": delay})
+    train, test = df.head(5000), df.take(np.arange(5000, n))
+
+    reg = TrainRegressor(
+        model=GBDTRegressor(num_iterations=60, num_leaves=31,
+                            min_data_in_leaf=10),
+        label_col="arr_delay")
+    with timed() as t:
+        model = reg.fit(train)
+    scored = model.transform(test)
+
+    stats = ComputeModelStatistics(label_col="arr_delay").evaluate(scored)
+    row = {c: float(stats[c][0]) for c in stats.columns}
+    per_row = ComputePerInstanceStatistics(
+        label_col="arr_delay").evaluate(scored)
+    worst = float(np.sort(per_row["L1_loss"])[-10:].mean())
+    print(f"fit {train.num_rows} flights in {t.seconds:.2f}s; "
+          f"test RMSE={row['root_mean_squared_error']:.2f} min, "
+          f"R^2={row['R^2']:.3f}, "
+          f"mean|err|={float(np.mean(per_row['L1_loss'])):.2f}, "
+          f"10-worst|err|={worst:.1f}")
+    assert row["R^2"] > 0.5
+
+
+if __name__ == "__main__":
+    main()

@@ -132,8 +132,11 @@ class Featurize(Estimator, HasOutputCol):
                               "levels": list(levels)})
             elif (meta or {}).get("datetime"):
                 plans.append({"col": name, "kind": "datetime"})
-            elif col.dtype == np.dtype("O") and col.ndim == 1 and (
-                    not len(col) or isinstance(_first_non_null(col), str)):
+            elif col.ndim == 1 and (
+                    col.dtype.kind in ("U", "S")   # numpy str columns
+                    or (col.dtype == np.dtype("O") and (
+                        not len(col)
+                        or isinstance(_first_non_null(col), str)))):
                 distinct = {v for v in col if v is not None}
                 if len(distinct) < min(self.number_of_features, 100):
                     lv = sorted(distinct)

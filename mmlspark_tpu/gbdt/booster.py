@@ -284,28 +284,85 @@ class Booster:
         # needs the host: plain gbdt (any small K; the scan body unrolls
         # K tree growers, so huge class counts would balloon compile
         # time and keep the cached per-tree path instead), no
-        # row/feature sampling, no validation/early-stopping/logging
+        # row/feature sampling, no per-iteration logging. Early stopping
+        # IS eligible: validation rows ride the scan (appended + masked,
+        # metric evaluated on device — the reference's in-native eval
+        # loop, `TrainUtils.scala:105-145`) and the host replays the
+        # stopping rule on the fetched metric series, so an
+        # early-stopping fit still pays exactly one fetch.
+        es_active = bool(valid_sets) and params.early_stopping_round > 0
+        device_metric = None
+        if es_active and not log_every and len(valid_sets) == 1 \
+                and len(valid_sets[0][0]) > 0 \
+                and init_model is None and sharding is None:
+            from mmlspark_tpu.gbdt.device_metrics import get_device_metric
+            device_metric = get_device_metric(
+                metric_name, obj, params.alpha,
+                params.tweedie_variance_power)
         fused = (params.boosting_type == "gbdt" and K <= 16
                  and tree_learner == "data" and grower._voting_fn is None
                  and params.bagging_fraction >= 1.0
                  and params.feature_fraction >= 1.0
-                 and not valid_sets and not log_every)
+                 and (not es_active or device_metric is not None)
+                 and not log_every)
         if fused:
             from mmlspark_tpu.gbdt.tree import (boost_loop_device,
                                                 tree_from_arrays)
-            bins_t = (grower._get_bins_t(bins)
+            n_valid = 0
+            bins_dev, y_fit, w_fit, mask_fit, raw_fit = \
+                bins, y_dev, w, put(valid_rows), raw.astype(jnp.float32)
+            if device_metric is not None:
+                # validation rows become the tail of the row set: masked
+                # out of histograms/renewal, routed (and scored) for free
+                vX = np.asarray(valid_sets[0][0], dtype=np.float64)
+                vy_np = np.asarray(valid_sets[0][1], dtype=np.float32)
+                n_valid = len(vX)
+                vbins = mapper.transform(vX)
+                bins_dev = put(np.concatenate([bins_np, vbins]))
+                y_fit = put(np.concatenate([y_np, vy_np]))
+                w_fit = put(np.concatenate(
+                    [w_np, np.ones(n_valid, np.float32)]))
+                mask_fit = put(np.concatenate(
+                    [valid_rows, np.zeros(n_valid, bool)]))
+                raw_fit = put(np.broadcast_to(
+                    np.asarray(booster.init_score, np.float32)[None, :],
+                    (n_padded + n_valid, K)).copy())
+            bins_t = (grower._get_bins_t(bins_dev)
                       if grower.hist_impl != "xla" else None)
 
             _, stacked = boost_loop_device(
-                bins, bins_t, y_dev, w, put(valid_rows),
-                raw.astype(jnp.float32),
+                bins_dev, bins_t, y_fit, w_fit, mask_fit, raw_fit,
                 obj.grad_hess,  # cached objective => stable jit cache key
                 params.num_iterations, K, params.growth(),
                 grower.is_categorical, None, grower.n_features,
                 grower.n_bins, grower.hist_impl, shrink,
-                obj.renew_quantile)
+                obj.renew_quantile, n_valid=n_valid,
+                metric_fn=device_metric[0] if device_metric else None)
             host = jax.device_get(stacked)  # ONE fetch for the whole fit
-            for it in range(params.num_iterations):
+            kept = params.num_iterations
+            if device_metric is not None:
+                # replay the host loop's stopping rule over the fetched
+                # per-iteration metric series (same comparisons, same
+                # messages — only the evaluation moved on device)
+                _, higher = device_metric
+                for it in range(params.num_iterations):
+                    val = float(host["metric"][it])
+                    improved = (best_metric is None or
+                                (val > best_metric if higher
+                                 else val < best_metric))
+                    if improved:
+                        best_metric, best_iter, rounds_no_improve = \
+                            val, it, 0
+                    else:
+                        rounds_no_improve += 1
+                    if rounds_no_improve >= params.early_stopping_round:
+                        kept = it + 1
+                        booster.best_iteration = best_iter
+                        print(f"[gbdt] early stop at iter {it + 1}; "
+                              f"best iter {best_iter + 1} "
+                              f"{metric_name}={best_metric:.6f}")
+                        break
+            for it in range(kept):
                 booster.trees.append([tree_from_arrays(
                     mapper, host["feature"][it][k],
                     host["threshold_bin"][it][k],
@@ -314,7 +371,8 @@ class Booster:
                     host["right"][it][k], host["value"][it][k],
                     host["gain"][it][k], int(host["n_nodes"][it][k]))
                     for k in range(K)])
-            booster.best_iteration = len(booster.trees) - 1
+            if booster.best_iteration < 0:
+                booster.best_iteration = len(booster.trees) - 1
             booster.__dict__.pop("_mdc", None)
             booster.__dict__.pop("_tree_dev", None)
             return booster

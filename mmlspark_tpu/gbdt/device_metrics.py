@@ -1,0 +1,103 @@
+"""On-device validation metrics for the fused GBDT boosting loop.
+
+The reference evaluates validation metrics inside its native eval loop
+every iteration (`TrainUtils.scala:105-145`: `LGBM_BoosterGetEval` after
+each `UpdateOneIter`) — no JVM round-trip per round. The TPU shape of
+that idea: the fused fit (`tree.boost_loop_device`) carries the
+validation rows' raw scores in the scan and evaluates the metric as a
+device scalar each iteration, so an early-stopping fit still touches
+the host exactly twice. Host-side :func:`mmlspark_tpu.gbdt.booster.
+eval_metric` stays the single source of truth for metric *definitions*;
+everything here mirrors it in jnp (f32 — rank sums and means are exact
+well past typical validation-set sizes).
+
+AUC uses tie-averaged ranks computed with the same segment trick as
+``renew_leaf_values``: sort, group equal predictions via a cumsum of
+group starts, scatter-min/max the ranks per group, and average.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Callable, Optional, Tuple
+
+import jax.numpy as jnp
+
+from mmlspark_tpu.gbdt.objectives import Objective
+
+_EPS = 1e-15
+
+
+def _tie_rank_auc(pred, y):
+    m = pred.shape[0]
+    order = jnp.argsort(pred)
+    sp, sy = pred[order], y[order]
+    starts = jnp.concatenate([jnp.ones((1,), bool), sp[1:] != sp[:-1]])
+    gid = jnp.cumsum(starts) - 1                       # tie-group per row
+    r = jnp.arange(1, m + 1, dtype=jnp.float32)
+    gmin = jnp.full(m, jnp.inf, jnp.float32).at[gid].min(r)
+    gmax = jnp.full(m, -jnp.inf, jnp.float32).at[gid].max(r)
+    avg_rank = (gmin[gid] + gmax[gid]) / 2.0
+    pos = (sy == 1).astype(jnp.float32)
+    n_pos, n_neg = jnp.sum(pos), jnp.sum((sy == 0).astype(jnp.float32))
+    auc = (jnp.sum(avg_rank * pos) - n_pos * (n_pos + 1) / 2.0) \
+        / jnp.maximum(n_pos * n_neg, 1e-12)
+    return jnp.where((n_pos == 0) | (n_neg == 0), 0.5, auc)
+
+
+_SUPPORTED = ("auc", "binary_logloss", "binary_error", "multi_logloss",
+              "multi_error", "rmse", "l2", "l1", "mae", "quantile",
+              "poisson", "tweedie")
+
+
+@functools.lru_cache(maxsize=64)
+def get_device_metric(name: str, obj: Objective, alpha: float,
+                      tweedie_p: float
+                      ) -> Optional[Tuple[Callable, bool]]:
+    """``(metric_fn, higher_is_better)`` or None if the metric has no
+    device implementation (the caller falls back to the host loop).
+
+    ``metric_fn(vraw, vy) -> f32 scalar`` where ``vraw`` is the
+    validation rows' raw scores ``(m, K)`` and ``vy`` their labels
+    ``(m,)``; mirrors :func:`booster.eval_metric` definition-for-
+    definition. lru-cached so the returned closure's identity is stable
+    across fits (jit cache key, same rule as ``get_objective``).
+    """
+    if name not in _SUPPORTED:
+        return None
+
+    def fn(vraw, vy):
+        pred = obj.transform(vraw)                     # user-facing (m, K)
+        p1 = pred[:, 0]
+        if name == "auc":
+            return _tie_rank_auc(p1, vy)
+        if name == "binary_logloss":
+            p = jnp.clip(p1, _EPS, 1 - _EPS)
+            return -jnp.mean(vy * jnp.log(p) + (1 - vy) * jnp.log(1 - p))
+        if name == "binary_error":
+            return jnp.mean(((p1 > 0.5) != (vy > 0.5)).astype(jnp.float32))
+        if name == "multi_logloss":
+            p = pred[jnp.arange(pred.shape[0]), vy.astype(jnp.int32)]
+            return -jnp.mean(jnp.log(jnp.clip(p, _EPS, 1.0)))
+        if name == "multi_error":
+            return jnp.mean((jnp.argmax(pred, axis=1)
+                             != vy.astype(jnp.int32)).astype(jnp.float32))
+        if name in ("rmse", "l2"):
+            mse = jnp.mean(jnp.square(p1 - vy))
+            return jnp.sqrt(mse) if name == "rmse" else mse
+        if name in ("l1", "mae"):
+            return jnp.mean(jnp.abs(p1 - vy))
+        if name == "quantile":
+            d = vy - p1
+            return jnp.mean(jnp.where(d >= 0, alpha * d, (alpha - 1) * d))
+        if name == "poisson":
+            mu = jnp.maximum(p1, _EPS)
+            return jnp.mean(mu - vy * jnp.log(mu))
+        if name == "tweedie":
+            mu = jnp.maximum(p1, _EPS)
+            return jnp.mean(-vy * jnp.power(mu, 1 - tweedie_p)
+                            / (1 - tweedie_p)
+                            + jnp.power(mu, 2 - tweedie_p) / (2 - tweedie_p))
+        raise AssertionError(name)
+
+    return fn, (name == "auc")

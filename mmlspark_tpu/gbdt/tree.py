@@ -823,32 +823,45 @@ def tree_from_arrays(mapper, feature, threshold_bin, missing_left,
 
 @partial(jax.jit, static_argnames=(
     "grad_hess", "n_iters", "n_outputs", "params", "n_features", "n_bins",
-    "hist_impl", "shrinkage", "renew_q"))
+    "hist_impl", "shrinkage", "renew_q", "n_valid", "metric_fn"))
 def boost_loop_device(bins, bins_t, y, w, valid_mask, init_raw, grad_hess,
                       n_iters: int, n_outputs: int, params: GrowthParams,
                       is_categorical, feat_mask, n_features: int,
                       n_bins: int, hist_impl: str, shrinkage: float,
-                      renew_q: Optional[float]):
+                      renew_q: Optional[float],
+                      n_valid: int = 0, metric_fn=None):
     """The ENTIRE boosting fit as one scanned device program.
 
-    Eligible fits (plain gbdt, no bagging/goss/dart, no validation) need
-    the host only twice: once to start the scan and once to fetch every
-    tree's node arrays at the end — against the reference's fully-native
-    hot loop (`TrainUtils.scala:95-146`, one `LGBM_BoosterUpdateOneIter`
-    per iteration) this is the TPU shape of the same idea, and it removes
-    the per-tree dispatch + fetch round-trips that dominate wall-clock on
-    high-latency host<->device links.
+    Eligible fits (plain gbdt, no bagging/goss/dart) need the host only
+    twice: once to start the scan and once to fetch every tree's node
+    arrays at the end — against the reference's fully-native hot loop
+    (`TrainUtils.scala:95-146`, one `LGBM_BoosterUpdateOneIter` per
+    iteration) this is the TPU shape of the same idea, and it removes
+    the per-tree dispatch + fetch round-trips that dominate wall-clock
+    on high-latency host<->device links.
 
     Per scan step: gradients from the carried ``(n, K)`` raw scores, one
     :func:`grow_tree_device` tree per model output (K trees for
     multiclass), optional L1/quantile leaf renewal, raw update. Emits
     per-iteration node arrays stacked as ``(n_iters, K, ...)``.
     Returns (final raw, stacked dict).
+
+    Validation/early stopping (the reference's in-native eval loop,
+    `TrainUtils.scala:105-145`): the caller appends the validation rows
+    as the LAST ``n_valid`` rows of ``bins``/``y``/``w`` with
+    ``valid_mask`` False there — they are excluded from histograms,
+    leaf stats, and renewal, but :func:`grow_tree_device` routes every
+    row, so their raw scores accrue each tree for free. Each iteration
+    then emits ``metric_fn(raw[-n_valid:], y[-n_valid:])`` under
+    ``"metric"``; the host replays the stopping rule on the fetched
+    (n_iters,) series and truncates — identical trees, one fetch.
     """
     K = n_outputs
     max_nodes = 2 * params.num_leaves - 1
     emit_keys = ("feature", "threshold_bin", "missing_left", "categorical",
                  "cat_mask", "left", "right", "gain", "n_nodes")
+    n_total = bins.shape[0]
+    vy = y[n_total - n_valid:] if n_valid else None
 
     def iteration(raw, _):
         pred = raw[:, 0] if K == 1 else raw
@@ -873,6 +886,8 @@ def boost_loop_device(bins, bins_t, y, w, valid_mask, init_raw, grad_hess,
             emits.append(emit)
         stacked = {kk: jnp.stack([e[kk] for e in emits])
                    for kk in emits[0]}
+        if n_valid:
+            stacked["metric"] = metric_fn(raw[n_total - n_valid:], vy)
         return raw, stacked
 
     return jax.lax.scan(iteration, init_raw, None, length=n_iters)

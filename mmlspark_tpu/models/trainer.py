@@ -135,7 +135,10 @@ class NNLearner(Estimator, HasLabelCol, HasFeaturesCol):
         import optax
 
         from mmlspark_tpu.models.nn import _stack_column
-        x = _stack_column(df[self.features_col])
+        # _stack_column preserves source dtype (for integer-payload
+        # scoring); training always computes in f32
+        x = _stack_column(df[self.features_col]).astype(np.float32,
+                                                        copy=False)
         y = np.asarray(df[self.label_col])
         w = (np.asarray(df[self.weight_col], dtype=np.float32)
              if self.weight_col else np.ones(len(y), dtype=np.float32))

@@ -61,6 +61,12 @@ class TransformerConfig:
     n_experts: int = 0        # 0 = dense MLP; >0 = top-1 MoE in every block
     microbatches: int = 1
     dtype: str = "float32"
+    # un-ring-sharded attention engine: "dense" = XLA softmax-attention;
+    # "flash" = the Pallas streaming kernel (custom VJP; fwd never puts
+    # (S x S) scores in HBM — wins as S grows); "auto" = flash on TPU
+    # for long sequences, dense otherwise (at short S, XLA's fused
+    # dense path with stored probabilities beats the recompute)
+    attention_impl: str = "auto"
 
     @property
     def n_layers(self) -> int:
@@ -208,18 +214,36 @@ def _compute_dtype(cfg: TransformerConfig):
 
 
 def _attention(bp, x, cfg: TransformerConfig, ax: _Axes, pos):
-    # mixed precision: the heavy projections run in cfg.dtype (bf16 hits
-    # the MXU's fast path); rope/softmax and the residual stream stay f32
+    # mixed precision: the heavy projections AND the two S^2 attention
+    # matmuls run in cfg.dtype (bf16 hits the MXU's fast path, f32 MXU
+    # accumulation via preferred_element_type — no upcast pass over the
+    # scores); rope/softmax and the residual stream stay f32
     dt = _compute_dtype(cfg)
+    mm_dt = dt if dt != jnp.float32 else None
     h = _rmsnorm(x, bp["ln1"]).astype(dt)
     q = jnp.einsum("bsd,dhk->bshk", h, bp["wq"].astype(dt)).astype(jnp.float32)
     k = jnp.einsum("bsd,dhk->bshk", h, bp["wk"].astype(dt)).astype(jnp.float32)
     v = jnp.einsum("bsd,dhk->bshk", h, bp["wv"].astype(dt)).astype(jnp.float32)
     q, k = _rope(q, pos), _rope(k, pos)
     if ax.seq:
-        a = ring_attention_local(q, k, v, ax.seq, causal=True)
+        a = ring_attention_local(q, k, v, ax.seq, causal=True,
+                                 compute_dtype=mm_dt)
     else:
-        a = dense_attention(q, k, v, causal=True)
+        from mmlspark_tpu.parallel.pallas_attention import (
+            flash_attention, flash_available)
+        impl = cfg.attention_impl
+        if impl == "auto":
+            # flash wins once the (S x S) score/probability tensors stop
+            # being HBM-cheap; at short S XLA's fused dense attention
+            # (which stores p instead of recomputing it) is faster
+            impl = ("flash" if flash_available()
+                    and q.shape[1] >= 2048 else "dense")
+        if impl == "flash" and flash_available():
+            if mm_dt is not None:
+                q, k, v = q.astype(dt), k.astype(dt), v.astype(dt)
+            a = flash_attention(q, k, v, True)
+        else:
+            a = dense_attention(q, k, v, causal=True, compute_dtype=mm_dt)
     o = jnp.einsum("bshk,hkd->bsd", a.astype(dt),
                    bp["wo"].astype(dt)).astype(jnp.float32)
     return _psum_if(o, ax.model)
@@ -312,11 +336,23 @@ def local_loss(params, tokens, labels, mask, cfg: TransformerConfig,
             state = jax.lax.ppermute(state, ax.pipe, perm)
 
     h = _rmsnorm(out.reshape(b_loc, s_loc, cfg.d_model), params["final_norm"])
-    # the vocab head stays f32: casting it saves matmul time but pays
-    # more in up-casting the [b, s, vocab] logits for the softmax
-    logits = jnp.einsum("bsd,dv->bsv", h, params["head"])
-    logp = jax.nn.log_softmax(logits, axis=-1)
-    ce = -jnp.take_along_axis(logp, labels[..., None], axis=-1)[..., 0]
+    # the vocab head is a third of a small LM's forward FLOPs: run the
+    # matmul with bf16 inputs + f32 MXU accumulation. The logits COME OUT
+    # f32 (preferred_element_type), so there is no separate upcast pass
+    # over [b, s, vocab] — the trap that made a plain bf16 head slower
+    dt = _compute_dtype(cfg)
+    if dt != jnp.float32:
+        logits = jnp.einsum("bsd,dv->bsv", h.astype(dt),
+                            params["head"].astype(dt),
+                            preferred_element_type=jnp.float32)
+    else:
+        logits = jnp.einsum("bsd,dv->bsv", h, params["head"])
+    # fused CE: logsumexp - gold logit. log_softmax would materialize a
+    # second [b, s, vocab] array (logp) just to gather one column — at
+    # 32k vocab that is a gigabyte of pure HBM traffic per step
+    lse = jax.nn.logsumexp(logits, axis=-1)
+    gold = jnp.take_along_axis(logits, labels[..., None], axis=-1)[..., 0]
+    ce = lse - gold
     is_last = (p_rank == p_size - 1).astype(jnp.float32)
     loss_sum = jnp.sum(ce * mask) * is_last
     count = jnp.sum(mask) * is_last
@@ -363,8 +399,9 @@ def reference_loss(params, tokens, labels, mask, cfg: TransformerConfig):
                 x = x + jnp.einsum("bsf,fd->bsd", z, bp["w2"]) + bp["b2"]
     h = _rmsnorm(x, params["final_norm"])
     logits = jnp.einsum("bsd,dv->bsv", h, params["head"])
-    logp = jax.nn.log_softmax(logits, axis=-1)
-    ce = -jnp.take_along_axis(logp, labels[..., None], axis=-1)[..., 0]
+    lse = jax.nn.logsumexp(logits, axis=-1)
+    gold = jnp.take_along_axis(logits, labels[..., None], axis=-1)[..., 0]
+    ce = lse - gold
     return jnp.sum(ce * mask) / jnp.maximum(jnp.sum(mask), 1.0)
 
 

@@ -38,6 +38,16 @@ def _round_up(x: int, m: int) -> int:
     return ((x + m - 1) // m) * m
 
 
+def _tile_live(qpos, kpos, causal: bool):
+    """False when the whole (q-tile x kv-tile) is masked out: all-padding
+    keys, or (causal) every key strictly in every query's future."""
+    kmin = jnp.min(kpos)
+    live = kmin != _PAD_POS
+    if causal:
+        live = live & (jnp.max(qpos) >= kmin)
+    return live
+
+
 def _vma(x):
     """Varying-manual-axes of ``x`` (empty outside shard_map)."""
     return getattr(jax.typeof(x), "vma", frozenset()) or frozenset()
@@ -55,28 +65,39 @@ def _flash_kernel(qpos_ref, kpos_ref, q_ref, k_ref, v_ref,
         m_scr[:] = jnp.full_like(m_scr, _NEG_INF)
         l_scr[:] = jnp.zeros_like(l_scr)
 
-    q = q_ref[0]                                       # (TQ, D)
-    s = jax.lax.dot_general(q, k_ref[0],
-                            (((1,), (1,)), ((), ())),
-                            preferred_element_type=jnp.float32) * scale
     qpos = qpos_ref[0]                                 # (TQ,)
     kpos = kpos_ref[0]                                 # (TK,)
-    mask = (kpos != _PAD_POS)[None, :]
-    if causal:
-        mask = mask & (qpos[:, None] >= kpos[None, :])
-    s = jnp.where(mask, s, _NEG_INF)
 
-    m_prev = m_scr[:]                                  # (TQ, 1)
-    m_new = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
-    p = jnp.exp(s - m_new)
-    # fully-masked rows: m_new == -1e30 makes exp(s - m_new) = exp(0);
-    # kill those ones so l stays 0 and the ring merge sees "no data"
-    p = jnp.where(mask, p, 0.0)
-    alpha = jnp.exp(m_prev - m_new)                    # (TQ, 1)
-    l_scr[:] = l_scr[:] * alpha + jnp.sum(p, axis=1, keepdims=True)
-    acc[:] = acc[:] * alpha + jnp.dot(
-        p, v_ref[0], preferred_element_type=jnp.float32)
-    m_scr[:] = m_new
+    # tile skipping: a tile whose every key is padding, or (causal)
+    # whose every key is in the future of every query, contributes
+    # nothing — skip its two matmuls (half of all tiles under causal)
+    live = _tile_live(qpos, kpos, causal)
+
+    @pl.when(live)
+    def _():
+        q = q_ref[0]                                   # (TQ, D)
+        s = jax.lax.dot_general(q, k_ref[0],
+                                (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32) * scale
+        mask = (kpos != _PAD_POS)[None, :]
+        if causal:
+            mask = mask & (qpos[:, None] >= kpos[None, :])
+        s = jnp.where(mask, s, _NEG_INF)
+
+        m_prev = m_scr[:]                              # (TQ, 1)
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
+        p = jnp.exp(s - m_new)
+        # fully-masked rows: m_new == -1e30 makes exp(s - m_new) = exp(0);
+        # kill those ones so l stays 0 and the ring merge sees "no data"
+        p = jnp.where(mask, p, 0.0)
+        alpha = jnp.exp(m_prev - m_new)                # (TQ, 1)
+        l_scr[:] = l_scr[:] * alpha + jnp.sum(p, axis=1, keepdims=True)
+        # P·V in the inputs' dtype (bf16 inputs keep the MXU fast path),
+        # f32 accumulation via preferred_element_type
+        acc[:] = acc[:] * alpha + jnp.dot(
+            p.astype(v_ref.dtype), v_ref[0],
+            preferred_element_type=jnp.float32)
+        m_scr[:] = m_new
 
     @pl.when(kv_idx == pl.num_programs(2) - 1)
     def _():
@@ -161,3 +182,241 @@ def flash_block_attn(q, k, v, scale, q_pos, k_pos, causal: bool,
 
 def flash_available() -> bool:
     return jax.default_backend() == "tpu"
+
+
+# ---------------------------------------------------------------------------
+# Full flash attention with a Pallas backward (custom VJP)
+# ---------------------------------------------------------------------------
+#
+# The ring path above streams (m, l, o) partials and is forward-only; this
+# is the standalone differentiable kernel for the un-ring-sharded (dense)
+# attention path in models/transformer.py — the path the single-chip train
+# bench measures. Forward reuses _flash_call; backward is the
+# FlashAttention-2 recipe: save (q, k, v, out, lse), recompute each score
+# tile in VMEM, and accumulate dq (kv-innermost grid) and dk/dv
+# (q-innermost grid) in scratch. No (S x S) matrix ever reaches HBM in
+# either direction — at seq 1024 x 8 heads x 8 layers the dense path
+# round-trips ~2 GB of scores+probabilities per train step, which is pure
+# HBM-bandwidth stall on a TPU.
+
+
+def _flash_dq_kernel(qpos_ref, kpos_ref, q_ref, k_ref, v_ref, do_ref,
+                     lse_ref, delta_ref, dq_ref, dq_acc,
+                     *, scale: float, causal: bool):
+    kv_idx = pl.program_id(2)
+
+    @pl.when(kv_idx == 0)
+    def _():
+        dq_acc[:] = jnp.zeros_like(dq_acc)
+
+    @pl.when(_tile_live(qpos_ref[0], kpos_ref[0], causal))
+    def _():
+        q, k, v, do = q_ref[0], k_ref[0], v_ref[0], do_ref[0]
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32) * scale
+        mask = (kpos_ref[0] != _PAD_POS)[None, :]
+        if causal:
+            mask = mask & (qpos_ref[0][:, None] >= kpos_ref[0][None, :])
+        p = jnp.where(mask, jnp.exp(s - lse_ref[0]), 0.0)   # (TQ, TK)
+        dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        ds = (p * (dp - delta_ref[0])).astype(k.dtype)      # (TQ, TK)
+        dq_acc[:] += jax.lax.dot_general(
+            ds, k, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale
+
+    @pl.when(kv_idx == pl.num_programs(2) - 1)
+    def _():
+        dq_ref[0] = dq_acc[:]
+
+
+def _flash_dkv_kernel(qpos_ref, kpos_ref, q_ref, k_ref, v_ref, do_ref,
+                      lse_ref, delta_ref, dk_ref, dv_ref, dk_acc, dv_acc,
+                      *, scale: float, causal: bool):
+    q_idx = pl.program_id(2)
+
+    @pl.when(q_idx == 0)
+    def _():
+        dk_acc[:] = jnp.zeros_like(dk_acc)
+        dv_acc[:] = jnp.zeros_like(dv_acc)
+
+    @pl.when(_tile_live(qpos_ref[0], kpos_ref[0], causal))
+    def _():
+        q, k, v, do = q_ref[0], k_ref[0], v_ref[0], do_ref[0]
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32) * scale
+        mask = (kpos_ref[0] != _PAD_POS)[None, :]
+        if causal:
+            mask = mask & (qpos_ref[0][:, None] >= kpos_ref[0][None, :])
+        p = jnp.where(mask, jnp.exp(s - lse_ref[0]), 0.0)   # (TQ, TK)
+        dv_acc[:] += jax.lax.dot_general(                   # p^T @ do
+            p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        ds = (p * (dp - delta_ref[0])).astype(q.dtype)
+        dk_acc[:] += jax.lax.dot_general(                   # ds^T @ q
+            ds, q, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale
+
+    @pl.when(q_idx == pl.num_programs(2) - 1)
+    def _():
+        dk_ref[0] = dk_acc[:]
+        dv_ref[0] = dv_acc[:]
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("scale", "causal", "interpret"))
+def _flash_bwd_call(q, k, v, do, lse, delta, q_pos, k_pos,
+                    scale: float, causal: bool, interpret: bool):
+    """All (BH, S_pad, D_pad) f32; lse/delta (BH, S_pad, 1)."""
+    bh, sq, d = q.shape
+    sk = k.shape[1]
+    nq, nk = sq // Q_TILE, sk // KV_TILE
+    q_spec = pl.BlockSpec((1, Q_TILE, d), lambda b, i, j: (b, i, 0))
+    kv_spec_dq = pl.BlockSpec((1, KV_TILE, d), lambda b, i, j: (b, j, 0))
+    stat_spec = pl.BlockSpec((1, Q_TILE, 1), lambda b, i, j: (b, i, 0))
+    dq = pl.pallas_call(
+        functools.partial(_flash_dq_kernel, scale=scale, causal=causal),
+        grid=(bh, nq, nk),
+        in_specs=[
+            pl.BlockSpec((1, Q_TILE), lambda b, i, j: (0, i)),
+            pl.BlockSpec((1, KV_TILE), lambda b, i, j: (0, j)),
+            q_spec, kv_spec_dq, kv_spec_dq, q_spec, stat_spec, stat_spec,
+        ],
+        out_specs=q_spec,
+        out_shape=jax.ShapeDtypeStruct((bh, sq, d), jnp.float32,
+                                       vma=_vma(q)),
+        scratch_shapes=[pltpu.VMEM((Q_TILE, d), jnp.float32)],
+        interpret=interpret,
+    )(q_pos, k_pos, q, k, v, do, lse, delta)
+
+    # dk/dv accumulate across q tiles -> q is the innermost grid axis
+    q_spec2 = pl.BlockSpec((1, Q_TILE, d), lambda b, j, i: (b, i, 0))
+    kv_spec2 = pl.BlockSpec((1, KV_TILE, d), lambda b, j, i: (b, j, 0))
+    stat_spec2 = pl.BlockSpec((1, Q_TILE, 1), lambda b, j, i: (b, i, 0))
+    dk, dv = pl.pallas_call(
+        functools.partial(_flash_dkv_kernel, scale=scale, causal=causal),
+        grid=(bh, nk, nq),
+        in_specs=[
+            pl.BlockSpec((1, Q_TILE), lambda b, j, i: (0, i)),
+            pl.BlockSpec((1, KV_TILE), lambda b, j, i: (0, j)),
+            q_spec2, kv_spec2, kv_spec2, q_spec2, stat_spec2, stat_spec2,
+        ],
+        out_specs=[kv_spec2, kv_spec2],
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, sk, d), jnp.float32, vma=_vma(q)),
+            jax.ShapeDtypeStruct((bh, sk, d), jnp.float32, vma=_vma(q)),
+        ],
+        scratch_shapes=[pltpu.VMEM((KV_TILE, d), jnp.float32),
+                        pltpu.VMEM((KV_TILE, d), jnp.float32)],
+        interpret=interpret,
+    )(q_pos, k_pos, q, k, v, do, lse, delta)
+    return dq, dk, dv
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def flash_attention(q, k, v, causal: bool = True, scale=None,
+                    interpret: bool = False, bwd_impl: str = "xla"):
+    """Differentiable flash attention, [B, S, H, Dh] in/out.
+
+    Forward = the streaming kernel above (normalized, saves the
+    log-sum-exp). Backward recomputes ``p = exp(s - lse)`` and applies
+    the FlashAttention-2 gradient algebra, via one of two engines:
+
+    - ``bwd_impl="xla"`` (default): the recompute as XLA einsums. The
+      (S x S) probabilities exist transiently but XLA fuses the chain;
+      at head_dim 64 this is FASTER than the Pallas backward below,
+      whose (128-lane) head padding doubles every matmul's work.
+    - ``bwd_impl="pallas"``: dq and dk/dv Pallas kernels accumulating in
+      VMEM scratch — nothing (S x S) ever reaches HBM, the right regime
+      for long sequences where the dense recompute stops fitting.
+
+    Numerically equivalent to :func:`ring_attention.dense_attention` in
+    value and gradients to f32 tolerance (tests/test_transformer.py).
+    ``interpret=True`` runs the kernels interpreted for CPU tests.
+    """
+    out, _ = _flash_fwd(q, k, v, causal, scale, interpret, bwd_impl)
+    return out
+
+
+def _layout(q, k, v):
+    """Shared fwd/bwd padded (B*H, S_pad, D_pad) layout + positions."""
+    b, sq, h, d = q.shape
+    sk = k.shape[1]
+    sq_p, sk_p, d_p = (_round_up(sq, Q_TILE), _round_up(sk, KV_TILE),
+                       _round_up(d, LANE))
+
+    def to_bh(x, s, s_pad):                 # keeps dtype (bf16 stays bf16)
+        x = jnp.swapaxes(x, 1, 2).reshape(b * h, s, d)
+        return jnp.pad(x, ((0, 0), (0, s_pad - s), (0, d_p - d)))
+
+    qpos = jnp.pad(jnp.arange(sq, dtype=jnp.int32), (0, sq_p - sq))[None]
+    kpos = jnp.pad(jnp.arange(sk, dtype=jnp.int32), (0, sk_p - sk),
+                   constant_values=_PAD_POS)[None]
+    return (b, sq, sk, h, d, sq_p, sk_p, d_p, to_bh, qpos, kpos)
+
+
+def _flash_fwd(q, k, v, causal, scale, interpret, bwd_impl):
+    (b, sq, sk, h, d, sq_p, sk_p, d_p, to_bh, qpos, kpos) = _layout(q, k, v)
+    scale_f = float(scale) if scale is not None else d ** -0.5
+    o, m, l = _flash_call(to_bh(q, sq, sq_p), to_bh(k, sk, sk_p),
+                          to_bh(v, sk, sk_p), qpos, kpos,
+                          scale_f, causal, interpret)   # all f32 (BH,Sq_p,.)
+    l_safe = jnp.maximum(l, 1e-30)
+    out_bh = o / l_safe                                  # normalized
+    # lse = m + log l reconstructs p = exp(s - lse) tile-locally in the
+    # backward; fully-masked rows get +BIG so their p (and grads) are 0
+    lse_bh = jnp.where(l > 0, m + jnp.log(l_safe), 1e30)  # (BH, Sq_p, 1)
+    out = out_bh[:, :sq, :d].reshape(b, h, sq, d).swapaxes(1, 2)
+    return out.astype(q.dtype), (q, k, v, out_bh, lse_bh)
+
+
+def _flash_bwd(causal, scale, interpret, bwd_impl, res, dout):
+    q, k, v, out_bh, lse_bh = res
+    (b, sq, sk, h, d, sq_p, sk_p, d_p, to_bh, qpos, kpos) = _layout(q, k, v)
+    scale_f = float(scale) if scale is not None else d ** -0.5
+
+    if bwd_impl == "xla":
+        # dense recompute: p from the saved lse, then the FA-2 gradient
+        # algebra as einsums (bf16 matmuls, f32 accumulation)
+        lse = lse_bh[:, :sq, 0].reshape(b, h, sq)        # (B, H, Sq)
+        out = out_bh[:, :sq, :d].reshape(b, h, sq, d).swapaxes(1, 2)
+        s = jnp.einsum("bqhd,bkhd->bhqk", q, k,
+                       preferred_element_type=jnp.float32) * scale_f
+        p = jnp.exp(s - lse[..., None])                  # (B, H, Sq, Sk)
+        if causal:
+            mask = jnp.arange(sq)[:, None] >= jnp.arange(sk)[None, :]
+            p = jnp.where(mask[None, None], p, 0.0)
+        do = dout.astype(jnp.float32)
+        delta = jnp.sum(do * out, axis=-1)               # (B, Sq, H)
+        pc = p.astype(q.dtype)
+        dv = jnp.einsum("bhqk,bqhd->bkhd", pc, dout,
+                        preferred_element_type=jnp.float32)
+        dp = jnp.einsum("bqhd,bkhd->bhqk", dout, v,
+                        preferred_element_type=jnp.float32)
+        ds = (p * (dp - jnp.swapaxes(delta, 1, 2)[..., None])) \
+            .astype(q.dtype)
+        dq = jnp.einsum("bhqk,bkhd->bqhd", ds, k,
+                        preferred_element_type=jnp.float32) * scale_f
+        dk = jnp.einsum("bhqk,bqhd->bkhd", ds, q,
+                        preferred_element_type=jnp.float32) * scale_f
+        return (dq.astype(q.dtype), dk.astype(k.dtype),
+                dv.astype(v.dtype))
+
+    do_bh = to_bh(dout, sq, sq_p)
+    delta = jnp.sum(do_bh.astype(jnp.float32) * out_bh, axis=-1,
+                    keepdims=True)                       # (BH, Sq_p, 1)
+    dq, dk, dv = _flash_bwd_call(
+        to_bh(q, sq, sq_p), to_bh(k, sk, sk_p), to_bh(v, sk, sk_p),
+        do_bh, lse_bh, delta, qpos, kpos, scale_f, causal, interpret)
+
+    def from_bh(x, s):
+        return x[:, :s, :d].reshape(b, h, s, d).swapaxes(1, 2)
+
+    return (from_bh(dq, sq).astype(q.dtype),
+            from_bh(dk, sk).astype(k.dtype),
+            from_bh(dv, sk).astype(v.dtype))
+
+
+flash_attention.defvjp(_flash_fwd, _flash_bwd)

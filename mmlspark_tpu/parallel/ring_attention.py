@@ -27,14 +27,28 @@ _NEG_INF = -1e30  # large-negative instead of -inf: keeps fully-masked
                   # blocks (causal, future-only) free of inf-inf NaNs
 
 
-def _block_attn(q, k, v, scale, q_pos, k_pos, causal):
+def _mm(spec: str, a, b, compute_dtype):
+    """Attention matmul with the shared mixed-precision policy: inputs
+    cast to ``compute_dtype`` (e.g. bf16 hits the MXU fast path) with
+    f32 MXU accumulation via ``preferred_element_type`` — no separate
+    upcast pass over the result; None = plain einsum."""
+    if compute_dtype is None:
+        return jnp.einsum(spec, a, b)
+    return jnp.einsum(spec, a.astype(compute_dtype),
+                      b.astype(compute_dtype),
+                      preferred_element_type=jnp.float32)
+
+
+def _block_attn(q, k, v, scale, q_pos, k_pos, causal, compute_dtype=None):
     """One (q-block × kv-block) streaming-attention partial.
 
     Returns (m, l, o): running max, normalizer, unnormalized output for
     this block, to be merged by the online-softmax accumulator.
     q: [B, Sq, H, Dh]; k, v: [B, Sk, H, Dh]; *_pos: global positions.
+    ``compute_dtype``: as in :func:`dense_attention` — matmul inputs in
+    that dtype, f32 MXU accumulation, softmax math f32.
     """
-    s = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale
+    s = _mm("bqhd,bkhd->bhqk", q, k, compute_dtype) * scale
     if causal:
         mask = q_pos[:, None] >= k_pos[None, :]          # [Sq, Sk]
         s = jnp.where(mask[None, None], s, _NEG_INF)
@@ -44,13 +58,14 @@ def _block_attn(q, k, v, scale, q_pos, k_pos, causal):
         # rows with no visible key: kill the exp(0)=1 garbage
         p = jnp.where(mask[None, None], p, 0.0)
     l = jnp.sum(p, axis=-1)                              # [B, H, Sq]
-    o = jnp.einsum("bhqk,bkhd->bqhd", p, v)              # [B, Sq, H, Dh]
+    o = _mm("bhqk,bkhd->bqhd", p, v, compute_dtype)      # [B, Sq, H, Dh]
     return m, l, o
 
 
 def ring_attention_local(q, k, v, axis_name: str, causal: bool = True,
                          scale: Optional[float] = None,
-                         block_impl: str = "dense"):
+                         block_impl: str = "dense",
+                         compute_dtype=None):
     """Exact attention with sequence sharded over ``axis_name`` (per-device).
 
     Must run inside ``shard_map``. ``q/k/v``: [B, S_local, H, Dh] — the
@@ -76,7 +91,8 @@ def ring_attention_local(q, k, v, axis_name: str, causal: bool = True,
         block_fn = functools.partial(
             flash_block_attn, interpret=(block_impl == "flash_interpret"))
     elif block_impl == "dense":
-        block_fn = _block_attn
+        block_fn = functools.partial(_block_attn,
+                                     compute_dtype=compute_dtype)
     else:
         raise ValueError(f"unknown block_impl {block_impl!r}")
     n = jax.lax.axis_size(axis_name)
@@ -116,17 +132,26 @@ def ring_attention_local(q, k, v, axis_name: str, causal: bool = True,
 
 
 def dense_attention(q, k, v, causal: bool = True,
-                    scale: Optional[float] = None):
-    """Unsharded reference attention (tests + single-device fallback)."""
+                    scale: Optional[float] = None,
+                    compute_dtype=None):
+    """Unsharded reference attention (tests + single-device fallback).
+
+    ``compute_dtype`` (e.g. ``jnp.bfloat16``): run the two matmuls with
+    inputs cast to it and ``preferred_element_type=float32`` — the MXU
+    accumulates in f32 natively, so this hits the bf16 fast path with NO
+    separate upcast pass over the [B,H,S,S] scores, while the softmax
+    stays f32. This is where half a small LM's training FLOPs live;
+    leaving the scores matmul in f32 halves attention MFU on TPU.
+    """
     dh = q.shape[-1]
     scale = scale if scale is not None else dh ** -0.5
-    s = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale
+    s = _mm("bqhd,bkhd->bhqk", q, k, compute_dtype) * scale
     if causal:
         sq, sk = q.shape[1], k.shape[1]
         mask = jnp.arange(sq)[:, None] >= jnp.arange(sk)[None, :]
         s = jnp.where(mask[None, None], s, _NEG_INF)
     p = jax.nn.softmax(s, axis=-1)
-    return jnp.einsum("bhqk,bkhd->bqhd", p, v)
+    return _mm("bhqk,bkhd->bqhd", p, v, compute_dtype)
 
 
 def ring_attention(q, k, v, mesh, axis_name: str = "seq",

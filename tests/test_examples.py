@@ -15,7 +15,8 @@ EXAMPLES = ["drug_discovery_quantile.py", "adult_census_binary.py",
             "cifar10_resnet_scoring.py", "transfer_learning.py",
             "distributed_sgd.py", "text_classification.py",
             "recommender_sar.py", "interpret_lime.py", "serving_demo.py",
-            "serving_distributed.py"]
+            "serving_distributed.py", "flight_delays_regression.py",
+            "hyperparam_tuning.py"]
 EX_DIR = os.path.join(os.path.dirname(os.path.dirname(
     os.path.abspath(__file__))), "examples")
 

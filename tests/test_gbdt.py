@@ -584,6 +584,75 @@ class TestStages:
         assert model.booster.num_total_iterations < 200
 
 
+class TestFusedEarlyStopping:
+    """Early stopping inside the fused device loop (parity: the
+    reference's in-native eval loop, `TrainUtils.scala:105-145`): valid
+    rows ride the scan masked out of histograms, the metric is a device
+    scalar per iteration, and the host replays the stopping rule after
+    the single fetch — the decision and the trees must match the
+    per-tree host loop exactly."""
+
+    def _host_loop(self, monkeypatch):
+        """Force the host loop by denying the device metric."""
+        from mmlspark_tpu.gbdt import device_metrics
+        monkeypatch.setattr(device_metrics, "get_device_metric",
+                            lambda *a, **k: None)
+
+    @pytest.mark.parametrize("objective,metric_sub", [
+        ("binary", "auc"), ("regression", "rmse"), ("quantile", "quantile"),
+    ])
+    def test_fused_matches_host_loop(self, monkeypatch, objective,
+                                     metric_sub, capsys):
+        rng = np.random.default_rng(11)
+        X = rng.normal(size=(600, 8))
+        t = X[:, 0] * 2 - X[:, 1] + 0.5 * rng.normal(size=600)
+        y = (t > 0).astype(np.float64) if objective == "binary" else t
+        Xtr, ytr, Xv, yv = X[:450], y[:450], X[450:], y[450:]
+        p = BoosterParams(objective=objective, num_iterations=120,
+                          num_leaves=7, early_stopping_round=4, seed=0)
+        b_fused = Booster.train(p, Xtr, ytr, valid_sets=[(Xv, yv)])
+        assert metric_sub in capsys.readouterr().out
+        self._host_loop(monkeypatch)
+        b_host = Booster.train(p, Xtr, ytr, valid_sets=[(Xv, yv)])
+        assert b_fused.num_total_iterations == b_host.num_total_iterations
+        assert b_fused.best_iteration == b_host.best_iteration
+        assert b_fused.num_total_iterations < 120  # it actually stopped
+        np.testing.assert_allclose(b_fused.predict(Xv), b_host.predict(Xv),
+                                   rtol=1e-4, atol=1e-5)
+
+    def test_fused_multiclass_early_stop(self, monkeypatch):
+        rng = np.random.default_rng(5)
+        X = rng.normal(size=(500, 6))
+        y = (X[:, 0] + 0.3 * rng.normal(size=500) > 0).astype(int) \
+            + (X[:, 1] > 0.8).astype(int)          # 3 classes
+        p = BoosterParams(objective="multiclass", num_class=3,
+                          num_iterations=80, num_leaves=7,
+                          early_stopping_round=3, seed=0)
+        bf = Booster.train(p, X[:400], y[:400],
+                           valid_sets=[(X[400:], y[400:])])
+        self._host_loop(monkeypatch)
+        bh = Booster.train(p, X[:400], y[:400],
+                           valid_sets=[(X[400:], y[400:])])
+        assert bf.num_total_iterations == bh.num_total_iterations
+        assert bf.best_iteration == bh.best_iteration
+        assert (bf.predict(X).argmax(1) == bh.predict(X).argmax(1)).all()
+
+    def test_logging_fit_falls_back_to_host_loop(self, capsys):
+        # per-iteration logging needs the host every round, so an ES fit
+        # with log_every takes the per-tree loop — and still stops
+        rng = np.random.default_rng(7)
+        X = rng.normal(size=(400, 5))
+        y = (X[:, 0] + X[:, 1] + 1.2 * rng.normal(size=400) > 0) \
+            .astype(np.float64)
+        p = BoosterParams(objective="binary", num_iterations=60,
+                          num_leaves=7, early_stopping_round=6, seed=0)
+        b = Booster.train(p, X[:320], y[:320],
+                          valid_sets=[(X[320:], y[320:])], log_every=5)
+        out = capsys.readouterr().out
+        assert "iter 5 valid auc" in out
+        assert b.num_total_iterations < 60
+
+
 class TestLeafRenewal:
     """L1/quantile leaf-output renewal (LightGBM RenewTreeOutput parity)."""
 
