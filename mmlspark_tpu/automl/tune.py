@@ -181,11 +181,13 @@ class TuneHyperparameters(Estimator, HasLabelCol):
                         validator=in_range(lo=1))
     search_mode = Param("random", "random | grid", ptype=str)
     seed = Param(0, "sampling/fold seed", ptype=int)
-    trial_devices = Param(False, "assign each trial its own chip "
+    trial_devices = Param("auto", "assign each trial its own chip "
                           "(round-robin over jax.local_devices()) so "
                           "trials run device-parallel instead of "
                           "contending for one; parallelism should be "
-                          ">= the device count", ptype=bool)
+                          ">= the device count. auto = enabled whenever "
+                          "the host has more than one device | True | "
+                          "False")
 
     def _spaces(self) -> List[Dict[str, Any]]:
         models = self.models or []
@@ -228,7 +230,11 @@ class TuneHyperparameters(Estimator, HasLabelCol):
         # version gives each trial its own chip so single-chip fits run
         # device-parallel across the mesh)
         devices = None
-        if self.trial_devices:
+        use_devices = self.trial_devices
+        if use_devices == "auto":
+            import jax
+            use_devices = len(jax.local_devices()) > 1
+        if use_devices:
             import jax
             devices = jax.local_devices()
 

@@ -50,7 +50,6 @@ _SUPPORTED = ("auc", "binary_logloss", "binary_error", "multi_logloss",
               "poisson", "tweedie")
 
 
-@functools.lru_cache(maxsize=64)
 def get_device_metric(name: str, obj: Objective, alpha: float,
                       tweedie_p: float
                       ) -> Optional[Tuple[Callable, bool]]:
@@ -60,11 +59,24 @@ def get_device_metric(name: str, obj: Objective, alpha: float,
     ``metric_fn(vraw, vy) -> f32 scalar`` where ``vraw`` is the
     validation rows' raw scores ``(m, K)`` and ``vy`` their labels
     ``(m,)``; mirrors :func:`booster.eval_metric` definition-for-
-    definition. lru-cached so the returned closure's identity is stable
-    across fits (jit cache key, same rule as ``get_objective``).
+    definition. Cached so the returned closure's identity is stable
+    across fits (``metric_fn`` is a static jit arg of the fused boosting
+    scan — a fresh identity means a full recompile); the cache key drops
+    ``alpha``/``tweedie_p`` for the metrics that ignore them, so e.g.
+    binary-AUC fits that differ only in ``alpha`` share one program.
     """
     if name not in _SUPPORTED:
         return None
+    if name != "quantile":
+        alpha = 0.0
+    if name != "tweedie":
+        tweedie_p = 0.0
+    return _cached_metric(name, obj, alpha, tweedie_p)
+
+
+@functools.lru_cache(maxsize=None)
+def _cached_metric(name: str, obj: Objective, alpha: float,
+                   tweedie_p: float) -> Tuple[Callable, bool]:
 
     def fn(vraw, vy):
         pred = obj.transform(vraw)                     # user-facing (m, K)

@@ -59,6 +59,12 @@ class TransformerConfig:
     n_stages: int = 1
     layers_per_stage: int = 1
     n_experts: int = 0        # 0 = dense MLP; >0 = top-1 MoE in every block
+    # 0 = dense dispatch (every token through every local expert, psum
+    # combine — compute scales with n_experts); > 0 = capacity-factor
+    # routing: per-expert token budget ceil(factor * T / E), all_to_all
+    # over the expert axis, overflow tokens dropped to the residual —
+    # compute scales with the factor, not the expert count
+    moe_capacity_factor: float = 0.0
     microbatches: int = 1
     dtype: str = "float32"
     # un-ring-sharded attention engine: "dense" = XLA softmax-attention;
@@ -259,12 +265,84 @@ def _mlp(bp, x, ax: _Axes, cfg: TransformerConfig):
     return _psum_if(y, ax.model) + bp["b2"]
 
 
+def _moe_capacity(bp, x, cfg: TransformerConfig, ax: _Axes):
+    """Capacity-factor top-1 MoE dispatch (the production shape).
+
+    Each rank builds per-expert token queues bounded by
+    ``C = ceil(factor * T / E)`` (tokens beyond an expert's budget drop
+    to the residual), ``all_to_all`` over the ``expert`` axis swaps
+    queue shards so every rank holds the full cross-rank queues of its
+    LOCAL experts, the expert FFNs run as one batched einsum, and a
+    second ``all_to_all`` routes results home, combined weighted by the
+    router probability. Per-token FLOPs scale with the capacity factor,
+    not ``n_experts`` — unlike :func:`_moe`'s dense dispatch, which
+    multiplies every token through every local expert.
+    """
+    import math
+    dt = _compute_dtype(cfg)
+    h = _rmsnorm(x, bp["ln2"])
+    logits = jnp.einsum("bsd,de->bse", h, bp["router"])
+    probs = jax.nn.softmax(logits, axis=-1)
+    b, s, d = x.shape
+    T, E = b * s, cfg.n_experts
+    e_size, e_rank = _size(ax.expert), _index(ax.expert)
+    if T % e_size:
+        raise ValueError(
+            f"capacity MoE dispatch needs local tokens ({T}) divisible "
+            f"by the expert axis ({e_size})")
+    # activations arrive REPLICATED over the expert axis; treat that
+    # axis as extra token parallelism: each rank routes its own token
+    # shard, so expert compute per rank scales with T/e_size
+    T_sh = T // e_size
+    off = e_rank * T_sh
+    hT = jax.lax.dynamic_slice_in_dim(h.reshape(T, d), off, T_sh)
+    top = jax.lax.dynamic_slice_in_dim(
+        jnp.argmax(probs, axis=-1).reshape(T), off, T_sh)
+    topp = jax.lax.dynamic_slice_in_dim(
+        jnp.max(probs, axis=-1).reshape(T), off, T_sh)
+    C = max(int(math.ceil(cfg.moe_capacity_factor * T_sh / E)), 1)
+
+    onehot = jax.nn.one_hot(top, E, dtype=jnp.int32)     # [T_sh, E]
+    # position of each token within its expert's queue (arrival order)
+    pos = jnp.cumsum(onehot, axis=0) * onehot - 1
+    slot = jnp.take_along_axis(pos, top[:, None], axis=1)[:, 0]
+    keep = slot < C
+    # overflow tokens land in a scratch column C that is sliced away
+    slot_c = jnp.where(keep, slot, C)
+    disp = jnp.zeros((E, C + 1, d), dt).at[top, slot_c].set(
+        hT.astype(dt))
+    disp = disp[:, :C]                                   # [E, C, d]
+
+    if ax.expert:
+        # queues regrouped so each rank holds the ALL-RANK queues of
+        # its local experts: [E, C, d] -> [e_local, e_size*C, d]
+        disp = jax.lax.all_to_all(disp, ax.expert, split_axis=0,
+                                  concat_axis=1, tiled=True)
+    z = jax.nn.relu(jnp.einsum("ecd,edf->ecf", disp,
+                               bp["ew1"].astype(dt)))
+    y = jnp.einsum("ecf,efd->ecd", z,
+                   bp["ew2"].astype(dt)).astype(jnp.float32)
+    if ax.expert:
+        # route results back to their owner ranks: [E, C, d] again
+        y = jax.lax.all_to_all(y, ax.expert, split_axis=1,
+                               concat_axis=0, tiled=True)
+    y = jnp.pad(y, ((0, 0), (0, 1), (0, 0)))             # overflow row
+    ytok = y[top, slot_c] * (keep * topp)[:, None]        # [T_sh, d]
+    # restore expert-axis replication: every rank contributes its own
+    # token shard, psum rebuilds the full (invariant) token set
+    full = jnp.zeros((T, d), jnp.float32)
+    full = jax.lax.dynamic_update_slice_in_dim(full, ytok, off, axis=0)
+    return _psum_if(full, ax.expert).reshape(b, s, d)
+
+
 def _moe(bp, x, cfg: TransformerConfig, ax: _Axes):
     """Top-1 MoE, experts sharded over ``expert``: each rank runs its
     local experts on its local tokens; psum over the axis combines (the
     gate selects exactly one expert somewhere on the axis). Dense
-    dispatch — production capacity-based all_to_all routing slots in
-    here without touching the surrounding sharding."""
+    dispatch by default; ``cfg.moe_capacity_factor > 0`` switches to
+    the capacity-based all_to_all dispatch (:func:`_moe_capacity`)."""
+    if cfg.moe_capacity_factor > 0:
+        return _moe_capacity(bp, x, cfg, ax)
     dt = _compute_dtype(cfg)
     h = _rmsnorm(x, bp["ln2"])
     # router stays f32 (softmax + argmax routing decisions); the expert
@@ -452,7 +530,10 @@ def build_spmd_train_step(cfg: TransformerConfig, mesh,
         local_step, mesh=mesh,
         in_specs=(specs, specs, data_spec, data_spec, data_spec),
         out_specs=(specs, specs, P()))
-    return jax.jit(sharded)
+    # donate params+velocity: the optimizer update happens in place in
+    # HBM instead of allocating (and copying into) a second full copy
+    # of the model state every step
+    return jax.jit(sharded, donate_argnums=(0, 1))
 
 
 def shard_params(params, cfg: TransformerConfig, mesh):

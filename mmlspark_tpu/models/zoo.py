@@ -34,6 +34,10 @@ class ModelSchema:
     input_shape: List[int]
     layer_names: List[str]
     num_classes: Optional[int] = None
+    # scorer input convention: "uint8" = the net was trained on raw
+    # bytes normalized on device — consumers must score with
+    # NNModel(input_dtype="uint8"); None = pre-normalized floats
+    input_dtype: Optional[str] = None
 
     def to_json(self) -> Dict[str, Any]:
         return dataclasses.asdict(self)
@@ -96,7 +100,8 @@ class ModelRepo:
 
     def publish(self, name: str, fn: NNFunction, dataset: str = "",
                 model_type: str = "", input_shape: Optional[List[int]] = None,
-                num_classes: Optional[int] = None) -> ModelSchema:
+                num_classes: Optional[int] = None,
+                input_dtype: Optional[str] = None) -> ModelSchema:
         """Add a checkpoint to the repo and record its manifest entry."""
         model_dir = _fs.join(self.root, name)
         if _fs.is_remote(self.root):
@@ -120,7 +125,8 @@ class ModelRepo:
             hash=tree_hash,
             input_shape=list(input_shape or []),
             layer_names=fn.layer_names,
-            num_classes=num_classes)
+            num_classes=num_classes,
+            input_dtype=input_dtype)
         # rewrite from the RAW manifest: models() resolves uris against
         # self.root, and re-serializing resolved paths would bake this
         # machine's absolute paths into the portable manifest

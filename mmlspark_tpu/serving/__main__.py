@@ -1,0 +1,117 @@
+"""Runnable serving entrypoints for containers/orchestrators.
+
+``python -m mmlspark_tpu.serving coordinator`` — the driver-side
+registry (`serving.ServingCoordinator`); ``python -m
+mmlspark_tpu.serving worker`` — load a persisted pipeline/transformer
+from ``$MODEL_URI`` (any io.fs path: local dir, gs://...), serve it
+(`serving.ServingServer`), and register ``$POD_IP:$PORT`` with
+``$COORDINATOR_URL``. These are the commands the k8s manifests under
+``tools/k8s/`` run (parity: the reference's spark-serving helm chart,
+`/root/reference/tools/helm/`); the readiness probe hits the server's
+``GET /status``.
+
+Environment:
+  PORT             listen port (default 8000)
+  MODEL_URI        (worker) persisted stage directory to serve
+  COORDINATOR_URL  (worker, optional) http://host:port to register with
+  POD_IP           (worker, optional) address advertised to the
+                   coordinator; defaults to the local hostname
+  MAX_BATCH_SIZE / MAX_LATENCY_MS / JOURNAL_SIZE / JOURNAL_TTL
+                   (worker, optional) ServingServer knobs
+"""
+
+import os
+import signal
+import socket
+import sys
+import threading
+import time
+
+
+def _env_float(name, default):
+    v = os.environ.get(name)
+    return default if v in (None, "") else float(v)
+
+
+def run_coordinator() -> None:
+    from mmlspark_tpu.serving.server import ServingCoordinator
+    port = int(os.environ.get("PORT", "8000"))
+    coord = ServingCoordinator(host="0.0.0.0", port=port).start()
+    print(f"[serving] coordinator listening on :{coord.port}", flush=True)
+    _wait_forever(coord.stop)
+
+
+def run_worker() -> None:
+    from mmlspark_tpu.core.stage import PipelineStage
+    from mmlspark_tpu.serving.server import (
+        ServingCoordinator, ServingServer)
+
+    uri = os.environ.get("MODEL_URI")
+    if not uri:
+        raise SystemExit("worker needs MODEL_URI (a persisted stage dir)")
+    model = PipelineStage.load(uri)
+    port = int(os.environ.get("PORT", "8000"))
+    ttl = _env_float("JOURNAL_TTL", 0.0)
+    srv = ServingServer(
+        model, host="0.0.0.0", port=port,
+        max_batch_size=int(_env_float("MAX_BATCH_SIZE", 64)),
+        max_latency_ms=_env_float("MAX_LATENCY_MS", 10.0),
+        journal_size=int(_env_float("JOURNAL_SIZE", 4096)),
+        journal_ttl=ttl if ttl > 0 else None).start()
+    print(f"[serving] worker serving {uri} on :{srv.port}", flush=True)
+
+    coord_url = os.environ.get("COORDINATOR_URL")
+    if coord_url:
+        ip = os.environ.get("POD_IP") or socket.gethostbyname(
+            socket.gethostname())
+        ServingCoordinator.register_worker(coord_url, ip, srv.port)
+        print(f"[serving] registered {ip}:{srv.port} with {coord_url}",
+              flush=True)
+
+        # periodic re-register: registration is idempotent, so this is
+        # a heartbeat that repopulates a restarted (in-memory-registry)
+        # coordinator without operator intervention
+        def heartbeat():
+            interval = float(os.environ.get("REGISTER_INTERVAL", "10"))
+            while True:
+                time.sleep(interval)
+                try:
+                    ServingCoordinator.register_worker(coord_url, ip,
+                                                       srv.port)
+                except Exception:  # noqa: BLE001 — coordinator down;
+                    pass           # keep serving, retry next tick
+
+        threading.Thread(target=heartbeat, daemon=True).start()
+    _wait_forever(srv.stop)
+
+
+def _wait_forever(stop) -> None:
+    done = threading.Event()
+
+    def handler(signum, frame):
+        done.set()
+
+    signal.signal(signal.SIGTERM, handler)
+    signal.signal(signal.SIGINT, handler)
+    done.wait()
+    stop()
+
+
+def main() -> None:
+    if os.environ.get("MMLSPARK_TPU_SERVING_CPU") == "1":
+        # dev boxes whose sitecustomize pins an accelerator platform:
+        # flip before the first device touch (env vars alone cannot)
+        from mmlspark_tpu.parallel.topology import use_cpu_devices
+        use_cpu_devices(1)
+    role = sys.argv[1] if len(sys.argv) > 1 else ""
+    if role == "coordinator":
+        run_coordinator()
+    elif role == "worker":
+        run_worker()
+    else:
+        raise SystemExit(
+            "usage: python -m mmlspark_tpu.serving coordinator|worker")
+
+
+if __name__ == "__main__":
+    main()

@@ -384,6 +384,13 @@ class ServingCoordinator:
                     return
                 with coordinator._lock:
                     if self.path == "/register":
+                        # idempotent: a re-registering worker (periodic
+                        # heartbeat, or after a coordinator restart)
+                        # replaces its old entry instead of duplicating
+                        coordinator._services = [
+                            s for s in coordinator._services
+                            if (s.get("host"), s.get("port"))
+                            != (info.get("host"), info.get("port"))]
                         coordinator._services.append(info)
                     else:
                         coordinator._services = [

@@ -198,6 +198,15 @@ class TestTrialDevices:
         # 8 grid trials x 2 folds round-robined over the 8-device mesh
         assert len(set(seen)) == len(jax.local_devices())
 
+        # the DEFAULT ("auto") must behave the same on a multi-device
+        # host — device-parallel tuning is on out of the box there
+        seen.clear()
+        TuneHyperparameters(
+            models=[Recorder()], param_space=space, search_mode="grid",
+            evaluation_metric="mean_squared_error", num_folds=2,
+            parallelism=8, label_col="label").fit(df)
+        assert len(set(seen)) == len(jax.local_devices())
+
     def test_device_parallel_matches_thread_pool(self):
         df = _binary_df(150)
         space = {"num_leaves": DiscreteHyperParam([3, 7]),

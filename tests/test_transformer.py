@@ -126,6 +126,59 @@ class TestSpmdTrainStep:
                                   d_ff=32, layers_per_stage=2, n_experts=2)
         _compare({"expert": 2}, cfg)
 
+    def test_expert_parallel_capacity_dispatch(self):
+        # capacity-based all_to_all dispatch must equal the dense-dispatch
+        # golden when the budget is large enough that no token drops
+        cfg = T.TransformerConfig(vocab=64, d_model=16, n_heads=2, d_head=8,
+                                  d_ff=32, layers_per_stage=2, n_experts=2,
+                                  moe_capacity_factor=4.0)
+        _compare({"expert": 2}, cfg)
+
+    def test_capacity_dispatch_drops_overflow(self):
+        # a tight budget must still train (dropped tokens ride the
+        # residual), not crash or NaN
+        cfg = T.TransformerConfig(vocab=64, d_model=16, n_heads=2, d_head=8,
+                                  d_ff=32, layers_per_stage=2, n_experts=2,
+                                  moe_capacity_factor=0.5)
+        mesh = submesh({"expert": 2})
+        rng = np.random.default_rng(3)
+        tokens, labels, mask = T.make_batch(rng, cfg, 8, 16)
+        step = T.build_spmd_train_step(cfg, mesh, 0.1, 0.9)
+        params = T.shard_params(T.init_params(cfg, 0), cfg, mesh)
+        vel = T.shard_params(
+            jax.tree.map(jnp.zeros_like, T.init_params(cfg, 0)), cfg, mesh)
+        losses = []
+        for _ in range(4):
+            params, vel, loss = step(params, vel, tokens, labels, mask)
+            losses.append(float(loss))
+        assert np.isfinite(losses).all()
+        assert losses[-1] < losses[0]
+
+    def test_capacity_flops_scale_with_factor_not_experts(self):
+        # the point of capacity dispatch: expert compute ~ factor, not E
+        def step_flops(n_experts, factor):
+            cfg = T.TransformerConfig(vocab=32, d_model=32, n_heads=2,
+                                      d_head=16, d_ff=256,
+                                      layers_per_stage=1,
+                                      n_experts=n_experts,
+                                      moe_capacity_factor=factor)
+            mesh = submesh({"data": 1})
+            rng = np.random.default_rng(0)
+            tokens, labels, mask = T.make_batch(rng, cfg, 4, 32)
+            params = T.shard_params(T.init_params(cfg, 0), cfg, mesh)
+            vel = T.shard_params(
+                jax.tree.map(jnp.zeros_like, T.init_params(cfg, 0)),
+                cfg, mesh)
+            step = T.build_spmd_train_step(cfg, mesh, 0.1, 0.9)
+            cost = step.lower(params, vel, tokens, labels,
+                              mask).compile().cost_analysis()
+            return float(cost["flops"])
+
+        cap_2, cap_8 = step_flops(2, 1.0), step_flops(8, 1.0)
+        dense_2, dense_8 = step_flops(2, 0.0), step_flops(8, 0.0)
+        assert dense_8 / dense_2 > 2.0       # dense pays per expert
+        assert cap_8 / cap_2 < 1.35          # capacity does not
+
     def test_full_composition_5axis(self):
         """tp+pp+sp+ep+dp in one mesh — the pod-shaped program."""
         cfg = T.TransformerConfig(**_DENSE, n_stages=2, n_experts=2,

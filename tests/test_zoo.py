@@ -20,6 +20,8 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 ZOO = os.path.join(REPO, "zoo")
 GOLDEN = os.path.join(REPO, "tests", "resources",
                       "golden_digits_resnet8.npz")
+GOLDEN_CIFAR = os.path.join(REPO, "tests", "resources",
+                            "golden_cifar10s_resnet20.npz")
 
 
 @pytest.fixture
@@ -81,3 +83,84 @@ class TestShippedZoo:
         acc_rand = linear_probe_acc(random_fn)
         assert acc_pre > acc_rand, (acc_pre, acc_rand)
         assert acc_pre >= 0.9, acc_pre
+
+
+class TestCifarZoo:
+    """The CIFAR-scale zoo model (ResNet-20, 32x32x3, 10 classes) —
+    trained on TPU by `tools/train_zoo_models.py cifar` (real CIFAR-10
+    when its files exist; otherwise the committed procedural surrogate,
+    recorded in the manifest's dataset field)."""
+
+    def test_manifest_entry(self, downloader):
+        meta = downloader.list_models()["cifar10s_resnet20"]
+        assert meta.input_shape == [32, 32, 3]
+        assert meta.num_classes == 10
+        assert meta.model_type == "cifar_resnet/20"
+        assert meta.input_dtype == "uint8"   # scorer input convention
+
+    def test_golden_logits_and_accuracy_gate(self, downloader):
+        fn = downloader.load("cifar10s_resnet20")
+        g = np.load(GOLDEN_CIFAR)
+        got = np.asarray(fn.apply(g["x"].astype(np.float32) / 255.0),
+                         dtype=np.float32)
+        np.testing.assert_allclose(got, g["logits"], rtol=1e-4, atol=1e-4)
+        assert float(g["test_accuracy"]) >= 0.90   # committed gate
+
+    @staticmethod
+    def _require_synth_weights(downloader):
+        # the synth-data accuracy gates only make sense for weights
+        # trained on the synth corpus; a republish from real CIFAR-10
+        # (the documented preferred path) records "cifar-10" in the
+        # manifest and these gates step aside
+        meta = downloader.list_models()["cifar10s_resnet20"]
+        if not meta.dataset.startswith("synth"):
+            pytest.skip(f"zoo weights trained on {meta.dataset}, "
+                        f"not the synth corpus")
+
+    def test_scores_through_nnmodel_uint8(self, downloader):
+        # the manifest's input_dtype wires straight into NNModel so a
+        # consumer scores raw uint8 images with on-device normalize
+        from mmlspark_tpu.core.dataframe import DataFrame
+        from mmlspark_tpu.models.nn import NNModel
+        from mmlspark_tpu.testing.datagen import synth_cifar
+
+        self._require_synth_weights(downloader)
+        meta = downloader.list_models()["cifar10s_resnet20"]
+        fn = downloader.load("cifar10s_resnet20")
+        scorer = NNModel(model=fn, input_col="image", output_col="scores",
+                         input_dtype=meta.input_dtype, batch_size=256)
+        X, y = synth_cifar(800, seed=2_000_003)
+        out = scorer.transform(DataFrame({"image": X}))
+        acc = float((np.asarray(out["scores"]).argmax(1) == y).mean())
+        assert acc >= 0.85, acc   # fresh draw, not the committed split
+
+    def test_transfer_to_unseen_families(self, downloader):
+        """Pool features must transfer to pattern families 10/11, which
+        training never saw — same criterion as the digits model."""
+        from mmlspark_tpu.models.function import NNFunction
+        from mmlspark_tpu.testing.datagen import synth_cifar
+
+        self._require_synth_weights(downloader)
+        X, y = synth_cifar(600, seed=77, classes=(10, 11))
+        Xf = X.astype(np.float32) / 255.0
+        n_tr = len(X) // 2
+
+        pretrained = downloader.load("cifar10s_resnet20")
+        random_fn = NNFunction.init(pretrained.arch,
+                                    input_shape=(32, 32, 3), seed=3)
+
+        def linear_probe_acc(fn):
+            emb = np.asarray(fn.apply(Xf, output_layer="pool"),
+                             dtype=np.float64)
+            emb = (emb - emb[:n_tr].mean(0)) / (emb[:n_tr].std(0) + 1e-9)
+            A = emb[:n_tr]
+            t = y[:n_tr] * 2.0 - 1.0
+            wgt = np.linalg.solve(A.T @ A + 1e-3 * np.eye(A.shape[1]),
+                                  A.T @ t)
+            pred = (emb[n_tr:] @ wgt) > 0
+            return float((pred == y[n_tr:].astype(bool)).mean())
+
+        acc_pre = linear_probe_acc(pretrained)
+        acc_rand = linear_probe_acc(random_fn)
+        assert acc_pre > acc_rand, (acc_pre, acc_rand)
+        assert acc_pre >= 0.8, acc_pre

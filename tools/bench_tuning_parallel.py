@@ -1,0 +1,70 @@
+"""Measure device-parallel tuning vs the shared-device thread pool.
+
+SURVEY §2.9 row 6: the reference's TuneHyperparameters runs trials on a
+driver thread pool contending for shared Spark executors; the TPU-first
+version pins each trial to its own chip (``trial_devices``, now ``auto``
+— on whenever the host has >1 device). This records the wall-clock
+comparison artifact on the virtual 8-device CPU mesh.
+
+NOTE: virtual CPU devices timeshare physical cores, so the win is only
+measurable on a multi-core host (a 1-core box shows ~1.0x by
+construction — the same reason tests/test_automl.py gates its
+wall-clock assertion on core count). Run on a multi-core machine:
+
+    python tools/bench_tuning_parallel.py
+
+Writes ``docs/artifacts/tuning_parallel.json``.
+"""
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from mmlspark_tpu.parallel.topology import use_cpu_devices  # noqa: E402
+
+use_cpu_devices(8)
+
+
+def main() -> None:
+    from mmlspark_tpu.core.dataframe import DataFrame, obj_col
+    from mmlspark_tpu.gbdt import GBDTClassifier
+    from mmlspark_tpu.automl.train import TrainClassifier
+    from mmlspark_tpu.automl.tune import (
+        DiscreteHyperParam, TuneHyperparameters)
+
+    rng = np.random.default_rng(0)
+    X = rng.normal(size=(2000, 12))
+    y = (X[:, 0] + X[:, 1] * 0.5 + 0.4 * rng.normal(size=2000) > 0
+         ).astype(np.int64)
+    df = DataFrame({"features": obj_col(list(X)), "label": y})
+    space = {"num_leaves": DiscreteHyperParam([7, 15, 31, 63]),
+             "num_iterations": DiscreteHyperParam([20, 40])}
+
+    out = {"n_cores": len(os.sched_getaffinity(0)), "n_devices": 8}
+    for key, td in (("pinned_devices_s", True), ("shared_device_s", False)):
+        t0 = time.perf_counter()
+        TuneHyperparameters(
+            models=[TrainClassifier(model=GBDTClassifier(min_data_in_leaf=5),
+                                    label_col="label")],
+            param_space=space, evaluation_metric="accuracy",
+            num_folds=2, num_runs=6, parallelism=4, seed=1,
+            trial_devices=td).fit(df)
+        out[key] = round(time.perf_counter() - t0, 2)
+    out["speedup"] = round(out["shared_device_s"]
+                           / max(out["pinned_devices_s"], 1e-9), 2)
+
+    path = os.path.join(REPO, "docs", "artifacts", "tuning_parallel.json")
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    with open(path, "w") as f:
+        json.dump(out, f, indent=2)
+    print(json.dumps(out))
+
+
+if __name__ == "__main__":
+    main()
