@@ -21,7 +21,7 @@ from typing import Any, Dict, Iterator, List, Optional, Sequence, Tuple
 import numpy as np
 
 from mmlspark_tpu.core.dataframe import DataFrame, py_scalar as _scalar
-from mmlspark_tpu.core.params import Param, HasLabelCol, in_range
+from mmlspark_tpu.core.params import Param, HasLabelCol, in_range, in_set
 from mmlspark_tpu.core.stage import Estimator, Model, PipelineStage
 from mmlspark_tpu.automl.metrics import ComputeModelStatistics
 from mmlspark_tpu.automl.best import metric_higher_is_better
@@ -187,7 +187,7 @@ class TuneHyperparameters(Estimator, HasLabelCol):
                           "contending for one; parallelism should be "
                           ">= the device count. auto = enabled whenever "
                           "the host has more than one device | True | "
-                          "False")
+                          "False", validator=in_set("auto", True, False))
 
     def _spaces(self) -> List[Dict[str, Any]]:
         models = self.models or []

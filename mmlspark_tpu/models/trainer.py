@@ -179,6 +179,10 @@ class NNLearner(Estimator, HasLabelCol, HasFeaturesCol):
         # at scoring time would silently corrupt values > 255
         is_int = x.dtype == np.uint8
         scale = np.float32(1.0 / 255.0) if is_int else np.float32(1.0)
+        # datasets smaller than the batch keep working (the host loop
+        # pads ragged batches; here the batch shrinks to the data)
+        bs = min(bs, len(x))
+        steps_per_epoch = max(len(x) // bs, 1)
         x_dev = jnp.asarray(x)
         y_dev = jnp.asarray(y)
         w_dev = jnp.asarray(w)
@@ -247,7 +251,10 @@ class NNLearner(Estimator, HasLabelCol, HasFeaturesCol):
         # f32, but a device-resident fit keeps integer image data
         # integer ON THE LINK and normalizes on device
         x = _stack_column(df[self.features_col])
-        if not (self.device_resident and x.dtype == np.uint8):
+        # uint8 survives for BOTH paths (each normalizes /255 and tags
+        # the scorer identically — a perf flag must never change the
+        # learned function); every other dtype trains as f32
+        if x.dtype != np.uint8:
             x = x.astype(np.float32, copy=False)
         y = np.asarray(df[self.label_col])
         w = (np.asarray(df[self.weight_col], dtype=np.float32)

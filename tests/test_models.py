@@ -130,6 +130,45 @@ class TestNNModel:
         out = NNModel(model=convnet, input_col="image").transform(df)
         assert out["scores"].shape == (3, 10)
 
+    def test_fetch_group_sizes_identical_outputs(self, convnet, rng):
+        # grouped device-side-concat fetches must be a pure perf knob:
+        # every group size (incl. 1 = per-batch draining) yields the
+        # same scores in the same order
+        imgs = rng.uniform(0, 1, (37, 32, 32, 3)).astype(np.float32)
+        df = DataFrame({"image": imgs})
+        ref = None
+        for fetch in (1, 2, 64):
+            out = NNModel(model=convnet, input_col="image", batch_size=8,
+                          fetch_batches=fetch).transform(df)["scores"]
+            if ref is None:
+                ref = np.asarray(out)
+            else:
+                np.testing.assert_array_equal(np.asarray(out), ref,
+                                              err_msg=f"fetch={fetch}")
+
+    def test_uint8_input_matches_normalized_float(self, convnet, rng):
+        # uint8 transfer + on-device x/255 == pre-normalized f32 path
+        u8 = rng.integers(0, 256, (20, 32, 32, 3), dtype=np.uint8)
+        out_u8 = NNModel(model=convnet, input_col="image",
+                         input_dtype="uint8", batch_size=8).transform(
+            DataFrame({"image": u8}))["scores"]
+        out_f = NNModel(model=convnet, input_col="image",
+                        batch_size=8).transform(
+            DataFrame({"image": u8.astype(np.float32) / 255.0}))["scores"]
+        np.testing.assert_allclose(np.asarray(out_u8), np.asarray(out_f),
+                                   rtol=1e-4, atol=1e-5)
+
+    def test_input_scale_offset_applied_on_device(self, convnet, rng):
+        # explicit affine preprocessing fused into the forward
+        x = rng.uniform(0, 1, (6, 32, 32, 3)).astype(np.float32)
+        out_pre = NNModel(model=convnet, input_col="image").transform(
+            DataFrame({"image": x * 2.0 - 1.0}))["scores"]
+        out_dev = NNModel(model=convnet, input_col="image",
+                          input_scale=2.0, input_offset=-1.0).transform(
+            DataFrame({"image": x}))["scores"]
+        np.testing.assert_allclose(np.asarray(out_dev), np.asarray(out_pre),
+                                   rtol=1e-4, atol=1e-5)
+
 
 class TestZoo:
     def test_publish_download_load(self, convnet, tmp_path, images):

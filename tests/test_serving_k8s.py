@@ -101,8 +101,14 @@ class TestEntrypointFleet:
                              POD_IP="127.0.0.1", MAX_LATENCY_MS="1"),
                     cwd=REPO, stdout=subprocess.PIPE, text=True)
                 procs.append(wp)
-                while "registered" not in wp.stdout.readline():
-                    pass
+                while True:
+                    line = wp.stdout.readline()
+                    if not line:   # EOF: worker died before registering
+                        raise AssertionError(
+                            f"worker exited rc={wp.poll()} before "
+                            f"registering")
+                    if "registered" in line:
+                        break
 
             from mmlspark_tpu.serving.server import ServingClient
             client = ServingClient(coord_url, timeout=30)

@@ -35,6 +35,78 @@ class TestNNLearner:
         model = learner.fit(blobs)
         assert _accuracy(model, blobs) > 0.95
 
+    def test_device_resident_learns_blobs(self, blobs):
+        # whole-epoch scanned fit (one dispatch + one fetch per epoch);
+        # single_device_scope forces n_data == 1 so the scanned path
+        # (not the multi-shard host fallback) is what runs on the CI mesh
+        from mmlspark_tpu.parallel.topology import single_device_scope
+        learner = NNLearner(arch={"builder": "mlp", "hidden": [16],
+                                  "num_outputs": 2},
+                            optimizer="adam", learning_rate=0.01,
+                            epochs=5, batch_size=64, log_every=0,
+                            device_resident=True)
+        with single_device_scope():
+            model = learner.fit(blobs)
+        assert _accuracy(model, blobs) > 0.95
+        assert model.input_dtype == "auto"   # floats: no uint8 tagging
+
+    @pytest.mark.parametrize("device_resident", [True, False])
+    def test_uint8_images_round_trip(self, rng, device_resident):
+        # uint8 stays uint8 on the wire, /255 on device, and the
+        # returned scorer carries the same input convention — on BOTH
+        # paths (a perf flag must not change the learned function)
+        from mmlspark_tpu.parallel.topology import single_device_scope
+        lo = rng.integers(0, 110, (120, 64))
+        hi = rng.integers(145, 256, (120, 64))
+        x = np.concatenate([lo, hi]).astype(np.uint8)
+        y = np.r_[np.zeros(120), np.ones(120)].astype(np.int64)
+        order = rng.permutation(len(x))
+        x, y = x[order], y[order]
+        df = DataFrame({"features": x, "label": y})
+        learner = NNLearner(arch={"builder": "mlp", "hidden": [8],
+                                  "num_outputs": 2},
+                            optimizer="adam", learning_rate=0.05,
+                            epochs=20, batch_size=48, log_every=0,
+                            device_resident=device_resident, clip_norm=1.0)
+        with single_device_scope():
+            model = learner.fit(df)
+        assert model.input_dtype == "uint8"
+        assert _accuracy(model, df) > 0.9
+
+    def test_device_resident_dataset_smaller_than_batch(self, rng):
+        from mmlspark_tpu.parallel.topology import single_device_scope
+        lo = rng.integers(0, 110, (20, 16))
+        hi = rng.integers(145, 256, (20, 16))
+        x = np.concatenate([lo, hi]).astype(np.uint8)
+        y = np.r_[np.zeros(20), np.ones(20)].astype(np.int64)
+        df = DataFrame({"features": x, "label": y})
+        learner = NNLearner(arch={"builder": "mlp", "hidden": [8],
+                                  "num_outputs": 2},
+                            optimizer="adam", learning_rate=0.05,
+                            epochs=30, batch_size=256, log_every=0,
+                            device_resident=True)
+        with single_device_scope():
+            model = learner.fit(df)   # bs shrinks to the data
+        assert _accuracy(model, df) > 0.9
+
+    def test_augmentation_preserves_shapes_and_learns(self, rng):
+        # dominant-channel label with a wide margin: invariant under
+        # flips/translations, so augmented views stay consistent
+        from mmlspark_tpu.parallel.topology import single_device_scope
+        x = rng.integers(0, 120, (200, 8, 8, 3))
+        y = rng.integers(0, 2, 200).astype(np.int64)
+        x[np.arange(200), :, :, y] += 110
+        x = x.astype(np.uint8)
+        df = DataFrame({"features": x, "label": y})
+        learner = NNLearner(arch={"builder": "cifar_convnet",
+                                  "num_classes": 2},
+                            epochs=6, batch_size=50, learning_rate=0.02,
+                            optimizer="adam", log_every=0,
+                            device_resident=True, augment="flip_crop")
+        with single_device_scope():
+            model = learner.fit(df)
+        assert _accuracy(model, df) > 0.85
+
     def test_regression_loss(self, rng):
         x = rng.normal(size=(512, 3)).astype(np.float32)
         w_true = np.array([1.0, -2.0, 0.5], dtype=np.float32)

@@ -6,14 +6,21 @@ version pins each trial to its own chip (``trial_devices``, now ``auto``
 — on whenever the host has >1 device). This records the wall-clock
 comparison artifact on the virtual 8-device CPU mesh.
 
-NOTE: virtual CPU devices timeshare physical cores, so the win is only
-measurable on a multi-core host (a 1-core box shows ~1.0x by
-construction — the same reason tests/test_automl.py gates its
-wall-clock assertion on core count). Run on a multi-core machine:
+Two distinct effects add up, and the artifact records which host shape
+measured them:
+
+- On ANY host (even 1 core — the committed artifact's 3.8x): pinning
+  removes cross-thread contention on a single device's execution
+  stream (concurrent trials interleaving dispatches against one device
+  serialize far worse than independent per-device queues).
+- On multi-core hosts, the virtual devices additionally run trial
+  compute in true parallel, compounding the win (the reason
+  tests/test_automl.py's wall-clock assertion is gated on core count).
 
     python tools/bench_tuning_parallel.py
 
-Writes ``docs/artifacts/tuning_parallel.json``.
+Writes ``docs/artifacts/tuning_parallel.json`` (n_cores included so
+the number is interpretable).
 """
 
 import json
@@ -46,7 +53,10 @@ def main() -> None:
     space = {"num_leaves": DiscreteHyperParam([7, 15, 31, 63]),
              "num_iterations": DiscreteHyperParam([20, 40])}
 
-    out = {"n_cores": len(os.sched_getaffinity(0)), "n_devices": 8}
+    out = {"n_cores": len(os.sched_getaffinity(0)), "n_devices": 8,
+           "mechanism": ("dispatch-contention relief only (1 core)"
+                         if len(os.sched_getaffinity(0)) == 1 else
+                         "contention relief + parallel trial compute")}
     for key, td in (("pinned_devices_s", True), ("shared_device_s", False)):
         t0 = time.perf_counter()
         TuneHyperparameters(
