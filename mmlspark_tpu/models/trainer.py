@@ -157,8 +157,7 @@ class NNLearner(Estimator, HasLabelCol, HasFeaturesCol):
 
         return jax.vmap(crop)(padded, offs)
 
-    def _fit_device_resident(self, x, y, w, fn, module, mesh, bs,
-                             steps_per_epoch, tx, loss_fn):
+    def _fit_device_resident(self, x, y, w, fn, module, bs, tx, loss_fn):
         """Whole-epoch scanned training with a device-resident dataset.
 
         The per-step host loop below pays one host->device batch
@@ -284,14 +283,20 @@ class NNLearner(Estimator, HasLabelCol, HasFeaturesCol):
         loss_fn = make_loss(self.loss)
         if self.device_resident and n_data == 1 \
                 and self._checkpoint_manager() is None:
-            return self._fit_device_resident(x, y, w, fn, module, mesh,
-                                             bs, steps_per_epoch, tx,
-                                             loss_fn)
+            return self._fit_device_resident(x, y, w, fn, module, bs,
+                                             tx, loss_fn)
+        if self.augment != "none":
+            import warnings
+            warnings.warn(
+                "augment is applied by the device-resident scanned fit "
+                "only; this fit takes the per-step host loop "
+                f"(device_resident={self.device_resident}, data shards="
+                f"{n_data}, checkpointing="
+                f"{self.checkpoint_dir is not None}) and trains WITHOUT "
+                "augmentation", stacklevel=2)
         was_int = x.dtype == np.uint8        # image bytes only, as above
         if was_int:
             x = x.astype(np.float32) / 255.0   # host fallback normalizes
-        elif not np.issubdtype(x.dtype, np.floating):
-            x = x.astype(np.float32)
         step = jax.jit(self.build_train_step(module, tx, loss_fn),
                        donate_argnums=(0, 1))
 

@@ -65,6 +65,12 @@ class TransformerConfig:
     # over the expert axis, overflow tokens dropped to the residual —
     # compute scales with the factor, not the expert count
     moe_capacity_factor: float = 0.0
+    # Switch-style load-balancing auxiliary loss weight (0 = off). With
+    # capacity routing this is what keeps experts from collapsing to a
+    # favored few (and overflow drops bounded): per MoE layer,
+    # aux = E * sum_e f_e * P_e with f_e the routed-token fraction and
+    # P_e the mean router probability — 1.0 at perfect balance.
+    moe_aux_weight: float = 0.0
     microbatches: int = 1
     dtype: str = "float32"
     # un-ring-sharded attention engine: "dense" = XLA softmax-attention;
@@ -265,6 +271,26 @@ def _mlp(bp, x, ax: _Axes, cfg: TransformerConfig):
     return _psum_if(y, ax.model) + bp["b2"]
 
 
+def _router_stats(probs2d, top, E: int, axes):
+    """GLOBAL per-layer routing statistics for the Switch aux loss.
+
+    ``probs2d`` (T_local, E) / ``top`` (T_local,) are this rank's token
+    share; returns ``(f, P)`` — routed-fraction and mean-probability
+    vectors pmean'd over every token-holding axis in ``axes``. The aux
+    ``E * sum_e f_e P_e`` is NONLINEAR in (f, P), so only these linear
+    statistics may be averaged across shards (and across microbatches —
+    see ``local_loss``); the product is taken once, at the end, from the
+    fully aggregated vectors, exactly matching the unsharded golden.
+    """
+    f = jnp.mean(jax.nn.one_hot(top, E, dtype=jnp.float32), axis=0)
+    P = jnp.mean(probs2d.astype(jnp.float32), axis=0)
+    for a in axes:
+        if a:
+            f = jax.lax.pmean(f, a)
+            P = jax.lax.pmean(P, a)
+    return f, P
+
+
 def _moe_capacity(bp, x, cfg: TransformerConfig, ax: _Axes):
     """Capacity-factor top-1 MoE dispatch (the production shape).
 
@@ -328,11 +354,16 @@ def _moe_capacity(bp, x, cfg: TransformerConfig, ax: _Axes):
                                concat_axis=0, tiled=True)
     y = jnp.pad(y, ((0, 0), (0, 1), (0, 0)))             # overflow row
     ytok = y[top, slot_c] * (keep * topp)[:, None]        # [T_sh, d]
+    stats = (jnp.zeros(E, jnp.float32), jnp.zeros(E, jnp.float32))
+    if cfg.moe_aux_weight > 0:
+        pT = jax.lax.dynamic_slice_in_dim(
+            probs.reshape(T, E), off, T_sh)
+        stats = _router_stats(pT, top, E, (ax.data, ax.seq, ax.expert))
     # restore expert-axis replication: every rank contributes its own
     # token shard, psum rebuilds the full (invariant) token set
     full = jnp.zeros((T, d), jnp.float32)
     full = jax.lax.dynamic_update_slice_in_dim(full, ytok, off, axis=0)
-    return _psum_if(full, ax.expert).reshape(b, s, d)
+    return _psum_if(full, ax.expert).reshape(b, s, d), stats
 
 
 def _moe(bp, x, cfg: TransformerConfig, ax: _Axes):
@@ -340,7 +371,9 @@ def _moe(bp, x, cfg: TransformerConfig, ax: _Axes):
     local experts on its local tokens; psum over the axis combines (the
     gate selects exactly one expert somewhere on the axis). Dense
     dispatch by default; ``cfg.moe_capacity_factor > 0`` switches to
-    the capacity-based all_to_all dispatch (:func:`_moe_capacity`)."""
+    the capacity-based all_to_all dispatch (:func:`_moe_capacity`).
+    Returns ``(y, aux)`` — the load-balancing aux scalar is 0 unless
+    ``cfg.moe_aux_weight > 0``."""
     if cfg.moe_capacity_factor > 0:
         return _moe_capacity(bp, x, cfg, ax)
     dt = _compute_dtype(cfg)
@@ -363,18 +396,37 @@ def _moe(bp, x, cfg: TransformerConfig, ax: _Axes):
         z = jnp.einsum("bsf,fd->bsd", z,
                        bp["ew2"][e].astype(dt)).astype(jnp.float32)
         y = y + z * sel[..., None]
-    return _psum_if(y, ax.expert)
+    E = cfg.n_experts
+    stats = (jnp.zeros(E, jnp.float32), jnp.zeros(E, jnp.float32))
+    if cfg.moe_aux_weight > 0:
+        # tokens are REPLICATED over the expert axis here, so only the
+        # data/seq axes hold distinct tokens
+        stats = _router_stats(probs.reshape(-1, E), top.reshape(-1), E,
+                              (ax.data, ax.seq))
+    return _psum_if(y, ax.expert), stats
 
 
 def _stage(stage_blocks, x, cfg: TransformerConfig, ax: _Axes, pos):
-    """One pipeline stage = ``layers_per_stage`` transformer blocks."""
+    """One pipeline stage = ``layers_per_stage`` transformer blocks.
+    Returns ``(x, f_stack, P_stack)``: per-block [n_blocks, E] routing
+    statistics for the load-balancing aux (zeros when dense-MLP or aux
+    disabled) — kept as linear stats so microbatches can be averaged
+    before the aux's nonlinear product (see ``local_loss``)."""
+    fs, Ps = [], []
     for bp in stage_blocks:
         x = x + _attention(bp, x, cfg, ax, pos)
         if cfg.n_experts:
-            x = x + _moe(bp, x, cfg, ax)
+            y, (f, P) = _moe(bp, x, cfg, ax)
+            x = x + y
+            fs.append(f)
+            Ps.append(P)
         else:
             x = x + _mlp(bp, x, ax, cfg)
-    return x
+    if not fs:
+        z = jnp.zeros((len(stage_blocks), max(cfg.n_experts, 1)),
+                      jnp.float32)
+        return x, z, z
+    return x, jnp.stack(fs), jnp.stack(Ps)
 
 
 def local_loss(params, tokens, labels, mask, cfg: TransformerConfig,
@@ -401,11 +453,22 @@ def local_loss(params, tokens, labels, mask, cfg: TransformerConfig,
     state = jnp.zeros((mb, s_loc, cfg.d_model), jnp.float32)
     out = jnp.zeros((m, mb, s_loc, cfg.d_model), jnp.float32)
     perm = [(i, (i + 1) % p_size) for i in range(p_size)]
+    n_blk = len(stage_blocks)
+    F_acc = jnp.zeros((n_blk, max(cfg.n_experts, 1)), jnp.float32)
+    P_acc = jnp.zeros_like(F_acc)
     for t in range(m + p_size - 1):
         if t < m:
             inp = params["embed"][tok_mb[t]]             # [mb, S_loc, D]
             state = jnp.where(p_rank == 0, inp, state)
-        state = _stage(stage_blocks, state, cfg, ax, pos)
+        state, f_t, p_t = _stage(stage_blocks, state, cfg, ax, pos)
+        if cfg.n_experts and cfg.moe_aux_weight > 0:
+            # accumulate only ticks where REAL data flows through this
+            # rank (fill/drain ticks carry garbage activations); the
+            # stats are linear, so averaging them over microbatches then
+            # taking the product equals the full-batch aux exactly
+            real = ((p_rank <= t) & (t < p_rank + m)).astype(jnp.float32)
+            F_acc = F_acc + f_t * real
+            P_acc = P_acc + p_t * real
         o_idx = t - (p_size - 1)
         if o_idx >= 0:
             out = out.at[o_idx].set(
@@ -438,7 +501,16 @@ def local_loss(params, tokens, labels, mask, cfg: TransformerConfig,
     if axes:
         loss_sum = jax.lax.psum(loss_sum, axes)
         count = jax.lax.psum(count, axes)
-    return loss_sum / jnp.maximum(count, 1.0)
+    loss = loss_sum / jnp.maximum(count, 1.0)
+    if cfg.n_experts and cfg.moe_aux_weight > 0:
+        # per-layer aux from microbatch-averaged (f, P), summed over
+        # this rank's layers, then over all stages (each pipe rank
+        # holds different layers)
+        aux = cfg.n_experts * jnp.sum((F_acc / m) * (P_acc / m))
+        if ax.pipe:
+            aux = jax.lax.psum(aux, ax.pipe)
+        loss = loss + cfg.moe_aux_weight * aux
+    return loss
 
 
 # ---------------------------------------------------------------------------
@@ -449,6 +521,7 @@ def reference_loss(params, tokens, labels, mask, cfg: TransformerConfig):
     """Same math on one device: dense attention, dense MoE, no pipeline."""
     x = params["embed"][tokens]
     pos = jnp.arange(tokens.shape[1])
+    aux_total = jnp.float32(0.0)
     for s in range(cfg.n_stages):
         for bp_all in params["blocks"]:
             bp = {k: v[s] for k, v in bp_all.items()}
@@ -471,6 +544,11 @@ def reference_loss(params, tokens, labels, mask, cfg: TransformerConfig):
                     z = jnp.einsum("bsf,fd->bsd", z, bp["ew2"][e])
                     y = y + z * sel[..., None]
                 x = x + y
+                if cfg.moe_aux_weight > 0:
+                    f, P = _router_stats(
+                        probs.reshape(-1, cfg.n_experts),
+                        top.reshape(-1), cfg.n_experts, ())
+                    aux_total = aux_total + cfg.n_experts * jnp.sum(f * P)
             else:
                 z = jax.nn.relu(
                     jnp.einsum("bsd,df->bsf", h, bp["w1"]) + bp["b1"])
@@ -480,7 +558,8 @@ def reference_loss(params, tokens, labels, mask, cfg: TransformerConfig):
     lse = jax.nn.logsumexp(logits, axis=-1)
     gold = jnp.take_along_axis(logits, labels[..., None], axis=-1)[..., 0]
     ce = lse - gold
-    return jnp.sum(ce * mask) / jnp.maximum(jnp.sum(mask), 1.0)
+    loss = jnp.sum(ce * mask) / jnp.maximum(jnp.sum(mask), 1.0)
+    return loss + cfg.moe_aux_weight * aux_total
 
 
 # ---------------------------------------------------------------------------

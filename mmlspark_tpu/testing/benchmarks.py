@@ -49,7 +49,9 @@ class Benchmarks:
 
     def _write_new(self, precisions: Dict[str, float]) -> None:
         with open(self.new_csv_path, "w", newline="") as f:
-            w = csv.writer(f)
+            # csv defaults to \r\n; committed fixtures stay LF like the
+            # rest of the repo
+            w = csv.writer(f, lineterminator="\n")
             w.writerow(["name", "value", "precision"])
             for entry, value in self.entries:
                 # entries without a committed precision get a

@@ -134,6 +134,45 @@ class TestSpmdTrainStep:
                                   moe_capacity_factor=4.0)
         _compare({"expert": 2}, cfg)
 
+    @pytest.mark.parametrize("capacity", [0.0, 4.0])
+    def test_load_balancing_aux_matches_golden(self, capacity):
+        # the Switch aux is computed from GLOBAL (f, P) router stats —
+        # pmean'd across every token-holding axis BEFORE the nonlinear
+        # product — so sharded training must equal the unsharded golden
+        # for both dense and capacity dispatch
+        cfg = T.TransformerConfig(vocab=64, d_model=16, n_heads=2, d_head=8,
+                                  d_ff=32, layers_per_stage=2, n_experts=2,
+                                  moe_capacity_factor=capacity,
+                                  moe_aux_weight=0.02)
+        _compare({"expert": 2}, cfg)
+
+    def test_aux_balances_expert_load(self):
+        # with the aux on, a few steps must reduce routing imbalance
+        cfg = T.TransformerConfig(vocab=64, d_model=16, n_heads=2, d_head=8,
+                                  d_ff=32, layers_per_stage=2, n_experts=4,
+                                  moe_capacity_factor=1.0,
+                                  moe_aux_weight=1.0)
+        mesh = submesh({"data": 2})
+        rng = np.random.default_rng(9)
+        tokens, labels, mask = T.make_batch(rng, cfg, 8, 16)
+        step = T.build_spmd_train_step(cfg, mesh, 0.3, 0.9)
+        params = T.shard_params(T.init_params(cfg, 4), cfg, mesh)
+        vel = T.shard_params(
+            jax.tree.map(jnp.zeros_like, T.init_params(cfg, 4)), cfg, mesh)
+
+        def max_frac(p):
+            host = jax.device_get(p)
+            h = np.asarray(host["embed"])[np.asarray(tokens)]
+            router = np.asarray(host["blocks"][0]["router"][0])
+            top = (h @ router).argmax(-1).reshape(-1)
+            return float(max(np.bincount(top, minlength=4) / len(top)))
+
+        before = max_frac(params)
+        for _ in range(10):
+            params, vel, _ = step(params, vel, tokens, labels, mask)
+        after = max_frac(params)
+        assert after <= before + 1e-6, (before, after)
+
     def test_capacity_dispatch_drops_overflow(self):
         # a tight budget must still train (dropped tokens ride the
         # residual), not crash or NaN

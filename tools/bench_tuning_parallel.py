@@ -9,7 +9,7 @@ comparison artifact on the virtual 8-device CPU mesh.
 Two distinct effects add up, and the artifact records which host shape
 measured them:
 
-- On ANY host (even 1 core — the committed artifact's 3.8x): pinning
+- On ANY host (even 1 core — see the committed artifact): pinning
   removes cross-thread contention on a single device's execution
   stream (concurrent trials interleaving dispatches against one device
   serialize far worse than independent per-device queues).
