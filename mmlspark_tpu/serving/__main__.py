@@ -36,7 +36,9 @@ def _env_float(name, default):
 def run_coordinator() -> None:
     from mmlspark_tpu.serving.server import ServingCoordinator
     port = int(os.environ.get("PORT", "8000"))
-    coord = ServingCoordinator(host="0.0.0.0", port=port).start()
+    stale = _env_float("STALE_AFTER", 0.0)   # 0 = never expire
+    coord = ServingCoordinator(host="0.0.0.0", port=port,
+                               stale_after=stale or None).start()
     print(f"[serving] coordinator listening on :{coord.port}", flush=True)
     _wait_forever(coord.stop)
 

@@ -366,8 +366,16 @@ class ServingCoordinator:
     GET ``/services`` for the worker list and round-robin between them.
     """
 
-    def __init__(self, host: str = "127.0.0.1", port: int = 0):
+    def __init__(self, host: str = "127.0.0.1", port: int = 0,
+                 stale_after: Optional[float] = None):
+        # stale_after: drop workers not re-registered within this many
+        # seconds — workers heartbeat (`python -m mmlspark_tpu.serving
+        # worker` re-registers every REGISTER_INTERVAL), so dead pods
+        # age out instead of accumulating forever. None = never expire.
         self._services: List[Dict[str, Any]] = []
+        self._seen: Dict[Tuple[Any, Any], float] = {}
+        self.stale_after = (float(stale_after)
+                            if stale_after and stale_after > 0 else None)
         self._lock = threading.Lock()
         coordinator = self
 
@@ -382,6 +390,7 @@ class ServingCoordinator:
                 except ValueError:
                     self.send_error(400, "invalid JSON")
                     return
+                key = (info.get("host"), info.get("port"))
                 with coordinator._lock:
                     if self.path == "/register":
                         # idempotent: a re-registering worker (periodic
@@ -389,14 +398,14 @@ class ServingCoordinator:
                         # replaces its old entry instead of duplicating
                         coordinator._services = [
                             s for s in coordinator._services
-                            if (s.get("host"), s.get("port"))
-                            != (info.get("host"), info.get("port"))]
+                            if (s.get("host"), s.get("port")) != key]
                         coordinator._services.append(info)
+                        coordinator._seen[key] = time.monotonic()
                     else:
                         coordinator._services = [
                             s for s in coordinator._services
-                            if (s.get("host"), s.get("port"))
-                            != (info.get("host"), info.get("port"))]
+                            if (s.get("host"), s.get("port")) != key]
+                        coordinator._seen.pop(key, None)
                 self.send_response(200)
                 self.send_header("Content-Length", "2")
                 self.end_headers()
@@ -407,6 +416,7 @@ class ServingCoordinator:
                     self.send_error(404)
                     return
                 with coordinator._lock:
+                    coordinator._prune_stale_locked()
                     body = json.dumps(coordinator._services).encode()
                 self.send_response(200)
                 self.send_header("Content-Type", "application/json")
@@ -433,8 +443,20 @@ class ServingCoordinator:
         if self._thread:
             self._thread.join(timeout=5)
 
+    def _prune_stale_locked(self) -> None:
+        if self.stale_after is None:
+            return
+        horizon = time.monotonic() - self.stale_after
+        self._services = [
+            s for s in self._services
+            if self._seen.get((s.get("host"), s.get("port")), 0) >= horizon]
+        # drop the timestamps too: months of rolling pod redeploys must
+        # not accumulate one _seen entry per worker IP ever seen
+        self._seen = {k: t for k, t in self._seen.items() if t >= horizon}
+
     def services(self) -> List[Dict[str, Any]]:
         with self._lock:
+            self._prune_stale_locked()
             return list(self._services)
 
     @staticmethod

@@ -525,6 +525,28 @@ class TestExactlyOnce:
             assert "n_window_missed" in s and "n_replayed" in s
 
 
+class TestCoordinatorRegistry:
+    def test_reregister_is_idempotent_and_stale_entries_expire(self):
+        from mmlspark_tpu.serving.server import ServingCoordinator
+        with ServingCoordinator(stale_after=3.0) as coord:
+            url = f"http://{coord.host}:{coord.port}"
+            for _ in range(3):   # heartbeats replace, never duplicate
+                requests.post(f"{url}/register",
+                              json={"host": "10.0.0.1", "port": 9000},
+                              timeout=5)
+            requests.post(f"{url}/register",
+                          json={"host": "10.0.0.2", "port": 9000},
+                          timeout=5)
+            assert len(requests.get(f"{url}/services", timeout=5).json()) == 2
+            time.sleep(3.5)      # no heartbeats: both entries age out
+            requests.post(f"{url}/register",
+                          json={"host": "10.0.0.2", "port": 9000},
+                          timeout=5)
+            alive = requests.get(f"{url}/services", timeout=5).json()
+            assert [s["host"] for s in alive] == ["10.0.0.2"]
+            assert list(coord._seen) == [("10.0.0.2", 9000)]
+
+
 WORKER_SCRIPT = """
 import sys, time
 from mmlspark_tpu.serving.server import ServingServer, ServingCoordinator
