@@ -58,7 +58,7 @@ class TransformerConfig:
     d_ff: int = 128
     n_stages: int = 1
     layers_per_stage: int = 1
-    n_experts: int = 0        # 0 = dense MLP; >0 = top-1 MoE in every block
+    n_experts: int = 0        # 0 = dense MLP; >0 = MoE in every block
     # 0 = dense dispatch (every token through every local expert, psum
     # combine — compute scales with n_experts); > 0 = capacity-factor
     # routing: per-expert token budget ceil(factor * T / E), all_to_all
@@ -71,6 +71,11 @@ class TransformerConfig:
     # aux = E * sum_e f_e * P_e with f_e the routed-token fraction and
     # P_e the mean router probability — 1.0 at perfect balance.
     moe_aux_weight: float = 0.0
+    # experts consulted per token. 1 = Switch-style (combine weight is
+    # the raw router probability); k >= 2 = Mixtral-style (weights are
+    # the top-k probabilities renormalized to sum to 1). The capacity
+    # budget scales with k: C = ceil(factor * T * k / E).
+    moe_top_k: int = 1
     microbatches: int = 1
     dtype: str = "float32"
     # un-ring-sharded attention engine: "dense" = XLA softmax-attention;
@@ -271,6 +276,19 @@ def _mlp(bp, x, ax: _Axes, cfg: TransformerConfig):
     return _psum_if(y, ax.model) + bp["b2"]
 
 
+def _route_top_k(probs, k: int):
+    """``(weights, experts)`` for the top-k choices, trailing dim k.
+
+    k == 1 keeps Switch semantics (raw top probability); k >= 2 uses the
+    Mixtral rule (top-k probabilities renormalized to sum to one).
+    """
+    vals, idx = jax.lax.top_k(probs, k)
+    if k > 1:
+        vals = vals / jnp.maximum(
+            jnp.sum(vals, axis=-1, keepdims=True), 1e-12)
+    return vals, idx
+
+
 def _router_stats(probs2d, top, E: int, axes):
     """GLOBAL per-layer routing statistics for the Switch aux loss.
 
@@ -292,17 +310,18 @@ def _router_stats(probs2d, top, E: int, axes):
 
 
 def _moe_capacity(bp, x, cfg: TransformerConfig, ax: _Axes):
-    """Capacity-factor top-1 MoE dispatch (the production shape).
+    """Capacity-factor top-k MoE dispatch (the production shape).
 
-    Each rank builds per-expert token queues bounded by
-    ``C = ceil(factor * T / E)`` (tokens beyond an expert's budget drop
-    to the residual), ``all_to_all`` over the ``expert`` axis swaps
-    queue shards so every rank holds the full cross-rank queues of its
-    LOCAL experts, the expert FFNs run as one batched einsum, and a
-    second ``all_to_all`` routes results home, combined weighted by the
-    router probability. Per-token FLOPs scale with the capacity factor,
-    not ``n_experts`` — unlike :func:`_moe`'s dense dispatch, which
-    multiplies every token through every local expert.
+    Each token contributes ``moe_top_k`` routings; each rank builds
+    per-expert routing queues bounded by ``C = ceil(factor * T * k /
+    E)`` (routings beyond an expert's budget drop to the residual),
+    ``all_to_all`` over the ``expert`` axis swaps queue shards so every
+    rank holds the full cross-rank queues of its LOCAL experts, the
+    expert FFNs run as one batched einsum, and a second ``all_to_all``
+    routes results home, combined with the top-k router weights
+    (:func:`_route_top_k`). Per-token FLOPs scale with the capacity
+    factor and k, not ``n_experts`` — unlike :func:`_moe`'s dense
+    dispatch, which multiplies every token through every local expert.
     """
     import math
     dt = _compute_dtype(cfg)
@@ -321,22 +340,26 @@ def _moe_capacity(bp, x, cfg: TransformerConfig, ax: _Axes):
     # shard, so expert compute per rank scales with T/e_size
     T_sh = T // e_size
     off = e_rank * T_sh
+    k = cfg.moe_top_k
     hT = jax.lax.dynamic_slice_in_dim(h.reshape(T, d), off, T_sh)
-    top = jax.lax.dynamic_slice_in_dim(
-        jnp.argmax(probs, axis=-1).reshape(T), off, T_sh)
-    topp = jax.lax.dynamic_slice_in_dim(
-        jnp.max(probs, axis=-1).reshape(T), off, T_sh)
-    C = max(int(math.ceil(cfg.moe_capacity_factor * T_sh / E)), 1)
+    wts, experts = _route_top_k(probs.reshape(T, E), k)  # [T, k]
+    wts = jax.lax.dynamic_slice_in_dim(wts, off, T_sh)
+    experts = jax.lax.dynamic_slice_in_dim(experts, off, T_sh)
+    # each (token, choice) routing occupies one queue slot; the budget
+    # scales with k so factor=1 still holds everything at perfect balance
+    C = max(int(math.ceil(cfg.moe_capacity_factor * T_sh * k / E)), 1)
 
-    onehot = jax.nn.one_hot(top, E, dtype=jnp.int32)     # [T_sh, E]
-    # position of each token within its expert's queue (arrival order)
+    top = experts.reshape(T_sh * k)                      # routing slots
+    wf = wts.reshape(T_sh * k)
+    onehot = jax.nn.one_hot(top, E, dtype=jnp.int32)     # [T_sh*k, E]
+    # position of each routing within its expert's queue (arrival order)
     pos = jnp.cumsum(onehot, axis=0) * onehot - 1
     slot = jnp.take_along_axis(pos, top[:, None], axis=1)[:, 0]
     keep = slot < C
-    # overflow tokens land in a scratch column C that is sliced away
+    # overflow routings land in a scratch column C that is sliced away
     slot_c = jnp.where(keep, slot, C)
     disp = jnp.zeros((E, C + 1, d), dt).at[top, slot_c].set(
-        hT.astype(dt))
+        jnp.repeat(hT.astype(dt), k, axis=0))
     disp = disp[:, :C]                                   # [E, C, d]
 
     if ax.expert:
@@ -353,12 +376,15 @@ def _moe_capacity(bp, x, cfg: TransformerConfig, ax: _Axes):
         y = jax.lax.all_to_all(y, ax.expert, split_axis=1,
                                concat_axis=0, tiled=True)
     y = jnp.pad(y, ((0, 0), (0, 1), (0, 0)))             # overflow row
-    ytok = y[top, slot_c] * (keep * topp)[:, None]        # [T_sh, d]
+    yflat = y[top, slot_c] * (keep * wf)[:, None]        # [T_sh*k, d]
+    ytok = jnp.sum(yflat.reshape(T_sh, k, d), axis=1)    # combine choices
     stats = (jnp.zeros(E, jnp.float32), jnp.zeros(E, jnp.float32))
     if cfg.moe_aux_weight > 0:
         pT = jax.lax.dynamic_slice_in_dim(
             probs.reshape(T, E), off, T_sh)
-        stats = _router_stats(pT, top, E, (ax.data, ax.seq, ax.expert))
+        # aux counts the FIRST choice (Switch definition) for any k
+        stats = _router_stats(pT, experts[:, 0], E,
+                              (ax.data, ax.seq, ax.expert))
     # restore expert-axis replication: every rank contributes its own
     # token shard, psum rebuilds the full (invariant) token set
     full = jnp.zeros((T, d), jnp.float32)
@@ -378,19 +404,19 @@ def _moe(bp, x, cfg: TransformerConfig, ax: _Axes):
         return _moe_capacity(bp, x, cfg, ax)
     dt = _compute_dtype(cfg)
     h = _rmsnorm(x, bp["ln2"])
-    # router stays f32 (softmax + argmax routing decisions); the expert
+    # router stays f32 (softmax + routing decisions); the expert
     # matmuls — the MoE's dominant FLOPs — run in cfg.dtype
     logits = jnp.einsum("bsd,de->bse", h, bp["router"])
     probs = jax.nn.softmax(logits, axis=-1)
-    top = jnp.argmax(probs, axis=-1)                     # [b, s]
-    topp = jnp.max(probs, axis=-1)
+    wts, experts = _route_top_k(probs, cfg.moe_top_k)    # [b, s, k]
     e_size, e_rank = _size(ax.expert), _index(ax.expert)
     e_local = cfg.n_experts // e_size
     h_c = h.astype(dt)
     y = jnp.zeros_like(x)
     for e in range(e_local):
         gid = e_rank * e_local + e
-        sel = (top == gid).astype(x.dtype) * topp        # [b, s]
+        sel = jnp.sum((experts == gid).astype(jnp.float32) * wts,
+                      axis=-1)                           # [b, s]
         z = jax.nn.relu(jnp.einsum("bsd,df->bsf", h_c,
                                    bp["ew1"][e].astype(dt)))
         z = jnp.einsum("bsf,fd->bsd", z,
@@ -400,8 +426,10 @@ def _moe(bp, x, cfg: TransformerConfig, ax: _Axes):
     stats = (jnp.zeros(E, jnp.float32), jnp.zeros(E, jnp.float32))
     if cfg.moe_aux_weight > 0:
         # tokens are REPLICATED over the expert axis here, so only the
-        # data/seq axes hold distinct tokens
-        stats = _router_stats(probs.reshape(-1, E), top.reshape(-1), E,
+        # data/seq axes hold distinct tokens; the aux counts the FIRST
+        # choice (the Switch definition), whatever k is
+        stats = _router_stats(probs.reshape(-1, E),
+                              experts[..., 0].reshape(-1), E,
                               (ax.data, ax.seq))
     return _psum_if(y, ax.expert), stats
 
@@ -535,11 +563,11 @@ def reference_loss(params, tokens, labels, mask, cfg: TransformerConfig):
             if cfg.n_experts:
                 logits = jnp.einsum("bsd,de->bse", h, bp["router"])
                 probs = jax.nn.softmax(logits, axis=-1)
-                top = jnp.argmax(probs, axis=-1)
-                topp = jnp.max(probs, axis=-1)
+                wts, experts = _route_top_k(probs, cfg.moe_top_k)
                 y = jnp.zeros_like(x)
                 for e in range(cfg.n_experts):
-                    sel = (top == e).astype(x.dtype) * topp
+                    sel = jnp.sum((experts == e).astype(jnp.float32)
+                                  * wts, axis=-1)
                     z = jax.nn.relu(jnp.einsum("bsd,df->bsf", h, bp["ew1"][e]))
                     z = jnp.einsum("bsf,fd->bsd", z, bp["ew2"][e])
                     y = y + z * sel[..., None]
@@ -547,7 +575,7 @@ def reference_loss(params, tokens, labels, mask, cfg: TransformerConfig):
                 if cfg.moe_aux_weight > 0:
                     f, P = _router_stats(
                         probs.reshape(-1, cfg.n_experts),
-                        top.reshape(-1), cfg.n_experts, ())
+                        experts[..., 0].reshape(-1), cfg.n_experts, ())
                     aux_total = aux_total + cfg.n_experts * jnp.sum(f * P)
             else:
                 z = jax.nn.relu(
@@ -592,6 +620,10 @@ def build_spmd_train_step(cfg: TransformerConfig, mesh,
         raise ValueError("d_ff must divide over the model axis")
     if ax.expert and cfg.n_experts and cfg.n_experts % mesh.shape[ax.expert]:
         raise ValueError("n_experts must divide over the expert axis")
+    if cfg.n_experts and not 1 <= cfg.moe_top_k <= cfg.n_experts:
+        raise ValueError(
+            f"moe_top_k={cfg.moe_top_k} must be in [1, n_experts="
+            f"{cfg.n_experts}]")
 
     specs = param_specs(cfg, mesh)
     data_spec = P(ax.data, ax.seq)

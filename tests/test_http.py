@@ -528,7 +528,7 @@ class TestExactlyOnce:
 class TestCoordinatorRegistry:
     def test_reregister_is_idempotent_and_stale_entries_expire(self):
         from mmlspark_tpu.serving.server import ServingCoordinator
-        with ServingCoordinator(stale_after=3.0) as coord:
+        with ServingCoordinator(stale_after=1.5) as coord:
             url = f"http://{coord.host}:{coord.port}"
             for _ in range(3):   # heartbeats replace, never duplicate
                 requests.post(f"{url}/register",
@@ -538,7 +538,7 @@ class TestCoordinatorRegistry:
                           json={"host": "10.0.0.2", "port": 9000},
                           timeout=5)
             assert len(requests.get(f"{url}/services", timeout=5).json()) == 2
-            time.sleep(3.5)      # no heartbeats: both entries age out
+            time.sleep(2.0)      # no heartbeats: both entries age out
             requests.post(f"{url}/register",
                           json={"host": "10.0.0.2", "port": 9000},
                           timeout=5)

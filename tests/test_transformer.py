@@ -135,6 +135,16 @@ class TestSpmdTrainStep:
         _compare({"expert": 2}, cfg)
 
     @pytest.mark.parametrize("capacity", [0.0, 4.0])
+    def test_top2_routing_matches_golden(self, capacity):
+        # Mixtral-style top-2 (renormalized weights), dense AND capacity
+        # dispatch, must equal the unsharded golden on the expert mesh
+        cfg = T.TransformerConfig(vocab=64, d_model=16, n_heads=2, d_head=8,
+                                  d_ff=32, layers_per_stage=2, n_experts=4,
+                                  moe_top_k=2, moe_capacity_factor=capacity,
+                                  moe_aux_weight=0.02)
+        _compare({"expert": 2}, cfg)
+
+    @pytest.mark.parametrize("capacity", [0.0, 4.0])
     def test_load_balancing_aux_matches_golden(self, capacity):
         # the Switch aux is computed from GLOBAL (f, P) router stats —
         # pmean'd across every token-holding axis BEFORE the nonlinear
