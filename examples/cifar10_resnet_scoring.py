@@ -43,8 +43,11 @@ def main():
     assert out["scores"].shape == (n, 10)
     acc = float((np.asarray(out["scores"]).argmax(1) == labels).mean())
     rate = n / t.seconds / max(len(devices), 1)
+    caveat = (" [on the procedural SURROGATE corpus — not real CIFAR-10; "
+              "republish via tools/train_zoo_models.py when real files "
+              "exist]" if meta.dataset.startswith("synth") else "")
     print(f"resnet20 scoring: {rate:.0f} images/sec/chip "
-          f"({len(devices)} device(s)), accuracy={acc:.4f}")
+          f"({len(devices)} device(s)), accuracy={acc:.4f}{caveat}")
     if meta.dataset.startswith("synth"):   # gate matches the corpus
         assert acc > 0.85
 

@@ -742,11 +742,16 @@ def renew_leaf_values(node_of_row, residual, weights, sample_mask,
     contiguous residual-ascending segment of its weighted rows; the
     global weight cumsum minus each segment's base gives within-leaf
     cumulative weights, and a scatter-min picks the first row reaching
-    the target quantile weight. Like LightGBM's ``PercentileFun`` /
-    ``WeightedPercentileFun``, when the target weight falls strictly
+    the target quantile weight. When the target weight falls strictly
     between two rows' cumulative weights the value is linearly
     interpolated between the bracketing sorted residuals (a pure
-    ceiling pick drifts high on small leaves). Returns ``(values
+    ceiling pick drifts high on small leaves). This interpolates in
+    cumulative-*weight* space, which *approximates* — not matches —
+    LightGBM's ``PercentileFun`` convention of positional
+    ``(cnt-1)*alpha`` interpolation for the unweighted case (e.g. the
+    unweighted median of a 2-row leaf is the lower residual here, the
+    midpoint in LightGBM); the host-side reference in the tests mirrors
+    this rule. Returns ``(values
     (max_nodes,) f32, counts (max_nodes,) f32)``; leaves with zero
     sampled rows keep their caller-side value (count==0 flags them).
     """

@@ -192,6 +192,7 @@ class NNModel(Model, HasInputCol, HasOutputCol):
         inflight = []                 # dispatched batches of this group
         ready: deque = deque()        # device-concat groups awaiting fetch
         outs = []
+        out_sized = False             # group re-bounded by output bytes yet?
 
         def seal():
             if not inflight:
@@ -209,6 +210,19 @@ class NNModel(Model, HasInputCol, HasOutputCol):
             if in_sharding is not None:
                 padded = jax.device_put(padded, in_sharding)
             inflight.append((self._jitted(params, padded), n))
+            if not out_sized:
+                # the input-byte cap alone under-counts when the model's
+                # output is wider than its input (truncated conv layers
+                # emit per-row activations orders of magnitude larger) —
+                # re-bound the group by the dispatched output's aval
+                # (shape/dtype known without a fetch) so at most ~256 MB
+                # of outputs are pinned in HBM awaiting readback
+                o = inflight[0][0]
+                out_bytes = max(
+                    int(np.prod(o.shape, dtype=np.int64)) * o.dtype.itemsize,
+                    1)
+                group = max(min(group, (256 << 20) // out_bytes), 1)
+                out_sized = True
             if len(inflight) >= group:
                 seal()
                 while len(ready) > 1:   # keep one group in flight

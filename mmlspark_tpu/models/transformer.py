@@ -79,10 +79,13 @@ class TransformerConfig:
     microbatches: int = 1
     dtype: str = "float32"
     # un-ring-sharded attention engine: "dense" = XLA softmax-attention;
-    # "flash" = the Pallas streaming kernel (custom VJP; fwd never puts
-    # (S x S) scores in HBM — wins as S grows); "auto" = flash on TPU
-    # for long sequences, dense otherwise (at short S, XLA's fused
-    # dense path with stored probabilities beats the recompute)
+    # "folded" = the feature-major Pallas kernel (heads on the sublane
+    # axis — no lane padding at short head dims; custom VJP, nothing
+    # (S x S) ever reaches HBM); "flash" = the head-per-program Pallas
+    # kernel (for shapes the folded layout can't take); "auto" = folded
+    # on TPU from S >= 256 at short head dims (< 128), flash from
+    # S >= 2048 otherwise, dense below (at short S, XLA's fused dense
+    # path with stored probabilities wins)
     attention_impl: str = "auto"
 
     @property
@@ -247,17 +250,30 @@ def _attention(bp, x, cfg: TransformerConfig, ax: _Axes, pos):
                                  compute_dtype=mm_dt)
     else:
         from mmlspark_tpu.parallel.pallas_attention import (
-            flash_attention, flash_available)
+            flash_attention, flash_attention_folded, flash_available,
+            folded_available)
+        b_, s_, h_, dh_ = q.shape
         impl = cfg.attention_impl
         if impl == "auto":
-            # flash wins once the (S x S) score/probability tensors stop
-            # being HBM-cheap; at short S XLA's fused dense attention
-            # (which stores p instead of recomputing it) is faster
-            impl = ("flash" if flash_available()
-                    and q.shape[1] >= 2048 else "dense")
-        if impl == "flash" and flash_available():
-            if mm_dt is not None:
-                q, k, v = q.astype(dt), k.astype(dt), v.astype(dt)
+            # the folded (feature-major) kernel wins from S >= 256 at
+            # short head dims (measured at dh=64: 2.1x whole-step at
+            # S=1024 and 1.29x at S=256 vs XLA dense —
+            # tools/probe_transformer_perf.py); at dh >= 128 its
+            # rationale (dodging lane padding) vanishes and it is
+            # unmeasured, so those shapes keep the flash kernel's
+            # long-S gate; below both, XLA's fused dense attention
+            # (which stores p instead of recomputing) is faster
+            if folded_available(s_, s_, dh_) and s_ >= 256 and dh_ < 128:
+                impl = "folded"
+            elif flash_available() and s_ >= 2048:
+                impl = "flash"
+            else:
+                impl = "dense"
+        if impl in ("folded", "flash") and mm_dt is not None:
+            q, k, v = q.astype(dt), k.astype(dt), v.astype(dt)
+        if impl == "folded" and folded_available(s_, s_, dh_):
+            a = flash_attention_folded(q, k, v, True)
+        elif impl in ("flash", "folded") and flash_available():
             a = flash_attention(q, k, v, True)
         else:
             a = dense_attention(q, k, v, causal=True, compute_dtype=mm_dt)
@@ -596,7 +612,8 @@ def reference_loss(params, tokens, labels, mask, cfg: TransformerConfig):
 
 def build_spmd_train_step(cfg: TransformerConfig, mesh,
                           learning_rate: float = 0.1,
-                          momentum: float = 0.9):
+                          momentum: float = 0.9,
+                          donate: bool = True):
     """Jitted full train step over ``mesh``: fwd + bwd + per-leaf grad
     psum + momentum-SGD update, all inside one shard_map.
 
@@ -605,6 +622,15 @@ def build_spmd_train_step(cfg: TransformerConfig, mesh,
     laid out per :func:`param_specs`. Replaces the reference's
     mpirun/BrainScript data-parallel SGD chain (`CommandBuilders.scala`)
     with one compiled program; adds tp/pp/sp/ep the reference never had.
+
+    .. warning:: With ``donate=True`` (the default) the ``params`` and
+       ``velocity`` arguments are **donated**: their buffers are reused
+       for the outputs, and the input arrays are invalidated after the
+       call *on TPU/GPU* (CPU ignores donation, so misuse only surfaces
+       on accelerator backends). Always rebind, ``params, velocity,
+       loss = step(params, velocity, ...)``; callers that must reuse the
+       pre-step state (warm-up probes, pre/post diffing) should pass
+       ``donate=False``.
     """
     from jax.sharding import PartitionSpec as P
 
@@ -644,7 +670,7 @@ def build_spmd_train_step(cfg: TransformerConfig, mesh,
     # donate params+velocity: the optimizer update happens in place in
     # HBM instead of allocating (and copying into) a second full copy
     # of the model state every step
-    return jax.jit(sharded, donate_argnums=(0, 1))
+    return jax.jit(sharded, donate_argnums=(0, 1) if donate else ())
 
 
 def shard_params(params, cfg: TransformerConfig, mesh):

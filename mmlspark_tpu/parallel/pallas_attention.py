@@ -420,3 +420,304 @@ def _flash_bwd(causal, scale, interpret, bwd_impl, res, dout):
 
 
 flash_attention.defvjp(_flash_fwd, _flash_bwd)
+
+
+# ---------------------------------------------------------------------------
+# Folded (feature-major) flash kernels — the short-head-dim regime
+# ---------------------------------------------------------------------------
+#
+# The kernels above put head_dim on the LANE axis, so head_dim 64 pads to
+# the 128-lane width: every DMA moves 2x the bytes and every d-output
+# matmul does 2x the work. That is exactly the regime of the train bench
+# (8 heads x 64), where the padded backward measures slower than XLA's
+# dense attention. The folded layout dodges the padding entirely:
+#
+#   q, k, v, o:  (B, H*Dh, S)   — heads*features on the SUBLANE axis
+#                                  (8-multiple, no 128 constraint),
+#                                  sequence tiles on the lane axis
+#   per head:    X[h*Dh:(h+1)*Dh, :] — a cheap sublane slice
+#
+# Every matmul runs in transposed form — s^T = k_h . q_h (contract the
+# feature sublanes), o_h = v_h . p^T — so no operand or output ever has
+# fewer than 128 live lanes, whatever Dh is (Dh % 8 == 0). The softmax
+# runs over the SUBLANE axis of s^T with (1, TQ) running stats. One grid
+# step processes every head of a (q-tile, kv-tile) block, so K/V tiles
+# are DMA'd once per q-tile, not once per head.
+
+F_TILE = 512   # q/kv tile edge (clamped to S; S must divide by it)
+
+
+def _fold_tile(s: int) -> int:
+    for t in (F_TILE, 256, 128):
+        if s % t == 0:
+            return t
+    return 0
+
+
+def folded_available(sq: int, sk: int, d: int) -> bool:
+    """Same-length self-attention, tileable S, sublane-aligned head."""
+    return (sq == sk and d % 8 == 0 and _fold_tile(sq) > 0
+            and jax.default_backend() == "tpu")
+
+
+def _causal_mask_t(i, j, tq: int, tk: int):
+    """Mask for the TRANSPOSED score tile s^T (TK, TQ): key pos <= q pos."""
+    qpos = i * tq + jax.lax.broadcasted_iota(jnp.int32, (tk, tq), 1)
+    kpos = j * tk + jax.lax.broadcasted_iota(jnp.int32, (tk, tq), 0)
+    return kpos <= qpos
+
+
+def _ffwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc, m_scr, l_scr,
+                 *, scale: float, causal: bool, h: int, d: int,
+                 tq: int, tk: int):
+    i, j = pl.program_id(1), pl.program_id(2)
+
+    @pl.when(j == 0)
+    def _():
+        acc[:] = jnp.zeros_like(acc)
+        m_scr[:] = jnp.full_like(m_scr, _NEG_INF)
+        l_scr[:] = jnp.zeros_like(l_scr)
+
+    live = (j * tk <= i * tq + tq - 1) if causal else (j >= 0)
+
+    @pl.when(live)
+    def _():
+        mask = _causal_mask_t(i, j, tq, tk) if causal else None
+        for hh in range(h):
+            sl = slice(hh * d, (hh + 1) * d)
+            st = jax.lax.dot_general(                      # (TK, TQ)
+                k_ref[0, sl, :], q_ref[0, sl, :],
+                (((0,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32) * scale
+            if causal:
+                st = jnp.where(mask, st, _NEG_INF)
+            m_prev = m_scr[hh]                             # (1, TQ)
+            m_new = jnp.maximum(m_prev,
+                                jnp.max(st, axis=0, keepdims=True))
+            pt = jnp.exp(st - m_new)                       # (TK, TQ)
+            alpha = jnp.exp(m_prev - m_new)
+            l_scr[hh] = l_scr[hh] * alpha + jnp.sum(pt, axis=0,
+                                                    keepdims=True)
+            acc[sl, :] = acc[sl, :] * alpha + jax.lax.dot_general(
+                v_ref[0, sl, :], pt.astype(v_ref.dtype),
+                (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32)
+            m_scr[hh] = m_new
+
+    @pl.when(j == pl.num_programs(2) - 1)
+    def _():
+        for hh in range(h):
+            sl = slice(hh * d, (hh + 1) * d)
+            l_safe = jnp.maximum(l_scr[hh], 1e-30)         # (1, TQ)
+            o_ref[0, sl, :] = (acc[sl, :] / l_safe).astype(o_ref.dtype)
+            lse_ref[0, hh] = (m_scr[hh] + jnp.log(l_safe))[0]
+
+
+def _fdq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                dq_ref, dq_acc, *, scale: float, causal: bool, h: int,
+                d: int, tq: int, tk: int):
+    i, j = pl.program_id(1), pl.program_id(2)
+
+    @pl.when(j == 0)
+    def _():
+        dq_acc[:] = jnp.zeros_like(dq_acc)
+
+    live = (j * tk <= i * tq + tq - 1) if causal else (j >= 0)
+
+    @pl.when(live)
+    def _():
+        mask = _causal_mask_t(i, j, tq, tk) if causal else None
+        for hh in range(h):
+            sl = slice(hh * d, (hh + 1) * d)
+            kh, qh = k_ref[0, sl, :], q_ref[0, sl, :]
+            st = jax.lax.dot_general(
+                kh, qh, (((0,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32) * scale
+            if causal:
+                st = jnp.where(mask, st, _NEG_INF)
+            lse = lse_ref[0, hh].reshape(1, tq)
+            pt = jnp.exp(st - lse)                         # (TK, TQ)
+            dpt = jax.lax.dot_general(                     # do . v
+                v_ref[0, sl, :], do_ref[0, sl, :],
+                (((0,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32)
+            dst = pt * (dpt - delta_ref[0, hh].reshape(1, tq))
+            dq_acc[sl, :] += jax.lax.dot_general(          # (D, TQ)
+                kh, dst.astype(kh.dtype), (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32) * scale
+
+    @pl.when(j == pl.num_programs(2) - 1)
+    def _():
+        dq_ref[0] = dq_acc[:].astype(dq_ref.dtype)
+
+
+def _fdkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                 dk_ref, dv_ref, dk_acc, dv_acc, *, scale: float,
+                 causal: bool, h: int, d: int, tq: int, tk: int):
+    j, i = pl.program_id(1), pl.program_id(2)
+
+    @pl.when(i == 0)
+    def _():
+        dk_acc[:] = jnp.zeros_like(dk_acc)
+        dv_acc[:] = jnp.zeros_like(dv_acc)
+
+    live = (j * tk <= i * tq + tq - 1) if causal else (j >= 0)
+
+    @pl.when(live)
+    def _():
+        mask = _causal_mask_t(i, j, tq, tk) if causal else None
+        for hh in range(h):
+            sl = slice(hh * d, (hh + 1) * d)
+            qh, doh = q_ref[0, sl, :], do_ref[0, sl, :]
+            st = jax.lax.dot_general(
+                k_ref[0, sl, :], qh, (((0,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32) * scale
+            if causal:
+                st = jnp.where(mask, st, _NEG_INF)
+            pt = jnp.exp(st - lse_ref[0, hh].reshape(1, tq))
+            dv_acc[sl, :] += jax.lax.dot_general(          # do . p
+                doh, pt.astype(doh.dtype), (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32)
+            dpt = jax.lax.dot_general(
+                v_ref[0, sl, :], doh, (((0,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32)
+            dst = (pt * (dpt - delta_ref[0, hh].reshape(1, tq))
+                   ).astype(qh.dtype)
+            dk_acc[sl, :] += jax.lax.dot_general(          # (D, TK)
+                qh, dst, (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32) * scale
+
+    @pl.when(i == pl.num_programs(2) - 1)
+    def _():
+        dk_ref[0] = dk_acc[:].astype(dk_ref.dtype)
+        dv_ref[0] = dv_acc[:].astype(dv_ref.dtype)
+
+
+@functools.partial(jax.jit, static_argnames=("h", "scale", "causal",
+                                             "interpret"))
+def _ffwd_call(qf, kf, vf, h: int, scale: float, causal: bool,
+               interpret: bool):
+    """qf/kf/vf (B, H*D, S) -> (o (B, H*D, S), lse (B, H, S) f32)."""
+    b, hd, s = qf.shape
+    d = hd // h
+    t = _fold_tile(s)
+    grid = (b, s // t, s // t)
+    kernel = functools.partial(_ffwd_kernel, scale=scale, causal=causal,
+                               h=h, d=d, tq=t, tk=t)
+    seq_spec = pl.BlockSpec((1, hd, t), lambda b_, i, j: (b_, 0, i))
+    kv_spec = pl.BlockSpec((1, hd, t), lambda b_, i, j: (b_, 0, j))
+    return pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[seq_spec, kv_spec, kv_spec],
+        out_specs=[seq_spec,
+                   pl.BlockSpec((1, h, t), lambda b_, i, j: (b_, 0, i))],
+        out_shape=[
+            jax.ShapeDtypeStruct((b, hd, s), qf.dtype, vma=_vma(qf)),
+            jax.ShapeDtypeStruct((b, h, s), jnp.float32, vma=_vma(qf)),
+        ],
+        scratch_shapes=[pltpu.VMEM((hd, t), jnp.float32),
+                        pltpu.VMEM((h, 1, t), jnp.float32),
+                        pltpu.VMEM((h, 1, t), jnp.float32)],
+        interpret=interpret,
+    )(qf, kf, vf)
+
+
+@functools.partial(jax.jit, static_argnames=("h", "scale", "causal",
+                                             "interpret"))
+def _fbwd_call(qf, kf, vf, dof, lse, delta, h: int, scale: float,
+               causal: bool, interpret: bool):
+    """Folded backward: all (B, H*D, S); lse/delta (B, H, S) f32."""
+    b, hd, s = qf.shape
+    d = hd // h
+    t = _fold_tile(s)
+    n = s // t
+    f32 = jnp.float32
+
+    q_spec = pl.BlockSpec((1, hd, t), lambda b_, i, j: (b_, 0, i))
+    kv_spec = pl.BlockSpec((1, hd, t), lambda b_, i, j: (b_, 0, j))
+    st_spec = pl.BlockSpec((1, h, t), lambda b_, i, j: (b_, 0, i))
+    dq = pl.pallas_call(
+        functools.partial(_fdq_kernel, scale=scale, causal=causal,
+                          h=h, d=d, tq=t, tk=t),
+        grid=(b, n, n),
+        in_specs=[q_spec, kv_spec, kv_spec, q_spec, st_spec, st_spec],
+        out_specs=q_spec,
+        out_shape=jax.ShapeDtypeStruct((b, hd, s), f32, vma=_vma(qf)),
+        scratch_shapes=[pltpu.VMEM((hd, t), f32)],
+        interpret=interpret,
+    )(qf, kf, vf, dof, lse, delta)
+
+    # dk/dv accumulate across q tiles -> q innermost; note the index
+    # maps swap (b, j, i)
+    q_spec2 = pl.BlockSpec((1, hd, t), lambda b_, j, i: (b_, 0, i))
+    kv_spec2 = pl.BlockSpec((1, hd, t), lambda b_, j, i: (b_, 0, j))
+    st_spec2 = pl.BlockSpec((1, h, t), lambda b_, j, i: (b_, 0, i))
+    dk, dv = pl.pallas_call(
+        functools.partial(_fdkv_kernel, scale=scale, causal=causal,
+                          h=h, d=d, tq=t, tk=t),
+        grid=(b, n, n),
+        in_specs=[q_spec2, kv_spec2, kv_spec2, q_spec2, st_spec2, st_spec2],
+        out_specs=[kv_spec2, kv_spec2],
+        out_shape=[jax.ShapeDtypeStruct((b, hd, s), f32, vma=_vma(qf)),
+                   jax.ShapeDtypeStruct((b, hd, s), f32, vma=_vma(qf))],
+        scratch_shapes=[pltpu.VMEM((hd, t), f32),
+                        pltpu.VMEM((hd, t), f32)],
+        interpret=interpret,
+    )(qf, kf, vf, dof, lse, delta)
+    return dq, dk, dv
+
+
+def _to_folded(x):
+    """(B, S, H, D) -> (B, H*D, S)."""
+    b, s, h, d = x.shape
+    return x.transpose(0, 2, 3, 1).reshape(b, h * d, s)
+
+
+def _from_folded(x, h: int):
+    """(B, H*D, S) -> (B, S, H, D)."""
+    b, hd, s = x.shape
+    return x.reshape(b, h, hd // h, s).transpose(0, 3, 1, 2)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
+def flash_attention_folded(q, k, v, causal: bool = True, scale=None,
+                           interpret: bool = False):
+    """Differentiable folded flash attention, [B, S, H, Dh] in/out.
+
+    The short-head-dim twin of :func:`flash_attention`: same streaming
+    algorithm and FA-2 backward algebra, feature-major kernels (heads on
+    the sublane axis — see the section comment). Use when
+    :func:`folded_available`; numerics match ``dense_attention`` to f32
+    tolerance (tests/test_transformer.py).
+    """
+    out, _ = _ffold_fwd(q, k, v, causal, scale, interpret)
+    return out
+
+
+def _ffold_fwd(q, k, v, causal, scale, interpret):
+    b, s, h, d = q.shape
+    scale_f = float(scale) if scale is not None else d ** -0.5
+    qf, kf, vf = _to_folded(q), _to_folded(k), _to_folded(v)
+    of, lse = _ffwd_call(qf, kf, vf, h, scale_f, causal, interpret)
+    return _from_folded(of, h).astype(q.dtype), (qf, kf, vf, of, lse)
+
+
+def _ffold_bwd(causal, scale, interpret, res, dout):
+    qf, kf, vf, of, lse = res
+    b, hd, s = qf.shape
+    h = lse.shape[1]
+    d = hd // h
+    scale_f = float(scale) if scale is not None else d ** -0.5
+    dof = _to_folded(dout).astype(qf.dtype)
+    # delta_h = sum_d do * out, per (b, h, s) — in f32, outside the kernel
+    delta = jnp.sum((dof * of).astype(jnp.float32)
+                    .reshape(b, h, d, s), axis=2)          # (B, H, S)
+    dq, dk, dv = _fbwd_call(qf, kf, vf, dof, lse, delta, h, scale_f,
+                            causal, interpret)
+    return (_from_folded(dq, h).astype(qf.dtype),
+            _from_folded(dk, h).astype(kf.dtype),
+            _from_folded(dv, h).astype(vf.dtype))
+
+
+flash_attention_folded.defvjp(_ffold_fwd, _ffold_bwd)

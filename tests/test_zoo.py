@@ -99,12 +99,17 @@ class TestCifarZoo:
         assert meta.input_dtype == "uint8"   # scorer input convention
 
     def test_golden_logits_and_accuracy_gate(self, downloader):
+        meta = downloader.list_models()["cifar10s_resnet20"]
         fn = downloader.load("cifar10s_resnet20")
         g = np.load(GOLDEN_CIFAR)
         got = np.asarray(fn.apply(g["x"].astype(np.float32) / 255.0),
                          dtype=np.float32)
         np.testing.assert_allclose(got, g["logits"], rtol=1e-4, atol=1e-4)
-        assert float(g["test_accuracy"]) >= 0.90   # committed gate
+        # same floors as tools/train_zoo_models.py's publish gate: real
+        # CIFAR-10 publishes at >= 0.85, the synth surrogate at >= 0.90 —
+        # a legitimate real-data republish must not leave this test red
+        floor = 0.90 if meta.dataset.startswith("synth") else 0.85
+        assert float(g["test_accuracy"]) >= floor, (g["test_accuracy"], floor)
 
     @staticmethod
     def _require_synth_weights(downloader):
