@@ -252,14 +252,14 @@ class Booster:
         rng = np.random.default_rng(params.seed)
 
         # raw predictions (n_padded, K) on device
-        raw = np.broadcast_to(
+        raw_np = np.broadcast_to(
             np.asarray(booster.init_score, dtype=np.float32)[None, :],
             (n_padded, K)).copy()
         if init_model is not None and booster.trees:
             prior = (booster._predict_raw_np(X)
                      - booster.init_score[None, :]).astype(np.float32)
-            raw[:n] += prior
-        raw = put(raw)
+            raw_np[:n] += prior
+        raw = put(raw_np)
 
         # continuation must re-decide the best iteration over the new run
         booster.best_iteration = -1
@@ -281,28 +281,30 @@ class Booster:
         # -- fully-fused fit: the whole boosting loop as ONE device scan
         # (the TPU shape of the reference's native hot loop,
         # `TrainUtils.scala:95-146`) — eligible when nothing in the loop
-        # needs the host: plain gbdt (any small K; the scan body unrolls
-        # K tree growers, so huge class counts would balloon compile
-        # time and keep the cached per-tree path instead), no
-        # row/feature sampling, no per-iteration logging. Early stopping
-        # IS eligible: validation rows ride the scan (appended + masked,
-        # metric evaluated on device — the reference's in-native eval
-        # loop, `TrainUtils.scala:105-145`) and the host replays the
-        # stopping rule on the fetched metric series, so an
-        # early-stopping fit still pays exactly one fetch.
+        # needs the host: gbdt or goss boosting (any small K; the scan
+        # body unrolls K tree growers, so huge class counts would
+        # balloon compile time and keep the cached per-tree path
+        # instead) and no per-iteration logging. Bagging, goss, and
+        # feature sampling ride the scan as device RNG (threefry key in
+        # the carry — a different stream than the host loop's numpy rng,
+        # so sampled fits match in distribution/quality, not
+        # tree-for-tree); ``init_model`` continuations seed the scan's
+        # raw scores with the prior. Early stopping IS eligible:
+        # validation rows ride the scan (appended + masked, metric
+        # evaluated on device — the reference's in-native eval loop,
+        # `TrainUtils.scala:105-145`) and the host replays the stopping
+        # rule on the fetched metric series, so an early-stopping fit
+        # still pays exactly one fetch.
         es_active = bool(valid_sets) and params.early_stopping_round > 0
         device_metric = None
         if es_active and not log_every and len(valid_sets) == 1 \
-                and len(valid_sets[0][0]) > 0 \
-                and init_model is None and sharding is None:
+                and len(valid_sets[0][0]) > 0 and sharding is None:
             from mmlspark_tpu.gbdt.device_metrics import get_device_metric
             device_metric = get_device_metric(
                 metric_name, obj, params.alpha,
                 params.tweedie_variance_power)
-        fused = (params.boosting_type == "gbdt" and K <= 16
+        fused = (params.boosting_type in ("gbdt", "goss") and K <= 16
                  and tree_learner == "data" and grower._voting_fn is None
-                 and params.bagging_fraction >= 1.0
-                 and params.feature_fraction >= 1.0
                  and (not es_active or device_metric is not None)
                  and not log_every)
         if fused:
@@ -313,7 +315,8 @@ class Booster:
                 bins, y_dev, w, put(valid_rows), raw.astype(jnp.float32)
             if device_metric is not None:
                 # validation rows become the tail of the row set: masked
-                # out of histograms/renewal, routed (and scored) for free
+                # out of histograms/sampling/renewal, routed (and
+                # scored) for free
                 vX = np.asarray(valid_sets[0][0], dtype=np.float64)
                 vy_np = np.asarray(valid_sets[0][1], dtype=np.float32)
                 n_valid = len(vX)
@@ -324,9 +327,15 @@ class Booster:
                     [w_np, np.ones(n_valid, np.float32)]))
                 mask_fit = put(np.concatenate(
                     [valid_rows, np.zeros(n_valid, bool)]))
-                raw_fit = put(np.broadcast_to(
+                raw_v = np.broadcast_to(
                     np.asarray(booster.init_score, np.float32)[None, :],
-                    (n_padded + n_valid, K)).copy())
+                    (n_valid, K)).copy()
+                if init_model is not None and booster.trees:
+                    raw_v += (booster._predict_raw_np(vX)
+                              - booster.init_score[None, :]
+                              ).astype(np.float32)
+                raw_fit = put(np.concatenate([raw_np, raw_v])
+                              .astype(np.float32))
             bins_t = (grower._get_bins_t(bins_dev)
                       if grower.hist_impl != "xla" else None)
 
@@ -337,7 +346,14 @@ class Booster:
                 grower.is_categorical, None, grower.n_features,
                 grower.n_bins, grower.hist_impl, shrink,
                 obj.renew_quantile, n_valid=n_valid,
-                metric_fn=device_metric[0] if device_metric else None)
+                metric_fn=device_metric[0] if device_metric else None,
+                rng_key=jax.random.PRNGKey(params.seed),
+                bagging_fraction=params.bagging_fraction,
+                bagging_freq=params.bagging_freq,
+                goss=is_goss, top_rate=params.top_rate,
+                other_rate=params.other_rate,
+                feature_fraction=params.feature_fraction,
+                n_real=n, it_offset=start_iter)
             host = jax.device_get(stacked)  # ONE fetch for the whole fit
             kept = params.num_iterations
             if device_metric is not None:
@@ -377,6 +393,7 @@ class Booster:
             booster.__dict__.pop("_tree_dev", None)
             return booster
 
+        bag_mask_host = None   # persisted bag between bagging redraws
         for it in range(start_iter, start_iter + params.num_iterations):
             # -- dart: drop trees for this round's gradient computation
             # (drop indices are relative to THIS run's trees,
@@ -420,11 +437,17 @@ class Booster:
                 goss_amp = np.ones(n_padded, dtype=np.float32)
                 goss_amp[other_idx] = (1.0 - params.top_rate) / max(
                     params.other_rate, 1e-12)
-            elif (params.bagging_fraction < 1.0 and
-                  (is_rf or (params.bagging_freq > 0 and
-                             it % params.bagging_freq == 0))):
-                sample = valid_rows & (rng.random(n_padded)
-                                       < params.bagging_fraction)
+            elif params.bagging_fraction < 1.0 and (
+                    is_rf or params.bagging_freq > 0):
+                # LightGBM semantics: redraw every bagging_freq
+                # iterations (rf: every iteration), and the bag PERSISTS
+                # between redraws — intermediate iterations train on the
+                # held bag, not on the full data
+                if (is_rf or it % params.bagging_freq == 0
+                        or bag_mask_host is None):
+                    bag_mask_host = valid_rows & (
+                        rng.random(n_padded) < params.bagging_fraction)
+                sample = bag_mask_host
 
             # -- feature sampling
             feat_mask = None
@@ -561,6 +584,16 @@ class Booster:
     def predict_raw(self, X: np.ndarray,
                     num_iteration: Optional[int] = None) -> np.ndarray:
         X = np.asarray(X, dtype=np.float64)
+        zf = getattr(self, "zero_missing_features", None)
+        if zf:
+            # imported LightGBM missing_type=Zero (zero_as_missing=true):
+            # |x| <= 1e-35 is missing on these features and routes to the
+            # node's default side — pre-map to NaN so the ordinary
+            # missing_left routing reproduces LightGBM's NumericalDecision
+            X = X.copy()
+            for j in zf:
+                col = X[:, j]
+                X[:, j] = np.where(np.abs(col) <= 1e-35, np.nan, col)
         n = X.shape[0]
         K = self.obj.num_model_outputs
         stop = (num_iteration if num_iteration is not None
@@ -628,7 +661,7 @@ class Booster:
     # -- persistence (parity: SaveModelToString/LoadModelFromString) --------
 
     def model_to_string(self) -> str:
-        return json.dumps({
+        d = {
             "format": "mmlspark_tpu.gbdt.v1",
             "params": dataclasses.asdict(self.params),
             "mapper": self.mapper.to_json(),
@@ -638,7 +671,18 @@ class Booster:
             "init_score": self.init_score.tolist(),
             "best_iteration": self.best_iteration,
             "trees": [[t.to_json() for t in it] for it in self.trees],
-        })
+        }
+        # imported-LightGBM predict-time state must survive the json
+        # roundtrip too (the text format carries these in its own
+        # encoding: sigmoid in the objective spec, Zero missing in
+        # decision_type)
+        sigmoid = getattr(self, "lgbm_sigmoid", 1.0)
+        if sigmoid != 1.0:
+            d["lgbm_sigmoid"] = sigmoid
+        zf = getattr(self, "zero_missing_features", None)
+        if zf:
+            d["zero_missing_features"] = sorted(int(j) for j in zf)
+        return json.dumps(d)
 
     def to_lightgbm_string(self) -> str:
         """Export as LightGBM's text model format (the reverse of the
@@ -661,6 +705,15 @@ class Booster:
         b.init_score = np.asarray(d["init_score"], dtype=np.float64)
         b.best_iteration = d["best_iteration"]
         b.trees = [[Tree.from_json(t) for t in it] for it in d["trees"]]
+        sigmoid = float(d.get("lgbm_sigmoid", 1.0))
+        if sigmoid != 1.0:
+            from mmlspark_tpu.gbdt.objectives import jax_sigmoid
+            b.obj = dataclasses.replace(
+                b.obj, transform=lambda raw, k=sigmoid: jax_sigmoid(k * raw))
+            b.lgbm_sigmoid = sigmoid
+        if d.get("zero_missing_features"):
+            b.zero_missing_features = frozenset(
+                int(j) for j in d["zero_missing_features"])
         return b
 
     def merge(self, other: "Booster") -> "Booster":

@@ -22,17 +22,22 @@ bit 1 = default-left, bits 2-3 = missing_type (0 = None, 1 = Zero,
 already include shrinkage, and there is no separate init score
 (LightGBM bakes boost-from-average into the leaves).
 
-Parity scope: models with missing_type None or NaN (the defaults) and
-any ``sigmoid`` coefficient reproduce ``PredictForMat`` outputs on
-finite and NaN inputs; missing_type Zero (``zero_as_missing=true``)
-routes zeros specially in LightGBM and cannot be represented by this
-tree format, so it raises. Categorical (many-vs-many bitset) splits are
-not imported yet and raise.
+Parity scope: models with any missing_type (None / Zero / NaN) and any
+``sigmoid`` coefficient reproduce ``PredictForMat`` outputs on finite
+and NaN inputs. ``missing_type=Zero`` (``zero_as_missing=true``) is
+handled the way LightGBM's predictor handles it — values with
+``|x| <= 1e-35`` on those features are treated as missing and routed to
+the default side (`Booster.zero_missing_features`). Categorical
+(many-vs-many bitset) splits import and export: the bitset maps onto
+the framework's per-node ``cat_mask`` with the identity level map
+``category value v <-> bin v + 1`` (values beyond the bitset, negative,
+or NaN fall to bin 0 and route right, exactly LightGBM's
+``CategoricalDecision``).
 """
 
 from __future__ import annotations
 
-from typing import Dict, List
+from typing import Dict, List, Optional
 
 import numpy as np
 
@@ -92,12 +97,26 @@ def _floats(v: str) -> np.ndarray:
     return np.array([float(x) for x in v.split()], dtype=np.float64)
 
 
-def _convert_tree(blk: Dict[str, str]) -> Tree:
+_BITS_PER_WORD = 32
+
+
+def _bitset_values(words: np.ndarray) -> List[int]:
+    """Category values whose bit is set in a LightGBM uint32 bitset."""
+    out = []
+    for wi, w in enumerate(words):
+        w = int(w) & 0xFFFFFFFF
+        for b in range(_BITS_PER_WORD):
+            if w >> b & 1:
+                out.append(wi * _BITS_PER_WORD + b)
+    return out
+
+
+def _convert_tree(blk: Dict[str, str], cat_width: Dict[int, int],
+                  zero_features: set) -> Tree:
+    """Build one :class:`Tree`; records per-feature categorical bitset
+    widths in ``cat_width`` and Zero-missing features in
+    ``zero_features`` (both shared across the file's trees)."""
     n_leaves = int(blk["num_leaves"])
-    if int(blk.get("num_cat", "0")) > 0:
-        raise NotImplementedError(
-            "categorical (bitset) splits in LightGBM model files are not "
-            "supported by the importer yet")
     leaf_value = _floats(blk["leaf_value"])
     n_internal = n_leaves - 1
     n_nodes = n_internal + n_leaves
@@ -105,10 +124,12 @@ def _convert_tree(blk: Dict[str, str]) -> Tree:
     feature = np.full(n_nodes, -1, np.int32)
     threshold = np.zeros(n_nodes, np.float64)
     missing_left = np.zeros(n_nodes, bool)
+    categorical = np.zeros(n_nodes, bool)
     left = np.zeros(n_nodes, np.int32)
     right = np.zeros(n_nodes, np.int32)
     value = np.zeros(n_nodes, np.float32)
     value[n_internal:] = leaf_value.astype(np.float32)
+    cat_left: Dict[int, List[int]] = {}   # node -> category values left
 
     if n_internal:
         split_feature = _ints(blk["split_feature"])
@@ -116,36 +137,65 @@ def _convert_tree(blk: Dict[str, str]) -> Tree:
         decision = _ints(blk["decision_type"])
         lc = _ints(blk["left_child"])
         rc = _ints(blk["right_child"])
+        n_cat = int(blk.get("num_cat", "0"))
+        cat_boundaries = (_ints(blk["cat_boundaries"]) if n_cat
+                          else np.zeros(1, np.int32))
+        cat_words = (np.array([int(x) for x in
+                               blk["cat_threshold"].split()],
+                              dtype=np.int64) if n_cat
+                     else np.zeros(0, np.int64))
 
         def node_id(c: int) -> int:
             return c if c >= 0 else n_internal + (~c)
 
         for i in range(n_internal):
-            if decision[i] & 1:
-                raise NotImplementedError(
-                    "categorical decision_type in LightGBM model file")
-            missing_type = (int(decision[i]) >> 2) & 3
             feature[i] = split_feature[i]
-            threshold[i] = thr[i]
-            if missing_type == 0:
-                # None: LightGBM coerces NaN to 0.0 at predict time, then
-                # applies the numerical rule — route NaN where 0.0 goes
-                missing_left[i] = bool(0.0 <= thr[i])
-            elif missing_type == 1:
-                raise NotImplementedError(
-                    "missing_type=Zero (zero_as_missing=true) routes zeros "
-                    "to the default side, which this tree format cannot "
-                    "represent")
-            else:  # NaN: missing goes to the default-left side
-                missing_left[i] = bool(decision[i] & 2)
+            if decision[i] & 1:
+                # categorical: threshold holds the index into
+                # cat_boundaries; the bitset lists the values going LEFT.
+                # Values beyond the bitset / negative / NaN go right —
+                # LightGBM's CategoricalDecision — which the identity
+                # level map reproduces via the missing bin (right).
+                categorical[i] = True
+                ci = int(thr[i])
+                words = cat_words[cat_boundaries[ci]:cat_boundaries[ci + 1]]
+                vals = _bitset_values(words)
+                cat_left[i] = vals
+                f = int(split_feature[i])
+                width = len(words) * _BITS_PER_WORD
+                cat_width[f] = max(cat_width.get(f, 0), width)
+            else:
+                missing_type = (int(decision[i]) >> 2) & 3
+                threshold[i] = thr[i]
+                if missing_type == 0:
+                    # None: LightGBM coerces NaN to 0.0 at predict time,
+                    # then applies the numerical rule — route NaN where
+                    # 0.0 goes
+                    missing_left[i] = bool(0.0 <= thr[i])
+                elif missing_type == 1:
+                    # Zero: |x| <= 1e-35 AND NaN are missing, routed to
+                    # the default side; the booster pre-maps zeros to
+                    # NaN on these features at predict time
+                    zero_features.add(int(split_feature[i]))
+                    missing_left[i] = bool(decision[i] & 2)
+                else:  # NaN: missing goes to the default-left side
+                    missing_left[i] = bool(decision[i] & 2)
             left[i] = node_id(int(lc[i]))
             right[i] = node_id(int(rc[i]))
+
+    # cat_mask over bin space with the identity level map: value v is
+    # bin v + 1 (bin 0 = missing/unseen, never in a left set => right)
+    mask_width = 1 + max(cat_width.values(), default=0)
+    cat_mask = np.zeros((n_nodes, max(mask_width, 1)), bool)
+    for node, vals in cat_left.items():
+        for v in vals:
+            cat_mask[node, v + 1] = True
 
     return Tree(feature=feature, threshold=threshold,
                 threshold_bin=np.zeros(n_nodes, np.int32),
                 missing_left=missing_left,
-                categorical=np.zeros(n_nodes, bool),
-                cat_mask=np.zeros((n_nodes, 1), bool),
+                categorical=categorical,
+                cat_mask=cat_mask,
                 left=left, right=right, value=value,
                 gain=np.zeros(n_nodes, np.float32), n_nodes=n_nodes)
 
@@ -191,29 +241,56 @@ def from_lightgbm_text(s: str):
             from mmlspark_tpu.gbdt.objectives import jax_sigmoid
             obj = dataclasses.replace(
                 obj, transform=lambda raw, k=sigmoid: jax_sigmoid(k * raw))
-    mapper = BinMapper(max_bin=255,
-                       upper_bounds=[np.zeros(0)] * n_features,
-                       categorical=[False] * n_features, cat_levels={})
+    cat_width: Dict[int, int] = {}
+    zero_features: set = set()
+    trees = [_convert_tree(b, cat_width, zero_features) for b in blocks]
+    # identity level map for imported categorical features: category
+    # value v <-> bin v + 1, so the trees' bitset masks index directly
+    mapper = BinMapper(
+        max_bin=255,
+        upper_bounds=[np.zeros(0)] * n_features,
+        categorical=[j in cat_width for j in range(n_features)],
+        cat_levels={j: np.arange(w, dtype=np.float64)
+                    for j, w in cat_width.items()})
     booster = Booster(params, mapper, obj, names)
     booster.init_score = np.zeros(obj.num_model_outputs)
     if obj_name == "binary":
         booster.lgbm_sigmoid = sigmoid  # preserved on re-export
+    booster.zero_missing_features = frozenset(zero_features)
 
-    trees = [_convert_tree(b) for b in blocks]
     booster.trees = [trees[i:i + per_iter]
                      for i in range(0, len(trees), per_iter)]
     booster.best_iteration = len(booster.trees) - 1
     return booster
 
 
-def _export_tree(tree: Tree, idx: int, init_shift: float) -> str:
+def _cat_left_values(tree: Tree, node: int, levels: np.ndarray) -> List[int]:
+    """Nonneg-int category values routed left by ``node``'s cat_mask."""
+    mask = tree.cat_mask[node]
+    if mask.shape[0] > 0 and bool(mask[0]):
+        raise NotImplementedError(
+            "this categorical split routes MISSING left, which LightGBM's "
+            "categorical decision cannot express (NaN always goes right "
+            "there); use save_native_model(path, format='json') for "
+            "exact persistence of this model")
+    vals = []
+    for b in np.flatnonzero(mask[1:1 + len(levels)]):
+        v = float(levels[int(b)])
+        if v < 0 or v != int(v):
+            raise ValueError(
+                f"categorical level {v!r} is not a nonnegative integer; "
+                "LightGBM bitsets index categories by nonneg int value "
+                "(the reference passes integer-coded categoricals "
+                "straight through, `LightGBMBase.scala:54-58`)")
+        vals.append(int(v))
+    return vals
+
+
+def _export_tree(tree: Tree, idx: int, init_shift: float,
+                 cat_levels: Optional[Dict[int, np.ndarray]] = None,
+                 zero_features: frozenset = frozenset()) -> str:
     """One ``Tree=`` block in LightGBM's node encoding (internal nodes
     indexed 0.., leaves referenced as ``~leaf_idx``)."""
-    if bool(np.any(tree.categorical[:tree.n_nodes])):
-        raise NotImplementedError(
-            "categorical (bitset) splits cannot be exported to the "
-            "LightGBM text format yet; use save_native_model(path, "
-            "format='json') for models with categorical splits")
     internal: List[int] = []
     leaves: List[int] = []
     order: List[int] = [0]
@@ -231,26 +308,58 @@ def _export_tree(tree: Tree, idx: int, init_shift: float) -> str:
     def child_ref(c: int) -> int:
         return int_idx[c] if tree.feature[c] >= 0 else ~leaf_idx[c]
 
+    # categorical nodes: threshold = index into cat_boundaries; bitsets
+    # of the LEFT category values, 32-bit words
+    cat_boundaries = [0]
+    cat_words: List[int] = []
+    thr_str: List[str] = []
+    dt: List[int] = []
+    n_cat = 0
+    for n in internal:
+        f = int(tree.feature[n])
+        if bool(tree.categorical[n]):
+            levels = (cat_levels or {}).get(f, np.zeros(0))
+            vals = _cat_left_values(tree, n, levels)
+            width_words = (max(vals) // _BITS_PER_WORD + 1) if vals else 1
+            words = [0] * width_words
+            for v in vals:
+                words[v // _BITS_PER_WORD] |= 1 << (v % _BITS_PER_WORD)
+            cat_words.extend(words)
+            cat_boundaries.append(cat_boundaries[-1] + width_words)
+            thr_str.append(str(n_cat))
+            n_cat += 1
+            dt.append(1)
+        else:
+            thr_str.append(f"{float(tree.threshold[n]):.17g}")
+            if f in zero_features:
+                # preserve an imported Zero missing_type on re-export
+                dt.append(4 | (2 if tree.missing_left[n] else 0))
+            else:
+                # bit1=default-left, bits 2-3 = missing_type NaN (2) —
+                # our missing bin holds NaN
+                dt.append(8 | (2 if tree.missing_left[n] else 0))
+
     lines = [f"Tree={idx}",
              f"num_leaves={len(leaves)}",
-             "num_cat=0"]
+             f"num_cat={n_cat}"]
     if internal:
-        # decision_type: bit0=0 numerical, bit1=default-left,
-        # bits 2-3 = missing_type NaN (2) — our missing bin holds NaN
-        dt = [8 | (2 if tree.missing_left[n] else 0) for n in internal]
         lines += [
             "split_feature=" + " ".join(str(int(tree.feature[n]))
                                         for n in internal),
             "split_gain=" + " ".join(f"{float(tree.gain[n]):.17g}"
                                      for n in internal),
-            "threshold=" + " ".join(f"{float(tree.threshold[n]):.17g}"
-                                    for n in internal),
+            "threshold=" + " ".join(thr_str),
             "decision_type=" + " ".join(str(d) for d in dt),
             "left_child=" + " ".join(str(child_ref(int(tree.left[n])))
                                      for n in internal),
             "right_child=" + " ".join(str(child_ref(int(tree.right[n])))
                                       for n in internal),
         ]
+        if n_cat:
+            lines += [
+                "cat_boundaries=" + " ".join(str(b) for b in cat_boundaries),
+                "cat_threshold=" + " ".join(str(w) for w in cat_words),
+            ]
     lines += [
         "leaf_value=" + " ".join(f"{float(tree.value[n]) + init_shift:.17g}"
                                  for n in leaves),
@@ -309,6 +418,9 @@ def to_lightgbm_text(booster) -> str:
     n_iters = (booster.best_iteration + 1
                if booster.best_iteration >= 0 else len(booster.trees))
     is_rf = params.boosting_type == "rf"
+    cat_levels = booster.mapper.cat_levels or {}
+    zero_features = frozenset(
+        getattr(booster, "zero_missing_features", frozenset()))
     blocks = []
     for it, iter_trees in enumerate(booster.trees[:n_iters]):
         for k, tree in enumerate(iter_trees):
@@ -319,5 +431,6 @@ def to_lightgbm_text(booster) -> str:
             shift = 0.0
             if k < len(init) and (is_rf or it == 0):
                 shift = float(init[k])
-            blocks.append(_export_tree(tree, it * K + k, shift))
+            blocks.append(_export_tree(tree, it * K + k, shift,
+                                       cat_levels, zero_features))
     return "\n".join(head) + "\n" + "\n".join(blocks) + "\nend of trees\n"

@@ -184,14 +184,16 @@ class _GBDTModelBase(Model, HasFeaturesCol):
     def save_native_model(self, path: str, format: Optional[str] = None) -> None:
         """Parity: LightGBMBooster.saveNativeModel (`LightGBMBooster.scala:104`).
 
-        ``format="lightgbm"`` writes LightGBM's text model format,
-        loadable by LightGBM tooling and by :func:`load_native_model`;
-        ``format="json"`` writes this framework's own model string (also
-        loadable by :func:`load_native_model`). By default (``format=None``)
-        the LightGBM format is written, but models with categorical splits —
-        which that format cannot represent — fall back to json with a
-        warning instead of raising; an explicit ``format="lightgbm"``
-        request on such a model still raises ``NotImplementedError``.
+        ``format="lightgbm"`` writes LightGBM's text model format
+        (including categorical bitset splits), loadable by LightGBM
+        tooling and by :func:`load_native_model`; ``format="json"``
+        writes this framework's own model string (also loadable by
+        :func:`load_native_model`). By default (``format=None``) the
+        LightGBM format is written; the rare tree that format cannot
+        represent (a categorical split routing MISSING left — LightGBM
+        always sends NaN right) falls back to json with a warning, while
+        an explicit ``format="lightgbm"`` request still raises
+        ``NotImplementedError``.
         """
         if format not in (None, "lightgbm", "json"):
             raise ValueError(f"unknown format {format!r}")
@@ -206,9 +208,10 @@ class _GBDTModelBase(Model, HasFeaturesCol):
                     raise
                 import warnings
                 warnings.warn(
-                    "model has categorical splits, which LightGBM's text "
-                    "format cannot represent; saving format='json' instead "
-                    "(loadable by load_native_model)", stacklevel=2)
+                    "model has a categorical split routing MISSING left, "
+                    "which LightGBM's text format cannot represent; saving "
+                    "format='json' instead (loadable by load_native_model)",
+                    stacklevel=2)
                 text = self.booster.model_to_string()
         _fs.write_text(path, text)
 

@@ -828,22 +828,30 @@ def tree_from_arrays(mapper, feature, threshold_bin, missing_left,
 
 @partial(jax.jit, static_argnames=(
     "grad_hess", "n_iters", "n_outputs", "params", "n_features", "n_bins",
-    "hist_impl", "shrinkage", "renew_q", "n_valid", "metric_fn"))
+    "hist_impl", "shrinkage", "renew_q", "n_valid", "metric_fn",
+    "bagging_fraction", "bagging_freq", "goss", "top_rate", "other_rate",
+    "feature_fraction", "n_real", "it_offset"))
 def boost_loop_device(bins, bins_t, y, w, valid_mask, init_raw, grad_hess,
                       n_iters: int, n_outputs: int, params: GrowthParams,
                       is_categorical, feat_mask, n_features: int,
                       n_bins: int, hist_impl: str, shrinkage: float,
                       renew_q: Optional[float],
-                      n_valid: int = 0, metric_fn=None):
+                      n_valid: int = 0, metric_fn=None,
+                      rng_key=None,
+                      bagging_fraction: float = 1.0, bagging_freq: int = 0,
+                      goss: bool = False, top_rate: float = 0.2,
+                      other_rate: float = 0.1,
+                      feature_fraction: float = 1.0,
+                      n_real: int = 0, it_offset: int = 0):
     """The ENTIRE boosting fit as one scanned device program.
 
-    Eligible fits (plain gbdt, no bagging/goss/dart) need the host only
-    twice: once to start the scan and once to fetch every tree's node
-    arrays at the end — against the reference's fully-native hot loop
-    (`TrainUtils.scala:95-146`, one `LGBM_BoosterUpdateOneIter` per
-    iteration) this is the TPU shape of the same idea, and it removes
-    the per-tree dispatch + fetch round-trips that dominate wall-clock
-    on high-latency host<->device links.
+    Eligible fits need the host only twice: once to start the scan and
+    once to fetch every tree's node arrays at the end — against the
+    reference's fully-native hot loop (`TrainUtils.scala:95-146`, one
+    `LGBM_BoosterUpdateOneIter` per iteration) this is the TPU shape of
+    the same idea, and it removes the per-tree dispatch + fetch
+    round-trips that dominate wall-clock on high-latency host<->device
+    links.
 
     Per scan step: gradients from the carried ``(n, K)`` raw scores, one
     :func:`grow_tree_device` tree per model output (K trees for
@@ -851,15 +859,40 @@ def boost_loop_device(bins, bins_t, y, w, valid_mask, init_raw, grad_hess,
     per-iteration node arrays stacked as ``(n_iters, K, ...)``.
     Returns (final raw, stacked dict).
 
+    Row/feature sampling lives in the scan as device RNG (threefry key
+    in the carry) — the reference never pays per-iteration host
+    round-trips for sampling modes either (`TrainUtils.scala:95-146`
+    covers every boosting mode natively):
+
+    - ``bagging_fraction < 1`` with ``bagging_freq > 0``: a per-row
+      Bernoulli mask redrawn every ``freq`` iterations (carried
+      between redraws), feeding the same ``in_leaf`` masks the full-data
+      fit uses. LightGBM semantics: subsample, no reweighting.
+    - ``goss=True``: per iteration (from absolute iteration 1), the
+      ``int(top_rate * n_real)`` rows with the largest summed |gradient|
+      plus ``int(other_rate * n_real)`` uniformly drawn others, the
+      others' grad/hess amplified by ``(1 - top_rate) / other_rate``
+      (LightGBM's GOSS estimator).
+    - ``feature_fraction < 1``: per-iteration Bernoulli feature mask
+      (at least one feature kept), applied at split-finding time.
+
+    The device RNG stream differs from the host loop's numpy stream, so
+    sampled fits match the host path in distribution and quality, not
+    tree-for-tree (the exact-equality tests cover the deterministic
+    modes).
+
     Validation/early stopping (the reference's in-native eval loop,
     `TrainUtils.scala:105-145`): the caller appends the validation rows
     as the LAST ``n_valid`` rows of ``bins``/``y``/``w`` with
     ``valid_mask`` False there — they are excluded from histograms,
-    leaf stats, and renewal, but :func:`grow_tree_device` routes every
-    row, so their raw scores accrue each tree for free. Each iteration
-    then emits ``metric_fn(raw[-n_valid:], y[-n_valid:])`` under
-    ``"metric"``; the host replays the stopping rule on the fetched
-    (n_iters,) series and truncates — identical trees, one fetch.
+    leaf stats, sampling, and renewal, but :func:`grow_tree_device`
+    routes every row, so their raw scores accrue each tree for free.
+    Each iteration then emits ``metric_fn(raw[-n_valid:], y[-n_valid:])``
+    under ``"metric"``; the host replays the stopping rule on the
+    fetched (n_iters,) series and truncates — identical trees, one
+    fetch. ``init_raw`` may carry a continuation prior (``init_model``),
+    and ``it_offset`` keeps the absolute iteration number for the
+    goss warm-up and bagging redraw phases.
     """
     K = n_outputs
     max_nodes = 2 * params.num_leaves - 1
@@ -867,22 +900,81 @@ def boost_loop_device(bins, bins_t, y, w, valid_mask, init_raw, grad_hess,
                  "cat_mask", "left", "right", "gain", "n_nodes")
     n_total = bins.shape[0]
     vy = y[n_total - n_valid:] if n_valid else None
+    bagging = bagging_fraction < 1.0 and bagging_freq > 0 and not goss
+    if rng_key is None:
+        rng_key = jax.random.PRNGKey(0)
 
-    def iteration(raw, _):
+    def iteration(carry, it):
+        raw, key, bag_mask = carry
         pred = raw[:, 0] if K == 1 else raw
         g, h = grad_hess(pred, y, w)
         g = g if g.ndim == 2 else g[:, None]
         h = h if h.ndim == 2 else h[:, None]
+
+        amp = None
+        if goss:
+            key, sub = jax.random.split(key)
+            g_abs = jnp.where(valid_mask, jnp.sum(jnp.abs(g), axis=1),
+                              -jnp.inf)
+            n_top = int(top_rate * n_real)
+            n_other = int(other_rate * n_real)
+            order = jnp.argsort(-g_abs)
+            top_mask = (jnp.zeros(n_total, bool).at[order[:n_top]].set(True)
+                        & valid_mask)
+            r = jnp.where(valid_mask & ~top_mask,
+                          jax.random.uniform(sub, (n_total,)), jnp.inf)
+            other_order = jnp.argsort(r)
+            other_mask = (jnp.zeros(n_total, bool)
+                          .at[other_order[:n_other]].set(True)
+                          & valid_mask & ~top_mask)
+            warm = (it + it_offset) >= 1   # LightGBM: full first iteration
+            sample = jnp.where(warm, top_mask | other_mask, valid_mask)
+            amp = jnp.where(
+                warm & other_mask,
+                (1.0 - top_rate) / max(other_rate, 1e-12), 1.0
+            ).astype(jnp.float32)
+        elif bagging:
+            key, sub = jax.random.split(key)
+            # redraw on the freq schedule AND at the scan's first
+            # iteration (a continuation whose start_iter is mid-cycle
+            # must still open with a fresh bag, like the host loop's
+            # "bag_mask_host is None" draw)
+            redraw = (((it + it_offset) % bagging_freq) == 0) | (it == 0)
+            fresh = valid_mask & (jax.random.uniform(sub, (n_total,))
+                                  < bagging_fraction)
+            bag_mask = jnp.where(redraw, fresh, bag_mask)
+            sample = bag_mask
+        else:
+            sample = valid_mask
+
+        fm = feat_mask
+        if feature_fraction < 1.0:
+            key, sub = jax.random.split(key)
+            key, sub2 = jax.random.split(key)
+            keep = jax.random.uniform(sub, (n_features,)) < feature_fraction
+            fallback = jax.nn.one_hot(
+                jax.random.randint(sub2, (), 0, n_features), n_features,
+                dtype=jnp.bool_)
+            keep = jnp.where(keep.any(), keep, fallback)
+            pad_f = bins.shape[1] - n_features
+            fm = (jnp.concatenate([keep, jnp.zeros(pad_f, bool)])
+                  if pad_f else keep)
+            if feat_mask is not None:
+                fm = fm & feat_mask
+
         emits = []
         for k in range(K):  # static unroll: one tree per model output
-            s = grow_tree_device(bins, bins_t, g[:, k], h[:, k],
-                                 valid_mask, is_categorical, feat_mask,
+            gk, hk = g[:, k], h[:, k]
+            if amp is not None:
+                gk, hk = gk * amp, hk * amp
+            s = grow_tree_device(bins, bins_t, gk, hk,
+                                 sample, is_categorical, fm,
                                  params, n_features, n_bins, hist_impl)
             val = s["value"]
             if renew_q is not None:  # renewal objectives are all K == 1
                 rv, rc = renew_leaf_values(
                     s["node_of_row"], y - raw[:, 0], w,
-                    valid_mask, max_nodes, renew_q)
+                    sample, max_nodes, renew_q)
                 val = jnp.where((s["feature"] < 0) & (rc > 0), rv, val)
             shrunk = (val * shrinkage).astype(jnp.float32)
             raw = raw.at[:, k].add(shrunk[s["node_of_row"]])
@@ -893,6 +985,9 @@ def boost_loop_device(bins, bins_t, y, w, valid_mask, init_raw, grad_hess,
                    for kk in emits[0]}
         if n_valid:
             stacked["metric"] = metric_fn(raw[n_total - n_valid:], vy)
-        return raw, stacked
+        return (raw, key, bag_mask), stacked
 
-    return jax.lax.scan(iteration, init_raw, None, length=n_iters)
+    (raw_out, _, _), stacked = jax.lax.scan(
+        iteration, (init_raw, rng_key, valid_mask),
+        jnp.arange(n_iters), length=n_iters)
+    return raw_out, stacked

@@ -76,6 +76,11 @@ class TransformerConfig:
     # the top-k probabilities renormalized to sum to 1). The capacity
     # budget scales with k: C = ceil(factor * T * k / E).
     moe_top_k: int = 1
+    # router z-loss weight (0 = off): weight * mean_tokens
+    # logsumexp(router_logits)^2 — keeps router logits from drifting
+    # large (train instability / bf16 overflow), the ST-MoE regularizer
+    # that production MoE configs run alongside the balance aux.
+    moe_zloss_weight: float = 0.0
     microbatches: int = 1
     dtype: str = "float32"
     # un-ring-sharded attention engine: "dense" = XLA softmax-attention;
@@ -305,6 +310,14 @@ def _route_top_k(probs, k: int):
     return vals, idx
 
 
+def _pmean_token_axes(x, axes):
+    """pmean a token-linear statistic over every token-holding axis."""
+    for a in axes:
+        if a:
+            x = jax.lax.pmean(x, a)
+    return x
+
+
 def _router_stats(probs2d, top, E: int, axes):
     """GLOBAL per-layer routing statistics for the Switch aux loss.
 
@@ -394,13 +407,21 @@ def _moe_capacity(bp, x, cfg: TransformerConfig, ax: _Axes):
     y = jnp.pad(y, ((0, 0), (0, 1), (0, 0)))             # overflow row
     yflat = y[top, slot_c] * (keep * wf)[:, None]        # [T_sh*k, d]
     ytok = jnp.sum(yflat.reshape(T_sh, k, d), axis=1)    # combine choices
-    stats = (jnp.zeros(E, jnp.float32), jnp.zeros(E, jnp.float32))
+    f_stat = (jnp.zeros(E, jnp.float32), jnp.zeros(E, jnp.float32))
     if cfg.moe_aux_weight > 0:
         pT = jax.lax.dynamic_slice_in_dim(
             probs.reshape(T, E), off, T_sh)
         # aux counts the FIRST choice (Switch definition) for any k
-        stats = _router_stats(pT, experts[:, 0], E,
-                              (ax.data, ax.seq, ax.expert))
+        f_stat = _router_stats(pT, experts[:, 0], E,
+                               (ax.data, ax.seq, ax.expert))
+    z_stat = jnp.float32(0.0)
+    if cfg.moe_zloss_weight > 0:
+        lse = jax.nn.logsumexp(
+            jax.lax.dynamic_slice_in_dim(logits.reshape(T, E), off, T_sh),
+            axis=-1)
+        z_stat = _pmean_token_axes(jnp.mean(jnp.square(lse)),
+                                   (ax.data, ax.seq, ax.expert))
+    stats = (*f_stat, z_stat)
     # restore expert-axis replication: every rank contributes its own
     # token shard, psum rebuilds the full (invariant) token set
     full = jnp.zeros((T, d), jnp.float32)
@@ -439,38 +460,45 @@ def _moe(bp, x, cfg: TransformerConfig, ax: _Axes):
                        bp["ew2"][e].astype(dt)).astype(jnp.float32)
         y = y + z * sel[..., None]
     E = cfg.n_experts
-    stats = (jnp.zeros(E, jnp.float32), jnp.zeros(E, jnp.float32))
+    f_stat = (jnp.zeros(E, jnp.float32), jnp.zeros(E, jnp.float32))
     if cfg.moe_aux_weight > 0:
         # tokens are REPLICATED over the expert axis here, so only the
         # data/seq axes hold distinct tokens; the aux counts the FIRST
         # choice (the Switch definition), whatever k is
-        stats = _router_stats(probs.reshape(-1, E),
-                              experts[..., 0].reshape(-1), E,
-                              (ax.data, ax.seq))
-    return _psum_if(y, ax.expert), stats
+        f_stat = _router_stats(probs.reshape(-1, E),
+                               experts[..., 0].reshape(-1), E,
+                               (ax.data, ax.seq))
+    z_stat = jnp.float32(0.0)
+    if cfg.moe_zloss_weight > 0:
+        lse = jax.nn.logsumexp(logits, axis=-1)
+        z_stat = _pmean_token_axes(jnp.mean(jnp.square(lse)),
+                                   (ax.data, ax.seq))
+    return _psum_if(y, ax.expert), (*f_stat, z_stat)
 
 
 def _stage(stage_blocks, x, cfg: TransformerConfig, ax: _Axes, pos):
     """One pipeline stage = ``layers_per_stage`` transformer blocks.
-    Returns ``(x, f_stack, P_stack)``: per-block [n_blocks, E] routing
-    statistics for the load-balancing aux (zeros when dense-MLP or aux
-    disabled) — kept as linear stats so microbatches can be averaged
+    Returns ``(x, f_stack, P_stack, z_stack)``: per-block [n_blocks, E]
+    routing statistics for the load-balancing aux plus the per-block
+    z-loss scalars [n_blocks] (zeros when dense-MLP or the regularizers
+    are disabled) — kept as linear stats so microbatches can be averaged
     before the aux's nonlinear product (see ``local_loss``)."""
-    fs, Ps = [], []
+    fs, Ps, zs = [], [], []
     for bp in stage_blocks:
         x = x + _attention(bp, x, cfg, ax, pos)
         if cfg.n_experts:
-            y, (f, P) = _moe(bp, x, cfg, ax)
+            y, (f, P, z) = _moe(bp, x, cfg, ax)
             x = x + y
             fs.append(f)
             Ps.append(P)
+            zs.append(z)
         else:
             x = x + _mlp(bp, x, ax, cfg)
     if not fs:
         z = jnp.zeros((len(stage_blocks), max(cfg.n_experts, 1)),
                       jnp.float32)
-        return x, z, z
-    return x, jnp.stack(fs), jnp.stack(Ps)
+        return x, z, z, jnp.zeros(len(stage_blocks), jnp.float32)
+    return x, jnp.stack(fs), jnp.stack(Ps), jnp.stack(zs)
 
 
 def local_loss(params, tokens, labels, mask, cfg: TransformerConfig,
@@ -500,12 +528,14 @@ def local_loss(params, tokens, labels, mask, cfg: TransformerConfig,
     n_blk = len(stage_blocks)
     F_acc = jnp.zeros((n_blk, max(cfg.n_experts, 1)), jnp.float32)
     P_acc = jnp.zeros_like(F_acc)
+    Z_acc = jnp.zeros(n_blk, jnp.float32)
     for t in range(m + p_size - 1):
         if t < m:
             inp = params["embed"][tok_mb[t]]             # [mb, S_loc, D]
             state = jnp.where(p_rank == 0, inp, state)
-        state, f_t, p_t = _stage(stage_blocks, state, cfg, ax, pos)
-        if cfg.n_experts and cfg.moe_aux_weight > 0:
+        state, f_t, p_t, z_t = _stage(stage_blocks, state, cfg, ax, pos)
+        if cfg.n_experts and (cfg.moe_aux_weight > 0
+                              or cfg.moe_zloss_weight > 0):
             # accumulate only ticks where REAL data flows through this
             # rank (fill/drain ticks carry garbage activations); the
             # stats are linear, so averaging them over microbatches then
@@ -513,6 +543,7 @@ def local_loss(params, tokens, labels, mask, cfg: TransformerConfig,
             real = ((p_rank <= t) & (t < p_rank + m)).astype(jnp.float32)
             F_acc = F_acc + f_t * real
             P_acc = P_acc + p_t * real
+            Z_acc = Z_acc + z_t * real
         o_idx = t - (p_size - 1)
         if o_idx >= 0:
             out = out.at[o_idx].set(
@@ -554,6 +585,13 @@ def local_loss(params, tokens, labels, mask, cfg: TransformerConfig,
         if ax.pipe:
             aux = jax.lax.psum(aux, ax.pipe)
         loss = loss + cfg.moe_aux_weight * aux
+    if cfg.n_experts and cfg.moe_zloss_weight > 0:
+        # z-loss is already token-linear; microbatch average then sum
+        # over this rank's layers and all stages
+        zterm = jnp.sum(Z_acc / m)
+        if ax.pipe:
+            zterm = jax.lax.psum(zterm, ax.pipe)
+        loss = loss + cfg.moe_zloss_weight * zterm
     return loss
 
 
@@ -566,6 +604,7 @@ def reference_loss(params, tokens, labels, mask, cfg: TransformerConfig):
     x = params["embed"][tokens]
     pos = jnp.arange(tokens.shape[1])
     aux_total = jnp.float32(0.0)
+    z_total = jnp.float32(0.0)
     for s in range(cfg.n_stages):
         for bp_all in params["blocks"]:
             bp = {k: v[s] for k, v in bp_all.items()}
@@ -593,6 +632,9 @@ def reference_loss(params, tokens, labels, mask, cfg: TransformerConfig):
                         probs.reshape(-1, cfg.n_experts),
                         experts[..., 0].reshape(-1), cfg.n_experts, ())
                     aux_total = aux_total + cfg.n_experts * jnp.sum(f * P)
+                if cfg.moe_zloss_weight > 0:
+                    lse_r = jax.nn.logsumexp(logits, axis=-1)
+                    z_total = z_total + jnp.mean(jnp.square(lse_r))
             else:
                 z = jax.nn.relu(
                     jnp.einsum("bsd,df->bsf", h, bp["w1"]) + bp["b1"])
@@ -603,7 +645,8 @@ def reference_loss(params, tokens, labels, mask, cfg: TransformerConfig):
     gold = jnp.take_along_axis(logits, labels[..., None], axis=-1)[..., 0]
     ce = lse - gold
     loss = jnp.sum(ce * mask) / jnp.maximum(jnp.sum(mask), 1.0)
-    return loss + cfg.moe_aux_weight * aux_total
+    return (loss + cfg.moe_aux_weight * aux_total
+            + cfg.moe_zloss_weight * z_total)
 
 
 # ---------------------------------------------------------------------------

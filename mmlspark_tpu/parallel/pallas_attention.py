@@ -710,8 +710,9 @@ def _ffold_bwd(causal, scale, interpret, res, dout):
     d = hd // h
     scale_f = float(scale) if scale is not None else d ** -0.5
     dof = _to_folded(dout).astype(qf.dtype)
-    # delta_h = sum_d do * out, per (b, h, s) — in f32, outside the kernel
-    delta = jnp.sum((dof * of).astype(jnp.float32)
+    # delta_h = sum_d do * out, per (b, h, s) — cast BEFORE the product
+    # so bf16 inputs multiply in f32 (matching _flash_bwd's numerics)
+    delta = jnp.sum((dof.astype(jnp.float32) * of.astype(jnp.float32))
                     .reshape(b, h, d, s), axis=2)          # (B, H, S)
     dq, dk, dv = _fbwd_call(qf, kf, vf, dof, lse, delta, h, scale_f,
                             causal, interpret)

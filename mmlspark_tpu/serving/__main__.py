@@ -18,6 +18,10 @@ Environment:
                    coordinator; defaults to the local hostname
   MAX_BATCH_SIZE / MAX_LATENCY_MS / JOURNAL_SIZE / JOURNAL_TTL
                    (worker, optional) ServingServer knobs
+  JOURNAL_PATH     (worker, optional) durable replay-journal file (any
+                   io.fs path — mount a PVC and point this at it, or
+                   gs://...): committed replies survive pod restarts,
+                   reported as ``journal_recovered`` in GET /status
 """
 
 import os
@@ -59,7 +63,8 @@ def run_worker() -> None:
         max_batch_size=int(_env_float("MAX_BATCH_SIZE", 64)),
         max_latency_ms=_env_float("MAX_LATENCY_MS", 10.0),
         journal_size=int(_env_float("JOURNAL_SIZE", 4096)),
-        journal_ttl=ttl if ttl > 0 else None).start()
+        journal_ttl=ttl if ttl > 0 else None,
+        journal_path=os.environ.get("JOURNAL_PATH") or None).start()
     print(f"[serving] worker serving {uri} on :{srv.port}", flush=True)
 
     coord_url = os.environ.get("COORDINATOR_URL")

@@ -16,6 +16,7 @@ DriverServiceUtils' coordination server, `HTTPSourceV2.scala:111-167`).
 from __future__ import annotations
 
 import json
+import os
 import threading
 import time
 import uuid
@@ -68,6 +69,7 @@ class ServingServer:
                  request_timeout: float = 30.0,
                  journal_size: int = 4096,
                  journal_ttl: Optional[float] = None,
+                 journal_path: Optional[str] = None,
                  idle_timeout: Optional[float] = 60.0):
         self.model = model
         self.api_path = api_path
@@ -114,6 +116,21 @@ class ServingServer:
         self.n_replayed = 0
         self.n_journal_evicted = 0
         self.n_window_missed = 0
+        # -- durable journal (optional): the in-memory journal dies with
+        # the process, so a pod crash-restart (exactly the k8s scenario)
+        # would lose the replay window and a client retry spanning the
+        # restart would re-execute. With ``journal_path`` (any io.fs
+        # path — a PVC mount, gs://...), every commit appends one JSON
+        # line and ServingServer REPLAYS the file on construction:
+        # committed replies survive restarts, surfaced via
+        # ``journal_recovered`` in ``GET /status``. Wall-clock
+        # timestamps ride the file so the TTL window spans restarts.
+        self.journal_path = journal_path
+        self.n_journal_recovered = 0
+        self._journal_fh = None
+        self._journal_file_lines = 0   # appended since last compaction
+        if journal_path:
+            self._recover_journal()
 
     # -- HTTP side -----------------------------------------------------------
 
@@ -162,6 +179,8 @@ class ServingServer:
                         "journal_entries": len(serving._journal),
                         "journal_size": serving.journal_size,
                         "journal_ttl": serving.journal_ttl,
+                        "journal_path": serving.journal_path,
+                        "journal_recovered": serving.n_journal_recovered,
                     }
                 self._reply(200, json.dumps(status).encode())
 
@@ -308,6 +327,74 @@ class ServingServer:
             self._journal.popitem(last=False)
             self._evict_locked(rid)
 
+    def _recover_journal(self) -> None:
+        """Replay the durable journal file into the in-memory window,
+        then compact it (rewrite only the surviving entries)."""
+        from mmlspark_tpu.io import fs as _fs
+        now_wall, now_mono = time.time(), time.monotonic()
+        if _fs.exists(self.journal_path):
+            for line in _fs.read_text(self.journal_path).splitlines():
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    rec = json.loads(line)
+                    rid, status = rec["rid"], int(rec["status"])
+                    reply, t_wall = rec["reply"].encode(), float(rec["t"])
+                except (ValueError, KeyError):
+                    continue                      # torn tail write
+                age = max(now_wall - t_wall, 0.0)
+                if self.journal_ttl is not None and age > self.journal_ttl:
+                    continue
+                self._journal.pop(rid, None)      # newest record wins
+                self._journal[rid] = (status, reply, now_mono - age)
+            while len(self._journal) > self.journal_size:
+                self._journal.popitem(last=False)
+            self.n_journal_recovered = len(self._journal)
+        parent = os.path.dirname(self.journal_path)
+        if parent:
+            _fs.makedirs(parent)
+        self._compact_journal_locked()
+
+    @staticmethod
+    def _journal_line(rid, entry, t_wall) -> str:
+        return json.dumps({"rid": rid, "status": entry[0],
+                           "reply": entry[1].decode(),
+                           "t": round(t_wall, 3)}) + "\n"
+
+    def _compact_journal_locked(self) -> None:
+        """Rewrite the file to exactly the live in-memory window and
+        reopen the append handle. Runs at construction and whenever the
+        append-only file outgrows the window by 4x — the file stays
+        O(journal_size) however long the worker lives, and the next
+        restart's replay stays O(window), not O(requests-ever)."""
+        from mmlspark_tpu.io import fs as _fs
+        if self._journal_fh is not None:
+            try:
+                self._journal_fh.close()
+            except Exception:  # noqa: BLE001
+                pass
+        now_wall, now_mono = time.time(), time.monotonic()
+        _fs.write_text(self.journal_path, "".join(
+            self._journal_line(rid, e, now_wall - (now_mono - e[2]))
+            for rid, e in self._journal.items()))
+        self._journal_fh = _fs.open_file(self.journal_path, "ab")
+        self._journal_file_lines = len(self._journal)
+
+    def _append_journal_locked(self, rid: str, entry) -> None:
+        if self._journal_fh is None:
+            return
+        try:
+            self._journal_fh.write(
+                self._journal_line(rid, entry, time.time()).encode())
+            self._journal_fh.flush()
+            self._journal_file_lines += 1
+            if self._journal_file_lines > 4 * self.journal_size:
+                self._compact_journal_locked()
+        except Exception:  # noqa: BLE001 — durability is best-effort;
+            logger.warning("journal append to %s failed",
+                           self.journal_path, exc_info=True)
+
     def _commit(self, p: _PendingRequest) -> None:
         """Commit a reply, then release waiters. Successful replies are
         journaled under the client request id (exactly-once); errors are
@@ -315,8 +402,9 @@ class ServingServer:
         with self._commit_lock:
             if self._inflight.pop(p.rid, None) is not None \
                     and p.status == 200:
-                self._journal[p.rid] = (p.status, p.reply or b"{}",
-                                        time.monotonic())
+                entry = (p.status, p.reply or b"{}", time.monotonic())
+                self._journal[p.rid] = entry
+                self._append_journal_locked(p.rid, entry)
                 while len(self._journal) > self.journal_size:
                     old_rid, _ = self._journal.popitem(last=False)
                     self._evict_locked(old_rid)
@@ -346,6 +434,12 @@ class ServingServer:
         self._server.server_close()
         for t in self._threads:
             t.join(timeout=5)
+        if self._journal_fh is not None:
+            try:
+                self._journal_fh.close()
+            except Exception:  # noqa: BLE001
+                pass
+            self._journal_fh = None
 
     @property
     def address(self) -> str:

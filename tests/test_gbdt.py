@@ -411,6 +411,41 @@ parameters:
 end of parameters
 """
 
+# Two-feature binary model with a categorical root split: f0 in
+# {1, 3, 66} -> leaf 0.3; otherwise numeric f1 <= 0.5 -> -0.2 else 0.1.
+# The bitset spans three uint32 words (66 = word 2, bit 2).
+LGBM_CATEGORICAL_MODEL = """tree
+version=v3
+num_class=1
+num_tree_per_iteration=1
+label_index=0
+max_feature_idx=1
+objective=binary sigmoid:1
+feature_names=f0 f1
+feature_infos=none none
+
+Tree=0
+num_leaves=3
+num_cat=1
+split_feature=0 1
+split_gain=5 2
+threshold=0 0.5
+decision_type=1 2
+left_child=-1 -2
+right_child=1 -3
+leaf_value=0.3 -0.2 0.1
+leaf_weight=1 1 1
+leaf_count=10 10 10
+internal_value=0 0
+internal_weight=0 0
+internal_count=30 20
+cat_boundaries=0 3
+cat_threshold=10 0 4
+shrinkage=1
+
+end of trees
+"""
+
 # One-feature, one-split binary model with a templated decision_type
 # ("DTYPE") for exercising missing_type bits: x<=1.25 -> 0.2 else -0.3.
 LGBM_MISSING_NAN_MODEL = """tree
@@ -488,10 +523,75 @@ class TestLightGBMImport:
                 b.predict(np.array([[0.0]])),
                 1 / (1 + np.exp(-0.2)), rtol=1e-6)
 
-    def test_missing_type_zero_raises(self):
-        model = LGBM_MISSING_NAN_MODEL.replace("DTYPE", "4")  # Zero missing
-        with pytest.raises(NotImplementedError):
-            Booster.from_string(model)
+    def test_missing_type_zero_routes_default(self):
+        # decision_type 4 = Zero missing, default RIGHT; 6 = default LEFT.
+        # zero_as_missing=true: |x| <= 1e-35 AND NaN route to the default
+        # side (LightGBM's NumericalDecision); other values numerically.
+        for dt, raw_missing in ((4, -0.3), (6, 0.2)):
+            model = LGBM_MISSING_NAN_MODEL.replace("DTYPE", str(dt))
+            b = Booster.from_string(model)
+            assert b.zero_missing_features == frozenset({0})
+            for xv in (0.0, np.nan, 1e-40):
+                np.testing.assert_allclose(
+                    b.predict(np.array([[xv]])),
+                    1 / (1 + np.exp(-raw_missing)), rtol=1e-6,
+                    err_msg=f"dt={dt} x={xv}")
+            np.testing.assert_allclose(           # finite values numeric
+                b.predict(np.array([[1.0], [2.0]])),
+                1 / (1 + np.exp(-np.array([0.2, -0.3]))), rtol=1e-6)
+
+    def test_missing_type_zero_survives_reexport(self):
+        model = LGBM_MISSING_NAN_MODEL.replace("DTYPE", "6")
+        b = Booster.from_string(model)
+        again = Booster.from_string(b.to_lightgbm_string())
+        assert again.zero_missing_features == frozenset({0})
+        X = np.array([[0.0], [np.nan], [1.0], [2.0]])
+        np.testing.assert_allclose(again.predict(X), b.predict(X),
+                                   rtol=1e-6)
+
+    def test_zero_missing_and_sigmoid_survive_json_roundtrip(self):
+        # the framework's OWN json format (save_native_model's fallback)
+        # must carry the imported predict-time state too — silently
+        # dropping zero_as_missing or a trained sigmoid would change
+        # predictions on reload
+        model = LGBM_MISSING_NAN_MODEL.replace("DTYPE", "6") \
+            .replace("sigmoid:1", "sigmoid:2.5")
+        b = Booster.from_string(model)
+        again = Booster.from_string(b.model_to_string())   # json path
+        assert again.zero_missing_features == frozenset({0})
+        X = np.array([[0.0], [np.nan], [1e-40], [1.0], [2.0]])
+        np.testing.assert_allclose(again.predict(X), b.predict(X),
+                                   rtol=1e-6)
+
+    def test_categorical_bitset_import(self):
+        # f0 categorical: {1, 3, 66} -> left 0.3 (66 needs a 2nd bitset
+        # word); everything else (incl. NaN / negative / beyond-bitset)
+        # falls through to the numeric split on f1
+        b = Booster.from_string(LGBM_CATEGORICAL_MODEL)
+        X = np.array([
+            [1.0, 9.0],     # in set -> 0.3
+            [3.0, 9.0],     # in set -> 0.3
+            [66.0, 9.0],    # in set (word 2) -> 0.3
+            [2.0, 0.2],     # not in set, f1<=0.5 -> -0.2
+            [2.0, 9.0],     # not in set, f1>0.5 -> 0.1
+            [70.0, 9.0],    # beyond bitset -> right -> 0.1
+            [-1.0, 9.0],    # negative -> right -> 0.1
+            [np.nan, 9.0],  # NaN -> right -> 0.1
+        ])
+        expect = np.array([0.3, 0.3, 0.3, -0.2, 0.1, 0.1, 0.1, 0.1])
+        np.testing.assert_allclose(
+            b.predict(X), 1 / (1 + np.exp(-expect)), rtol=1e-6)
+
+    def test_categorical_import_reexport_roundtrip(self):
+        b = Booster.from_string(LGBM_CATEGORICAL_MODEL)
+        text = b.to_lightgbm_string()
+        assert "cat_threshold=10 0 4" in text  # bits {1,3}; 66 = word 2 bit 2
+        again = Booster.from_string(text)
+        X = np.column_stack([
+            np.array([0, 1, 2, 3, 50, 66, 70, -2, np.nan]),
+            np.linspace(-1, 1, 9)])
+        np.testing.assert_allclose(again.predict(X), b.predict(X),
+                                   rtol=1e-6)
 
     def test_nondefault_sigmoid_coefficient(self):
         model = LGBM_MISSING_NAN_MODEL.replace("DTYPE", "0") \
@@ -653,6 +753,132 @@ class TestFusedEarlyStopping:
         assert b.num_total_iterations < 60
 
 
+class TestFusedSamplingModes:
+    """Bagging / goss / feature sampling / init_model continuation inside
+    the fused device scan (parity: every boosting mode shares the
+    reference's native hot loop, `TrainUtils.scala:95-146` — none pays
+    per-iteration host round-trips). The device threefry stream differs
+    from the host loop's numpy stream, so sampled modes are compared on
+    quality, not tree-for-tree."""
+
+    @staticmethod
+    def _counting_fused(monkeypatch):
+        """Wrap boost_loop_device to count fused invocations."""
+        from mmlspark_tpu.gbdt import tree as tree_mod
+        calls = []
+        orig = tree_mod.boost_loop_device
+
+        def wrapped(*a, **k):
+            calls.append(1)
+            return orig(*a, **k)
+        monkeypatch.setattr(tree_mod, "boost_loop_device", wrapped)
+        return calls
+
+    @staticmethod
+    def _binary_data(seed=3, n=900):
+        rng = np.random.default_rng(seed)
+        X = rng.normal(size=(n, 10))
+        y = (X[:, 0] * 2 - X[:, 1] + X[:, 2] * 0.5
+             + rng.logistic(size=n) * 0.5 > 0).astype(np.float64)
+        cut = int(n * 0.75)
+        return X[:cut], y[:cut], X[cut:], y[cut:]
+
+    @staticmethod
+    def _auc(b, X, y):
+        return _auc(y, b.predict(X))
+
+    def test_goss_early_stopping_fit_is_fused(self, monkeypatch, capsys):
+        Xtr, ytr, Xv, yv = self._binary_data()
+        calls = self._counting_fused(monkeypatch)
+        p = BoosterParams(objective="binary", boosting_type="goss",
+                          num_iterations=60, num_leaves=7,
+                          early_stopping_round=5, seed=0)
+        b = Booster.train(p, Xtr, ytr, valid_sets=[(Xv, yv)])
+        assert len(calls) == 1           # whole fit = one device scan
+        assert self._auc(b, Xv, yv) > 0.85
+
+    def test_goss_fused_quality_matches_host_loop(self, monkeypatch):
+        Xtr, ytr, Xv, yv = self._binary_data(seed=9)
+        p = BoosterParams(objective="binary", boosting_type="goss",
+                          num_iterations=40, num_leaves=7, seed=0)
+        auc_fused = self._auc(Booster.train(p, Xtr, ytr), Xv, yv)
+        # log_every forces the per-tree host loop (numpy-rng goss)
+        auc_host = self._auc(
+            Booster.train(p, Xtr, ytr, log_every=1000), Xv, yv)
+        assert abs(auc_fused - auc_host) < 0.03, (auc_fused, auc_host)
+
+    def test_bagged_early_stopping_fit_is_fused(self, monkeypatch):
+        Xtr, ytr, Xv, yv = self._binary_data(seed=5)
+        calls = self._counting_fused(monkeypatch)
+        p = BoosterParams(objective="binary", bagging_fraction=0.7,
+                          bagging_freq=2, num_iterations=60, num_leaves=7,
+                          early_stopping_round=5, seed=0)
+        b = Booster.train(p, Xtr, ytr, valid_sets=[(Xv, yv)])
+        assert len(calls) == 1
+        assert self._auc(b, Xv, yv) > 0.85
+
+    def test_feature_fraction_fit_is_fused(self, monkeypatch):
+        Xtr, ytr, Xv, yv = self._binary_data(seed=7)
+        calls = self._counting_fused(monkeypatch)
+        p = BoosterParams(objective="binary", feature_fraction=0.7,
+                          num_iterations=40, num_leaves=7, seed=0)
+        b = Booster.train(p, Xtr, ytr)
+        assert len(calls) == 1
+        assert self._auc(b, Xv, yv) > 0.85
+
+    def test_bagged_quantile_renewal_fused(self, monkeypatch):
+        # sampling + L1 leaf renewal compose: renewal must see the BAG,
+        # not the full row set
+        rng = np.random.default_rng(13)
+        X = rng.normal(size=(700, 8))
+        y = X[:, 0] * 3 + X[:, 1] + 0.3 * rng.normal(size=700)
+        calls = self._counting_fused(monkeypatch)
+        p = BoosterParams(objective="quantile", alpha=0.8,
+                          bagging_fraction=0.8, bagging_freq=1,
+                          num_iterations=30, num_leaves=7, seed=0)
+        b = Booster.train(p, X, y)
+        assert len(calls) == 1
+        frac = float(np.mean(y <= b.predict(X)))
+        assert 0.7 < frac < 0.92, frac   # calibrated-ish quantile
+
+    def test_init_model_continuation_fused_matches_host(self, monkeypatch):
+        # deterministic (no sampling) continuation: the fused scan seeded
+        # with the prior must equal the per-tree host loop exactly
+        rng = np.random.default_rng(21)
+        X = rng.normal(size=(600, 8))
+        y = X[:, 0] * 2 - X[:, 1] + 0.2 * rng.normal(size=600)
+        p1 = BoosterParams(objective="regression", num_iterations=15,
+                           num_leaves=7, seed=0)
+        base = Booster.train(p1, X, y)
+        n_base = base.num_total_iterations
+        p2 = BoosterParams(objective="regression", num_iterations=15,
+                           num_leaves=7, seed=0)
+        calls = self._counting_fused(monkeypatch)
+        b_fused = Booster.train(p2, X, y, init_model=base)
+        assert len(calls) == 1           # continuation fused too
+        assert b_fused.num_total_iterations == n_base + 15
+        base2 = Booster.train(p1, X, y)  # fresh identical base
+        b_host = Booster.train(p2, X, y, init_model=base2, log_every=1000)
+        np.testing.assert_allclose(b_fused.predict(X), b_host.predict(X),
+                                   rtol=1e-4, atol=1e-5)
+
+    def test_goss_continuation_with_early_stopping_fused(self, monkeypatch):
+        # init_model + goss + valid set: everything at once, one scan
+        Xtr, ytr, Xv, yv = self._binary_data(seed=17)
+        p1 = BoosterParams(objective="binary", num_iterations=10,
+                           num_leaves=7, seed=0)
+        base = Booster.train(p1, Xtr, ytr)
+        calls = self._counting_fused(monkeypatch)
+        p2 = BoosterParams(objective="binary", boosting_type="goss",
+                           num_iterations=50, num_leaves=7,
+                           early_stopping_round=5, seed=0)
+        b = Booster.train(p2, Xtr, ytr, valid_sets=[(Xv, yv)],
+                          init_model=base)
+        assert len(calls) == 1
+        assert b.num_total_iterations >= 10
+        assert self._auc(b, Xv, yv) > 0.85
+
+
 class TestLeafRenewal:
     """L1/quantile leaf-output renewal (LightGBM RenewTreeOutput parity)."""
 
@@ -767,7 +993,11 @@ class TestLightGBMExport:
         np.testing.assert_allclose(b2.predict(X), b.predict(X),
                                    rtol=1e-4, atol=1e-5)
 
-    def test_categorical_split_export_rejected(self):
+    def test_categorical_split_export_roundtrip(self):
+        # a TRAINED categorical model round-trips through the LightGBM
+        # text format with prediction parity (the reference passes
+        # categoricals straight to native LightGBM and its model files
+        # carry them, `LightGBMBase.scala:54-58`)
         rng = np.random.default_rng(2)
         X = rng.normal(size=(400, 4))
         X[:, 2] = rng.integers(0, 6, 400)
@@ -775,7 +1005,34 @@ class TestLightGBMExport:
         p = BoosterParams(objective="binary", num_iterations=5,
                           num_leaves=7, min_data_in_leaf=5, seed=0)
         b = Booster.train(p, X, y, categorical_features=[2])
-        with pytest.raises(NotImplementedError, match="categorical"):
+        assert any(t.categorical[:t.n_nodes].any()
+                   for it in b.trees for t in it), "no categorical split"
+        text = b.to_lightgbm_string()
+        assert "cat_boundaries=" in text
+        again = Booster.from_string(text)
+        Xt = X.copy()
+        Xt[:5, 2] = [7.0, -1.0, np.nan, 0.0, 5.0]  # unseen/neg/NaN too
+        np.testing.assert_allclose(again.predict(Xt), b.predict(Xt),
+                                   rtol=1e-5, atol=1e-6)
+
+    def test_categorical_missing_left_export_rejected(self):
+        # LightGBM's CategoricalDecision always sends NaN right; a tree
+        # routing the MISSING bin left is unrepresentable and must raise
+        rng = np.random.default_rng(2)
+        X = rng.normal(size=(300, 3))
+        X[:, 1] = rng.integers(0, 5, 300)
+        y = (X[:, 1] > 2).astype(np.float64)
+        p = BoosterParams(objective="binary", num_iterations=3,
+                          num_leaves=7, min_data_in_leaf=5, seed=0)
+        b = Booster.train(p, X, y, categorical_features=[1])
+        for it in b.trees:
+            for t in it:
+                t.cat_mask = t.cat_mask.copy()
+                for node in np.flatnonzero(t.categorical[:t.n_nodes]):
+                    t.cat_mask[node, 0] = True   # force missing-left
+        assert any(t.categorical[:t.n_nodes].any()
+                   for it in b.trees for t in it)
+        with pytest.raises(NotImplementedError, match="MISSING"):
             b.to_lightgbm_string()
 
     def test_stage_save_native_model_formats(self, tmp_path):
@@ -800,10 +1057,11 @@ class TestLightGBMExport:
             if "probability" in model.transform(df).columns
             else model.transform(df)["prediction"], rtol=1e-5, atol=1e-6)
 
-    def test_default_save_falls_back_to_json_for_categorical(self, tmp_path):
-        # ADVICE r2: categorical-split models must not raise under the
-        # DEFAULT save format — they fall back to json with a warning;
-        # an explicit format="lightgbm" request still raises
+    def test_default_save_writes_lightgbm_text_for_categorical(
+            self, tmp_path):
+        # categorical models now export to the LightGBM text format
+        # directly (bitset encoding); the json fallback remains only for
+        # the unrepresentable missing-left case
         rng = np.random.default_rng(6)
         X = rng.normal(size=(300, 4))
         X[:, 2] = rng.integers(0, 5, 300)
@@ -817,16 +1075,14 @@ class TestLightGBMExport:
                    for it in model.booster.trees
                    for t in it), "no categorical split"
         path = str(tmp_path / "cat_model.txt")
-        with pytest.warns(UserWarning, match="categorical"):
-            model.save_native_model(path)          # default format
+        model.save_native_model(path)              # default format
+        assert open(path).read(16).startswith("tree")  # lightgbm text
         from mmlspark_tpu.gbdt import load_native_model
         loaded = load_native_model(path, is_classifier=True)
         np.testing.assert_allclose(
             np.asarray(loaded.transform(df)["probability"], np.float64),
             np.asarray(model.transform(df)["probability"], np.float64),
             rtol=1e-6)
-        with pytest.raises(NotImplementedError, match="categorical"):
-            model.save_native_model(path, format="lightgbm")
 
     def test_early_stopped_export_matches_predict(self):
         rng = np.random.default_rng(4)

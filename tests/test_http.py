@@ -476,6 +476,29 @@ class TestExactlyOnce:
             assert len(srv._journal) <= 4
             assert srv.n_journal_evicted == 6
 
+    def test_durable_journal_recovers_and_stays_compact(self, tmp_path):
+        # the on-disk journal must (a) replay committed replies into a
+        # fresh server and (b) stay O(journal_size) under steady traffic
+        # (compaction at 4x the window), so a PVC never fills and
+        # restart replay never scans requests-ever
+        model, calls = self._counting_model()
+        jp = str(tmp_path / "journal.jsonl")
+        with ServingServer(model, max_latency_ms=5, journal_size=4,
+                           journal_path=jp) as srv:
+            for i in range(40):
+                requests.post(srv.address, json={"x": i},
+                              headers={"X-Request-Id": f"r{i}"}, timeout=10)
+            n_lines = len(open(jp).read().splitlines())
+            assert n_lines <= 4 * 4 + 4, n_lines   # compacted, not 40
+        model2, calls2 = self._counting_model()
+        with ServingServer(model2, max_latency_ms=5, journal_size=4,
+                           journal_path=jp) as srv2:
+            assert srv2.n_journal_recovered == 4   # the live window
+            r = requests.post(srv2.address, json={"x": 39},
+                              headers={"X-Request-Id": "r39"}, timeout=10)
+            assert r.headers.get("X-Replayed") == "1"
+            assert sum(calls2) == 0                # replayed, not re-run
+
     def test_retry_beyond_window_is_detected_and_reexecuted(self):
         # a retry whose journal entry was LRU-evicted cannot be replayed;
         # it must RE-EXECUTE but be *detected* (header + counter), never

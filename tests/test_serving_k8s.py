@@ -130,3 +130,50 @@ class TestEntrypointFleet:
         finally:
             for p in procs:
                 p.kill()
+
+    def test_journal_survives_worker_restart(self, model_dir, tmp_path):
+        """Exactly-once across a pod crash-restart: a committed reply
+        must REPLAY (not re-execute) when the client retry lands on the
+        restarted worker — the durable-journal path the k8s manifests
+        enable via JOURNAL_PATH on a PVC mount."""
+        env_base = dict(os.environ, MMLSPARK_TPU_SERVING_CPU="1")
+        jpath = str(tmp_path / "journal" / "worker-0.jsonl")
+
+        def spawn_worker():
+            wp = subprocess.Popen(
+                [sys.executable, "-m", "mmlspark_tpu.serving", "worker"],
+                env=dict(env_base, PORT="0", MODEL_URI=model_dir,
+                         MAX_LATENCY_MS="1", JOURNAL_PATH=jpath),
+                cwd=REPO, stdout=subprocess.PIPE, text=True)
+            line = wp.stdout.readline()
+            if not line:
+                raise AssertionError(f"worker exited rc={wp.poll()}")
+            port = int(line.strip().rsplit(":", 1)[1])
+            return wp, f"http://127.0.0.1:{port}"
+
+        wp, base = spawn_worker()
+        try:
+            rid = "rid-restart-1"
+            r1 = requests.post(base + "/predict",
+                               json={"features": [1.0, 0.0, 0.0]},
+                               headers={"X-Request-Id": rid}, timeout=30)
+            assert r1.status_code == 200
+            assert "X-Replayed" not in r1.headers
+
+            wp.send_signal(signal.SIGKILL)         # pod crash
+            wp.wait(timeout=10)
+            wp, base = spawn_worker()              # k8s restarts it
+
+            s = requests.get(base + "/status", timeout=10).json()
+            assert s["journal_recovered"] >= 1
+            assert s["journal_path"] == jpath
+
+            # the retry spanning the restart replays the committed body
+            r2 = requests.post(base + "/predict",
+                               json={"features": [1.0, 0.0, 0.0]},
+                               headers={"X-Request-Id": rid}, timeout=30)
+            assert r2.status_code == 200
+            assert r2.headers.get("X-Replayed") == "1"
+            assert r2.content == r1.content
+        finally:
+            wp.kill()

@@ -1,0 +1,69 @@
+"""Wall-clock evidence: sampled fused GBDT fits vs the plain fused fit.
+
+Bagging/goss/feature-fraction now ride the fused device scan as device
+RNG (gbdt/tree.py::boost_loop_device), so a sampled early-stopping fit
+still pays exactly ONE host fetch — this records that the sampling
+machinery costs little wall-clock vs the plain fused fit (the
+reference's native loop serves every boosting mode with no per-mode
+overhead either, `TrainUtils.scala:95-146`).
+
+    python tools/bench_gbdt_fused_sampling.py
+
+Writes ``docs/artifacts/gbdt_fused_sampling.json``.
+"""
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+
+def main() -> None:
+    from mmlspark_tpu.gbdt.booster import Booster, BoosterParams
+    from mmlspark_tpu.core.environment import environment_info
+
+    rng = np.random.default_rng(0)
+    n, f = 4096, 100
+    X = rng.normal(size=(n, f))
+    y = X[:, :5].sum(axis=1) + 0.3 * rng.normal(size=n) + 5.0
+    Xv, yv = X[3500:], y[3500:]
+    Xt, yt = X[:3500], y[:3500]
+
+    common = dict(objective="regression", num_iterations=40, num_leaves=15,
+                  early_stopping_round=10, seed=0)
+    configs = {
+        "plain": BoosterParams(**common),
+        "bagged": BoosterParams(bagging_fraction=0.8, bagging_freq=2,
+                                **common),
+        "goss": BoosterParams(boosting_type="goss", **common),
+        "feature_fraction": BoosterParams(feature_fraction=0.8, **common),
+    }
+    out = {}
+    for name, p in configs.items():
+        fit = lambda: Booster.train(p, Xt, yt, valid_sets=[(Xv, yv)])
+        fit()                                    # warm: bin + compile
+        times = []
+        for _ in range(3):
+            t0 = time.perf_counter()
+            fit()
+            times.append(time.perf_counter() - t0)
+        out[name + "_s"] = round(float(np.median(times)), 3)
+    for name in ("bagged", "goss", "feature_fraction"):
+        out[name + "_vs_plain"] = round(out[name + "_s"] / out["plain_s"], 2)
+    info = environment_info()
+    out["chip"] = {k: info[k] for k in ("platform", "device_kind")}
+
+    path = os.path.join(REPO, "docs", "artifacts",
+                        "gbdt_fused_sampling.json")
+    with open(path, "w") as fh:
+        json.dump(out, fh, indent=2)
+    print(json.dumps(out))
+
+
+if __name__ == "__main__":
+    main()

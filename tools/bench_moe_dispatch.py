@@ -1,0 +1,77 @@
+"""Capacity vs dense MoE dispatch: executed FLOPs as the expert count
+grows, from XLA's own cost analysis on the 8-device dryrun mesh.
+
+Dense dispatch multiplies every token through every LOCAL expert (FLOPs
+scale with E); capacity dispatch routes bounded per-expert queues
+through two all_to_alls (FLOPs scale with capacity_factor x top_k).
+This records the compiled train step's per-device FLOPs for both modes
+at growing E — the measured form of the scaling claim the cost-analysis
+test pins (`tests/test_transformer.py`), and the reason the production
+config runs capacity dispatch.
+
+    python tools/bench_moe_dispatch.py
+
+Writes ``docs/artifacts/moe_dispatch.json``.
+"""
+
+import json
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from mmlspark_tpu.parallel.topology import use_cpu_devices  # noqa: E402
+
+use_cpu_devices(8)
+
+
+def step_flops(cfg, mesh) -> float:
+    import jax
+    import numpy as np
+    from mmlspark_tpu.models import transformer as T
+
+    params = T.shard_params(T.init_params(cfg, seed=0), cfg, mesh)
+    velocity = jax.tree.map(lambda p: p * 0.0, params)
+    rng = np.random.default_rng(0)
+    tokens, labels, mask = T.make_batch(rng, cfg, 8, 128)
+    step = T.build_spmd_train_step(cfg, mesh, donate=False)
+    cost = step.lower(params, velocity, tokens, labels,
+                      mask).compile().cost_analysis() or {}
+    return float(cost.get("flops", 0.0))
+
+
+def main() -> None:
+    from mmlspark_tpu.models import transformer as T
+    from mmlspark_tpu.parallel import MeshSpec, build_mesh
+
+    mesh = build_mesh(MeshSpec.from_dict({"expert": 8}))
+    base = dict(vocab=256, d_model=64, n_heads=2, d_head=32, d_ff=256,
+                n_stages=1, layers_per_stage=2, moe_top_k=2)
+    out = {"mesh": "expert=8 (virtual CPU dryrun mesh)",
+           "batch": 8, "seq": 128, "rows": []}
+    for E in (8, 16, 32):
+        dense = step_flops(
+            T.TransformerConfig(n_experts=E, **base), mesh)
+        cap = step_flops(
+            T.TransformerConfig(n_experts=E, moe_capacity_factor=1.25,
+                                **base), mesh)
+        out["rows"].append({"n_experts": E,
+                            "dense_gflops_per_dev": round(dense / 1e9, 3),
+                            "capacity_gflops_per_dev": round(cap / 1e9, 3),
+                            "capacity_vs_dense": round(cap / dense, 3)})
+    r0, r2 = out["rows"][0], out["rows"][-1]
+    out["summary"] = (
+        "dense grows {:.2f}x from E=8 to E=32; capacity grows {:.2f}x "
+        "(factor*k bounded)".format(
+            r2["dense_gflops_per_dev"] / r0["dense_gflops_per_dev"],
+            r2["capacity_gflops_per_dev"] / r0["capacity_gflops_per_dev"]))
+
+    path = os.path.join(REPO, "docs", "artifacts", "moe_dispatch.json")
+    with open(path, "w") as fh:
+        json.dump(out, fh, indent=2)
+    print(json.dumps(out))
+
+
+if __name__ == "__main__":
+    main()
