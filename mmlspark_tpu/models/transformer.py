@@ -599,8 +599,8 @@ def local_loss(params, tokens, labels, mask, cfg: TransformerConfig,
 # reference (unsharded) forward — golden model for the SPMD tests
 
 
-def reference_loss(params, tokens, labels, mask, cfg: TransformerConfig):
-    """Same math on one device: dense attention, dense MoE, no pipeline."""
+def _reference_forward(params, tokens, cfg: TransformerConfig):
+    """Unsharded forward: ``(logits, aux_total, z_total)``."""
     x = params["embed"][tokens]
     pos = jnp.arange(tokens.shape[1])
     aux_total = jnp.float32(0.0)
@@ -641,6 +641,21 @@ def reference_loss(params, tokens, labels, mask, cfg: TransformerConfig):
                 x = x + jnp.einsum("bsf,fd->bsd", z, bp["w2"]) + bp["b2"]
     h = _rmsnorm(x, params["final_norm"])
     logits = jnp.einsum("bsd,dv->bsv", h, params["head"])
+    return logits, aux_total, z_total
+
+
+def reference_logits(params, tokens, cfg: TransformerConfig):
+    """Per-position next-token logits ``[b, s, vocab]`` on one device —
+    the scoring entry for sequence-labeling / generation consumers (the
+    era analogue of scoring a pretrained BiLSTM tagger, `notebooks/
+    samples/DeepLearning - BiLSTM Medical Entity Extraction.ipynb`)."""
+    return _reference_forward(params, tokens, cfg)[0]
+
+
+def reference_loss(params, tokens, labels, mask, cfg: TransformerConfig):
+    """Same math as the SPMD step on one device: dense attention, dense
+    MoE, no pipeline — the golden model for the sharded tests."""
+    logits, aux_total, z_total = _reference_forward(params, tokens, cfg)
     lse = jax.nn.logsumexp(logits, axis=-1)
     gold = jnp.take_along_axis(logits, labels[..., None], axis=-1)[..., 0]
     ce = lse - gold

@@ -16,7 +16,8 @@ EXAMPLES = ["drug_discovery_quantile.py", "adult_census_binary.py",
             "distributed_sgd.py", "text_classification.py",
             "recommender_sar.py", "interpret_lime.py", "serving_demo.py",
             "serving_distributed.py", "flight_delays_regression.py",
-            "hyperparam_tuning.py", "opencv_image_pipeline.py"]
+            "hyperparam_tuning.py", "opencv_image_pipeline.py",
+            "sequence_tagging.py", "multiclass_image_transfer.py"]
 EX_DIR = os.path.join(os.path.dirname(os.path.dirname(
     os.path.abspath(__file__))), "examples")
 

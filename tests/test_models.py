@@ -146,6 +146,95 @@ class TestNNModel:
                 np.testing.assert_array_equal(np.asarray(out), ref,
                                               err_msg=f"fetch={fetch}")
 
+    def test_input_cache_one_upload_across_models(self, convnet, rng,
+                                                  monkeypatch):
+        """FindBestModel-style repeated scoring of ONE frame through N
+        models: the frame is stored on its SECOND sighting (one-shot
+        frames never pin HBM) and every later transform pays zero
+        uploads — the cache is shared across NNModel instances and
+        keyed on the column object + content fingerprint."""
+        from mmlspark_tpu.models import nn as nn_mod
+        nn_mod._frame_cache().clear()
+        nn_mod._FRAME_SEEN.clear()
+        calls = []
+        orig = nn_mod._device_put
+
+        def counting(x, p):
+            calls.append(1)
+            return orig(x, p)
+        monkeypatch.setattr(nn_mod, "_device_put", counting)
+
+        X = rng.uniform(0, 1, size=(300, 32, 32, 3)).astype(np.float32)
+        df = DataFrame({"image": X})
+        convnet2 = NNFunction.init(
+            {"builder": "cifar_convnet", "num_classes": 10},
+            input_shape=(32, 32, 3), seed=7)
+        m1 = NNModel(model=convnet, input_col="image", output_col="s",
+                     batch_size=128)
+        m2 = NNModel(model=convnet2, input_col="image", output_col="s",
+                     batch_size=128)
+        out1 = np.asarray(m1.transform(df)["s"])    # sighting 1: no store
+        n1 = len(calls)
+        out1b = np.asarray(m1.transform(df)["s"])   # sighting 2: stores
+        n2 = len(calls)
+        assert n2 - n1 == 3              # 300 rows / 128 batch = 3 batches
+        out2 = np.asarray(m2.transform(df)["s"])    # hit: zero uploads
+        out1c = np.asarray(m1.transform(df)["s"])
+        assert len(calls) == n2
+        np.testing.assert_allclose(out1b, out1, rtol=1e-6)
+        np.testing.assert_allclose(out1c, out1, rtol=1e-6)
+        assert out2.shape == out1.shape
+        # edited content misses (the fingerprint catches a changed head
+        # row even at the same buffer address)
+        X[0] += 1.0
+        m1.transform(df)
+        assert len(nn_mod._frame_cache()) == 1      # old entry, new key
+        nn_mod._frame_cache().clear()
+        nn_mod._FRAME_SEEN.clear()
+
+    def test_input_cache_object_column_mutation_detected(self, convnet,
+                                                         rng):
+        """Object-dtype columns fingerprint element CONTENT (head bytes),
+        not just ids — editing a row in place must miss, not serve stale
+        scores."""
+        from mmlspark_tpu.models import nn as nn_mod
+        nn_mod._frame_cache().clear()
+        nn_mod._FRAME_SEEN.clear()
+        imgs = [rng.uniform(0, 1, (32, 32, 3)).astype(np.float32)
+                for _ in range(64)]
+        col = np.empty(len(imgs), dtype=object)
+        for i, im in enumerate(imgs):
+            col[i] = im
+        df = DataFrame({"image": col})
+        m = NNModel(model=convnet, input_col="image", output_col="s",
+                    batch_size=64)
+        m.transform(df)
+        out_a = np.asarray(m.transform(df)["s"])    # stored this pass
+        assert len(nn_mod._frame_cache()) == 1
+        col[0][:] = 0.0                             # in-place element edit
+        out_b = np.asarray(m.transform(df)["s"])
+        assert not np.allclose(out_a[0], out_b[0])  # fresh, not stale
+        nn_mod._frame_cache().clear()
+        nn_mod._FRAME_SEEN.clear()
+
+    def test_input_cache_disabled(self, convnet, rng, monkeypatch):
+        from mmlspark_tpu.models import nn as nn_mod
+        nn_mod._frame_cache().clear()
+        calls = []
+        orig = nn_mod._device_put
+        monkeypatch.setattr(
+            nn_mod, "_device_put",
+            lambda x, p: (calls.append(1), orig(x, p))[1])
+        X = rng.uniform(0, 1, size=(64, 32, 32, 3)).astype(np.float32)
+        m = NNModel(model=convnet, input_col="image", output_col="s",
+                    batch_size=64, cache_inputs=False)
+        n_before = len(nn_mod._frame_cache())
+        m.transform(DataFrame({"image": X}))
+        n1 = len(calls)                 # sharded path uploads explicitly;
+        m.transform(DataFrame({"image": X}))  # single-device via jit (0)
+        assert len(nn_mod._frame_cache()) == n_before  # nothing cached
+        assert len(calls) == 2 * n1     # second transform re-uploaded
+
     def test_uint8_input_matches_normalized_float(self, convnet, rng):
         # uint8 transfer + on-device x/255 == pre-normalized f32 path
         u8 = rng.integers(0, 256, (20, 32, 32, 3), dtype=np.uint8)

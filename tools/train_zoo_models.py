@@ -2,11 +2,15 @@
 
 The reference's ModelDownloader serves *trained* CNTK nets
 (`ModelDownloader.scala:54,124`); this is the offline converter/trainer
-that fills the same role here (SURVEY §7 step 4). Two models:
+that fills the same role here (SURVEY §7 step 4). Three models:
 
 - ``digits_resnet8`` — ResNet-8 on sklearn's real 8x8 digits dataset,
   classes 0-7 ONLY (8/9 held out so the transfer-learning example is
   genuine: its features were never trained on the target classes).
+- ``digits32_resnet14`` — ResNet-14 on the SAME real digits upscaled to
+  32x32 (classes 0-7; 8/9 held out): the real-data model above 8x8 —
+  its accuracy gate and transfer tests are claims about real data, not
+  a surrogate.
 - ``cifar10s_resnet20`` — ResNet-20 on CIFAR-scale 32x32x3 data, 10
   classes, trained ON TPU with the device-resident epoch-scan fit
   (uint8 on the wire, normalize + flip/crop augmentation on device).
@@ -19,7 +23,8 @@ that fills the same role here (SURVEY §7 step 4). Two models:
   unseen for transfer). The manifest's ``dataset`` field records which
   corpus trained the published weights.
 
-Run from the repo root:  python tools/train_zoo_models.py [digits|cifar]
+Run from the repo root:
+    python tools/train_zoo_models.py [digits|digits32|cifar]
 """
 
 import os
@@ -36,6 +41,9 @@ GOLDEN_CIFAR = os.path.join(REPO, "tests", "resources",
                             "golden_cifar10s_resnet20.npz")
 ARCH = {"builder": "cifar_resnet", "depth": 8, "width": 8, "num_classes": 8}
 ARCH_CIFAR = {"builder": "cifar_resnet", "depth": 20, "num_classes": 10}
+ARCH_D32 = {"builder": "cifar_resnet", "depth": 14, "num_classes": 8}
+GOLDEN_D32 = os.path.join(REPO, "tests", "resources",
+                          "golden_digits32_resnet14.npz")
 
 
 def load_digits_pretrain_split():
@@ -52,6 +60,70 @@ def load_digits_pretrain_split():
     n_test = 200
     return (images[n_test:], labels[n_test:],
             images[:n_test], labels[:n_test])
+
+
+def load_digits32_split():
+    """REAL sklearn digits upscaled to 32x32 (classes 0-7; 8/9 held out
+    for transfer) — the largest real-data scale available in this
+    zero-egress environment above the 8x8 original."""
+    from mmlspark_tpu.ops.image import resize
+    Xtr, ytr, Xte, yte = load_digits_pretrain_split()
+    up = lambda a: np.asarray(resize(a, 32, 32), dtype=np.float32)
+    return up(Xtr), ytr, up(Xte), yte
+
+
+def train_digits32() -> None:
+    from mmlspark_tpu.core.dataframe import DataFrame
+    from mmlspark_tpu.models.trainer import NNLearner
+    from mmlspark_tpu.models.zoo import ModelRepo
+
+    Xtr, ytr, Xte, yte = load_digits32_split()
+    print(f"digits32 split: {len(Xtr)} train / {len(Xte)} test (REAL "
+          f"sklearn digits, upscaled 8x8 -> 32x32)")
+
+    # no flip augmentation: mirrored digits are different glyphs
+    learner = NNLearner(arch=ARCH_D32, epochs=60, batch_size=256,
+                        learning_rate=0.04, warmup_steps=100,
+                        clip_norm=1.0, device_resident=True,
+                        log_every=10, seed=0)
+    model = learner.fit(DataFrame({"features": Xtr, "label": ytr}))
+
+    scored = model.transform(DataFrame({"features": Xte, "label": yte}))
+    acc = float((np.asarray(scored["scores"]).argmax(axis=1) == yte).mean())
+    print(f"test accuracy (REAL digits, classes 0-7): {acc:.4f}")
+    if acc < 0.95:
+        raise SystemExit(f"refusing to publish a weak model (acc={acc:.3f})")
+
+    fn = model.model
+    meta = ModelRepo(ZOO).publish(
+        "digits32_resnet14", fn, dataset="sklearn-digits-32x32(0-7)",
+        model_type="cifar_resnet/14", input_shape=[32, 32, 1],
+        num_classes=8)
+    print(f"published {meta.name}: hash={meta.hash[:12]}... -> {meta.uri}")
+
+    # golden fixture from the TEST backend (CPU) — same drift rule as
+    # the cifar model
+    rng = np.random.default_rng(123)
+    x = rng.uniform(0, 1, size=(8, 32, 32, 1)).astype(np.float32)
+    os.makedirs(os.path.dirname(GOLDEN_D32), exist_ok=True)
+    np.savez(GOLDEN_D32, x=x, logits=np.zeros((8, 8), np.float32),
+             test_accuracy=acc)
+    import subprocess
+    subprocess.run([sys.executable, os.path.abspath(__file__),
+                    "digits32-golden"], check=True)
+    print(f"golden fixture (CPU-backend logits) -> {GOLDEN_D32}")
+
+
+def regen_digits32_golden() -> None:
+    from mmlspark_tpu.models.zoo import ModelDownloader
+    g = np.load(GOLDEN_D32)
+    import tempfile
+    with tempfile.TemporaryDirectory() as tmp:
+        fn = ModelDownloader(tmp, repo=ZOO).load("digits32_resnet14")
+    logits = np.asarray(fn.apply(g["x"].astype(np.float32)),
+                        dtype=np.float32)
+    np.savez(GOLDEN_D32, x=g["x"], logits=logits,
+             test_accuracy=g["test_accuracy"])
 
 
 def load_cifar_split():
@@ -174,5 +246,12 @@ if __name__ == "__main__":
         from mmlspark_tpu.parallel.topology import use_cpu_devices
         use_cpu_devices(1)   # the test backend
         regen_cifar_golden()
+    elif target == "digits32":
+        train_digits32()   # REAL data at 32x32; trains on the TPU
+    elif target == "digits32-golden":
+        from mmlspark_tpu.parallel.topology import use_cpu_devices
+        use_cpu_devices(1)   # the test backend
+        regen_digits32_golden()
     else:
-        raise SystemExit(f"unknown target {target!r}; use digits|cifar")
+        raise SystemExit(
+            f"unknown target {target!r}; use digits|digits32|cifar")
