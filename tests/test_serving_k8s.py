@@ -63,6 +63,59 @@ class TestManifests:
         assert c["livenessProbe"]["httpGet"]["path"] == "/status"
 
 
+class TestRenderTool:
+    def test_render_overrides(self):
+        sys.path.insert(0, os.path.join(REPO, "tools", "k8s"))
+        try:
+            import render
+        finally:
+            sys.path.pop(0)
+        docs = render.render(render.parse_sets([
+            "replicas=5", "image=gcr.io/me/tpu:v2",
+            "model_uri=gs://me/models/m", "journal_pvc=serving-journal",
+            "stale_after=45", "env.REGISTER_INTERVAL=5"]))
+        by_role = {d["metadata"]["labels"].get("role"): d
+                   for d in docs if d.get("kind") == "Deployment"}
+        worker, coord = by_role["worker"], by_role["coordinator"]
+        assert worker["spec"]["replicas"] == 5
+        wc = worker["spec"]["template"]["spec"]["containers"][0]
+        cc = coord["spec"]["template"]["spec"]["containers"][0]
+        assert wc["image"] == cc["image"] == "gcr.io/me/tpu:v2"
+        env = {e["name"]: e.get("value") for e in wc["env"]}
+        assert env["MODEL_URI"] == "gs://me/models/m"
+        assert env["REGISTER_INTERVAL"] == "5"
+        cenv = {e["name"]: e.get("value") for e in cc["env"]}
+        assert cenv["STALE_AFTER"] == "45"
+        # journal_pvc wires the WHOLE durable-journal story: the PVC
+        # volume, the mount, and a per-pod journal file (replicas must
+        # not share one journal)
+        assert env["JOURNAL_PATH"] == "/journal/$(POD_NAME).jsonl"
+        assert any(e.get("name") == "POD_NAME" and "valueFrom" in e
+                   for e in wc["env"])
+        assert {"name": "journal", "mountPath": "/journal"} \
+            in wc["volumeMounts"]
+        vols = worker["spec"]["template"]["spec"]["volumes"]
+        assert {"name": "journal", "persistentVolumeClaim":
+                {"claimName": "serving-journal"}} in vols
+        # untouched defaults survive (the manifests stay source of truth)
+        assert env["PORT"] == "8000"
+        assert any(e.get("name") == "POD_IP" and "valueFrom" in e
+                   for e in wc["env"])
+
+    def test_render_defaults_equal_committed_manifests(self):
+        sys.path.insert(0, os.path.join(REPO, "tools", "k8s"))
+        try:
+            import render
+        finally:
+            sys.path.pop(0)
+        docs = render.render(render.parse_sets([]))
+        committed = []
+        for fname in render.MANIFESTS:
+            with open(os.path.join(REPO, "tools", "k8s", fname)) as f:
+                committed.extend(d for d in yaml.safe_load_all(f) if d)
+        assert docs == committed
+
+
 class TestEntrypointFleet:
     @pytest.fixture
     def model_dir(self, tmp_path):

@@ -106,6 +106,26 @@ def ce_loss(params, h, labels, mask, cfg, mode):
     dt = T._compute_dtype(cfg)
     if mode == "none":
         return jnp.sum(h * h) * 1e-6
+    if mode.startswith("chunked"):
+        C = int(mode.split(":")[1]) if ":" in mode else 128
+        b, s, d = h.shape
+        n = s // C
+        W = params["head"].astype(dt)
+        hs = jnp.swapaxes(h.reshape(b, n, C, d), 0, 1)
+        ls = jnp.swapaxes(labels.reshape(b, n, C), 0, 1)
+        ms = jnp.swapaxes(mask.reshape(b, n, C), 0, 1)
+
+        @jax.checkpoint
+        def body(carry, args):
+            hc, lc, mc = args
+            logits = jnp.einsum("bcd,dv->bcv", hc.astype(dt), W,
+                                preferred_element_type=jnp.float32)
+            lse = jax.nn.logsumexp(logits, axis=-1)
+            gold = jnp.take_along_axis(logits, lc[..., None],
+                                       axis=-1)[..., 0]
+            return carry + jnp.sum((lse - gold) * mc), None
+        total, _ = jax.lax.scan(body, jnp.float32(0.0), (hs, ls, ms))
+        return total / jnp.maximum(jnp.sum(mask), 1.0)
     logits = jnp.einsum("bsd,dv->bsv", h.astype(dt),
                         params["head"].astype(dt),
                         preferred_element_type=jnp.float32)
@@ -186,6 +206,9 @@ VARIANTS = {
     "folded_s256": dict(attn_mode="folded", batch=32, seq=256),
     "full_s256": dict(batch=32, seq=256),
     "folded_noce": dict(attn_mode="folded", ce_mode="none"),
+    "folded_ce128": dict(attn_mode="folded", ce_mode="chunked:128"),
+    "folded_ce256": dict(attn_mode="folded", ce_mode="chunked:256"),
+    "folded_ce512": dict(attn_mode="folded", ce_mode="chunked:512"),
 }
 
 

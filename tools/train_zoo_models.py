@@ -62,6 +62,47 @@ def load_digits_pretrain_split():
             images[:n_test], labels[:n_test])
 
 
+def _publish_and_golden(fn, name, dataset, model_type, input_shape,
+                        num_classes, acc, golden_path, probe_x,
+                        golden_target, input_dtype=None):
+    """Shared publish + golden-fixture scaffold for TPU-trained models:
+    register the weights in the zoo, then write the fixture placeholder
+    and re-exec this script on the CPU TEST backend to fill the logits
+    (20 layers of f32 convs drift ~5e-2 between TPU and CPU while the
+    zoo tests pin at 1e-4 — the fixture must come from the backend the
+    tests run on)."""
+    from mmlspark_tpu.models.zoo import ModelRepo
+    kw = {"input_dtype": input_dtype} if input_dtype else {}
+    meta = ModelRepo(ZOO).publish(name, fn, dataset=dataset,
+                                  model_type=model_type,
+                                  input_shape=input_shape,
+                                  num_classes=num_classes, **kw)
+    print(f"published {meta.name}: hash={meta.hash[:12]}... -> {meta.uri}")
+    os.makedirs(os.path.dirname(golden_path), exist_ok=True)
+    np.savez(golden_path, x=probe_x,
+             logits=np.zeros((len(probe_x), num_classes), np.float32),
+             test_accuracy=acc)
+    import subprocess
+    subprocess.run([sys.executable, os.path.abspath(__file__),
+                    golden_target], check=True)
+    print(f"golden fixture (CPU-backend logits) -> {golden_path}")
+
+
+def _regen_golden(name, golden_path, input_scale=1.0):
+    """Fill a golden fixture's logits from the published weights on the
+    CPU test backend (run in a fresh process; see _publish_and_golden)."""
+    from mmlspark_tpu.models.zoo import ModelDownloader
+    g = np.load(golden_path)
+    import tempfile
+    with tempfile.TemporaryDirectory() as tmp:
+        fn = ModelDownloader(tmp, repo=ZOO).load(name)
+    logits = np.asarray(
+        fn.apply(g["x"].astype(np.float32) * input_scale),
+        dtype=np.float32)
+    np.savez(golden_path, x=g["x"], logits=logits,
+             test_accuracy=g["test_accuracy"])
+
+
 def load_digits32_split():
     """REAL sklearn digits upscaled to 32x32 (classes 0-7; 8/9 held out
     for transfer) — the largest real-data scale available in this
@@ -94,36 +135,18 @@ def train_digits32() -> None:
     if acc < 0.95:
         raise SystemExit(f"refusing to publish a weak model (acc={acc:.3f})")
 
-    fn = model.model
-    meta = ModelRepo(ZOO).publish(
-        "digits32_resnet14", fn, dataset="sklearn-digits-32x32(0-7)",
-        model_type="cifar_resnet/14", input_shape=[32, 32, 1],
-        num_classes=8)
-    print(f"published {meta.name}: hash={meta.hash[:12]}... -> {meta.uri}")
-
-    # golden fixture from the TEST backend (CPU) — same drift rule as
-    # the cifar model
     rng = np.random.default_rng(123)
-    x = rng.uniform(0, 1, size=(8, 32, 32, 1)).astype(np.float32)
-    os.makedirs(os.path.dirname(GOLDEN_D32), exist_ok=True)
-    np.savez(GOLDEN_D32, x=x, logits=np.zeros((8, 8), np.float32),
-             test_accuracy=acc)
-    import subprocess
-    subprocess.run([sys.executable, os.path.abspath(__file__),
-                    "digits32-golden"], check=True)
-    print(f"golden fixture (CPU-backend logits) -> {GOLDEN_D32}")
+    probe = rng.uniform(0, 1, size=(8, 32, 32, 1)).astype(np.float32)
+    _publish_and_golden(model.model, "digits32_resnet14",
+                        dataset="sklearn-digits-32x32(0-7)",
+                        model_type="cifar_resnet/14",
+                        input_shape=[32, 32, 1], num_classes=8, acc=acc,
+                        golden_path=GOLDEN_D32, probe_x=probe,
+                        golden_target="digits32-golden")
 
 
 def regen_digits32_golden() -> None:
-    from mmlspark_tpu.models.zoo import ModelDownloader
-    g = np.load(GOLDEN_D32)
-    import tempfile
-    with tempfile.TemporaryDirectory() as tmp:
-        fn = ModelDownloader(tmp, repo=ZOO).load("digits32_resnet14")
-    logits = np.asarray(fn.apply(g["x"].astype(np.float32)),
-                        dtype=np.float32)
-    np.savez(GOLDEN_D32, x=g["x"], logits=logits,
-             test_accuracy=g["test_accuracy"])
+    _regen_golden("digits32_resnet14", GOLDEN_D32)
 
 
 def load_cifar_split():
@@ -163,40 +186,19 @@ def train_cifar() -> None:
     if acc < floor:
         raise SystemExit(f"refusing to publish a weak model (acc={acc:.3f})")
 
-    fn = model.model
-    meta = ModelRepo(ZOO).publish(
-        "cifar10s_resnet20", fn, dataset=dataset,
-        model_type="cifar_resnet/20", input_shape=[32, 32, 3],
-        num_classes=10, input_dtype="uint8")
-    print(f"published {meta.name}: hash={meta.hash[:12]}... -> {meta.uri}")
-
-    # golden fixture: logits must come from the TEST backend (CPU mesh)
-    # — 20 layers of f32 convs drift ~5e-2 between TPU and CPU, and
-    # tests/test_zoo.py pins at 1e-4 — so a fresh CPU subprocess loads
-    # the just-published weights and writes the fixture
     rng = np.random.default_rng(123)
-    x = rng.integers(0, 256, size=(8, 32, 32, 3), dtype=np.uint8)
-    os.makedirs(os.path.dirname(GOLDEN_CIFAR), exist_ok=True)
-    np.savez(GOLDEN_CIFAR, x=x, logits=np.zeros((8, 10), np.float32),
-             test_accuracy=acc)
-    import subprocess
-    subprocess.run([sys.executable, os.path.abspath(__file__),
-                    "cifar-golden"], check=True)
-    print(f"golden fixture (CPU-backend logits) -> {GOLDEN_CIFAR}")
+    probe = rng.integers(0, 256, size=(8, 32, 32, 3), dtype=np.uint8)
+    _publish_and_golden(model.model, "cifar10s_resnet20", dataset=dataset,
+                        model_type="cifar_resnet/20",
+                        input_shape=[32, 32, 3], num_classes=10, acc=acc,
+                        golden_path=GOLDEN_CIFAR, probe_x=probe,
+                        golden_target="cifar-golden",
+                        input_dtype="uint8")
 
 
 def regen_cifar_golden() -> None:
-    """Fill GOLDEN_CIFAR's logits from the published weights on the CPU
-    test backend (run in a fresh process; see train_cifar)."""
-    from mmlspark_tpu.models.zoo import ModelDownloader
-    g = np.load(GOLDEN_CIFAR)
-    import tempfile
-    with tempfile.TemporaryDirectory() as tmp:
-        fn = ModelDownloader(tmp, repo=ZOO).load("cifar10s_resnet20")
-    logits = np.asarray(fn.apply(g["x"].astype(np.float32) / 255.0),
-                        dtype=np.float32)
-    np.savez(GOLDEN_CIFAR, x=g["x"], logits=logits,
-             test_accuracy=g["test_accuracy"])
+    _regen_golden("cifar10s_resnet20", GOLDEN_CIFAR,
+                  input_scale=1.0 / 255.0)
 
 
 def main() -> None:
