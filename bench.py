@@ -23,6 +23,10 @@ Plus (no era analogue, utilization/latency evidence):
   6. imagenet_scoring_v1         — ResNet-50 bf16 device scoring + MFU
   7. serving_latency_v1          — serving-stack p50/p99 request latency
   8. transformer_train_v1        — SPMD transformer LM step tokens/sec + MFU
+  9. transformer_train_long_v1   — same model at seq 4096 (folded flash
+                                   attention's long-context regime)
+ 10. moe_train_v1                — experts-on train step (top-2 capacity
+                                   dispatch + balance aux + z-loss)
 
 Every line carries chip metadata (platform/device kind/count) so the
 numbers are interpretable across hosts.
@@ -508,18 +512,21 @@ def bench_serving_latency():
             "chip": _chip()}
 
 
-def bench_transformer_train():
-    """SPMD transformer LM train step on one chip: tokens/sec + MFU.
+def _transformer_train_bench(metric: str, batch: int, seq: int):
+    """Shared harness for the transformer train benches: GPT-small-ish
+    dense config (~40M params) with the framework's mixed precision
+    (bf16 projections/MLP/attention matmuls, f32 softmax/residuals —
+    `transformer._compute_dtype`), one chip, dependent step chains + a
+    scalar loss fetch with long/short slope (see
+    _device_seconds_per_batch for why).
 
-    The framework's beyond-parity flagship (5-axis dp/tp/pp/sp/ep
-    transformer with ring attention, `models/transformer.py`); this
-    measures the single-chip train-step throughput of a GPT-small-ish
-    dense config (~40M params, seq 1024) with the framework's mixed
-    precision (bf16 projections/MLP, f32 softmax/residuals/vocab head —
-    `transformer._compute_dtype`). Timing uses
-    dependent step chains + a scalar loss fetch with long/short slope
-    (see _device_seconds_per_batch for why). Informational baseline:
-    0.25 MFU (a healthy small-model training utilization).
+    Analytic train FLOPs (PaLM-appendix style): 6 x matmul-params x
+    tokens + 12 x L x b x s^2 x d_attn for attention. XLA's
+    cost_analysis matches this within ~1% on the all-XLA graph but
+    cannot see inside pallas_call, so with the folded flash kernel in
+    the path it would under-count; the analytic number is dtype- and
+    kernel-independent. Informational baseline: 0.25 MFU (a healthy
+    small-model training utilization).
     """
     import jax
     from mmlspark_tpu.models import transformer as T
@@ -530,27 +537,18 @@ def bench_transformer_train():
                               layers_per_stage=8, dtype="bfloat16")
     mesh = build_mesh(MeshSpec.from_dict({"data": 1}),
                       devices=[jax.devices()[0]])
-    batch, seq = 8, 1024
     params = T.shard_params(T.init_params(cfg, seed=0), cfg, mesh)
     velocity = jax.tree.map(lambda p: p * 0.0, params)
     rng = np.random.default_rng(0)
     tokens, labels, mask = T.make_batch(rng, cfg, batch, seq)
     step = T.build_spmd_train_step(cfg, mesh, learning_rate=0.01)
 
-    # analytic train FLOPs (PaLM-appendix style): 6 x matmul-params x
-    # tokens + 12 x L x b x s^2 x d_attn for attention. XLA's
-    # cost_analysis matches this within ~1% on the all-XLA graph but
-    # cannot see inside pallas_call, so with the flash kernel in the
-    # path it would under-count; the analytic number is dtype- and
-    # kernel-independent (it is cross-checked against cost_analysis in
-    # tests/test_entry.py-adjacent benches when the path is pure XLA).
     L = cfg.n_stages * cfg.layers_per_stage
     d_attn = cfg.n_heads * cfg.d_head
     n_matmul = (cfg.d_model * cfg.vocab                  # vocab head
                 + L * (4 * cfg.d_model * d_attn          # qkv + o proj
                        + 2 * cfg.d_model * cfg.d_ff))    # mlp
-    tokens_per_step = batch * seq
-    flops_per_step = (6.0 * n_matmul * tokens_per_step
+    flops_per_step = (6.0 * n_matmul * batch * seq
                       + 12.0 * L * batch * seq * seq * d_attn)
 
     state = {"p": params, "v": velocity}
@@ -562,24 +560,45 @@ def bench_transformer_train():
         float(loss)
 
     sec_per_step = _chain_slope_seconds(run_chain, 2, 12)
-
     tput = batch * seq / sec_per_step
     chip = _chip()
-    out = {"metric": "transformer_train_v1", "value": round(tput, 1),
+    out = {"metric": metric, "value": round(tput, 1),
            "unit": "tokens/sec/chip", "batch": batch, "seq": seq,
            "ms_per_step": round(1000 * sec_per_step, 1), "chip": chip}
     peak = _PEAK_BF16_TFLOPS.get(chip.get("device_kind") or "")
-    if flops_per_step > 0:
-        achieved = flops_per_step / sec_per_step / 1e12
-        out["achieved_tflops"] = round(achieved, 2)
-        if peak:
-            out["mfu"] = round(achieved / peak, 4)
-            out["baseline"] = 0.25
-            out["vs_baseline"] = round(out["mfu"] / 0.25, 3)
-    if "vs_baseline" not in out:
+    achieved = flops_per_step / sec_per_step / 1e12
+    out["achieved_tflops"] = round(achieved, 2)
+    if peak:
+        out["mfu"] = round(achieved / peak, 4)
+        out["baseline"] = 0.25
+        out["vs_baseline"] = round(out["mfu"] / 0.25, 3)
+    else:
         out["baseline"] = 1000.0  # tokens/sec nominal on unknown chips
         out["vs_baseline"] = round(tput / 1000.0, 3)
     return out
+
+
+def bench_transformer_train():
+    """SPMD transformer LM train step on one chip: tokens/sec + MFU.
+
+    The framework's beyond-parity flagship (5-axis dp/tp/pp/sp/ep
+    transformer, `models/transformer.py`) at b8 x s1024 — the folded
+    flash-attention regime (`parallel/pallas_attention.py`).
+    """
+    return _transformer_train_bench("transformer_train_v1", 8, 1024)
+
+
+def bench_transformer_train_long():
+    """Long-context single-chip train step: the same model at seq 4096
+    (batch 2 — constant tokens/step vs the s1024 config).
+
+    Long context is where attention's S^2 terms take over; this is the
+    regime the folded flash kernel exists for (nothing (S x S) ever
+    reaches HBM in either direction) — measured 4.3x over XLA dense
+    attention at this shape (tools/probe_transformer_perf.py:
+    0.55 vs 0.13 MFU).
+    """
+    return _transformer_train_bench("transformer_train_long_v1", 2, 4096)
 
 
 def bench_moe_train():
@@ -661,7 +680,7 @@ BENCHES = [bench_gbdt_quantile, bench_adult_census, bench_cifar10_scoring,
            bench_cifar10_scoring_uint8, bench_imagenet_scoring,
            bench_transfer_learning, bench_distributed_sgd,
            bench_serving_latency, bench_transformer_train,
-           bench_moe_train]
+           bench_transformer_train_long, bench_moe_train]
 
 
 def main() -> None:

@@ -722,3 +722,125 @@ def _ffold_bwd(causal, scale, interpret, res, dout):
 
 
 flash_attention_folded.defvjp(_ffold_fwd, _ffold_bwd)
+
+
+# ---------------------------------------------------------------------------
+# Folded ring-block kernel (position-aware, forward-only)
+# ---------------------------------------------------------------------------
+#
+# The :func:`flash_block_attn` twin in the feature-major layout: the ring
+# path's per-step block attention for short head dims. Positions are
+# kernel operands — the query block's as a lane-oriented (1, S) row, the
+# rotating KV block's as a sublane-oriented (S, 1) column (the transposed
+# score tile s^T (TK, TQ) masks with kpos on sublanes, qpos on lanes) —
+# so one kernel serves every ring step: full / diagonal / no visibility
+# fall out of ``k_pos <= q_pos``, padded keys carry the sentinel.
+# Returns the ring merge's (m, l, o-unnormalized) partials contract.
+
+
+def _fring_kernel(qpos_ref, kpos_ref, q_ref, k_ref, v_ref,
+                  o_ref, m_ref, l_ref, acc, m_scr, l_scr,
+                  *, scale: float, causal: bool, h: int, d: int):
+    j = pl.program_id(2)
+
+    @pl.when(j == 0)
+    def _():
+        acc[:] = jnp.zeros_like(acc)
+        m_scr[:] = jnp.full_like(m_scr, _NEG_INF)
+        l_scr[:] = jnp.zeros_like(l_scr)
+
+    qpos = qpos_ref[0]                                  # (TQ,) lanes
+    kpos = kpos_ref[:, 0:1]                             # (TK, 1) sublanes
+    kmin = jnp.min(kpos)
+    live = kmin != _PAD_POS
+    if causal:
+        live = live & (jnp.max(qpos) >= kmin)
+
+    @pl.when(live)
+    def _():
+        mask = kpos != _PAD_POS                         # (TK, 1)
+        if causal:
+            mask = mask & (kpos <= qpos[None, :])       # (TK, TQ)
+        for hh in range(h):
+            sl = slice(hh * d, (hh + 1) * d)
+            st = jax.lax.dot_general(                   # (TK, TQ)
+                k_ref[0, sl, :], q_ref[0, sl, :],
+                (((0,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32) * scale
+            st = jnp.where(mask, st, _NEG_INF)
+            m_prev = m_scr[hh]                          # (1, TQ)
+            m_new = jnp.maximum(m_prev,
+                                jnp.max(st, axis=0, keepdims=True))
+            # fully-masked columns: m_new == -1e30 makes exp(st - m_new)
+            # = exp(0); kill those so l stays 0 (ring merge: "no data")
+            pt = jnp.where(mask, jnp.exp(st - m_new), 0.0)
+            alpha = jnp.exp(m_prev - m_new)
+            l_scr[hh] = l_scr[hh] * alpha + jnp.sum(pt, axis=0,
+                                                    keepdims=True)
+            acc[sl, :] = acc[sl, :] * alpha + jax.lax.dot_general(
+                v_ref[0, sl, :], pt.astype(v_ref.dtype),
+                (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32)
+            m_scr[hh] = m_new
+
+    @pl.when(j == pl.num_programs(2) - 1)
+    def _():
+        for hh in range(h):
+            sl = slice(hh * d, (hh + 1) * d)
+            o_ref[0, sl, :] = acc[sl, :].astype(o_ref.dtype)  # UNnormalized
+            m_ref[0, hh] = m_scr[hh][0]
+            l_ref[0, hh] = l_scr[hh][0]
+
+
+@functools.partial(jax.jit, static_argnames=("h", "scale", "causal",
+                                             "interpret"))
+def _fring_call(qf, kf, vf, qpos, kpos_t, h: int, scale: float,
+                causal: bool, interpret: bool):
+    """qf/kf/vf (B, H*D, S); qpos (1, S); kpos_t (S, 1) int32."""
+    b, hd, s = qf.shape
+    d = hd // h
+    t = _fold_tile(s)
+    grid = (b, s // t, s // t)
+    seq_spec = pl.BlockSpec((1, hd, t), lambda b_, i, j: (b_, 0, i))
+    kv_spec = pl.BlockSpec((1, hd, t), lambda b_, i, j: (b_, 0, j))
+    st_spec = pl.BlockSpec((1, h, t), lambda b_, i, j: (b_, 0, i))
+    return pl.pallas_call(
+        functools.partial(_fring_kernel, scale=scale, causal=causal,
+                          h=h, d=d),
+        grid=grid,
+        in_specs=[pl.BlockSpec((1, t), lambda b_, i, j: (0, i)),
+                  pl.BlockSpec((t, 1), lambda b_, i, j: (j, 0)),
+                  seq_spec, kv_spec, kv_spec],
+        out_specs=[seq_spec, st_spec, st_spec],
+        out_shape=[
+            jax.ShapeDtypeStruct((b, hd, s), qf.dtype, vma=_vma(qf)),
+            jax.ShapeDtypeStruct((b, h, s), jnp.float32, vma=_vma(qf)),
+            jax.ShapeDtypeStruct((b, h, s), jnp.float32, vma=_vma(qf)),
+        ],
+        scratch_shapes=[pltpu.VMEM((hd, t), jnp.float32),
+                        pltpu.VMEM((h, 1, t), jnp.float32),
+                        pltpu.VMEM((h, 1, t), jnp.float32)],
+        interpret=interpret,
+    )(qpos, kpos_t, qf, kf, vf)
+
+
+# same eligibility as the differentiable folded kernel (the ring's
+# local blocks are same-length by construction)
+folded_block_available = folded_available
+
+
+def folded_block_attn(q, k, v, scale, q_pos, k_pos, causal: bool,
+                      interpret: bool = False):
+    """:func:`flash_block_attn` twin in the folded layout: returns
+    (m (B,H,Sq), l (B,H,Sq), o (B,Sq,H,Dh) unnormalized) for the
+    online-softmax ring merge. Requires
+    :func:`folded_block_available` shapes (the ring's local blocks are
+    same-length by construction)."""
+    b, sq, h, d = q.shape
+    qf, kf, vf = _to_folded(q), _to_folded(k), _to_folded(v)
+    qpos = jnp.asarray(q_pos, jnp.int32)[None]            # (1, S)
+    kpos_t = jnp.asarray(k_pos, jnp.int32)[:, None]       # (S, 1)
+    o, m, l = _fring_call(qf, kf, vf, qpos, kpos_t, h, float(scale),
+                          causal, interpret)
+    return (m.astype(q.dtype), l.astype(q.dtype),
+            _from_folded(o, h).astype(q.dtype))

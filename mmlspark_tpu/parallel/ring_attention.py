@@ -39,6 +39,21 @@ def _mm(spec: str, a, b, compute_dtype):
                       preferred_element_type=jnp.float32)
 
 
+def _resolve_block_impl(s_local: int, dh: int) -> str:
+    """``auto`` policy, shared by both ring entry points: the folded
+    (feature-major) kernel where its layout pays off (eligible shape,
+    short head dim — the same dh < 128 rule as
+    ``transformer._attention``'s auto), else flash on TPU, else the
+    differentiable dense path."""
+    from mmlspark_tpu.parallel.pallas_attention import (
+        flash_available, folded_block_available)
+    if folded_block_available(s_local, s_local, dh) and dh < 128:
+        return "folded"
+    if flash_available():
+        return "flash"
+    return "dense"
+
+
 def _block_attn(q, k, v, scale, q_pos, k_pos, causal, compute_dtype=None):
     """One (q-block × kv-block) streaming-attention partial.
 
@@ -76,17 +91,26 @@ def ring_attention_local(q, k, v, axis_name: str, causal: bool = True,
 
     ``block_impl``: the per-step block attention. ``dense`` (default)
     materializes the (Sq × Sk_local) scores in XLA and is
-    differentiable — training uses it; ``flash`` is the Pallas
-    streaming kernel (``pallas_attention.py``) that never does
-    (forward-only: no VJP yet — use for scoring/serving);
-    ``flash_interpret`` runs it interpreted (CPU debugging; requires
+    differentiable — training uses it; ``folded`` is the feature-major
+    Pallas streaming kernel (``pallas_attention.folded_block_attn`` —
+    no lane padding at short head dims) and ``flash`` the
+    head-per-program one; both keep the (Sq × Sk) scores out of HBM
+    and are forward-only (no VJP yet — use for scoring/serving);
+    ``*_interpret`` runs them interpreted (CPU debugging; requires
     ``check_vma=False`` on the enclosing shard_map); ``auto`` picks
-    flash on TPU backends.
+    folded on TPU where eligible, else flash, else dense.
     """
+    n = jax.lax.axis_size(axis_name)
+    idx = jax.lax.axis_index(axis_name)
+    b, s_local, h, dh = q.shape
     if block_impl == "auto":
-        from mmlspark_tpu.parallel.pallas_attention import flash_available
-        block_impl = "flash" if flash_available() else "dense"
-    if block_impl in ("flash", "flash_interpret"):
+        block_impl = _resolve_block_impl(s_local, dh)
+    if block_impl in ("folded", "folded_interpret"):
+        from mmlspark_tpu.parallel.pallas_attention import folded_block_attn
+        block_fn = functools.partial(
+            folded_block_attn,
+            interpret=(block_impl == "folded_interpret"))
+    elif block_impl in ("flash", "flash_interpret"):
         from mmlspark_tpu.parallel.pallas_attention import flash_block_attn
         block_fn = functools.partial(
             flash_block_attn, interpret=(block_impl == "flash_interpret"))
@@ -95,9 +119,6 @@ def ring_attention_local(q, k, v, axis_name: str, causal: bool = True,
                                      compute_dtype=compute_dtype)
     else:
         raise ValueError(f"unknown block_impl {block_impl!r}")
-    n = jax.lax.axis_size(axis_name)
-    idx = jax.lax.axis_index(axis_name)
-    b, s_local, h, dh = q.shape
     scale = scale if scale is not None else dh ** -0.5
     q_pos = idx * s_local + jnp.arange(s_local)
 
@@ -160,7 +181,7 @@ def ring_attention(q, k, v, mesh, axis_name: str = "seq",
 
     q/k/v: full arrays [B, S, H, Dh]; batch over ``data`` if that axis
     exists in the mesh, sequence over ``axis_name``. ``block_impl`` as
-    in :func:`ring_attention_local` (``flash*`` variants are
+    in :func:`ring_attention_local` (``folded``/``flash`` variants are
     forward-only and run with VMA checking off).
     """
     from jax.sharding import PartitionSpec as P
@@ -168,8 +189,10 @@ def ring_attention(q, k, v, mesh, axis_name: str = "seq",
 
     if block_impl == "auto":  # resolve BEFORE wiring check_vma so the
         # dense resolution keeps VMA type-checking enabled
-        from mmlspark_tpu.parallel.pallas_attention import flash_available
-        block_impl = "flash" if flash_available() else "dense"
+        n_seq = dict(zip(mesh.axis_names, mesh.devices.shape)).get(
+            axis_name, 1)
+        block_impl = _resolve_block_impl(q.shape[1] // max(n_seq, 1),
+                                         q.shape[-1])
     batch_axis = "data" if "data" in mesh.axis_names else None
     spec = P(batch_axis, axis_name)
     fn = shard_map_fn(

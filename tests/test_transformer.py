@@ -42,6 +42,53 @@ class TestRingAttention:
         np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
                                    atol=2e-5)
 
+    @pytest.mark.parametrize("causal", [True, False])
+    def test_folded_block_matches_dense(self, rng, causal):
+        """Full ring with the FOLDED (feature-major) block kernel —
+        s_local=384 tiles to 128, a 3x3 grid per ring step, so the
+        cross-tile rescale runs under every visibility (full / diagonal
+        / none)."""
+        mesh = submesh({"seq": 2})
+        q, k, v = (jnp.asarray(
+            rng.normal(size=(1, 768, 2, 8)).astype(np.float32))
+            for _ in range(3))
+        out = ring_attention(q, k, v, mesh, causal=causal,
+                             block_impl="folded_interpret")
+        ref = dense_attention(q, k, v, causal=causal)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   atol=2e-5)
+
+    @pytest.mark.parametrize("causal", [True, False])
+    def test_folded_block_partials_match_dense_block(self, rng, causal):
+        """The (m, l, o-unnormalized) partials contract itself, with
+        ring-style rotated key positions (diagonal visibility)."""
+        from mmlspark_tpu.parallel.ring_attention import _block_attn
+        from mmlspark_tpu.parallel.pallas_attention import (
+            folded_block_attn)
+        B, S, H, D = 2, 128, 3, 16
+        q, k, v = (jnp.asarray(
+            rng.normal(size=(B, S, H, D)).astype(np.float32))
+            for _ in range(3))
+        q_pos = jnp.arange(S) + S          # queries are the LATER block
+        k_pos = jnp.arange(S)              # keys fully visible (causal)
+        scale = D ** -0.5
+        rm, rl, ro = _block_attn(q, k, v, scale, q_pos, k_pos, causal)
+        fm, fl, fo = folded_block_attn(q, k, v, scale, q_pos, k_pos,
+                                       causal, interpret=True)
+        np.testing.assert_allclose(np.asarray(fm), np.asarray(rm),
+                                   atol=2e-5)
+        np.testing.assert_allclose(np.asarray(fl), np.asarray(rl),
+                                   rtol=1e-5, atol=2e-5)
+        np.testing.assert_allclose(np.asarray(fo), np.asarray(ro),
+                                   rtol=1e-5, atol=2e-5)
+        # the reverse visibility: every key in the queries' future ->
+        # no data (m = -inf sentinel, l = 0, o = 0)
+        if causal:
+            fm2, fl2, fo2 = folded_block_attn(
+                q, k, v, scale, k_pos, q_pos, True, interpret=True)
+            assert float(jnp.max(fl2)) == 0.0
+            assert float(jnp.max(jnp.abs(fo2))) == 0.0
+
 
 class TestFlashAttentionVJP:
     """The differentiable Pallas flash kernel (interpret mode) must match

@@ -100,16 +100,26 @@ def render(values):
     return docs
 
 
+SUPPORTED_KEYS = frozenset({
+    "replicas", "image", "model_uri", "coordinator_url", "max_latency_ms",
+    "journal_size", "journal_pvc", "stale_after"})
+
+
 def parse_sets(pairs):
     values = {"env": {}}
     for p in pairs:
-        key, _, val = p.partition("=")
-        if not _:
+        key, sep, val = p.partition("=")
+        if not sep:
             raise SystemExit(f"--set needs key=value, got {p!r}")
-        if key.startswith("env."):
+        if key.startswith("env.") and len(key) > 4:
             values["env"][key[4:]] = val
-        else:
+        elif key in SUPPORTED_KEYS:
             values[key] = val
+        else:
+            # a typo must not silently deploy the defaults
+            raise SystemExit(
+                f"unknown --set key {key!r}; supported: "
+                f"{', '.join(sorted(SUPPORTED_KEYS))}, env.NAME")
     return values
 
 

@@ -209,6 +209,9 @@ VARIANTS = {
     "folded_ce128": dict(attn_mode="folded", ce_mode="chunked:128"),
     "folded_ce256": dict(attn_mode="folded", ce_mode="chunked:256"),
     "folded_ce512": dict(attn_mode="folded", ce_mode="chunked:512"),
+    "folded_s4096_b2": dict(attn_mode="folded", batch=2, seq=4096),
+    "full_s4096_b2": dict(batch=2, seq=4096),
+    "flashxla_s4096_b2": dict(attn_mode="flash_xla", batch=2, seq=4096),
 }
 
 
