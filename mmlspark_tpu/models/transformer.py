@@ -81,6 +81,15 @@ class TransformerConfig:
     # large (train instability / bf16 overflow), the ST-MoE regularizer
     # that production MoE configs run alongside the balance aux.
     moe_zloss_weight: float = 0.0
+    # routing direction. "token" (default): tokens pick their top-k
+    # experts (Switch/Mixtral semantics, needs the balance aux to stay
+    # balanced). "expert_choice": each expert picks its top-C tokens
+    # (C = ceil(moe_capacity_factor * T_local / E)) from its affinity
+    # column — perfectly balanced BY CONSTRUCTION (no aux needed; a
+    # token may be served by 0..E experts). Expert choice is applied
+    # within each rank's token shard (the standard group-wise form);
+    # requires moe_capacity_factor > 0, ignores moe_top_k.
+    moe_router: str = "token"
     microbatches: int = 1
     dtype: str = "float32"
     # un-ring-sharded attention engine: "dense" = XLA softmax-attention;
@@ -429,6 +438,65 @@ def _moe_capacity(bp, x, cfg: TransformerConfig, ax: _Axes):
     return _psum_if(full, ax.expert).reshape(b, s, d), stats
 
 
+def _moe_expert_choice(bp, x, cfg: TransformerConfig, ax: _Axes):
+    """Expert-choice routing (Zhou et al. 2022): each expert picks its
+    top-C tokens from its affinity column instead of tokens picking
+    experts — per-expert load is exactly C by construction, so no
+    balance aux is needed and no overflow drops exist. Applied within
+    each rank's token shard (the standard group-wise form at scale);
+    the dispatch/return ``all_to_all`` skeleton and token-shard
+    parallelism over the ``expert`` axis match :func:`_moe_capacity`.
+    The combine weight is the router probability of each (expert,
+    token) pick; a token may be served by several experts or none
+    (riding the residual).
+    """
+    import math
+    dt = _compute_dtype(cfg)
+    h = _rmsnorm(x, bp["ln2"])
+    logits = jnp.einsum("bsd,de->bse", h, bp["router"])
+    probs = jax.nn.softmax(logits, axis=-1)
+    b, s, d = x.shape
+    T, E = b * s, cfg.n_experts
+    e_size, e_rank = _size(ax.expert), _index(ax.expert)
+    if T % e_size:
+        raise ValueError(
+            f"expert-choice MoE needs local tokens ({T}) divisible by "
+            f"the expert axis ({e_size})")
+    T_sh = T // e_size
+    off = e_rank * T_sh
+    hT = jax.lax.dynamic_slice_in_dim(h.reshape(T, d), off, T_sh)
+    pT = jax.lax.dynamic_slice_in_dim(probs.reshape(T, E), off, T_sh)
+    C = max(int(math.ceil(cfg.moe_capacity_factor * T_sh / E)), 1)
+
+    wts, idx = jax.lax.top_k(pT.T, min(C, T_sh))       # (E, C) over tokens
+    disp = hT[idx].astype(dt)                          # (E, C, d)
+    if ax.expert:
+        disp = jax.lax.all_to_all(disp, ax.expert, split_axis=0,
+                                  concat_axis=1, tiled=True)
+    z = jax.nn.relu(jnp.einsum("ecd,edf->ecf", disp,
+                               bp["ew1"].astype(dt)))
+    y = jnp.einsum("ecf,efd->ecd", z,
+                   bp["ew2"].astype(dt)).astype(jnp.float32)
+    if ax.expert:
+        y = jax.lax.all_to_all(y, ax.expert, split_axis=1,
+                               concat_axis=0, tiled=True)
+    ytok = jnp.zeros((T_sh, d), jnp.float32).at[idx.reshape(-1)].add(
+        y.reshape(-1, d) * wts.reshape(-1)[:, None])
+    E_ = cfg.n_experts
+    # load is balanced by construction: the aux stats stay zero
+    stats = (jnp.zeros(E_, jnp.float32), jnp.zeros(E_, jnp.float32))
+    z_stat = jnp.float32(0.0)
+    if cfg.moe_zloss_weight > 0:
+        lse = jax.nn.logsumexp(
+            jax.lax.dynamic_slice_in_dim(logits.reshape(T, E), off, T_sh),
+            axis=-1)
+        z_stat = _pmean_token_axes(jnp.mean(jnp.square(lse)),
+                                   (ax.data, ax.seq, ax.expert))
+    full = jnp.zeros((T, d), jnp.float32)
+    full = jax.lax.dynamic_update_slice_in_dim(full, ytok, off, axis=0)
+    return _psum_if(full, ax.expert).reshape(b, s, d), (*stats, z_stat)
+
+
 def _moe(bp, x, cfg: TransformerConfig, ax: _Axes):
     """Top-1 MoE, experts sharded over ``expert``: each rank runs its
     local experts on its local tokens; psum over the axis combines (the
@@ -437,6 +505,13 @@ def _moe(bp, x, cfg: TransformerConfig, ax: _Axes):
     the capacity-based all_to_all dispatch (:func:`_moe_capacity`).
     Returns ``(y, aux)`` — the load-balancing aux scalar is 0 unless
     ``cfg.moe_aux_weight > 0``."""
+    if cfg.moe_router == "expert_choice":
+        if cfg.moe_capacity_factor <= 0:
+            raise ValueError("moe_router='expert_choice' needs "
+                             "moe_capacity_factor > 0 (defines C)")
+        return _moe_expert_choice(bp, x, cfg, ax)
+    if cfg.moe_router != "token":
+        raise ValueError(f"unknown moe_router {cfg.moe_router!r}")
     if cfg.moe_capacity_factor > 0:
         return _moe_capacity(bp, x, cfg, ax)
     dt = _compute_dtype(cfg)
@@ -599,7 +674,34 @@ def local_loss(params, tokens, labels, mask, cfg: TransformerConfig,
 # reference (unsharded) forward — golden model for the SPMD tests
 
 
-def _reference_forward(params, tokens, cfg: TransformerConfig):
+def _reference_ec(bp, h, cfg: TransformerConfig, ec_groups: int):
+    """Unsharded expert-choice MoE matching the sharded rule: expert
+    choice runs WITHIN each token group (a rank's token shard in the
+    SPMD step — pass the number of token shards as ``ec_groups``)."""
+    import math
+    b, s, d = h.shape
+    T, E = b * s, cfg.n_experts
+    hf = h.reshape(T, d)
+    logits = jnp.einsum("td,de->te", hf, bp["router"])
+    probs = jax.nn.softmax(logits, axis=-1)
+    Tg = T // ec_groups
+    C = max(int(math.ceil(cfg.moe_capacity_factor * Tg / E)), 1)
+    y = jnp.zeros((T, d), jnp.float32)
+    for g in range(ec_groups):
+        pg = probs[g * Tg:(g + 1) * Tg]                # (Tg, E)
+        hg = hf[g * Tg:(g + 1) * Tg]
+        wts, idx = jax.lax.top_k(pg.T, min(C, Tg))     # (E, C)
+        z = jax.nn.relu(jnp.einsum("ecd,edf->ecf", hg[idx], bp["ew1"]))
+        out = jnp.einsum("ecf,efd->ecd", z, bp["ew2"])
+        yg = jnp.zeros((Tg, d), jnp.float32).at[idx.reshape(-1)].add(
+            out.reshape(-1, d) * wts.reshape(-1)[:, None])
+        y = y.at[g * Tg:(g + 1) * Tg].add(yg)
+    lse = jax.nn.logsumexp(logits, axis=-1)
+    return y.reshape(b, s, d), jnp.mean(jnp.square(lse))
+
+
+def _reference_forward(params, tokens, cfg: TransformerConfig,
+                       ec_groups: int = 1):
     """Unsharded forward: ``(logits, aux_total, z_total)``."""
     x = params["embed"][tokens]
     pos = jnp.arange(tokens.shape[1])
@@ -615,7 +717,12 @@ def _reference_forward(params, tokens, cfg: TransformerConfig):
             a = dense_attention(q, k, v, causal=True)
             x = x + jnp.einsum("bshk,hkd->bsd", a, bp["wo"])
             h = _rmsnorm(x, bp["ln2"])
-            if cfg.n_experts:
+            if cfg.n_experts and cfg.moe_router == "expert_choice":
+                y, z_layer = _reference_ec(bp, h, cfg, ec_groups)
+                x = x + y
+                if cfg.moe_zloss_weight > 0:
+                    z_total = z_total + z_layer
+            elif cfg.n_experts:
                 logits = jnp.einsum("bsd,de->bse", h, bp["router"])
                 probs = jax.nn.softmax(logits, axis=-1)
                 wts, experts = _route_top_k(probs, cfg.moe_top_k)
@@ -652,10 +759,15 @@ def reference_logits(params, tokens, cfg: TransformerConfig):
     return _reference_forward(params, tokens, cfg)[0]
 
 
-def reference_loss(params, tokens, labels, mask, cfg: TransformerConfig):
+def reference_loss(params, tokens, labels, mask, cfg: TransformerConfig,
+                   ec_groups: int = 1):
     """Same math as the SPMD step on one device: dense attention, dense
-    MoE, no pipeline — the golden model for the sharded tests."""
-    logits, aux_total, z_total = _reference_forward(params, tokens, cfg)
+    MoE, no pipeline — the golden model for the sharded tests.
+    ``ec_groups``: for expert-choice routing, the number of token
+    groups the SPMD step shards tokens into (expert choice is
+    group-wise; see :func:`_reference_ec`)."""
+    logits, aux_total, z_total = _reference_forward(params, tokens, cfg,
+                                                    ec_groups)
     lse = jax.nn.logsumexp(logits, axis=-1)
     gold = jnp.take_along_axis(logits, labels[..., None], axis=-1)[..., 0]
     ce = lse - gold

@@ -837,6 +837,15 @@ def folded_block_attn(q, k, v, scale, q_pos, k_pos, causal: bool,
     :func:`folded_block_available` shapes (the ring's local blocks are
     same-length by construction)."""
     b, sq, h, d = q.shape
+    sk = k.shape[1]
+    if sq != sk or d % 8 != 0 or _fold_tile(sq) == 0:
+        # the flash twin pads arbitrary shapes; this layout cannot —
+        # fail with the rule, not a ZeroDivisionError inside the trace
+        raise ValueError(
+            f"folded_block_attn needs same-length blocks (sq={sq}, "
+            f"sk={sk}), head_dim % 8 == 0 (got {d}) and a 128-tileable "
+            f"sequence; use block_impl='flash' (or 'auto') for other "
+            f"shapes")
     qf, kf, vf = _to_folded(q), _to_folded(k), _to_folded(v)
     qpos = jnp.asarray(q_pos, jnp.int32)[None]            # (1, S)
     kpos_t = jnp.asarray(k_pos, jnp.int32)[:, None]       # (S, 1)

@@ -287,6 +287,78 @@ class TestSpmdTrainStep:
         # such pressure at this scale
         assert after < 0.9 * before, (before, after)
 
+    @pytest.mark.parametrize("mesh_shape,groups", [
+        ({"expert": 2}, 2), ({"data": 2}, 2),
+        ({"data": 2, "expert": 2}, 4),
+    ])
+    def test_expert_choice_matches_golden(self, mesh_shape, groups):
+        """Expert-choice routing (experts pick top-C tokens — balanced
+        by construction): the sharded step must equal the group-wise
+        unsharded golden, where groups = the step's contiguous token
+        shards (data x expert)."""
+        cfg = T.TransformerConfig(vocab=64, d_model=16, n_heads=2, d_head=8,
+                                  d_ff=32, layers_per_stage=2, n_experts=4,
+                                  moe_router="expert_choice",
+                                  moe_capacity_factor=1.0,
+                                  moe_zloss_weight=0.01)
+        mesh = submesh(mesh_shape)
+        params = T.init_params(cfg, seed=0)
+        rng = np.random.default_rng(1)
+        tokens, labels, mask = T.make_batch(rng, cfg, 8, 16)
+
+        ref_p = params
+        ref_v = jax.tree.map(jnp.zeros_like, params)
+        for _ in range(2):
+            loss_ref, g = jax.value_and_grad(T.reference_loss)(
+                ref_p, tokens, labels, mask, cfg, groups)
+            ref_v = jax.tree.map(lambda v, gr: 0.9 * v + gr, ref_v, g)
+            ref_p = jax.tree.map(lambda p, v: p - 0.1 * v, ref_p, ref_v)
+
+        step = T.build_spmd_train_step(cfg, mesh, 0.1, 0.9)
+        sp = T.shard_params(params, cfg, mesh)
+        sv = T.shard_params(
+            jax.tree.map(jnp.zeros_like, params), cfg, mesh)
+        for _ in range(2):
+            sp, sv, loss_sh = step(sp, sv, tokens, labels, mask)
+        assert abs(float(loss_ref) - float(loss_sh)) < 2e-5
+        diffs = jax.tree.map(lambda a, b: float(jnp.abs(a - b).max()),
+                             jax.device_get(sp), jax.device_get(ref_p))
+        assert max(jax.tree.leaves(diffs)) < 2e-4, diffs
+
+    def test_expert_choice_needs_capacity(self):
+        cfg = T.TransformerConfig(vocab=64, d_model=16, n_heads=2, d_head=8,
+                                  d_ff=32, n_experts=2,
+                                  moe_router="expert_choice")
+        mesh = submesh({"data": 2})
+        rng = np.random.default_rng(0)
+        tokens, labels, mask = T.make_batch(rng, cfg, 4, 8)
+        step = T.build_spmd_train_step(cfg, mesh)
+        params = T.shard_params(T.init_params(cfg, 0), cfg, mesh)
+        vel = T.shard_params(
+            jax.tree.map(jnp.zeros_like, T.init_params(cfg, 0)), cfg, mesh)
+        with pytest.raises(ValueError, match="capacity"):
+            step(params, vel, tokens, labels, mask)
+
+    def test_expert_choice_trains(self):
+        # EC needs no balance aux: the loss must decrease with aux off
+        cfg = T.TransformerConfig(vocab=64, d_model=16, n_heads=2, d_head=8,
+                                  d_ff=32, layers_per_stage=1, n_experts=4,
+                                  moe_router="expert_choice",
+                                  moe_capacity_factor=1.0)
+        mesh = submesh({"expert": 2})
+        rng = np.random.default_rng(3)
+        tokens, labels, mask = T.make_batch(rng, cfg, 8, 16)
+        step = T.build_spmd_train_step(cfg, mesh, 0.2, 0.9)
+        params = T.shard_params(T.init_params(cfg, 0), cfg, mesh)
+        vel = T.shard_params(
+            jax.tree.map(jnp.zeros_like, T.init_params(cfg, 0)), cfg, mesh)
+        losses = []
+        for _ in range(8):
+            params, vel, loss = step(params, vel, tokens, labels, mask)
+            losses.append(float(loss))
+        assert losses[-1] < losses[0]
+        assert np.isfinite(losses).all()
+
     def test_aux_balances_expert_load(self):
         # with the aux on, a few steps must reduce routing imbalance
         cfg = T.TransformerConfig(vocab=64, d_model=16, n_heads=2, d_head=8,
