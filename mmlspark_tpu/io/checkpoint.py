@@ -1,0 +1,22 @@
+"""Shared orbax checkpoint-manager construction.
+
+One place for the path rule both training stacks use (NNLearner step
+checkpoints, the SPMD transformer's save/restore): remote URLs
+(``gs://...``) pass through untouched — orbax's tensorstore backend
+handles them natively on TPU VMs — and only local paths are
+absolutized (parity: the reference checkpoints streaming state to
+HDFS, `HadoopUtils.scala`).
+"""
+
+from __future__ import annotations
+
+import os
+
+
+def manager(path: str, max_to_keep: int = 3, create: bool = True):
+    import orbax.checkpoint as ocp
+    from mmlspark_tpu.io import fs as _fs
+    path = path if _fs.is_remote(path) else os.path.abspath(path)
+    return ocp.CheckpointManager(
+        path, options=ocp.CheckpointManagerOptions(
+            max_to_keep=max_to_keep, create=create))

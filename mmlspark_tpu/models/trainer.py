@@ -366,17 +366,8 @@ class NNLearner(Estimator, HasLabelCol, HasFeaturesCol):
     def _checkpoint_manager(self):
         if not self.checkpoint_dir:
             return None
-        import orbax.checkpoint as ocp
-        from mmlspark_tpu.io import fs as _fs
-        # remote URLs (gs://...) pass through untouched — orbax's
-        # tensorstore backend handles them natively on TPU VMs; only
-        # local paths are absolutized (parity: the reference checkpoints
-        # streaming state to HDFS, `HadoopUtils.scala`)
-        path = (self.checkpoint_dir if _fs.is_remote(self.checkpoint_dir)
-                else os.path.abspath(self.checkpoint_dir))
-        return ocp.CheckpointManager(
-            path,
-            options=ocp.CheckpointManagerOptions(max_to_keep=3, create=True))
+        from mmlspark_tpu.io import checkpoint as _ckpt
+        return _ckpt.manager(self.checkpoint_dir)
 
     def _checkpoint(self, mngr, step_num: int, params, opt_state) -> None:
         import jax

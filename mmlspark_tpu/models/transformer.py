@@ -853,6 +853,57 @@ def shard_params(params, cfg: TransformerConfig, mesh):
         params, specs)
 
 
+# ---------------------------------------------------------------------------
+# checkpoint / resume
+
+
+def save_train_state(path: str, params, velocity, step: int,
+                     max_to_keep: int = 3) -> None:
+    """Checkpoint the SPMD training state (params + velocity) at
+    ``step``. Sharded arrays are written as-is (orbax handles sharded
+    ``jax.Array`` natively — no host gather, multi-process meshes
+    included); the on-disk format is mesh-layout independent, so a
+    resume may use a different mesh (fewer/more chips, different axis
+    split) than the run that saved it.
+    """
+    import orbax.checkpoint as ocp
+    from mmlspark_tpu.io import checkpoint as _ckpt
+    mngr = _ckpt.manager(path, max_to_keep)
+    mngr.save(step, args=ocp.args.StandardSave(
+        {"params": params, "velocity": velocity}))
+    mngr.wait_until_finished()
+    mngr.close()
+
+
+def restore_train_state(path: str, cfg: TransformerConfig, mesh,
+                        step: Optional[int] = None):
+    """Restore ``(params, velocity, step)`` directly onto ``mesh``'s
+    canonical shardings (:func:`param_specs`, via an abstract
+    ShapeDtypeStruct template — nothing is materialized on host) — the
+    resume half of :func:`save_train_state`, valid across mesh layouts.
+    ``step=None`` restores the latest checkpoint."""
+    import orbax.checkpoint as ocp
+    from jax.sharding import NamedSharding
+    from mmlspark_tpu.io import checkpoint as _ckpt
+    from mmlspark_tpu.io import fs as _fs
+    if not _fs.exists(path):
+        raise FileNotFoundError(f"no checkpoint under {path!r}")
+    mngr = _ckpt.manager(path, create=False)
+    target = step if step is not None else mngr.latest_step()
+    if target is None:
+        raise FileNotFoundError(f"no checkpoint under {path!r}")
+    shapes = jax.eval_shape(lambda: init_params(cfg, seed=0))
+    specs = param_specs(cfg, mesh)
+    abstract = jax.tree.map(
+        lambda a, s: jax.ShapeDtypeStruct(
+            a.shape, a.dtype, sharding=NamedSharding(mesh, s)),
+        shapes, specs)
+    restored = mngr.restore(target, args=ocp.args.StandardRestore(
+        {"params": abstract, "velocity": abstract}))
+    mngr.close()
+    return restored["params"], restored["velocity"], target
+
+
 def make_batch(rng: np.random.Generator, cfg: TransformerConfig,
                batch: int, seq: int):
     """Synthetic next-token batch (tokens, labels, mask) for tests/bench."""

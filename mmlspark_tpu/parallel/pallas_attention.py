@@ -454,10 +454,15 @@ def _fold_tile(s: int) -> int:
     return 0
 
 
+def _folded_shape_ok(sq: int, sk: int, d: int) -> bool:
+    """Same-length self-attention, tileable S, sublane-aligned head —
+    the shape half of the folded-kernel eligibility (backend-agnostic:
+    interpret mode runs these shapes on CPU too)."""
+    return sq == sk and d % 8 == 0 and _fold_tile(sq) > 0
+
+
 def folded_available(sq: int, sk: int, d: int) -> bool:
-    """Same-length self-attention, tileable S, sublane-aligned head."""
-    return (sq == sk and d % 8 == 0 and _fold_tile(sq) > 0
-            and jax.default_backend() == "tpu")
+    return _folded_shape_ok(sq, sk, d) and jax.default_backend() == "tpu"
 
 
 def _causal_mask_t(i, j, tq: int, tk: int):
@@ -838,7 +843,7 @@ def folded_block_attn(q, k, v, scale, q_pos, k_pos, causal: bool,
     same-length by construction)."""
     b, sq, h, d = q.shape
     sk = k.shape[1]
-    if sq != sk or d % 8 != 0 or _fold_tile(sq) == 0:
+    if not _folded_shape_ok(sq, sk, d):
         # the flash twin pads arbitrary shapes; this layout cannot —
         # fail with the rule, not a ZeroDivisionError inside the trace
         raise ValueError(
