@@ -23,9 +23,11 @@ Plus (no era analogue, utilization/latency evidence):
   6. imagenet_scoring_v1         — ResNet-50 bf16 device scoring + MFU
   7. serving_latency_v1          — serving-stack p50/p99 request latency
   8. transformer_train_v1        — SPMD transformer LM step tokens/sec + MFU
-  9. transformer_train_long_v1   — same model at seq 4096 (folded flash
+  9. serving_throughput_v1       — serving-stack req/sec under 8
+                                   concurrent keep-alive clients
+ 10. transformer_train_long_v1   — same model at seq 4096 (folded flash
                                    attention's long-context regime)
- 10. moe_train_v1                — experts-on train step (top-2 capacity
+ 11. moe_train_v1                — experts-on train step (top-2 capacity
                                    dispatch + balance aux + z-loss)
 
 Every line carries chip metadata (platform/device kind/count) so the
@@ -459,6 +461,19 @@ def bench_imagenet_scoring():
     return out
 
 
+def _identity_model():
+    """The trivial host-side serving model shared by the serving benches
+    (so both measure the STACK, not a model)."""
+    from mmlspark_tpu.core.stage import Transformer
+
+    class Identity(Transformer):
+        def transform(self, df):
+            return df.with_column(
+                "y", np.asarray(df["x"], dtype=np.float64))
+
+    return Identity()
+
+
 def bench_serving_latency():
     """Serving-stack request latency (reference headline: "sub-ms";
     "latencies as low as 1 ms", README.md:19, mmlspark-serving.md:10).
@@ -470,20 +485,14 @@ def bench_serving_latency():
     ~100 ms RTT that says nothing about the serving layer). Baseline:
     the reference's 1 ms claim; vs_baseline = baseline / p50.
     """
-    from mmlspark_tpu.core.stage import Transformer
     from mmlspark_tpu.serving import ServingServer
-
-    class Identity(Transformer):
-        def transform(self, df):
-            return df.with_column(
-                "y", np.asarray(df["x"], dtype=np.float64))
 
     # raw http.client on a kept-alive socket: the requests library adds
     # 1-2 ms of client-side machinery that is not serving-stack latency
     import http.client
 
     lat = []
-    with ServingServer(Identity(), max_latency_ms=0) as srv:
+    with ServingServer(_identity_model(), max_latency_ms=0) as srv:
         conn = http.client.HTTPConnection(srv.host, srv.port, timeout=10)
 
         def post(i):
@@ -510,6 +519,76 @@ def bench_serving_latency():
             "baseline": baseline,
             "vs_baseline": round(baseline / max(p50, 1e-9), 3),
             "chip": _chip()}
+
+
+def bench_serving_throughput():
+    """Serving-stack sustained throughput under concurrency: 8 keep-alive
+    clients hammering one worker (the batching queue's reason to exist —
+    `max_latency_ms` trades a bounded wait for micro-batched model
+    calls). Same trivial host-side model as ``serving_latency_v1`` so
+    the number is the STACK's ceiling, not a model's. Proxy baseline:
+    1000 req/s — a Spark-era continuous-serving executor handling ~1
+    request/ms end-to-end.
+    """
+    import http.client
+    import threading
+    from mmlspark_tpu.serving import ServingServer
+
+    n_clients, duration_s = 8, 3.0
+    counts = [0] * n_clients
+    errors = [0] * n_clients
+    with ServingServer(_identity_model(), max_latency_ms=2,
+                       max_batch_size=128) as srv:
+
+        def client(ci, deadline):
+            conn = http.client.HTTPConnection(srv.host, srv.port,
+                                              timeout=10)
+            body = json.dumps({"x": ci}).encode()
+            hdrs = {"Content-Type": "application/json"}
+            while time.perf_counter() < deadline:
+                # a dead thread would silently undercount; every failed
+                # request is recorded and surfaced in the output instead
+                try:
+                    conn.request("POST", srv.api_path, body, hdrs)
+                    resp = conn.getresponse()
+                    resp.read()
+                    ok = resp.status == 200
+                except OSError:
+                    ok = False
+                    conn.close()
+                    conn = http.client.HTTPConnection(
+                        srv.host, srv.port, timeout=10)
+                if ok:
+                    counts[ci] += 1
+                else:
+                    errors[ci] += 1
+            conn.close()
+
+        warm = threading.Thread(
+            target=client, args=(0, time.perf_counter() + 0.5))
+        warm.start()
+        warm.join()                     # warm sockets + code paths
+        counts[0] = errors[0] = 0
+        deadline = time.perf_counter() + duration_s
+        threads = [threading.Thread(target=client, args=(i, deadline))
+                   for i in range(n_clients)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+    rps = sum(counts) / duration_s
+    baseline = 1000.0
+    import os
+    cores = (len(os.sched_getaffinity(0))
+             if hasattr(os, "sched_getaffinity") else os.cpu_count())
+    return {"metric": "serving_throughput_v1", "value": round(rps, 1),
+            "unit": "req/sec", "n_clients": n_clients,
+            "n_errors": sum(errors),
+            # clients and server share this host's cores: on a 1-core
+            # dev box the number is a floor, not the stack's ceiling
+            "host_cores": cores,
+            "baseline": baseline,
+            "vs_baseline": round(rps / baseline, 3), "chip": _chip()}
 
 
 def _transformer_train_bench(metric: str, batch: int, seq: int):
@@ -679,7 +758,8 @@ def bench_moe_train():
 BENCHES = [bench_gbdt_quantile, bench_adult_census, bench_cifar10_scoring,
            bench_cifar10_scoring_uint8, bench_imagenet_scoring,
            bench_transfer_learning, bench_distributed_sgd,
-           bench_serving_latency, bench_transformer_train,
+           bench_serving_latency, bench_serving_throughput,
+           bench_transformer_train,
            bench_transformer_train_long, bench_moe_train]
 
 

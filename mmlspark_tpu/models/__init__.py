@@ -9,10 +9,14 @@ from mmlspark_tpu.models.transformer import (
     build_spmd_train_step,
     init_params as init_transformer_params,
     shard_params as shard_transformer_params,
+    reference_logits,
+    restore_train_state,
+    save_train_state,
 )
 
 __all__ = ["NNFunction", "LayeredModel", "NNModel", "NNLearner", "ResNet",
            "ConvNet", "cifar_resnet", "cifar_convnet", "ImageFeaturizer",
            "ModelDownloader", "ModelRepo", "ModelSchema",
            "TransformerConfig", "build_spmd_train_step",
-           "init_transformer_params", "shard_transformer_params"]
+           "init_transformer_params", "shard_transformer_params",
+           "reference_logits", "restore_train_state", "save_train_state"]

@@ -18,12 +18,20 @@ from mmlspark_tpu.parallel.ring_attention import (
     ring_attention,
     ring_attention_local,
 )
-from mmlspark_tpu.parallel.pallas_attention import flash_block_attn
+from mmlspark_tpu.parallel.pallas_attention import (
+    flash_attention,
+    flash_attention_folded,
+    flash_block_attn,
+    folded_block_attn,
+)
 
 __all__ = [
     "MeshSpec",
     "dense_attention",
+    "flash_attention",
+    "flash_attention_folded",
     "flash_block_attn",
+    "folded_block_attn",
     "ring_attention",
     "ring_attention_local",
     "build_mesh",
