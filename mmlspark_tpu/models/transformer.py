@@ -260,8 +260,19 @@ def _attention(bp, x, cfg: TransformerConfig, ax: _Axes, pos):
     v = jnp.einsum("bsd,dhk->bshk", h, bp["wv"].astype(dt)).astype(jnp.float32)
     q, k = _rope(q, pos), _rope(k, pos)
     if ax.seq:
-        a = ring_attention_local(q, k, v, ax.seq, causal=True,
-                                 compute_dtype=mm_dt)
+        from mmlspark_tpu.parallel.ring_attention import _resolve_block_impl
+        s_loc, dh_ = q.shape[1], q.shape[-1]
+        if _resolve_block_impl(s_loc, dh_) == "folded" \
+                and cfg.attention_impl in ("auto", "folded"):
+            # training-grade folded ring (differentiable custom VJP):
+            # same eligibility rule as the un-sharded folded kernel
+            if mm_dt is not None:
+                q, k, v = q.astype(dt), k.astype(dt), v.astype(dt)
+            a = ring_attention_local(q, k, v, ax.seq, causal=True,
+                                     block_impl="folded")
+        else:
+            a = ring_attention_local(q, k, v, ax.seq, causal=True,
+                                     compute_dtype=mm_dt)
     else:
         from mmlspark_tpu.parallel.pallas_attention import (
             flash_attention, flash_attention_folded, flash_available,

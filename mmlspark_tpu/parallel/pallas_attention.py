@@ -818,7 +818,11 @@ def _fring_call(qf, kf, vf, qpos, kpos_t, h: int, scale: float,
                   seq_spec, kv_spec, kv_spec],
         out_specs=[seq_spec, st_spec, st_spec],
         out_shape=[
-            jax.ShapeDtypeStruct((b, hd, s), qf.dtype, vma=_vma(qf)),
+            # the UNNORMALIZED accumulator stays f32 whatever the input
+            # dtype: the ring merge rescales it across n steps, and
+            # quantizing each step's partial to bf16 would compound
+            # (the dense ring keeps f32 partials too)
+            jax.ShapeDtypeStruct((b, hd, s), jnp.float32, vma=_vma(qf)),
             jax.ShapeDtypeStruct((b, h, s), jnp.float32, vma=_vma(qf)),
             jax.ShapeDtypeStruct((b, h, s), jnp.float32, vma=_vma(qf)),
         ],
@@ -832,6 +836,151 @@ def _fring_call(qf, kf, vf, qpos, kpos_t, h: int, scale: float,
 # same eligibility as the differentiable folded kernel (the ring's
 # local blocks are same-length by construction)
 folded_block_available = folded_available
+
+
+def _frdq_kernel(qpos_ref, kpos_ref, q_ref, k_ref, v_ref, do_ref,
+                 lse_ref, delta_ref, dq_ref, dq_acc,
+                 *, scale: float, causal: bool, h: int, d: int):
+    """Position-aware folded dq for one ring block pair (kv inner)."""
+    j = pl.program_id(2)
+
+    @pl.when(j == 0)
+    def _():
+        dq_acc[:] = jnp.zeros_like(dq_acc)
+
+    qpos = qpos_ref[0]
+    kpos = kpos_ref[:, 0:1]
+    kmin = jnp.min(kpos)
+    live = kmin != _PAD_POS
+    if causal:
+        live = live & (jnp.max(qpos) >= kmin)
+
+    @pl.when(live)
+    def _():
+        mask = kpos != _PAD_POS
+        if causal:
+            mask = mask & (kpos <= qpos[None, :])
+        for hh in range(h):
+            sl = slice(hh * d, (hh + 1) * d)
+            kh, qh = k_ref[0, sl, :], q_ref[0, sl, :]
+            st = jax.lax.dot_general(
+                kh, qh, (((0,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32) * scale
+            st = jnp.where(mask, st, _NEG_INF)
+            # lse rows with no visible key carry the +BIG sentinel, so
+            # exp(-inf - BIG) underflows to exactly 0 — no garbage flows
+            pt = jnp.exp(st - lse_ref[0, hh].reshape(1, -1))
+            dpt = jax.lax.dot_general(
+                v_ref[0, sl, :], do_ref[0, sl, :],
+                (((0,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32)
+            dst = pt * (dpt - delta_ref[0, hh].reshape(1, -1))
+            dq_acc[sl, :] += jax.lax.dot_general(
+                kh, dst.astype(kh.dtype), (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32) * scale
+
+    @pl.when(j == pl.num_programs(2) - 1)
+    def _():
+        dq_ref[0] = dq_acc[:].astype(dq_ref.dtype)
+
+
+def _frdkv_kernel(qpos_ref, kpos_ref, q_ref, k_ref, v_ref, do_ref,
+                  lse_ref, delta_ref, dk_ref, dv_ref, dk_acc, dv_acc,
+                  *, scale: float, causal: bool, h: int, d: int):
+    """Position-aware folded dk/dv for one ring block pair (q inner)."""
+    i = pl.program_id(2)
+
+    @pl.when(i == 0)
+    def _():
+        dk_acc[:] = jnp.zeros_like(dk_acc)
+        dv_acc[:] = jnp.zeros_like(dv_acc)
+
+    qpos = qpos_ref[0]
+    kpos = kpos_ref[:, 0:1]
+    kmin = jnp.min(kpos)
+    live = kmin != _PAD_POS
+    if causal:
+        live = live & (jnp.max(qpos) >= kmin)
+
+    @pl.when(live)
+    def _():
+        mask = kpos != _PAD_POS
+        if causal:
+            mask = mask & (kpos <= qpos[None, :])
+        for hh in range(h):
+            sl = slice(hh * d, (hh + 1) * d)
+            qh, doh = q_ref[0, sl, :], do_ref[0, sl, :]
+            st = jax.lax.dot_general(
+                k_ref[0, sl, :], qh, (((0,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32) * scale
+            st = jnp.where(mask, st, _NEG_INF)
+            pt = jnp.exp(st - lse_ref[0, hh].reshape(1, -1))
+            dv_acc[sl, :] += jax.lax.dot_general(
+                doh, pt.astype(doh.dtype), (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32)
+            dpt = jax.lax.dot_general(
+                v_ref[0, sl, :], doh, (((0,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32)
+            dst = (pt * (dpt - delta_ref[0, hh].reshape(1, -1))
+                   ).astype(qh.dtype)
+            dk_acc[sl, :] += jax.lax.dot_general(
+                qh, dst, (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32) * scale
+
+    @pl.when(i == pl.num_programs(2) - 1)
+    def _():
+        dk_ref[0] = dk_acc[:].astype(dk_ref.dtype)
+        dv_ref[0] = dv_acc[:].astype(dv_ref.dtype)
+
+
+@functools.partial(jax.jit, static_argnames=("h", "scale", "causal",
+                                             "interpret"))
+def _fring_bwd_call(qf, kf, vf, dof, lse, delta, qpos, kpos_t,
+                    h: int, scale: float, causal: bool, interpret: bool):
+    """Folded ring-block backward: one (q-block, kv-block) pair.
+    qf/kf/vf/dof (B, H*D, S); lse/delta (B, H, S) f32 (lse carries +BIG
+    on no-visibility rows); qpos (1, S); kpos_t (S, 1) int32."""
+    b, hd, s = qf.shape
+    d = hd // h
+    t = _fold_tile(s)
+    n = s // t
+    f32 = jnp.float32
+
+    q_spec = pl.BlockSpec((1, hd, t), lambda b_, i, j: (b_, 0, i))
+    kv_spec = pl.BlockSpec((1, hd, t), lambda b_, i, j: (b_, 0, j))
+    st_spec = pl.BlockSpec((1, h, t), lambda b_, i, j: (b_, 0, i))
+    dq = pl.pallas_call(
+        functools.partial(_frdq_kernel, scale=scale, causal=causal,
+                          h=h, d=d),
+        grid=(b, n, n),
+        in_specs=[pl.BlockSpec((1, t), lambda b_, i, j: (0, i)),
+                  pl.BlockSpec((t, 1), lambda b_, i, j: (j, 0)),
+                  q_spec, kv_spec, kv_spec, q_spec, st_spec, st_spec],
+        out_specs=q_spec,
+        out_shape=jax.ShapeDtypeStruct((b, hd, s), f32, vma=_vma(qf)),
+        scratch_shapes=[pltpu.VMEM((hd, t), f32)],
+        interpret=interpret,
+    )(qpos, kpos_t, qf, kf, vf, dof, lse, delta)
+
+    q_spec2 = pl.BlockSpec((1, hd, t), lambda b_, j, i: (b_, 0, i))
+    kv_spec2 = pl.BlockSpec((1, hd, t), lambda b_, j, i: (b_, 0, j))
+    st_spec2 = pl.BlockSpec((1, h, t), lambda b_, j, i: (b_, 0, i))
+    dk, dv = pl.pallas_call(
+        functools.partial(_frdkv_kernel, scale=scale, causal=causal,
+                          h=h, d=d),
+        grid=(b, n, n),
+        in_specs=[pl.BlockSpec((1, t), lambda b_, j, i: (0, i)),
+                  pl.BlockSpec((t, 1), lambda b_, j, i: (j, 0)),
+                  q_spec2, kv_spec2, kv_spec2, q_spec2, st_spec2,
+                  st_spec2],
+        out_specs=[kv_spec2, kv_spec2],
+        out_shape=[jax.ShapeDtypeStruct((b, hd, s), f32, vma=_vma(qf)),
+                   jax.ShapeDtypeStruct((b, hd, s), f32, vma=_vma(qf))],
+        scratch_shapes=[pltpu.VMEM((hd, t), f32),
+                        pltpu.VMEM((hd, t), f32)],
+        interpret=interpret,
+    )(qpos, kpos_t, qf, kf, vf, dof, lse, delta)
+    return dq, dk, dv
 
 
 def folded_block_attn(q, k, v, scale, q_pos, k_pos, causal: bool,

@@ -91,14 +91,14 @@ def ring_attention_local(q, k, v, axis_name: str, causal: bool = True,
 
     ``block_impl``: the per-step block attention. ``dense`` (default)
     materializes the (Sq × Sk_local) scores in XLA and is
-    differentiable — training uses it; ``folded`` is the feature-major
-    Pallas streaming kernel (``pallas_attention.folded_block_attn`` —
-    no lane padding at short head dims) and ``flash`` the
-    head-per-program one; both keep the (Sq × Sk) scores out of HBM
-    and are forward-only (no VJP yet — use for scoring/serving);
-    ``*_interpret`` runs them interpreted (CPU debugging; requires
-    ``check_vma=False`` on the enclosing shard_map); ``auto`` picks
-    folded on TPU where eligible, else flash, else dense.
+    differentiable; ``folded`` is the feature-major Pallas path — no
+    lane padding at short head dims, scores stay in VMEM, and it is
+    ALSO differentiable (:func:`ring_attention_folded_local`'s custom
+    VJP — the training-grade long-context engine); ``flash`` is the
+    head-per-program Pallas kernel, forward-only (scoring/serving);
+    ``*_interpret`` runs the Pallas paths interpreted (CPU debugging;
+    requires ``check_vma=False`` on the enclosing shard_map); ``auto``
+    picks folded on TPU where eligible, else flash, else dense.
     """
     n = jax.lax.axis_size(axis_name)
     idx = jax.lax.axis_index(axis_name)
@@ -106,10 +106,11 @@ def ring_attention_local(q, k, v, axis_name: str, causal: bool = True,
     if block_impl == "auto":
         block_impl = _resolve_block_impl(s_local, dh)
     if block_impl in ("folded", "folded_interpret"):
-        from mmlspark_tpu.parallel.pallas_attention import folded_block_attn
-        block_fn = functools.partial(
-            folded_block_attn,
-            interpret=(block_impl == "folded_interpret"))
+        # the folded path is DIFFERENTIABLE (custom VJP over the whole
+        # ring — scores stay in VMEM in both directions)
+        return ring_attention_folded_local(
+            q, k, v, axis_name, causal, scale,
+            block_impl == "folded_interpret")
     elif block_impl in ("flash", "flash_interpret"):
         from mmlspark_tpu.parallel.pallas_attention import flash_block_attn
         block_fn = functools.partial(
@@ -152,6 +153,146 @@ def ring_attention_local(q, k, v, axis_name: str, causal: bool = True,
     return o / l[..., None].swapaxes(1, 2)
 
 
+# ---------------------------------------------------------------------------
+# Differentiable folded ring attention (custom VJP)
+# ---------------------------------------------------------------------------
+#
+# The dense ring path is differentiable but materializes the
+# (Sq × Sk_local) scores per ring step; the folded block kernels keep
+# them in VMEM but Pallas has no autodiff — so the trainable version is
+# a custom VJP over the WHOLE ring: the forward runs the online-softmax
+# merge over folded block partials and saves (q, k, v, out, lse); the
+# backward runs a SECOND ring pass in which (dk, dv) accumulators
+# travel WITH their kv block — each rank adds its q-block's
+# FlashAttention-2 contribution to the visiting block's gradients, and
+# after n rotations the accumulators arrive home. Everything stays in
+# the folded (B, H·Dh, S) layout across steps, so the per-step cost is
+# the two Pallas calls plus the ppermutes.
+
+
+def _scale_of(of, c, h):
+    """of (B, H*D, S) * c (B, H, S) broadcast over each head's D."""
+    b, hd, s = of.shape
+    return (of.reshape(b, h, hd // h, s) * c[:, :, None, :]
+            ).reshape(b, hd, s)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def ring_attention_folded_local(q, k, v, axis_name: str,
+                                causal: bool = True, scale=None,
+                                interpret: bool = False):
+    """Differentiable ring attention with the folded block kernels.
+
+    Same contract as :func:`ring_attention_local` (must run inside
+    ``shard_map``; q/k/v ``[B, S_local, H, Dh]``), but the (Sq × Sk)
+    scores never reach HBM in EITHER direction — the training-grade
+    long-context path for short head dims. Gradient parity vs the dense
+    ring is pinned in tests/test_transformer.py.
+    """
+    out, _ = _ring_folded_fwd(q, k, v, axis_name, causal, scale,
+                              interpret)
+    return out
+
+
+def _ring_folded_fwd(q, k, v, axis_name, causal, scale, interpret):
+    from mmlspark_tpu.parallel.pallas_attention import (
+        _fring_call, _to_folded, _from_folded)
+    n = jax.lax.axis_size(axis_name)
+    idx = jax.lax.axis_index(axis_name)
+    b, s_local, h, dh = q.shape
+    scale_f = float(scale) if scale is not None else dh ** -0.5
+    qpos = (idx * s_local
+            + jnp.arange(s_local, dtype=jnp.int32))[None]      # (1, S)
+    perm = [(i, (i + 1) % n) for i in range(n)]
+    qf, kf, vf = _to_folded(q), _to_folded(k), _to_folded(v)
+
+    def body(t, carry):
+        m, l, of, kf_t, vf_t = carry
+        src = (idx - t) % n
+        kpos = (src * s_local
+                + jnp.arange(s_local, dtype=jnp.int32))[:, None]
+        bo, bm, bl = _fring_call(qf, kf_t, vf_t, qpos, kpos, h,
+                                 scale_f, causal, interpret)
+        m_new = jnp.maximum(m, bm)
+        c_old = jnp.exp(m - m_new)
+        c_blk = jnp.exp(bm - m_new)
+        l = l * c_old + bl * c_blk
+        of = (_scale_of(of, c_old, h)
+              + _scale_of(bo.astype(jnp.float32), c_blk, h))
+        kf_t = jax.lax.ppermute(kf_t, axis_name, perm)
+        vf_t = jax.lax.ppermute(vf_t, axis_name, perm)
+        return m_new, l, of, kf_t, vf_t
+
+    vma = tuple(jax.typeof(q).vma)
+
+    def varying(x):
+        return jax.lax.pcast(x, vma, to="varying")
+
+    m0 = varying(jnp.full((b, h, s_local), _NEG_INF, jnp.float32))
+    l0 = varying(jnp.zeros((b, h, s_local), jnp.float32))
+    of0 = varying(jnp.zeros((b, h * dh, s_local), jnp.float32))
+    m, l, of, _, _ = jax.lax.fori_loop(0, n, body, (m0, l0, of0, kf, vf))
+    l_safe = jnp.maximum(l, 1e-30)
+    out_f = _scale_of(of, 1.0 / l_safe, h)
+    # +BIG sentinel on no-visibility rows: the backward's
+    # exp(st - lse) then underflows to exactly 0 for them
+    lse = jnp.where(l > 0, m + jnp.log(l_safe), 1e30)      # (B, H, S)
+    out = _from_folded(out_f, h).astype(q.dtype)
+    return out, (qf, kf, vf, out_f, lse)
+
+
+def _ring_folded_bwd(axis_name, causal, scale, interpret, res, dout):
+    from mmlspark_tpu.parallel.pallas_attention import (
+        _fring_bwd_call, _to_folded, _from_folded)
+    qf, kf, vf, out_f, lse = res
+    b, hd, s_local = qf.shape
+    h = lse.shape[1]
+    dh = hd // h
+    scale_f = float(scale) if scale is not None else dh ** -0.5
+    n = jax.lax.axis_size(axis_name)
+    idx = jax.lax.axis_index(axis_name)
+    qpos = (idx * s_local
+            + jnp.arange(s_local, dtype=jnp.int32))[None]
+    perm = [(i, (i + 1) % n) for i in range(n)]
+    dof = _to_folded(dout).astype(qf.dtype)
+    delta = jnp.sum((dof.astype(jnp.float32) * out_f)
+                    .reshape(b, h, dh, s_local), axis=2)    # (B, H, S)
+
+    def body(t, carry):
+        dq, kf_t, vf_t, dk_acc, dv_acc = carry
+        src = (idx - t) % n
+        kpos = (src * s_local
+                + jnp.arange(s_local, dtype=jnp.int32))[:, None]
+        dqb, dkb, dvb = _fring_bwd_call(qf, kf_t, vf_t, dof, lse,
+                                        delta, qpos, kpos, h, scale_f,
+                                        causal, interpret)
+        dq = dq + dqb
+        dk_acc = dk_acc + dkb
+        dv_acc = dv_acc + dvb
+        # gradients travel WITH their kv block: after the full cycle
+        # of n rotations each accumulator is back at its owner rank
+        kf_t = jax.lax.ppermute(kf_t, axis_name, perm)
+        vf_t = jax.lax.ppermute(vf_t, axis_name, perm)
+        dk_acc = jax.lax.ppermute(dk_acc, axis_name, perm)
+        dv_acc = jax.lax.ppermute(dv_acc, axis_name, perm)
+        return dq, kf_t, vf_t, dk_acc, dv_acc
+
+    vma = tuple(jax.typeof(qf).vma)
+
+    def varying(x):
+        return jax.lax.pcast(x, vma, to="varying")
+
+    z = varying(jnp.zeros((b, hd, s_local), jnp.float32))
+    dq, _, _, dk, dv = jax.lax.fori_loop(
+        0, n, body, (z, kf, vf, z, z))
+    return (_from_folded(dq, h).astype(qf.dtype),
+            _from_folded(dk, h).astype(kf.dtype),
+            _from_folded(dv, h).astype(vf.dtype))
+
+
+ring_attention_folded_local.defvjp(_ring_folded_fwd, _ring_folded_bwd)
+
+
 def dense_attention(q, k, v, causal: bool = True,
                     scale: Optional[float] = None,
                     compute_dtype=None):
@@ -181,8 +322,9 @@ def ring_attention(q, k, v, mesh, axis_name: str = "seq",
 
     q/k/v: full arrays [B, S, H, Dh]; batch over ``data`` if that axis
     exists in the mesh, sequence over ``axis_name``. ``block_impl`` as
-    in :func:`ring_attention_local` (``folded``/``flash`` variants are
-    forward-only and run with VMA checking off).
+    in :func:`ring_attention_local` — ``folded`` is differentiable
+    (custom VJP), ``flash`` forward-only; both Pallas paths run with
+    VMA checking off.
     """
     from jax.sharding import PartitionSpec as P
     from mmlspark_tpu.parallel.collectives import shard_map_fn
