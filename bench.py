@@ -168,8 +168,11 @@ def bench_cifar10_scoring():
     rng = np.random.default_rng(0)
     images = rng.uniform(0, 1, size=(n_images, 32, 32, 3)).astype(np.float32)
     df = DataFrame({"image": images})
+    # cache_inputs=False: this metric is FRESH-data scoring — every
+    # timed pass pays the real host->device transfer (the repeated-
+    # scoring cache's win is measured by transfer_learning_e2e_v2)
     scorer = NNModel(model=model, input_col="image", output_col="scores",
-                     batch_size=batch)
+                     batch_size=batch, cache_inputs=False)
     scorer.transform(df.head(batch))  # warm: compile + first dispatch
 
     out = {}
@@ -252,7 +255,8 @@ def bench_cifar10_scoring_uint8():
                           dtype=np.uint8)
     df = DataFrame({"image": images})
     scorer = NNModel(model=model, input_col="image", output_col="scores",
-                     batch_size=batch, input_dtype="uint8")
+                     batch_size=batch, input_dtype="uint8",
+                     cache_inputs=False)   # fresh-data semantics, as v2
     scorer.transform(df.head(batch))  # warm: compile + first dispatch
 
     out = {}

@@ -260,19 +260,15 @@ def _attention(bp, x, cfg: TransformerConfig, ax: _Axes, pos):
     v = jnp.einsum("bsd,dhk->bshk", h, bp["wv"].astype(dt)).astype(jnp.float32)
     q, k = _rope(q, pos), _rope(k, pos)
     if ax.seq:
-        from mmlspark_tpu.parallel.ring_attention import _resolve_block_impl
-        s_loc, dh_ = q.shape[1], q.shape[-1]
-        if _resolve_block_impl(s_loc, dh_) == "folded" \
-                and cfg.attention_impl in ("auto", "folded"):
-            # training-grade folded ring (differentiable custom VJP):
-            # same eligibility rule as the un-sharded folded kernel
-            if mm_dt is not None:
-                q, k, v = q.astype(dt), k.astype(dt), v.astype(dt)
-            a = ring_attention_local(q, k, v, ax.seq, causal=True,
-                                     block_impl="folded")
-        else:
-            a = ring_attention_local(q, k, v, ax.seq, causal=True,
-                                     compute_dtype=mm_dt)
+        # auto_train: the ring module's shared policy resolves to the
+        # differentiable folded kernel where it pays off (never the
+        # forward-only flash), dense otherwise
+        ring_impl = ("auto_train" if cfg.attention_impl == "auto"
+                     else "folded" if cfg.attention_impl == "folded"
+                     else "dense")
+        a = ring_attention_local(q, k, v, ax.seq, causal=True,
+                                 compute_dtype=mm_dt,
+                                 block_impl=ring_impl)
     else:
         from mmlspark_tpu.parallel.pallas_attention import (
             flash_attention, flash_attention_folded, flash_available,

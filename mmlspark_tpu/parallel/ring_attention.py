@@ -39,17 +39,21 @@ def _mm(spec: str, a, b, compute_dtype):
                       preferred_element_type=jnp.float32)
 
 
-def _resolve_block_impl(s_local: int, dh: int) -> str:
-    """``auto`` policy, shared by both ring entry points: the folded
-    (feature-major) kernel where its layout pays off (eligible shape,
-    short head dim — the same dh < 128 rule as
-    ``transformer._attention``'s auto), else flash on TPU, else the
-    differentiable dense path."""
+def _resolve_block_impl(s_local: int, dh: int,
+                        trainable: bool = False) -> str:
+    """``auto`` policy, shared by every ring entry point: the folded
+    (feature-major) kernel where its layout pays off — eligible shape,
+    short head dim, and the same measured ``s >= 256`` floor as
+    ``transformer._attention``'s un-sharded auto (below it, XLA dense
+    wins) — else flash on TPU, else the dense path.
+    ``trainable=True`` (the ``auto_train`` mode) never resolves to the
+    forward-only flash kernel: folded or dense, both differentiable."""
     from mmlspark_tpu.parallel.pallas_attention import (
         flash_available, folded_block_available)
-    if folded_block_available(s_local, s_local, dh) and dh < 128:
+    if (folded_block_available(s_local, s_local, dh) and dh < 128
+            and s_local >= 256):
         return "folded"
-    if flash_available():
+    if not trainable and flash_available():
         return "flash"
     return "dense"
 
@@ -103,11 +107,17 @@ def ring_attention_local(q, k, v, axis_name: str, causal: bool = True,
     n = jax.lax.axis_size(axis_name)
     idx = jax.lax.axis_index(axis_name)
     b, s_local, h, dh = q.shape
-    if block_impl == "auto":
-        block_impl = _resolve_block_impl(s_local, dh)
+    if block_impl in ("auto", "auto_train"):
+        block_impl = _resolve_block_impl(
+            s_local, dh, trainable=(block_impl == "auto_train"))
     if block_impl in ("folded", "folded_interpret"):
         # the folded path is DIFFERENTIABLE (custom VJP over the whole
-        # ring — scores stay in VMEM in both directions)
+        # ring — scores stay in VMEM in both directions); mixed
+        # precision casts the inputs (the kernels' matmuls accumulate
+        # f32 via preferred_element_type, partials stay f32)
+        if compute_dtype is not None:
+            q, k, v = (q.astype(compute_dtype), k.astype(compute_dtype),
+                       v.astype(compute_dtype))
         return ring_attention_folded_local(
             q, k, v, axis_name, causal, scale,
             block_impl == "folded_interpret")
