@@ -101,6 +101,14 @@ class TransformerConfig:
     # S >= 2048 otherwise, dense below (at short S, XLA's fused dense
     # path with stored probabilities wins)
     attention_impl: str = "auto"
+    # cross-entropy engine for the vocab head: "fused" = the Pallas
+    # streaming kernel (ops/fused_ce.py — logit tiles live in VMEM,
+    # d_logits never reaches HBM; the move that cut the CE section of
+    # the b8/s1024 step from ~8.5 ms of f32 logit round-trips);
+    # "fused_interpret" runs it interpreted (CPU tests); "xla" = the
+    # einsum + logsumexp path; "auto" = fused on TPU when eligible
+    # (d_model lane-aligned), xla otherwise
+    ce_impl: str = "auto"
 
     @property
     def n_layers(self) -> int:
@@ -634,23 +642,42 @@ def local_loss(params, tokens, labels, mask, cfg: TransformerConfig,
             state = jax.lax.ppermute(state, ax.pipe, perm)
 
     h = _rmsnorm(out.reshape(b_loc, s_loc, cfg.d_model), params["final_norm"])
-    # the vocab head is a third of a small LM's forward FLOPs: run the
-    # matmul with bf16 inputs + f32 MXU accumulation. The logits COME OUT
-    # f32 (preferred_element_type), so there is no separate upcast pass
-    # over [b, s, vocab] — the trap that made a plain bf16 head slower
     dt = _compute_dtype(cfg)
-    if dt != jnp.float32:
-        logits = jnp.einsum("bsd,dv->bsv", h.astype(dt),
-                            params["head"].astype(dt),
-                            preferred_element_type=jnp.float32)
+    ce_impl = cfg.ce_impl
+    if ce_impl == "auto":
+        from mmlspark_tpu.ops.fused_ce import fused_ce_available
+        ce_impl = ("fused" if fused_ce_available(
+            b_loc * s_loc, cfg.d_model, cfg.vocab) else "xla")
+    if ce_impl in ("fused", "fused_interpret"):
+        # the Pallas streaming CE: logit tiles stay in VMEM, d_logits
+        # never reaches HBM, and the only large write is one
+        # compute-dtype logits copy for the backward (ops/fused_ce.py)
+        from mmlspark_tpu.ops.fused_ce import fused_softmax_xent
+        ce = fused_softmax_xent(
+            h.reshape(b_loc * s_loc, cfg.d_model), params["head"],
+            labels.reshape(b_loc * s_loc), compute_dtype=dt,
+            interpret=ce_impl == "fused_interpret",
+        ).reshape(b_loc, s_loc)
     else:
-        logits = jnp.einsum("bsd,dv->bsv", h, params["head"])
-    # fused CE: logsumexp - gold logit. log_softmax would materialize a
-    # second [b, s, vocab] array (logp) just to gather one column — at
-    # 32k vocab that is a gigabyte of pure HBM traffic per step
-    lse = jax.nn.logsumexp(logits, axis=-1)
-    gold = jnp.take_along_axis(logits, labels[..., None], axis=-1)[..., 0]
-    ce = lse - gold
+        # the vocab head is a third of a small LM's forward FLOPs: run
+        # the matmul with bf16 inputs + f32 MXU accumulation. The logits
+        # COME OUT f32 (preferred_element_type), so there is no separate
+        # upcast pass over [b, s, vocab] — the trap that made a plain
+        # bf16 head slower
+        if dt != jnp.float32:
+            logits = jnp.einsum("bsd,dv->bsv", h.astype(dt),
+                                params["head"].astype(dt),
+                                preferred_element_type=jnp.float32)
+        else:
+            logits = jnp.einsum("bsd,dv->bsv", h, params["head"])
+        # fused CE: logsumexp - gold logit. log_softmax would
+        # materialize a second [b, s, vocab] array (logp) just to gather
+        # one column — at 32k vocab that is a gigabyte of pure HBM
+        # traffic per step
+        lse = jax.nn.logsumexp(logits, axis=-1)
+        gold = jnp.take_along_axis(logits, labels[..., None],
+                                   axis=-1)[..., 0]
+        ce = lse - gold
     is_last = (p_rank == p_size - 1).astype(jnp.float32)
     loss_sum = jnp.sum(ce * mask) * is_last
     count = jnp.sum(mask) * is_last
@@ -790,7 +817,8 @@ def reference_loss(params, tokens, labels, mask, cfg: TransformerConfig,
 def build_spmd_train_step(cfg: TransformerConfig, mesh,
                           learning_rate: float = 0.1,
                           momentum: float = 0.9,
-                          donate: bool = True):
+                          donate: bool = True,
+                          check_vma: bool = True):
     """Jitted full train step over ``mesh``: fwd + bwd + per-leaf grad
     psum + momentum-SGD update, all inside one shard_map.
 
@@ -840,10 +868,18 @@ def build_spmd_train_step(cfg: TransformerConfig, mesh,
                               params, velocity)
         return params, velocity, loss
 
+    # check_vma=False exists ONLY for interpret-mode Pallas kernels in
+    # CPU tests (the HLO interpreter re-runs the kernel body with
+    # vma-typed values, where kernel-internal iota/scratch constants
+    # cannot be matched). It is sound only on single-device meshes:
+    # without vma types the shard_map transpose does NOT insert the
+    # cross-shard psums for replicated-parameter gradients (embed/head),
+    # so a real multi-shard mesh silently under-reduces them —
+    # tests/test_fused_ce.py pins this boundary from both sides.
     sharded = jax.shard_map(
         local_step, mesh=mesh,
         in_specs=(specs, specs, data_spec, data_spec, data_spec),
-        out_specs=(specs, specs, P()))
+        out_specs=(specs, specs, P()), check_vma=check_vma)
     # donate params+velocity: the optimizer update happens in place in
     # HBM instead of allocating (and copying into) a second full copy
     # of the model state every step
