@@ -328,6 +328,10 @@ def bench_distributed_sgd():
 
     Proxy baseline: 10 steps/sec — the era's CNTK-on-K80 data-parallel
     SGD rate for ResNet-20/batch-256 once MPI/ssh overhead amortized.
+    Mixed precision (bf16 convs, f32 params/optimizer — the same
+    treatment cifar10_scoring_v2 gives this model); reports
+    achieved_tflops/mfu from XLA's own cost analysis of the compiled
+    step (r4 VERDICT #2: the training side was unmeasured).
     """
     import jax
     import jax.numpy as jnp
@@ -339,12 +343,13 @@ def bench_distributed_sgd():
 
     n_dev = len(jax.devices())
     mesh = build_mesh(MeshSpec.from_dict({"data": n_dev}))
-    model = NNFunction.init({"builder": "cifar_resnet", "depth": 20},
-                            input_shape=(32, 32, 3), seed=0)
+    model = NNFunction.init(
+        {"builder": "cifar_resnet", "depth": 20, "dtype": "bfloat16"},
+        input_shape=(32, 32, 3), seed=0)
     learner = NNLearner(arch=model.arch, learning_rate=0.1)
     tx = make_optimizer("momentum", 0.1)
     loss_fn = make_loss("softmax_cross_entropy")
-    step = jax.jit(learner.build_train_step(model.module(), tx, loss_fn))
+    step_fn = learner.build_train_step(model.module(), tx, loss_fn)
 
     batch = 256
     repl, shard = replicated_sharding(mesh), batch_sharding(mesh)
@@ -356,26 +361,49 @@ def bench_distributed_sgd():
     y = jax.device_put(rng.integers(0, 10, batch).astype(np.int32), shard)
     w = jax.device_put(np.ones(batch, np.float32), shard)
 
-    # step chains are data-dependent (params/opt_state thread through),
-    # and the scalar loss fetch forces completion — block_until_ready
-    # alone returns early on the tunneled backend
-    state = {"p": params, "o": opt_state}
+    # sustained DEVICE throughput: the whole step chain runs as ONE
+    # scanned program (param/opt-state carries make every iteration
+    # data-dependent; the loss stack forces real compute), because at
+    # ~1 ms/step per-call host dispatch on a tunneled chip would
+    # dominate what this metric claims to measure. The long/short scan
+    # slope cancels the final fetch RTT (same methodology as
+    # _device_seconds_per_batch). FLOPs come from the SAME compiled
+    # scan program (n=2, divided by 2) — no extra single-step compile.
+    import functools as _ft
+    import jax as _jax
+
+    @_ft.partial(_jax.jit, static_argnames="n")
+    def scan_steps(p, o, n):
+        def body(c, _):
+            pp, oo, l = step_fn(c[0], c[1], x, y, w)
+            return (pp, oo), l
+        _, losses = _jax.lax.scan(body, (p, o), None, length=n)
+        return losses
+
+    cost = scan_steps.lower(params, opt_state, n=2).compile() \
+        .cost_analysis() or {}
+    flops_per_step = float(cost.get("flops", 0.0)) / 2.0
 
     def run_chain(n):
-        for _ in range(n):
-            state["p"], state["o"], loss = step(state["p"], state["o"],
-                                                x, y, w)
-        float(loss)
+        float(scan_steps(params, opt_state, n)[-1])
 
-    sec_per_step = _chain_slope_seconds(run_chain, 2, 22)
+    sec_per_step = _chain_slope_seconds(run_chain, 2, 42)
     steps_per_sec = 1.0 / sec_per_step
     baseline = 10.0
-    return {"metric": "distributed_sgd_step_v2",
-            "value": round(steps_per_sec, 2), "unit": "steps/sec",
-            "ms_per_step": round(1000 * sec_per_step, 1),
-            "batch_size": batch, "baseline": baseline,
-            "vs_baseline": round(steps_per_sec / baseline, 3),
-            "chip": _chip()}
+    chip = _chip()
+    out = {"metric": "distributed_sgd_step_v2",
+           "value": round(steps_per_sec, 2), "unit": "steps/sec",
+           "ms_per_step": round(1000 * sec_per_step, 1),
+           "batch_size": batch, "baseline": baseline,
+           "vs_baseline": round(steps_per_sec / baseline, 3),
+           "chip": chip}
+    peak = _PEAK_BF16_TFLOPS.get(chip.get("device_kind") or "")
+    if flops_per_step > 0:
+        achieved = flops_per_step / sec_per_step / 1e12
+        out["achieved_tflops"] = round(achieved, 2)
+        if peak:
+            out["mfu"] = round(achieved / peak, 4)
+    return out
 
 
 # peak dense bf16 TFLOP/s per chip, for the MFU report (public specs)
@@ -428,7 +456,10 @@ def bench_imagenet_scoring():
     import jax.numpy as jnp
     from mmlspark_tpu.models.function import NNFunction
 
-    batch = 64
+    # batch 128 is this chip's utilization sweet spot for ResNet-50
+    # (measured: b64 0.37-0.49 MFU, b128 0.55, b256 0.51 — b64 leaves
+    # MXU tiles under-filled in the wide early layers, b256 spills)
+    batch = 128
     model = NNFunction.init(
         {"builder": "imagenet_resnet", "depth": 50, "dtype": "bfloat16"},
         input_shape=(224, 224, 3), seed=0)

@@ -449,12 +449,13 @@ class Booster:
                         rng.random(n_padded) < params.bagging_fraction)
                 sample = bag_mask_host
 
-            # -- feature sampling
+            # -- feature sampling: exactly int(frac * F) columns without
+            # replacement per iteration (LightGBM's count semantics)
             feat_mask = None
             if params.feature_fraction < 1.0:
-                keep = rng.random(F) < params.feature_fraction
-                if not keep.any():
-                    keep[rng.integers(F)] = True
+                k_keep = max(int(params.feature_fraction * F), 1)
+                keep = np.zeros(F, dtype=bool)
+                keep[rng.permutation(F)[:k_keep]] = True
                 feat_mask = keep
 
             sample_dev = put(sample)

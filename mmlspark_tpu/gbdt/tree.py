@@ -873,8 +873,10 @@ def boost_loop_device(bins, bins_t, y, w, valid_mask, init_raw, grad_hess,
       plus ``int(other_rate * n_real)`` uniformly drawn others, the
       others' grad/hess amplified by ``(1 - top_rate) / other_rate``
       (LightGBM's GOSS estimator).
-    - ``feature_fraction < 1``: per-iteration Bernoulli feature mask
-      (at least one feature kept), applied at split-finding time.
+    - ``feature_fraction < 1``: per-iteration fixed-size feature draw —
+      exactly ``max(int(feature_fraction * F), 1)`` columns without
+      replacement (LightGBM's count semantics), applied at
+      split-finding time.
 
     The device RNG stream differs from the host loop's numpy stream, so
     sampled fits match the host path in distribution and quality, not
@@ -950,12 +952,14 @@ def boost_loop_device(bins, bins_t, y, w, valid_mask, init_raw, grad_hess,
         fm = feat_mask
         if feature_fraction < 1.0:
             key, sub = jax.random.split(key)
-            key, sub2 = jax.random.split(key)
-            keep = jax.random.uniform(sub, (n_features,)) < feature_fraction
-            fallback = jax.nn.one_hot(
-                jax.random.randint(sub2, (), 0, n_features), n_features,
-                dtype=jnp.bool_)
-            keep = jnp.where(keep.any(), keep, fallback)
+            # fixed-size selection without replacement (the k smallest
+            # of per-feature uniforms), matching LightGBM's exactly
+            # int(frac * F) columns per iteration — a Bernoulli mask's
+            # variable count diverges badly at small F (r4 advisor)
+            k_keep = max(int(feature_fraction * n_features), 1)
+            r = jax.random.uniform(sub, (n_features,))
+            keep = (jnp.zeros(n_features, bool)
+                    .at[jnp.argsort(r)[:k_keep]].set(True))
             pad_f = bins.shape[1] - n_features
             fm = (jnp.concatenate([keep, jnp.zeros(pad_f, bool)])
                   if pad_f else keep)

@@ -41,16 +41,18 @@ def _device_put(x, placement):
 # the SAME frame through many models; without a cache every transform pays
 # the full host->device upload again (the dominant cost on tunneled links).
 # The cache keys the device-resident padded batches on the COLUMN OBJECT's
-# identity plus a content fingerprint (data pointer, shape, dtype, head/tail
-# byte samples — for object columns, the element objects' ids and head
-# bytes) — numpy arrays aren't weakref-able, so pure id() could alias a
-# new array after gc; the fingerprint makes that practically impossible.
-# A frame is only STORED on its second sighting (one-shot workloads like
-# the serving batch loop never pin HBM for frames scored once), and the
-# store is a bounded LRU (4 frames, 256 MB each). In-place mutation of a
-# cached column is the one unsupported pattern (the head/tail samples
-# catch most, not all, such edits).
+# identity plus a FULL content digest (blake2b over every buffer byte;
+# object columns hash each element's bytes) — numpy arrays aren't
+# weakref-able, so pure id() could alias a new array after gc, and
+# anything short of the full buffer would let an in-place edit of a
+# cached column return silently stale predictions (r4 advisor finding).
+# Hashing runs at memory bandwidth (~GB/s), a rounding error next to the
+# host->device upload it saves. A frame is only STORED on its second
+# sighting (one-shot workloads like the serving batch loop never pin HBM
+# for frames scored once), and the store is a bounded LRU (4 frames,
+# 256 MB each).
 
+import hashlib
 import threading
 from collections import OrderedDict
 
@@ -66,20 +68,35 @@ def _frame_cache():
     return _FRAME_CACHE
 
 
-def _frame_key(col, transfer_dtype, bs: int, placement):
-    n = len(col)
+def _content_digest(col) -> bytes:
+    """Full-buffer blake2b of the column (every element for object
+    columns): in-place mutations of a cached column are ALWAYS detected,
+    at memory-bandwidth cost — negligible next to the upload a hit
+    saves."""
+    h = hashlib.blake2b(digest_size=16)
     if col.dtype == np.dtype("O"):
-        def elem_sample(e):
-            a = np.asarray(e)
-            return (id(e), a.shape, str(a.dtype),
-                    a.ravel()[:16].tobytes())
-        sample: tuple = ((elem_sample(col[0]), elem_sample(col[n - 1]))
-                         if n else ())
+        for e in col:
+            a = np.ascontiguousarray(np.asarray(e))
+            h.update(str((a.shape, a.dtype.str)).encode())
+            h.update(a.data if a.flags.c_contiguous else a.tobytes())
     else:
-        sample = (col[:1].tobytes()[:64], col[-1:].tobytes()[:64]) \
-            if n else ()
+        a = col if col.flags.c_contiguous else np.ascontiguousarray(col)
+        h.update(a.data if a.flags.c_contiguous else a.tobytes())
+    return h.digest()
+
+
+def _frame_cheap_key(col, transfer_dtype, bs: int, placement):
+    """Hash-free first-stage key: one-shot frames (never stored by
+    design) must not pay a full-buffer hash per transform — the digest
+    is only computed once this cheap key has been SEEN (i.e. the frame
+    is a store/lookup candidate)."""
     return (id(col), col.ctypes.data, col.shape, col.dtype.str,
-            np.dtype(transfer_dtype).str, bs, placement, sample)
+            np.dtype(transfer_dtype).str, bs, placement)
+
+
+def _frame_key(col, transfer_dtype, bs: int, placement):
+    return _frame_cheap_key(col, transfer_dtype, bs, placement) + (
+        _content_digest(col),)
 
 
 def _frame_est_bytes(col, transfer_dtype) -> int:
@@ -146,8 +163,10 @@ class NNModel(Model, HasInputCol, HasOutputCol):
                          "sighting and never again; frames scored only "
                          "once (e.g. serving request batches) are never "
                          "stored, and frames over 256 MB bypass the cache "
-                         "entirely. Disable if the input column is "
-                         "mutated in place between transforms", ptype=bool)
+                         "entirely. Keys include a full content digest, "
+                         "so in-place edits of a cached column are "
+                         "detected (and re-uploaded), never served stale",
+                         ptype=bool)
 
     # -- execution ----------------------------------------------------------
 
@@ -254,17 +273,24 @@ class NNModel(Model, HasInputCol, HasOutputCol):
         if self.cache_inputs and isinstance(col, np.ndarray) \
                 and 0 < _frame_est_bytes(col, tdtype) \
                 <= _FRAME_CACHE_MAX_BYTES:
-            cache_key = _frame_key(col, tdtype, bs, placement)
+            cheap = _frame_cheap_key(col, tdtype, bs, placement)
             with _FRAME_LOCK:
-                cached_batches = _FRAME_CACHE.get(cache_key)
-                if cached_batches is not None:
-                    _FRAME_CACHE.move_to_end(cache_key)
-                elif cache_key in _FRAME_SEEN:
-                    store_this_pass = True   # second sighting: worth HBM
-                else:
-                    _FRAME_SEEN[cache_key] = None
+                seen = cheap in _FRAME_SEEN
+                if not seen:
+                    _FRAME_SEEN[cheap] = None
                     while len(_FRAME_SEEN) > _FRAME_SEEN_MAX_ENTRIES:
                         _FRAME_SEEN.popitem(last=False)
+            if seen:
+                # candidate for lookup/store: NOW pay the content digest
+                # (outside the lock; full-buffer, so in-place edits of a
+                # cached column always miss instead of serving stale)
+                cache_key = cheap + (_content_digest(col),)
+                with _FRAME_LOCK:
+                    cached_batches = _FRAME_CACHE.get(cache_key)
+                    if cached_batches is not None:
+                        _FRAME_CACHE.move_to_end(cache_key)
+                    else:
+                        store_this_pass = True
         if cached_batches is not None:
             x = None                         # hit: never stack the frame
             n_rows = cached_batches[1]

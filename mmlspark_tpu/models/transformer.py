@@ -292,7 +292,7 @@ def _attention(bp, x, cfg: TransformerConfig, ax: _Axes, pos):
             # unmeasured, so those shapes keep the flash kernel's
             # long-S gate; below both, XLA's fused dense attention
             # (which stores p instead of recomputing) is faster
-            if folded_available(s_, s_, dh_) and s_ >= 256 and dh_ < 128:
+            if folded_available(s_, s_, dh_, h_) and s_ >= 256 and dh_ < 128:
                 impl = "folded"
             elif flash_available() and s_ >= 2048:
                 impl = "flash"
@@ -300,11 +300,33 @@ def _attention(bp, x, cfg: TransformerConfig, ax: _Axes, pos):
                 impl = "dense"
         if impl in ("folded", "flash") and mm_dt is not None:
             q, k, v = q.astype(dt), k.astype(dt), v.astype(dt)
-        if impl == "folded" and folded_available(s_, s_, dh_):
+        if impl == "folded" and folded_available(s_, s_, dh_, h_):
             a = flash_attention_folded(q, k, v, True)
         elif impl in ("flash", "folded") and flash_available():
+            if cfg.attention_impl == "folded":
+                # the user named a specific engine and is getting a
+                # different one — say so (silent fallback is reserved
+                # for 'auto'); folded needs head_dim % 8 == 0, a
+                # 128-tileable sequence, AND an (H*Dh x tile) working
+                # set inside the VMEM budget (r4 advisor)
+                import warnings
+                warnings.warn(
+                    f"attention_impl='folded' ineligible at shape "
+                    f"(S={s_}, head_dim={dh_}, H*Dh={h_ * dh_}) — needs "
+                    f"head_dim % 8 == 0, 128-tileable S, and H*Dh "
+                    f"within the folded VMEM budget; falling back to "
+                    f"the lane-padded flash kernel", stacklevel=2)
             a = flash_attention(q, k, v, True)
         else:
+            if cfg.attention_impl in ("folded", "flash"):
+                import warnings
+                warnings.warn(
+                    f"attention_impl={cfg.attention_impl!r} unavailable "
+                    f"(backend {jax.default_backend()!r}, S={s_}, "
+                    f"head_dim={dh_}, H*Dh={h_ * dh_} — needs a TPU "
+                    f"backend and, for 'folded', an eligible "
+                    f"shape/VMEM envelope); using dense attention",
+                    stacklevel=2)
             a = dense_attention(q, k, v, causal=True, compute_dtype=mm_dt)
     o = jnp.einsum("bshk,hkd->bsd", a.astype(dt),
                    bp["wo"].astype(dt)).astype(jnp.float32)
@@ -647,7 +669,8 @@ def local_loss(params, tokens, labels, mask, cfg: TransformerConfig,
     if ce_impl == "auto":
         from mmlspark_tpu.ops.fused_ce import fused_ce_available
         ce_impl = ("fused" if fused_ce_available(
-            b_loc * s_loc, cfg.d_model, cfg.vocab) else "xla")
+            b_loc * s_loc, cfg.d_model, cfg.vocab,
+            itemsize=jnp.dtype(dt).itemsize) else "xla")
     if ce_impl in ("fused", "fused_interpret"):
         # the Pallas streaming CE: logit tiles stay in VMEM, d_logits
         # never reaches HBM, and the only large write is one

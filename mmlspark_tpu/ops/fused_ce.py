@@ -81,16 +81,20 @@ def _kernel_vmem_bytes(d: int, tt: int, tv: int, itemsize: int = 2) -> int:
     return max(fwd, dh, dw)
 
 
-def fused_ce_available(t: int, d: int, v: int) -> bool:
+def fused_ce_available(t: int, d: int, v: int,
+                       itemsize: int = 2) -> bool:
     """Shape+backend eligibility for the default tiles: the model dim
     rides the lane axis of the ``h`` tile (lane-aligned), the kernels
     block-load the FULL d dimension (so wide models must fit the VMEM
     budget — fall back to XLA rather than fail the Mosaic compile), and
     small token counts are excluded (tile padding to T_TILE would cost
     more than the XLA einsum it replaces). V is padded/masked
-    internally, any size works."""
+    internally, any size works. ``itemsize`` is the compute dtype's
+    byte width (2 for bf16, 4 for f32) — the VMEM budget is a dtype
+    question, not just a shape one."""
     return (d % 128 == 0 and t >= T_TILE
-            and _kernel_vmem_bytes(d, T_TILE, V_TILE) <= _VMEM_BUDGET
+            and _kernel_vmem_bytes(d, T_TILE, V_TILE,
+                                   itemsize) <= _VMEM_BUDGET
             and jax.default_backend() == "tpu")
 
 

@@ -20,6 +20,7 @@ out even in bidirectional mode).
 from __future__ import annotations
 
 import functools
+from typing import Optional
 
 import jax
 import jax.numpy as jnp
@@ -454,15 +455,33 @@ def _fold_tile(s: int) -> int:
     return 0
 
 
-def _folded_shape_ok(sq: int, sk: int, d: int) -> bool:
+# VMEM the folded kernels' largest pass (dk/dv backward) may request:
+# q/k/v/do blocks double-buffered + two f32 output blocks + two f32
+# accumulator scratches, all (H*Dh, tile) — ~40 bytes per (H*Dh x tile)
+# element. 14 MB keeps a healthy margin under the ~16 MB v5e VMEM.
+_FOLDED_VMEM_BUDGET = 14 * 2**20
+
+
+def _folded_shape_ok(sq: int, sk: int, d: int,
+                     h: Optional[int] = None) -> bool:
     """Same-length self-attention, tileable S, sublane-aligned head —
     the shape half of the folded-kernel eligibility (backend-agnostic:
-    interpret mode runs these shapes on CPU too)."""
-    return sq == sk and d % 8 == 0 and _fold_tile(sq) > 0
+    interpret mode runs these shapes on CPU too). Pass ``h`` to also
+    bound the folded (H*Dh, tile) working set against VMEM: every
+    buffer in these kernels carries ALL heads, so wide-head configs
+    (large H*Dh) can exceed VMEM even at short head dims — the auto
+    policies must fall back rather than fail the Mosaic compile
+    (r4 advisor)."""
+    ok = sq == sk and d % 8 == 0 and _fold_tile(sq) > 0
+    if ok and h is not None:
+        ok = h * d * _fold_tile(sq) * 40 <= _FOLDED_VMEM_BUDGET
+    return ok
 
 
-def folded_available(sq: int, sk: int, d: int) -> bool:
-    return _folded_shape_ok(sq, sk, d) and jax.default_backend() == "tpu"
+def folded_available(sq: int, sk: int, d: int,
+                     h: Optional[int] = None) -> bool:
+    return _folded_shape_ok(sq, sk, d, h) and \
+        jax.default_backend() == "tpu"
 
 
 def _causal_mask_t(i, j, tq: int, tk: int):
@@ -992,14 +1011,15 @@ def folded_block_attn(q, k, v, scale, q_pos, k_pos, causal: bool,
     same-length by construction)."""
     b, sq, h, d = q.shape
     sk = k.shape[1]
-    if not _folded_shape_ok(sq, sk, d):
+    if not _folded_shape_ok(sq, sk, d, h):
         # the flash twin pads arbitrary shapes; this layout cannot —
         # fail with the rule, not a ZeroDivisionError inside the trace
         raise ValueError(
             f"folded_block_attn needs same-length blocks (sq={sq}, "
-            f"sk={sk}), head_dim % 8 == 0 (got {d}) and a 128-tileable "
-            f"sequence; use block_impl='flash' (or 'auto') for other "
-            f"shapes")
+            f"sk={sk}), head_dim % 8 == 0 (got {d}), a 128-tileable "
+            f"sequence, and an (H*Dh x tile) working set inside the "
+            f"VMEM budget (H*Dh={h * d}); use block_impl='flash' (or "
+            f"'auto') for other shapes")
     qf, kf, vf = _to_folded(q), _to_folded(k), _to_folded(v)
     qpos = jnp.asarray(q_pos, jnp.int32)[None]            # (1, S)
     kpos_t = jnp.asarray(k_pos, jnp.int32)[:, None]       # (S, 1)

@@ -40,7 +40,7 @@ def _mm(spec: str, a, b, compute_dtype):
 
 
 def _resolve_block_impl(s_local: int, dh: int,
-                        trainable: bool = False) -> str:
+                        trainable: bool = False, h: int = None) -> str:
     """``auto`` policy, shared by every ring entry point: the folded
     (feature-major) kernel where its layout pays off — eligible shape,
     short head dim, and the same measured ``s >= 256`` floor as
@@ -50,7 +50,7 @@ def _resolve_block_impl(s_local: int, dh: int,
     forward-only flash kernel: folded or dense, both differentiable."""
     from mmlspark_tpu.parallel.pallas_attention import (
         flash_available, folded_block_available)
-    if (folded_block_available(s_local, s_local, dh) and dh < 128
+    if (folded_block_available(s_local, s_local, dh, h) and dh < 128
             and s_local >= 256):
         return "folded"
     if not trainable and flash_available():
@@ -109,7 +109,7 @@ def ring_attention_local(q, k, v, axis_name: str, causal: bool = True,
     b, s_local, h, dh = q.shape
     if block_impl in ("auto", "auto_train"):
         block_impl = _resolve_block_impl(
-            s_local, dh, trainable=(block_impl == "auto_train"))
+            s_local, dh, trainable=(block_impl == "auto_train"), h=h)
     if block_impl in ("folded", "folded_interpret"):
         # the folded path is DIFFERENTIABLE (custom VJP over the whole
         # ring — scores stay in VMEM in both directions); mixed
@@ -344,7 +344,7 @@ def ring_attention(q, k, v, mesh, axis_name: str = "seq",
         n_seq = dict(zip(mesh.axis_names, mesh.devices.shape)).get(
             axis_name, 1)
         block_impl = _resolve_block_impl(q.shape[1] // max(n_seq, 1),
-                                         q.shape[-1])
+                                         q.shape[-1], h=q.shape[-2])
     batch_axis = "data" if "data" in mesh.axis_names else None
     spec = P(batch_axis, axis_name)
     fn = shard_map_fn(

@@ -184,11 +184,12 @@ class TestNNModel:
         np.testing.assert_allclose(out1b, out1, rtol=1e-6)
         np.testing.assert_allclose(out1c, out1, rtol=1e-6)
         assert out2.shape == out1.shape
-        # edited content misses (the fingerprint catches a changed head
-        # row even at the same buffer address)
+        # edited content misses (the digest catches a changed head row
+        # even at the same buffer address); the already-seen cheap key
+        # makes the new content store immediately
         X[0] += 1.0
         m1.transform(df)
-        assert len(nn_mod._frame_cache()) == 1      # old entry, new key
+        assert len(nn_mod._frame_cache()) == 2      # old + edited content
         nn_mod._frame_cache().clear()
         nn_mod._FRAME_SEEN.clear()
 
@@ -214,6 +215,26 @@ class TestNNModel:
         col[0][:] = 0.0                             # in-place element edit
         out_b = np.asarray(m.transform(df)["s"])
         assert not np.allclose(out_a[0], out_b[0])  # fresh, not stale
+        nn_mod._frame_cache().clear()
+        nn_mod._FRAME_SEEN.clear()
+
+    def test_input_cache_midbuffer_mutation_detected(self, convnet, rng):
+        """r4 advisor (medium): head/tail byte sampling missed edits in
+        the middle of a cached buffer — the full-content digest cannot.
+        Same object id, same data pointer, untouched head/tail rows."""
+        from mmlspark_tpu.models import nn as nn_mod
+        nn_mod._frame_cache().clear()
+        nn_mod._FRAME_SEEN.clear()
+        X = rng.uniform(0, 1, size=(128, 32, 32, 3)).astype(np.float32)
+        df = DataFrame({"image": X})
+        m = NNModel(model=convnet, input_col="image", output_col="s",
+                    batch_size=64)
+        m.transform(df)
+        out_a = np.asarray(m.transform(df)["s"])    # stored this pass
+        assert len(nn_mod._frame_cache()) == 1
+        X[64][:] = 0.0              # middle row only
+        out_b = np.asarray(m.transform(df)["s"])
+        assert not np.allclose(out_a[64], out_b[64])  # fresh, not stale
         nn_mod._frame_cache().clear()
         nn_mod._FRAME_SEEN.clear()
 
