@@ -81,6 +81,14 @@ class TransformerConfig:
     # large (train instability / bf16 overflow), the ST-MoE regularizer
     # that production MoE configs run alongside the balance aux.
     moe_zloss_weight: float = 0.0
+    # capacity-dispatch engine. "sort" (default): stable-sort routings
+    # by expert so per-expert queues are CONTIGUOUS runs — dispatch is E
+    # dynamic slices and combine is E ascending dynamic-update-slices
+    # (no scatter in either direction; the permutation rides a
+    # gather-both-ways custom VJP). "scatter": the one-hot cumsum +
+    # scatter/gather queue build (kept for A/B and as the golden
+    # cross-check — both engines drop the same overflow routings).
+    moe_dispatch: str = "sort"
     # routing direction. "token" (default): tokens pick their top-k
     # experts (Switch/Mixtral semantics, needs the balance aux to stay
     # balanced). "expert_choice": each expert picks its top-C tokens
@@ -384,6 +392,78 @@ def _router_stats(probs2d, top, E: int, axes):
     return f, P
 
 
+@jax.custom_vjp
+def _permute_rows(x, order, inv):
+    """``x[order]`` with a gather in BOTH autodiff directions.
+
+    A permutation gather's transpose is a scatter in general, but for a
+    bijection it equals gathering with the inverse permutation — XLA
+    cannot see that, so without this rewrite every sorted-dispatch
+    gather would pay a full row-scatter in the backward pass (the exact
+    cost the sort exists to avoid)."""
+    return x[order]
+
+
+def _permute_rows_fwd(x, order, inv):
+    return x[order], (order, inv)
+
+
+def _permute_rows_bwd(res, g):
+    order, inv = res
+    zero = np.zeros(order.shape, dtype=jax.dtypes.float0)
+    return g[inv], zero, zero
+
+
+_permute_rows.defvjp(_permute_rows_fwd, _permute_rows_bwd)
+
+
+def _sorted_capacity_queues(h_rep, top, wf, E: int, C: int, dt):
+    """Counting-sort capacity dispatch: returns ``(disp (E, C, dtype
+    dt), combine)`` where ``combine(y (E, C, d) f32) -> (Tk, d) f32``
+    routes expert outputs back to routing order with router weights
+    applied.
+
+    With only E distinct keys no comparison sort is needed: the one-hot
+    cumsum gives each routing its arrival-order slot within its expert,
+    ``dest = starts[expert] + slot`` IS the grouping permutation
+    (stable by construction — the SAME overflow routings drop as in the
+    scatter engine), and its inverse costs one O(Tk) int scatter. Rows
+    then move only through permutation gathers (gather in BOTH autodiff
+    directions via :func:`_permute_rows`) and per-expert dynamic
+    slices; the combine rebuilds sorted rows with ascending
+    dynamic-update-slices (group e's tail overlap is always rewritten
+    by group e+1). Queue rows beyond an expert's count hold other
+    groups' tokens — the keep mask zeroes their contribution, and their
+    zero cotangent keeps gradients exact. No row scatter exists in
+    either direction of either pass."""
+    Tk, d = h_rep.shape
+    onehot = jax.nn.one_hot(top, E, dtype=jnp.int32)     # (Tk, E)
+    pos = jnp.cumsum(onehot, axis=0) * onehot - 1
+    slot = jnp.take_along_axis(pos, top[:, None], axis=1)[:, 0]
+    counts = jnp.sum(onehot, axis=0)                     # (E,)
+    starts = jnp.concatenate(
+        [jnp.zeros(1, jnp.int32), jnp.cumsum(counts)[:-1]])
+    inv = starts[top] + slot          # routing -> its sorted row (dest)
+    order = jnp.zeros((Tk,), jnp.int32).at[inv].set(
+        jnp.arange(Tk, dtype=jnp.int32))                 # sorted -> routing
+    keep = (slot < C).astype(jnp.float32)                # routing order
+    hs = _permute_rows(h_rep, order, inv)                # (Tk, d) sorted
+    hs_pad = jnp.concatenate([hs, jnp.zeros((C, d), hs.dtype)])
+    disp = jnp.stack([
+        jax.lax.dynamic_slice_in_dim(hs_pad, starts[e], C)
+        for e in range(E)]).astype(dt)                   # (E, C, d)
+
+    def combine(y):
+        y_s = jnp.zeros((Tk + C, d), jnp.float32)
+        for e in range(E):
+            y_s = jax.lax.dynamic_update_slice_in_dim(
+                y_s, y[e], starts[e], 0)
+        y_r = _permute_rows(y_s[:Tk], inv, order)        # routing order
+        return y_r * (keep * wf)[:, None]
+
+    return disp, combine
+
+
 def _moe_capacity(bp, x, cfg: TransformerConfig, ax: _Axes):
     """Capacity-factor top-k MoE dispatch (the production shape).
 
@@ -426,16 +506,29 @@ def _moe_capacity(bp, x, cfg: TransformerConfig, ax: _Axes):
 
     top = experts.reshape(T_sh * k)                      # routing slots
     wf = wts.reshape(T_sh * k)
-    onehot = jax.nn.one_hot(top, E, dtype=jnp.int32)     # [T_sh*k, E]
-    # position of each routing within its expert's queue (arrival order)
-    pos = jnp.cumsum(onehot, axis=0) * onehot - 1
-    slot = jnp.take_along_axis(pos, top[:, None], axis=1)[:, 0]
-    keep = slot < C
-    # overflow routings land in a scratch column C that is sliced away
-    slot_c = jnp.where(keep, slot, C)
-    disp = jnp.zeros((E, C + 1, d), dt).at[top, slot_c].set(
-        jnp.repeat(hT.astype(dt), k, axis=0))
-    disp = disp[:, :C]                                   # [E, C, d]
+    if cfg.moe_dispatch == "sort":
+        # the whole permute/queue chain runs in the compute dtype: the
+        # sorted rows are matmul inputs, and bf16 halves the sort-path
+        # HBM traffic
+        disp, combine = _sorted_capacity_queues(
+            jnp.repeat(hT.astype(dt), k, axis=0), top, wf, E, C, dt)
+    elif cfg.moe_dispatch == "scatter":
+        onehot = jax.nn.one_hot(top, E, dtype=jnp.int32)  # [T_sh*k, E]
+        # position of each routing within its expert's queue (arrival)
+        pos = jnp.cumsum(onehot, axis=0) * onehot - 1
+        slot = jnp.take_along_axis(pos, top[:, None], axis=1)[:, 0]
+        keep = slot < C
+        # overflow routings land in a scratch column C, sliced away
+        slot_c = jnp.where(keep, slot, C)
+        disp = jnp.zeros((E, C + 1, d), dt).at[top, slot_c].set(
+            jnp.repeat(hT.astype(dt), k, axis=0))
+        disp = disp[:, :C]                               # [E, C, d]
+
+        def combine(y):
+            y = jnp.pad(y, ((0, 0), (0, 1), (0, 0)))     # overflow row
+            return y[top, slot_c] * (keep * wf)[:, None]
+    else:
+        raise ValueError(f"unknown moe_dispatch {cfg.moe_dispatch!r}")
 
     if ax.expert:
         # queues regrouped so each rank holds the ALL-RANK queues of
@@ -450,8 +543,7 @@ def _moe_capacity(bp, x, cfg: TransformerConfig, ax: _Axes):
         # route results back to their owner ranks: [E, C, d] again
         y = jax.lax.all_to_all(y, ax.expert, split_axis=1,
                                concat_axis=0, tiled=True)
-    y = jnp.pad(y, ((0, 0), (0, 1), (0, 0)))             # overflow row
-    yflat = y[top, slot_c] * (keep * wf)[:, None]        # [T_sh*k, d]
+    yflat = combine(y)                                   # [T_sh*k, d]
     ytok = jnp.sum(yflat.reshape(T_sh, k, d), axis=1)    # combine choices
     f_stat = (jnp.zeros(E, jnp.float32), jnp.zeros(E, jnp.float32))
     if cfg.moe_aux_weight > 0:
