@@ -8,9 +8,25 @@ from mmlspark_tpu.core.stage import (
     Evaluator,
 )
 from mmlspark_tpu.core.pipeline import Pipeline, PipelineModel
+from mmlspark_tpu.core.resilience import (
+    BreakerBoard,
+    CircuitBreaker,
+    CircuitOpen,
+    Deadline,
+    DeadlineExceeded,
+    ManualClock,
+    RetryPolicy,
+)
 from mmlspark_tpu.core import schema
 
 __all__ = [
+    "BreakerBoard",
+    "CircuitBreaker",
+    "CircuitOpen",
+    "Deadline",
+    "DeadlineExceeded",
+    "ManualClock",
+    "RetryPolicy",
     "DataFrame",
     "Param",
     "Params",

@@ -15,16 +15,19 @@ framework is feeding batched rows into jitted inference (see
 from __future__ import annotations
 
 import json
-import time
 from concurrent.futures import ThreadPoolExecutor
 from dataclasses import dataclass, field
 from typing import Any, Callable, Dict, List, Optional
+from urllib.parse import urlsplit
 
 import numpy as np
 
 from mmlspark_tpu.core.dataframe import DataFrame, obj_col
 from mmlspark_tpu.core.params import (
     Param, HasInputCol, HasOutputCol, in_range,
+)
+from mmlspark_tpu.core.resilience import (
+    BreakerBoard, Deadline, RetryPolicy,
 )
 from mmlspark_tpu.core.stage import Transformer
 
@@ -102,54 +105,97 @@ def _send_once(session, req: HTTPRequestData,
                             headers=dict(resp.headers))
 
 
-def basic_handler(session, req: HTTPRequestData, timeout: float = 60.0,
-                  backoffs: List[float] = (0.1, 0.5, 1.0)
-                  ) -> HTTPResponseData:
-    """Retry only on transport errors; any status code is returned as-is."""
-    last_err: Optional[Exception] = None
-    for wait in list(backoffs) + [None]:
+def policy_handler(session, req: HTTPRequestData, timeout: float = 60.0,
+                   policy: Optional[RetryPolicy] = None,
+                   breaker=None, deadline: Optional[Deadline] = None
+                   ) -> HTTPResponseData:
+    """Send one request under a :class:`RetryPolicy`.
+
+    The general handler the legacy fixed-list handlers now delegate to:
+    transport failures (returned as status 0, same contract as before)
+    and policy-retryable statuses back off per the policy (decorrelated
+    jitter or explicit list, attempt + time budgets), honoring
+    ``Retry-After``. An optional per-host :class:`CircuitBreaker` is
+    consulted before every send — an open circuit returns immediately
+    (status 0, reason ``"circuit open: ..."``) instead of burning the
+    retry schedule against a dead host. An optional :class:`Deadline`
+    bounds the whole exchange: it caps the per-attempt socket timeout
+    and no retry is attempted that could not finish in time.
+    """
+    policy = policy or RetryPolicy()
+    sched = policy.schedule(deadline)
+    resp: Optional[HTTPResponseData] = None
+    while True:
+        if deadline is not None and deadline.expired:
+            return resp or HTTPResponseData(
+                status_code=0, reason="deadline exceeded", body=None)
+        if breaker is not None and not breaker.allow():
+            return resp or HTTPResponseData(
+                status_code=0,
+                reason=f"circuit open: {breaker.name or req.url}",
+                body=None)
+        attempt_timeout = timeout
+        if deadline is not None:
+            attempt_timeout = min(timeout, max(deadline.remaining(), 1e-3))
         try:
-            return _send_once(session, req, timeout)
+            resp = _send_once(session, req, attempt_timeout)
         except Exception as e:  # transport-level failure
-            last_err = e
-            if wait is None:
-                break
-            time.sleep(wait)
-    return HTTPResponseData(status_code=0, reason=str(last_err), body=None)
+            resp = HTTPResponseData(status_code=0, reason=str(e), body=None)
+        # breaker health tracks the HOST: transport failures and server
+        # errors count against it even when the policy itself would not
+        # retry that status (e.g. the basic policy returns 5xx as-is)
+        if breaker is not None:
+            if resp.status_code == 0 or resp.status_code >= 500:
+                breaker.record_failure()
+            else:
+                breaker.record_success()
+        if not policy.retryable_status(resp.status_code):
+            return resp
+        retry_after = resp.headers.get("Retry-After")
+        if sched.give_up(retry_after):
+            return resp
+
+
+def basic_handler(session, req: HTTPRequestData, timeout: float = 60.0,
+                  backoffs: List[float] = (0.1, 0.5, 1.0),
+                  deadline: Optional[Deadline] = None) -> HTTPResponseData:
+    """Retry only on transport errors; any status code is returned as-is."""
+    return policy_handler(
+        session, req, timeout,
+        policy=RetryPolicy(backoffs=tuple(backoffs), retry_statuses=()),
+        deadline=deadline)
 
 
 def advanced_handler(session, req: HTTPRequestData, timeout: float = 60.0,
                      backoffs: List[float] = (0.1, 0.5, 1.0, 2.0),
-                     retry_statuses: tuple = (429, 500, 502, 503, 504)
+                     retry_statuses: tuple = (429, 500, 502, 503, 504),
+                     deadline: Optional[Deadline] = None
                      ) -> HTTPResponseData:
     """Also retry on throttling/server statuses with backoff.
 
     Parity: HandlingUtils.advanced (`HTTPClients.scala:107-133`) — 429s
     honor a Retry-After header when present.
     """
-    resp: Optional[HTTPResponseData] = None
-    for wait in list(backoffs) + [None]:
-        try:
-            resp = _send_once(session, req, timeout)
-        except Exception as e:
-            resp = HTTPResponseData(status_code=0, reason=str(e), body=None)
-        if resp.status_code not in retry_statuses and resp.status_code != 0:
-            return resp
-        if wait is None:
-            break
-        retry_after = resp.headers.get("Retry-After")
-        if retry_after is not None:
-            try:
-                wait = max(wait, float(retry_after))
-            except ValueError:
-                pass
-        time.sleep(wait)
-    return resp
+    return policy_handler(
+        session, req, timeout,
+        policy=RetryPolicy(backoffs=tuple(backoffs),
+                           retry_statuses=tuple(retry_statuses)),
+        deadline=deadline)
 
 
 # ---------------------------------------------------------------------------
 # Clients (parity: Clients.scala SingleThreadedClient / AsyncClient)
 # ---------------------------------------------------------------------------
+
+# per-host breakers shared by every policy-driven client in the process:
+# a host that died during one stage's transform is already open when the
+# next stage (or the next micro-batch) targets it
+SHARED_BREAKERS = BreakerBoard(failure_threshold=5, reset_timeout=30.0)
+
+
+def _host_of(url: str) -> str:
+    return urlsplit(url).netloc or url
+
 
 class HTTPClient:
     """Sends a list of requests, preserving order.
@@ -157,21 +203,61 @@ class HTTPClient:
     ``concurrency > 1`` uses a bounded thread pool — the analogue of the
     reference's per-partition AsyncClient with bounded futures
     (`Clients.scala:102`, `AsyncUtils`).
+
+    With ``policy`` set (or ``breakers``), sends go through
+    :func:`policy_handler`: jittered/bounded retries, per-host circuit
+    breaking (``breakers=True`` uses the process-wide
+    :data:`SHARED_BREAKERS` board; pass a :class:`BreakerBoard` to
+    isolate), and an optional per-send :class:`Deadline`. ``session``
+    is injectable so chaos tests wrap it in a
+    :class:`mmlspark_tpu.testing.faults.FaultySession`.
     """
 
     def __init__(self, concurrency: int = 1, timeout: float = 60.0,
-                 handler: Callable = advanced_handler):
-        import requests
+                 handler: Callable = advanced_handler,
+                 policy: Optional[RetryPolicy] = None,
+                 breakers=None, session=None):
         self.concurrency = max(int(concurrency), 1)
         self.timeout = timeout
         self.handler = handler
-        self._session = requests.Session()
+        self.policy = policy
+        if breakers is True:
+            breakers = SHARED_BREAKERS
+        self.breakers: Optional[BreakerBoard] = breakers or None
+        import inspect
+        try:
+            self._handler_takes_deadline = "deadline" in \
+                inspect.signature(handler).parameters
+        except (TypeError, ValueError):
+            self._handler_takes_deadline = False
+        if session is None:
+            import requests
+            session = requests.Session()
+        self._session = session
 
-    def send(self, reqs: List[Optional[HTTPRequestData]]
+    def send(self, reqs: List[Optional[HTTPRequestData]],
+             deadline: Optional[Deadline] = None
              ) -> List[Optional[HTTPResponseData]]:
+        policy_driven = (self.policy is not None
+                         or self.breakers is not None)
+
         def one(req):
             if req is None:
                 return None
+            if policy_driven:
+                breaker = (self.breakers.get(_host_of(req.url))
+                           if self.breakers is not None else None)
+                return policy_handler(self._session, req, self.timeout,
+                                      policy=self.policy, breaker=breaker,
+                                      deadline=deadline)
+            if deadline is not None and self._handler_takes_deadline:
+                # a deadline must never silently swap the configured
+                # handler's retry semantics for the default policy's
+                # (basic must keep returning 5xx as-is): the stock
+                # handlers thread the deadline through; a custom
+                # handler that cannot take one keeps its exact contract
+                return self.handler(self._session, req, self.timeout,
+                                    deadline=deadline)
             return self.handler(self._session, req, self.timeout)
 
         if self.concurrency == 1:
@@ -199,9 +285,18 @@ class HTTPTransformer(Transformer, HasInputCol, HasOutputCol):
     output_col = Param("response", "response column")
     concurrency = Param(8, "max in-flight requests", in_range(lo=1))
     timeout = Param(60.0, "per-request timeout, seconds", in_range(lo=0.0))
-    handler = Param("advanced", "retry policy: basic|advanced")
+    handler = Param("advanced", "retry policy: basic|advanced|policy "
+                    "(policy = jittered/budgeted retries + per-host "
+                    "circuit breakers)")
+    budget = Param(None, "optional whole-transform deadline, seconds: "
+                   "bounds retries AND per-attempt socket timeouts for "
+                   "every row in this frame", ptype=float)
 
     def _client(self) -> HTTPClient:
+        if self.handler == "policy":
+            return HTTPClient(concurrency=self.concurrency,
+                              timeout=self.timeout,
+                              policy=RetryPolicy(), breakers=True)
         handler = advanced_handler if self.handler == "advanced" \
             else basic_handler
         return HTTPClient(concurrency=self.concurrency,
@@ -217,8 +312,9 @@ class HTTPTransformer(Transformer, HasInputCol, HasOutputCol):
             else:
                 reqs.append(HTTPRequestData.from_dict(v))
         client = self._client()
+        deadline = Deadline(self.budget) if self.budget else None
         try:
-            resps = client.send(reqs)
+            resps = client.send(reqs, deadline=deadline)
         finally:
             client.close()
         out = [None if r is None else r.to_dict() for r in resps]
@@ -337,7 +433,9 @@ class SimpleHTTPTransformer(Transformer, HasInputCol, HasOutputCol):
     error_col = Param("error", "column for failed-request info")
     concurrency = Param(8, "max in-flight requests", in_range(lo=1))
     timeout = Param(60.0, "per-request timeout, s", in_range(lo=0.0))
-    handler = Param("advanced", "retry policy: basic|advanced")
+    handler = Param("advanced", "retry policy: basic|advanced|policy")
+    budget = Param(None, "optional whole-transform deadline, seconds",
+                   ptype=float)
 
     def transform(self, df: DataFrame) -> DataFrame:
         req_col = "__http_request"
@@ -352,7 +450,7 @@ class SimpleHTTPTransformer(Transformer, HasInputCol, HasOutputCol):
         work = HTTPTransformer(
             input_col=req_col, output_col=resp_col,
             concurrency=self.concurrency, timeout=self.timeout,
-            handler=self.handler).transform(work)
+            handler=self.handler, budget=self.budget).transform(work)
 
         errors = []
         resps = []

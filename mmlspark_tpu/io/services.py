@@ -42,6 +42,12 @@ class CognitiveServiceBase(Transformer, HasOutputCol):
     timeout = Param(60.0, "request timeout, s", in_range(lo=0.0))
     error_col = Param("error", "failed-request info column")
     output_col = Param("result", "parsed output column")
+    # policy-driven by default: jittered/budgeted retries + a per-host
+    # circuit breaker, so a dead or throttling service endpoint sheds
+    # the rest of the frame instead of timing out row by row
+    handler = Param("policy", "retry policy: basic|advanced|policy")
+    budget = Param(None, "optional whole-transform deadline, seconds",
+                   ptype=float)
 
     def _headers(self) -> Dict[str, str]:
         h = {"Content-Type": "application/json"}
@@ -65,7 +71,8 @@ class CognitiveServiceBase(Transformer, HasOutputCol):
             input_parser=CustomInputParser(udf=self._make_request),
             output_parser=self._output_parser(),
             error_col=self.error_col, concurrency=self.concurrency,
-            timeout=self.timeout)
+            timeout=self.timeout, handler=self.handler,
+            budget=self.budget)
         return inner.transform(df)
 
 
@@ -477,13 +484,15 @@ def _post_batches(url: str, payloads: List[Any],
                   headers: Optional[Dict[str, str]] = None,
                   concurrency: int = 2,
                   timeout: float = 30.0) -> List[Dict[str, Any]]:
-    """POST each payload (throttling-aware retry handler); returns the
-    per-batch error dicts shared by the batch writers."""
-    from mmlspark_tpu.io.http import HTTPClient, advanced_handler
+    """POST each payload (policy-driven: jittered retries with budget +
+    per-host circuit breaking); returns the per-batch error dicts shared
+    by the batch writers."""
+    from mmlspark_tpu.core.resilience import RetryPolicy
+    from mmlspark_tpu.io.http import HTTPClient
 
     reqs = [HTTPRequestData.post_json(url, p, headers) for p in payloads]
     client = HTTPClient(concurrency=concurrency, timeout=timeout,
-                        handler=advanced_handler)
+                        policy=RetryPolicy(), breakers=True)
     try:
         resps = client.send(reqs)
     finally:

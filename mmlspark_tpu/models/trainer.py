@@ -102,6 +102,19 @@ class NNLearner(Estimator, HasLabelCol, HasFeaturesCol):
     mesh_shape = Param(None, "mesh axes dict, e.g. {'data': -1}", ptype=dict)
     checkpoint_dir = Param(None, "orbax step-checkpoint directory", ptype=str)
     checkpoint_every = Param(0, "steps between checkpoints (0 = off)", ptype=int)
+    max_restarts = Param(2, "bounded in-process auto-restarts: when a "
+                         "train step fails and checkpointing is "
+                         "configured, restore the latest orbax "
+                         "checkpoint and resume the SAME shuffle "
+                         "stream (deterministic fast-forward); after "
+                         "this many restores the error propagates — a "
+                         "persistent fault must fail the fit, not loop "
+                         "it", ptype=int)
+    fault_injector = Param(None, "chaos-test hook: callable(global_step)"
+                           " invoked before each host-loop step; "
+                           "exceptions it raises exercise the bounded-"
+                           "restart path (see testing.faults.FaultPlan."
+                           "step_fault)", complex=True)
     log_every = Param(50, "steps between loss logs (0 = off)", ptype=int)
     device_resident = Param(False, "upload the dataset to the device ONCE "
                             "and run each epoch as one scanned device "
@@ -307,10 +320,64 @@ class NNLearner(Estimator, HasLabelCol, HasFeaturesCol):
 
         start_step = 0
         mngr = self._checkpoint_manager()
+        template = None
+        if mngr is not None:
+            # host-side structure template, captured BEFORE any step
+            # runs: the jitted step donates its params/opt_state
+            # buffers, so after a mid-step fault the live buffers may
+            # already be invalidated — restores must not depend on them
+            template = {"params": jax.device_get(params),
+                        "opt_state": jax.device_get(opt_state)}
         if mngr is not None and mngr.latest_step() is not None:
-            raw_params, raw_opt, start_step = self._restore(mngr, params, opt_state)
+            raw_params, raw_opt, start_step = self._restore(mngr, template)
             params = jax.device_put(raw_params, repl)
             opt_state = jax.device_put(raw_opt, repl)
+
+        # -- fault-tolerant fit: a step failure (preempted chip, injected
+        # chaos fault, failed checkpoint write) restores the latest
+        # checkpoint and re-enters the SAME deterministic shuffle stream
+        # (the fast-forward below), bounded by max_restarts so a
+        # persistent fault still fails the fit
+        restarts = 0
+        while True:
+            try:
+                params, opt_state = self._host_loop(
+                    x, y, w, step, shard, params, opt_state, start_step,
+                    steps_per_epoch, bs, n_data, mngr)
+                break
+            except Exception as e:  # noqa: BLE001 — classified below
+                if mngr is None or restarts >= self.max_restarts:
+                    raise
+                restarts += 1
+                latest = mngr.latest_step()
+                print(f"[NNLearner] step failed ({type(e).__name__}: {e});"
+                      f" restoring "
+                      f"{'step ' + str(latest) if latest is not None else 'init'}"
+                      f" (restart {restarts}/{self.max_restarts})")
+                if latest is None:
+                    params = jax.device_put(fn.params, repl)
+                    opt_state = jax.device_put(tx.init(params), repl)
+                    start_step = 0
+                else:
+                    raw_params, raw_opt, start_step = \
+                        self._restore(mngr, template)
+                    params = jax.device_put(raw_params, repl)
+                    opt_state = jax.device_put(raw_opt, repl)
+
+        trained = NNFunction(arch=dict(fn.arch), params=jax.device_get(params))
+        # keep the training-time input convention (see _fit_device_resident)
+        extra = {"input_dtype": "uint8"} if was_int else {}
+        return NNModel(model=trained, input_col=self.features_col,
+                       output_col="scores", **extra)
+
+    def _host_loop(self, x, y, w, step, shard, params, opt_state,
+                   start_step, steps_per_epoch, bs, n_data, mngr):
+        """One attempt at the per-step host loop, resumable at
+        ``start_step``: the shuffle stream is regenerated from the seed
+        and already-done steps are skipped, so every attempt sees the
+        identical batch sequence (restart N reaches the same params an
+        uninterrupted run does)."""
+        import jax
 
         rng = np.random.default_rng(self.seed)
         global_step = 0
@@ -328,6 +395,8 @@ class NNLearner(Estimator, HasLabelCol, HasFeaturesCol):
                 global_step += 1
                 if global_step <= start_step:
                     continue  # fast-forward after resume (same shuffle stream)
+                if self.fault_injector is not None:
+                    self.fault_injector(global_step)
                 idx = order[s * bs:(s + 1) * bs]
                 # ragged tail: pad to the data-axis multiple, zero the pad
                 # rows' weights so they contribute nothing to the loss
@@ -354,12 +423,7 @@ class NNLearner(Estimator, HasLabelCol, HasFeaturesCol):
         if mngr is not None:
             self._checkpoint(mngr, global_step, params, opt_state)
             mngr.wait_until_finished()
-
-        trained = NNFunction(arch=dict(fn.arch), params=jax.device_get(params))
-        # keep the training-time input convention (see _fit_device_resident)
-        extra = {"input_dtype": "uint8"} if was_int else {}
-        return NNModel(model=trained, input_col=self.features_col,
-                       output_col="scores", **extra)
+        return params, opt_state
 
     # -- orbax step checkpointing ------------------------------------------
 
@@ -376,14 +440,13 @@ class NNLearner(Estimator, HasLabelCol, HasFeaturesCol):
                  "opt_state": jax.device_get(opt_state)}
         mngr.save(step_num, args=ocp.args.StandardSave(state))
 
-    def _restore(self, mngr, params, opt_state):
-        """Restore against the live (params, opt_state) as structure template,
-        so optax NamedTuple states round-trip intact."""
-        import jax
+    def _restore(self, mngr, template):
+        """Restore the latest step against a host-side (params,
+        opt_state) structure template, so optax NamedTuple states
+        round-trip intact. The template must predate the first step:
+        the donated live buffers are not safe to read after a fault."""
         import orbax.checkpoint as ocp
         latest = mngr.latest_step()
-        template = {"params": jax.device_get(params),
-                    "opt_state": jax.device_get(opt_state)}
         restored = mngr.restore(latest, args=ocp.args.StandardRestore(template))
         print(f"[NNLearner] resumed from step {latest}")
         return restored["params"], restored["opt_state"], latest

@@ -464,6 +464,33 @@ def _sorted_capacity_queues(h_rep, top, wf, E: int, C: int, dt):
     return disp, combine
 
 
+def _scatter_capacity_queues(h_rep, top, wf, E: int, C: int, dt):
+    """One-hot cumsum + scatter/gather capacity dispatch: the golden
+    reference engine :func:`_sorted_capacity_queues` is A/B'd against.
+    Same contract: ``(disp (E, C, dtype dt), combine)`` with router
+    weights applied on the way back; overflow routings land in a
+    scratch column that is sliced away (dispatch) / zero-weighted
+    (combine). Shared by the model's ``moe_dispatch='scatter'`` branch
+    and ``tools/bench_moe_engines.py``, so the bench times exactly the
+    code the model runs."""
+    Tk, d = h_rep.shape
+    onehot = jax.nn.one_hot(top, E, dtype=jnp.int32)      # (Tk, E)
+    # position of each routing within its expert's queue (arrival order)
+    pos = jnp.cumsum(onehot, axis=0) * onehot - 1
+    slot = jnp.take_along_axis(pos, top[:, None], axis=1)[:, 0]
+    keep = slot < C
+    # overflow routings land in a scratch column C, sliced away
+    slot_c = jnp.where(keep, slot, C)
+    disp = jnp.zeros((E, C + 1, d), dt).at[top, slot_c].set(
+        h_rep.astype(dt))[:, :C]                          # (E, C, d)
+
+    def combine(y):
+        y = jnp.pad(y, ((0, 0), (0, 1), (0, 0)))          # overflow row
+        return y[top, slot_c] * (keep * wf)[:, None]
+
+    return disp, combine
+
+
 def _moe_capacity(bp, x, cfg: TransformerConfig, ax: _Axes):
     """Capacity-factor top-k MoE dispatch (the production shape).
 
@@ -513,20 +540,8 @@ def _moe_capacity(bp, x, cfg: TransformerConfig, ax: _Axes):
         disp, combine = _sorted_capacity_queues(
             jnp.repeat(hT.astype(dt), k, axis=0), top, wf, E, C, dt)
     elif cfg.moe_dispatch == "scatter":
-        onehot = jax.nn.one_hot(top, E, dtype=jnp.int32)  # [T_sh*k, E]
-        # position of each routing within its expert's queue (arrival)
-        pos = jnp.cumsum(onehot, axis=0) * onehot - 1
-        slot = jnp.take_along_axis(pos, top[:, None], axis=1)[:, 0]
-        keep = slot < C
-        # overflow routings land in a scratch column C, sliced away
-        slot_c = jnp.where(keep, slot, C)
-        disp = jnp.zeros((E, C + 1, d), dt).at[top, slot_c].set(
-            jnp.repeat(hT.astype(dt), k, axis=0))
-        disp = disp[:, :C]                               # [E, C, d]
-
-        def combine(y):
-            y = jnp.pad(y, ((0, 0), (0, 1), (0, 0)))     # overflow row
-            return y[top, slot_c] * (keep * wf)[:, None]
+        disp, combine = _scatter_capacity_queues(
+            jnp.repeat(hT.astype(dt), k, axis=0), top, wf, E, C, dt)
     else:
         raise ValueError(f"unknown moe_dispatch {cfg.moe_dispatch!r}")
 

@@ -4,7 +4,10 @@ Capability parity with Spark Serving (`src/io/http` serving sources/sinks)
 rebuilt for the TPU execution model — see :mod:`mmlspark_tpu.serving.server`.
 """
 
-from mmlspark_tpu.serving.server import ServingServer, ServingCoordinator
+from mmlspark_tpu.serving.server import (
+    ServingClient, ServingCoordinator, ServingServer,
+)
 from mmlspark_tpu.serving.consolidator import PartitionConsolidator
 
-__all__ = ["ServingServer", "ServingCoordinator", "PartitionConsolidator"]
+__all__ = ["ServingServer", "ServingCoordinator", "ServingClient",
+           "PartitionConsolidator"]

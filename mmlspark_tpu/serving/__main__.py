@@ -8,7 +8,10 @@ from ``$MODEL_URI`` (any io.fs path: local dir, gs://...), serve it
 ``$COORDINATOR_URL``. These are the commands the k8s manifests under
 ``tools/k8s/`` run (parity: the reference's spark-serving helm chart,
 `/root/reference/tools/helm/`); the readiness probe hits the server's
-``GET /status``.
+``GET /readyz`` (drain-aware), liveness ``GET /healthz``, counters
+``GET /status``. SIGTERM triggers the server's graceful drain
+(``ServingServer.stop``), so a pod delete finishes its accepted
+requests before the listener closes.
 
 Environment:
   PORT             listen port (default 8000)
@@ -16,8 +19,10 @@ Environment:
   COORDINATOR_URL  (worker, optional) http://host:port to register with
   POD_IP           (worker, optional) address advertised to the
                    coordinator; defaults to the local hostname
-  MAX_BATCH_SIZE / MAX_LATENCY_MS / JOURNAL_SIZE / JOURNAL_TTL
-                   (worker, optional) ServingServer knobs
+  MAX_BATCH_SIZE / MAX_LATENCY_MS / JOURNAL_SIZE / JOURNAL_TTL /
+  MAX_QUEUE        (worker, optional) ServingServer knobs (MAX_QUEUE
+                   bounds the batching queue: beyond it new requests
+                   shed with 429 + Retry-After, see docs/resilience.md)
   JOURNAL_PATH     (worker, optional) durable replay-journal file (any
                    io.fs path — mount a PVC and point this at it, or
                    gs://...): committed replies survive pod restarts,
@@ -64,7 +69,8 @@ def run_worker() -> None:
         max_latency_ms=_env_float("MAX_LATENCY_MS", 10.0),
         journal_size=int(_env_float("JOURNAL_SIZE", 4096)),
         journal_ttl=ttl if ttl > 0 else None,
-        journal_path=os.environ.get("JOURNAL_PATH") or None).start()
+        journal_path=os.environ.get("JOURNAL_PATH") or None,
+        max_queue=int(_env_float("MAX_QUEUE", 1024))).start()
     print(f"[serving] worker serving {uri} on :{srv.port}", flush=True)
 
     coord_url = os.environ.get("COORDINATOR_URL")

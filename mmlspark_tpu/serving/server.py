@@ -29,6 +29,10 @@ import numpy as np
 
 from mmlspark_tpu.core.dataframe import DataFrame
 from mmlspark_tpu.core.logs import get_logger
+from mmlspark_tpu.core.resilience import (
+    SYSTEM_CLOCK, BreakerBoard, Clock, Deadline, DeadlineExceeded,
+    RetryPolicy,
+)
 from mmlspark_tpu.core.serialize import _jsonify
 from mmlspark_tpu.core.stage import Transformer
 
@@ -44,14 +48,16 @@ class _Server(ThreadingHTTPServer):
 
 
 class _PendingRequest:
-    __slots__ = ("rid", "payload", "event", "reply", "status")
+    __slots__ = ("rid", "payload", "event", "reply", "status", "deadline")
 
-    def __init__(self, payload: Any, rid: Optional[str] = None):
+    def __init__(self, payload: Any, rid: Optional[str] = None,
+                 deadline: Optional[Deadline] = None):
         self.rid = rid or uuid.uuid4().hex
         self.payload = payload
         self.event = threading.Event()
         self.reply: Optional[bytes] = None
         self.status = 200
+        self.deadline = deadline
 
 
 class ServingServer:
@@ -70,7 +76,10 @@ class ServingServer:
                  journal_size: int = 4096,
                  journal_ttl: Optional[float] = None,
                  journal_path: Optional[str] = None,
-                 idle_timeout: Optional[float] = 60.0):
+                 idle_timeout: Optional[float] = 60.0,
+                 max_queue: int = 1024,
+                 shed_retry_after: float = 0.1,
+                 clock: Clock = SYSTEM_CLOCK):
         self.model = model
         self.api_path = api_path
         self.max_batch_size = int(max_batch_size)
@@ -80,6 +89,20 @@ class ServingServer:
         # None (stdlib idiom) and <= 0 both mean "no keep-alive reap"
         self.idle_timeout = (float(idle_timeout)
                              if idle_timeout is not None else 0.0)
+        # -- degradation under overload: beyond ``max_queue`` queued
+        # requests (0 = unbounded) NEW work is shed with 429 +
+        # Retry-After instead of queueing into a timeout — the client
+        # gets an honest backpressure signal while replays/joins of
+        # already-accepted work keep succeeding. ``clock`` feeds
+        # per-request deadlines (X-Deadline-Ms): injectable so chaos
+        # tests expire deadlines without wall-clock waits.
+        self.max_queue = int(max_queue)
+        self.shed_retry_after = float(shed_retry_after)
+        self.clock = clock
+        self.n_shed = 0
+        self.n_deadline_expired = 0
+        self._draining = threading.Event()
+        self._active_batches = 0
         self._queue: "Queue[_PendingRequest]" = Queue()
         self._stop = threading.Event()
         self._server = _Server((host, port), self._handler_class())
@@ -164,18 +187,38 @@ class ServingServer:
                        if serving.idle_timeout > 0 else None)
 
             def _reply(self, status: int, body: bytes, replayed=False,
-                       window_missed=False):
+                       window_missed=False, retry_after=None):
                 self.send_response(status)
                 self.send_header("Content-Type", "application/json")
                 if replayed:
                     self.send_header("X-Replayed", "1")
                 if window_missed:
                     self.send_header("X-Replay-Window-Missed", "1")
+                if retry_after is not None:
+                    self.send_header("Retry-After", str(retry_after))
                 self.send_header("Content-Length", str(len(body)))
                 self.end_headers()
                 self.wfile.write(body)
 
             def do_GET(self):
+                if self.path == "/healthz":
+                    # liveness: the process answers HTTP at all
+                    self._reply(200, b'{"ok": true}')
+                    return
+                if self.path == "/readyz":
+                    # readiness: flips 503 the moment drain starts, so
+                    # an orchestrator stops routing BEFORE the listener
+                    # goes away (the k8s readiness-probe contract)
+                    if serving._draining.is_set() or \
+                            serving._stop.is_set():
+                        self._reply(503, b'{"ready": false, '
+                                         b'"reason": "draining"}')
+                        return
+                    body = {"ready": True,
+                            "queue_depth": serving._queue.qsize(),
+                            "max_queue": serving.max_queue}
+                    self._reply(200, json.dumps(body).encode())
+                    return
                 if self.path != "/status":
                     self.send_error(404)
                     return
@@ -186,6 +229,11 @@ class ServingServer:
                         "n_replayed": serving.n_replayed,
                         "n_journal_evicted": serving.n_journal_evicted,
                         "n_window_missed": serving.n_window_missed,
+                        "n_shed": serving.n_shed,
+                        "n_deadline_expired": serving.n_deadline_expired,
+                        "queue_depth": serving._queue.qsize(),
+                        "max_queue": serving.max_queue,
+                        "draining": serving._draining.is_set(),
                         "journal_entries": len(serving._journal),
                         "journal_size": serving.journal_size,
                         "journal_ttl": serving.journal_ttl,
@@ -198,6 +246,13 @@ class ServingServer:
                 if self.path != serving.api_path:
                     self.send_error(404)
                     return
+                if serving._draining.is_set():
+                    # graceful drain: accepted work finishes, new work
+                    # is refused so the orchestrator's retry lands on a
+                    # live worker
+                    self._reply(503, b'{"error": "draining"}',
+                                retry_after=serving.shed_retry_after)
+                    return
                 length = int(self.headers.get("Content-Length", 0))
                 try:
                     payload = json.loads(self.rfile.read(length) or b"{}")
@@ -205,8 +260,11 @@ class ServingServer:
                     self.send_error(400, "invalid JSON")
                     return
 
+                deadline = Deadline.from_headers(self.headers,
+                                                 clock=serving.clock)
                 rid = self.headers.get("X-Request-Id")
                 window_missed = False
+                shed = False
                 if rid:
                     with serving._commit_lock:
                         serving._reap_expired_locked()
@@ -214,17 +272,27 @@ class ServingServer:
                         pending = (serving._inflight.get(rid)
                                    if committed is None else None)
                         if committed is None and pending is None:
-                            # request ids are unique per logical request,
-                            # so a rid in the evicted ring can only be a
-                            # retry that outlived the replay window —
-                            # detected, warned, and re-executed (the
-                            # documented past-window semantics)
-                            window_missed = rid in serving._evicted
-                            if window_missed:
-                                serving.n_window_missed += 1
-                            pending = _PendingRequest(payload, rid)
-                            serving._inflight[rid] = pending
-                            enqueue = True
+                            if serving._overloaded():
+                                # shedding applies to NEW work only:
+                                # replays and in-flight joins above cost
+                                # no inference and always succeed
+                                serving.n_shed += 1
+                                shed = True
+                                enqueue = False
+                            else:
+                                # request ids are unique per logical
+                                # request, so a rid in the evicted ring
+                                # can only be a retry that outlived the
+                                # replay window — detected, warned, and
+                                # re-executed (the documented
+                                # past-window semantics)
+                                window_missed = rid in serving._evicted
+                                if window_missed:
+                                    serving.n_window_missed += 1
+                                pending = _PendingRequest(payload, rid,
+                                                          deadline)
+                                serving._inflight[rid] = pending
+                                enqueue = True
                         else:
                             enqueue = False
                         if committed is not None:
@@ -233,6 +301,10 @@ class ServingServer:
                         self._reply(committed[0], committed[1],
                                     replayed=True)
                         return
+                    if shed:
+                        self._reply(429, b'{"error": "overloaded"}',
+                                    retry_after=serving.shed_retry_after)
+                        return
                     if window_missed:
                         logger.warning(
                             "request id %s retried after its journal "
@@ -240,7 +312,31 @@ class ServingServer:
                             "journal_ttl=%s); re-executing", rid,
                             serving.journal_size, serving.journal_ttl)
                 else:
-                    pending, enqueue = _PendingRequest(payload), True
+                    if serving._overloaded():
+                        with serving._commit_lock:
+                            serving.n_shed += 1
+                        self._reply(429, b'{"error": "overloaded"}',
+                                    retry_after=serving.shed_retry_after)
+                        return
+                    pending = _PendingRequest(payload, deadline=deadline)
+                    enqueue = True
+
+                if enqueue and deadline is not None and deadline.expired:
+                    # dead on arrival: the client's budget is already
+                    # spent — never enqueue work nobody will read. The
+                    # pending is resolved (status + event) BEFORE it
+                    # leaves _inflight, so a duplicate that joined it
+                    # in the window between the two locked sections is
+                    # released immediately instead of blocking until
+                    # request_timeout
+                    pending.status = 504
+                    pending.reply = b'{"error": "deadline exceeded"}'
+                    with serving._commit_lock:
+                        serving.n_deadline_expired += 1
+                        serving._inflight.pop(pending.rid, None)
+                    pending.event.set()
+                    self._reply(504, pending.reply)
+                    return
 
                 if enqueue:
                     serving._queue.put(pending)
@@ -260,6 +356,10 @@ class ServingServer:
         return Handler
 
     # -- batching loop -------------------------------------------------------
+
+    def _overloaded(self) -> bool:
+        return self.max_queue > 0 and \
+            self._queue.qsize() >= self.max_queue
 
     def _collect_batch(self) -> List[_PendingRequest]:
         try:
@@ -287,28 +387,53 @@ class ServingServer:
                 break
         return batch
 
+    def _expire(self, p: _PendingRequest, where: str) -> None:
+        """504 a request whose deadline passed; never journaled (status
+        != 200), so a fresh-budget retry re-executes for real."""
+        p.status = 504
+        p.reply = json.dumps(
+            {"error": f"deadline exceeded {where}"}).encode()
+        self.n_deadline_expired += 1
+        self._commit(p)
+
     def _serve_batch(self, batch: List[_PendingRequest]) -> None:
-        rows = [p.payload if isinstance(p.payload, dict) else
-                {"value": p.payload} for p in batch]
+        # deadline check #1 — before dispatch: a request whose budget
+        # expired while queued must not occupy a batch slot or run
+        # through the model at all
+        live = []
+        for p in batch:
+            if p.deadline is not None and p.deadline.expired:
+                self._expire(p, "before dispatch")
+            else:
+                live.append(p)
         try:
-            df = DataFrame.from_rows(rows)
-            out = self.model.transform(df)
-            if out.num_rows != len(batch):
-                raise RuntimeError(
-                    f"model returned {out.num_rows} rows for a "
-                    f"{len(batch)}-request batch; serving models must "
-                    f"preserve row count")
-            cols = self.reply_cols or \
-                [c for c in out.columns if c not in df.columns]
-            replies = []
-            for row in out.select(cols).rows():
-                replies.append(json.dumps(_jsonify(row)).encode())
-            for p, r in zip(batch, replies):
-                p.reply = r
-                self._commit(p)
+            if live:
+                rows = [p.payload if isinstance(p.payload, dict) else
+                        {"value": p.payload} for p in live]
+                df = DataFrame.from_rows(rows)
+                out = self.model.transform(df)
+                if out.num_rows != len(live):
+                    raise RuntimeError(
+                        f"model returned {out.num_rows} rows for a "
+                        f"{len(live)}-request batch; serving models must "
+                        f"preserve row count")
+                cols = self.reply_cols or \
+                    [c for c in out.columns if c not in df.columns]
+                replies = []
+                for row in out.select(cols).rows():
+                    replies.append(json.dumps(_jsonify(row)).encode())
+                for p, r in zip(live, replies):
+                    # deadline check #2 — before commit: the client is
+                    # already gone, so the reply must not be journaled
+                    # as a committed (replayable) result
+                    if p.deadline is not None and p.deadline.expired:
+                        self._expire(p, "before commit")
+                        continue
+                    p.reply = r
+                    self._commit(p)
         except Exception as e:  # noqa: BLE001 — any model failure -> 500s
             err = json.dumps({"error": str(e)}).encode()
-            for p in batch:
+            for p in live:
                 p.status = 500
                 p.reply = err
                 self._commit(p)
@@ -379,10 +504,24 @@ class ServingServer:
         by 4x — the file stays O(journal_size) however long the worker
         lives, and the next restart's replay stays O(window), not
         O(requests-ever). Only the in-memory snapshot is taken under the
-        commit lock; the file rewrite happens outside it."""
+        commit lock; the file rewrite happens outside it.
+
+        The queue is DISCARDED under the same lock that snapshots the
+        window (r5 advisor): commits enqueue their line while holding
+        the commit lock *after* inserting into ``_journal``, so at
+        snapshot time every queued line's rid is already in the
+        snapshot (or evicted from it) — the rewrite supersedes them
+        all. Without the drain those lines would be re-appended after
+        the rewrite (duplicate lines; ``_journal_file_lines``
+        over-counting, compacting early)."""
         from mmlspark_tpu.io import fs as _fs
         with self._commit_lock:
             items = list(self._journal.items())
+            try:
+                while True:
+                    self._journal_queue.get_nowait()
+            except Empty:
+                pass
         if self._journal_fh is not None:
             try:
                 self._journal_fh.close()
@@ -464,7 +603,11 @@ class ServingServer:
         while not self._stop.is_set():
             batch = self._collect_batch()
             if batch:
-                self._serve_batch(batch)
+                self._active_batches += 1
+                try:
+                    self._serve_batch(batch)
+                finally:
+                    self._active_batches -= 1
 
     # -- lifecycle -----------------------------------------------------------
 
@@ -483,7 +626,18 @@ class ServingServer:
             self._threads.append(self._journal_thread)
         return self
 
-    def stop(self):
+    def stop(self, drain: bool = True, drain_timeout: float = 5.0):
+        """Stop serving. With ``drain`` (the default), new requests are
+        refused first (503 + Retry-After; ``/readyz`` flips to 503) and
+        already-accepted work is given ``drain_timeout`` seconds to
+        batch, commit, and reply before the listener goes down — a
+        rolling restart loses no accepted request."""
+        self._draining.set()
+        if drain:
+            t_end = time.monotonic() + float(drain_timeout)
+            while time.monotonic() < t_end and \
+                    (self._queue.qsize() > 0 or self._active_batches > 0):
+                time.sleep(0.005)
         self._stop.set()
         self._server.shutdown()
         self._server.server_close()
@@ -635,15 +789,26 @@ class ServingCoordinator:
 
 class ServingClient:
     """Round-robin client over a coordinator's worker list, with
-    failover and idempotent retries.
+    breaker-guarded failover and budgeted idempotent retries.
 
     Every logical request carries a generated ``X-Request-Id``; a retry
-    (after a dropped connection or worker death) reuses the id, so a
-    worker that already computed the reply returns its journaled copy
-    instead of re-running inference (see :class:`ServingServer`).
-    Workers that refuse connections are skipped until the next
-    :meth:`refresh`. Parity: the reference's clients round-robin the
-    `/services` list of `DriverServiceUtils` (`HTTPSourceV2.scala:111`).
+    (after a dropped connection, a 5xx, or worker death) reuses the id,
+    so a worker that already computed the reply returns its journaled
+    copy instead of re-running inference (see :class:`ServingServer`).
+    Parity: the reference's clients round-robin the `/services` list of
+    `DriverServiceUtils` (`HTTPSourceV2.scala:111`).
+
+    Resilience wiring:
+
+    * a :class:`CircuitBreaker` per worker (``breakers``): a worker that
+      keeps failing is skipped without a connect attempt until its
+      reset timeout (on the injected clock) elapses;
+    * a :class:`RetryPolicy` bounds the TOTAL failover/retry schedule
+      per logical request (attempts + elapsed-time budget, jittered
+      backoff, 429 ``Retry-After`` honored);
+    * ``timeout_budget`` puts a :class:`Deadline` on the whole call,
+      propagated to workers via ``X-Deadline-Ms`` so the server also
+      stops spending on it (dropped before dispatch / commit).
 
     Dedup scope: the reply journal lives in each worker, so replay
     dedup is **per worker** — a retry that lands on a *different* worker
@@ -655,10 +820,19 @@ class ServingClient:
     """
 
     def __init__(self, coordinator_url: str, api_path: str = "/predict",
-                 timeout: float = 15.0):
+                 timeout: float = 15.0,
+                 retry_policy: Optional[RetryPolicy] = None,
+                 breakers: Optional[BreakerBoard] = None,
+                 clock: Clock = SYSTEM_CLOCK):
         self.coordinator_url = coordinator_url.rstrip("/")
         self.api_path = api_path
         self.timeout = timeout
+        self.clock = clock
+        self.policy = retry_policy or RetryPolicy(
+            max_attempts=6, base=0.02, cap=0.5, clock=clock)
+        self.breakers = breakers or BreakerBoard(
+            clock=clock, failure_threshold=3, reset_timeout=5.0)
+        self.n_failovers = 0
         self._workers: List[str] = []
         self._dead: set = set()
         self._rr = 0
@@ -673,17 +847,45 @@ class ServingClient:
         self._dead.clear()
         return list(self._workers)
 
-    def predict(self, payload: Any, request_id: Optional[str] = None) -> Any:
-        import requests
-        rid = request_id or uuid.uuid4().hex
+    def _pick(self) -> str:
+        """Next worker: alive, breaker-admitted, round-robin. Falls back
+        to breaker-refused workers rather than failing a request that
+        still has budget (availability over protection — the breakers
+        exist to stop *hammering*, not to refuse the only option)."""
         alive = [w for w in self._workers if w not in self._dead] \
             or self.refresh()
         if not alive:
             raise RuntimeError("no serving workers registered")
-        last_err: Optional[Exception] = None
         for _ in range(len(alive)):
             url = alive[self._rr % len(alive)]
             self._rr += 1
+            if self.breakers.get(url).allow():
+                return url
+        url = alive[self._rr % len(alive)]
+        self._rr += 1
+        return url
+
+    def predict(self, payload: Any, request_id: Optional[str] = None,
+                timeout_budget: Optional[float] = None) -> Any:
+        import requests
+        rid = request_id or uuid.uuid4().hex
+        deadline = (Deadline(timeout_budget, clock=self.clock)
+                    if timeout_budget is not None else None)
+        sched = self.policy.schedule(deadline)
+        last_err: Optional[Exception] = None
+        url: Optional[str] = None
+        while True:
+            if deadline is not None and deadline.expired:
+                raise DeadlineExceeded(
+                    f"request {rid} ran out of budget") from last_err
+            prev, url = url, self._pick()
+            if prev is not None and url != prev:
+                self.n_failovers += 1
+            breaker = self.breakers.get(url)
+            retry_after = None
+            headers = {"X-Request-Id": rid}
+            if deadline is not None:
+                headers[Deadline.HEADER] = deadline.to_header()
             # attempt 0, plus one same-worker retry after a timeout: the
             # worker may be alive-but-slow, and only ITS journal can
             # replay the reply without re-running inference
@@ -691,14 +893,36 @@ class ServingClient:
                 try:
                     r = requests.post(url, json=payload,
                                       timeout=self.timeout,
-                                      headers={"X-Request-Id": rid})
-                    r.raise_for_status()
-                    return r.json()
+                                      headers=headers)
                 except requests.ConnectionError as e:
                     last_err = e
-                    break  # worker dead: fail over immediately
+                    breaker.record_failure()
+                    self._dead.add(url)  # dead: fail over immediately
+                    break
                 except requests.Timeout as e:
                     last_err = e
-            self._dead.add(url)
-        raise RuntimeError(
-            f"all {len(alive)} serving workers unreachable") from last_err
+                    continue
+                if r.status_code == 429 or r.status_code >= 500:
+                    # shed/erroring worker: not dead, but this request
+                    # should back off and go elsewhere. 504 is excluded
+                    # from breaker health: a deadline-expired reply
+                    # says the REQUEST's budget was too tight, not that
+                    # the worker is sick — tight-budget clients must
+                    # not open circuits against healthy workers
+                    if r.status_code >= 500 and r.status_code != 504:
+                        breaker.record_failure()
+                    retry_after = r.headers.get("Retry-After")
+                    last_err = requests.HTTPError(
+                        f"{r.status_code} from {url}", response=r)
+                    break
+                breaker.record_success()
+                r.raise_for_status()    # other 4xx: caller's error
+                return r.json()
+            else:
+                # both same-worker attempts timed out
+                breaker.record_failure()
+                self._dead.add(url)
+            if sched.give_up(retry_after):
+                raise RuntimeError(
+                    f"serving workers unreachable after "
+                    f"{sched.attempt} attempts") from last_err

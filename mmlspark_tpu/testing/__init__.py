@@ -6,5 +6,14 @@ users regression-gate their own models the same way.
 """
 
 from mmlspark_tpu.testing.benchmarks import Benchmarks
+from mmlspark_tpu.testing.faults import (
+    Fault,
+    FaultPlan,
+    FaultyCheckpointManager,
+    FaultyModel,
+    FaultySession,
+    InjectedFault,
+)
 
-__all__ = ["Benchmarks"]
+__all__ = ["Benchmarks", "Fault", "FaultPlan", "FaultyCheckpointManager",
+           "FaultyModel", "FaultySession", "InjectedFault"]

@@ -73,6 +73,49 @@ class TestFusedCE:
         np.testing.assert_allclose(np.asarray(ce), np.asarray(ce_ref),
                                    rtol=0.05, atol=0.05)
 
+    @pytest.mark.skipif(
+        not hasattr(jax, "typeof"),
+        reason="fused kernels target the VMA-era jax API (jax.typeof, "
+               "ShapeDtypeStruct(vma=...)); this jax predates it")
+    def test_bf16_grads_track_f32_reference(self, rng):
+        """value_and_grad through the bf16 compute-dtype path vs the f32
+        reference (ADVICE r5): the backward rebuilds softmax
+        probabilities from logits STORED in bf16, so its gradients carry
+        bf16 rounding the XLA path does not — this pins the error
+        magnitude of that stored-logits tradeoff so a regression (e.g.
+        accidentally dropping to fp16 accumulation, or re-materializing
+        in the wrong dtype) is caught, not silent."""
+        t, d, v = 128, 128, 512
+        h = jnp.asarray(rng.normal(size=(t, d)).astype(np.float32))
+        w = jnp.asarray(rng.normal(size=(d, v)).astype(np.float32) * 0.1)
+        lbl = jnp.asarray(rng.integers(0, v, t).astype(np.int32))
+
+        def loss(fn):
+            def f(h_, w_):
+                return jnp.mean(fn(h_, w_))
+            return f
+
+        l0, (gh0, gw0) = jax.value_and_grad(
+            loss(lambda a, b: _ref_ce(a, b, lbl)), argnums=(0, 1))(h, w)
+        l1, (gh1, gw1) = jax.value_and_grad(
+            loss(lambda a, b: fused_softmax_xent(
+                a, b, lbl, compute_dtype=jnp.bfloat16, interpret=True)),
+            argnums=(0, 1))(h, w)
+        assert gh1.dtype == gw1.dtype == jnp.float32
+        np.testing.assert_allclose(float(l1), float(l0), rtol=0.02)
+        # calibrated against bf16's ~8-bit mantissa: probabilities carry
+        # ~4e-3 relative rounding; grads are prob-weighted sums over
+        # O(0.1)-scale inputs, so absolute error sits well under 1e-2
+        # while staying far above the f32 path's ~3e-5 (the assertion
+        # detects a precision REGRESSION, not noise)
+        np.testing.assert_allclose(np.asarray(gh1), np.asarray(gh0),
+                                   atol=8e-3)
+        np.testing.assert_allclose(np.asarray(gw1), np.asarray(gw0),
+                                   atol=8e-3)
+        # and the tolerance is tight enough to be meaningful: a fully
+        # broken backward (e.g. zero grads) is far outside it
+        assert float(jnp.abs(gh0).max()) > 8e-3
+
     def test_inside_shard_map(self, rng):
         """Composes under VMA-checked shard_map: varying dh, psum'd
         (invariant) dW for the replicated head weight."""

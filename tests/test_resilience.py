@@ -1,0 +1,704 @@
+"""Chaos suite: resilience primitives + fault-injected serving/IO/training.
+
+Everything here is deterministic by construction: fault schedules are
+scripted or seeded (:class:`FaultPlan`), and every time-driven
+transition (backoff, deadline expiry, breaker reset) runs on a
+:class:`ManualClock` — the suite never sleeps through a schedule, so it
+is fast enough for tier-1. The only waiting is bounded *condition*
+waits (events / tiny polls) used to sequence real localhost HTTP
+threads.
+"""
+
+import json
+import threading
+import time
+
+import numpy as np
+import pytest
+import requests
+
+from mmlspark_tpu.core.dataframe import DataFrame
+from mmlspark_tpu.core.resilience import (
+    BreakerBoard, CircuitBreaker, Deadline, DeadlineExceeded, ManualClock,
+    RetryPolicy,
+)
+from mmlspark_tpu.core.stage import Transformer
+from mmlspark_tpu.io.http import (
+    HTTPClient, HTTPRequestData, basic_handler, policy_handler,
+)
+from mmlspark_tpu.serving import (
+    ServingClient, ServingCoordinator, ServingServer,
+)
+from mmlspark_tpu.testing.faults import (
+    Fault, FaultPlan, FaultyCheckpointManager, FaultyModel, FaultySession,
+    InjectedFault,
+)
+
+pytestmark = pytest.mark.chaos
+
+
+def wait_until(cond, timeout=5.0, what="condition"):
+    """Bounded condition wait (sequencing real server threads); the
+    outcome never depends on the polling cadence."""
+    t0 = time.monotonic()
+    while not cond():
+        if time.monotonic() - t0 > timeout:
+            raise AssertionError(f"{what} not reached within {timeout}s")
+        time.sleep(0.002)
+
+
+class RecordingClock(ManualClock):
+    def __init__(self):
+        super().__init__()
+        self.sleeps = []
+
+    def sleep(self, seconds):
+        self.sleeps.append(seconds)
+        super().sleep(seconds)
+
+
+# ---------------------------------------------------------------------------
+# RetryPolicy
+# ---------------------------------------------------------------------------
+
+class TestRetryPolicy:
+    def _delays(self, seed):
+        clk = RecordingClock()
+        pol = RetryPolicy(max_attempts=6, base=0.1, cap=2.0, seed=seed,
+                          clock=clk)
+        sched = pol.schedule()
+        while not sched.give_up():
+            pass
+        return clk.sleeps
+
+    def test_decorrelated_jitter_is_seeded_and_bounded(self):
+        a, b = self._delays(7), self._delays(7)
+        assert a == b                      # reproducible schedule
+        assert len(a) == 5                 # max_attempts-1 backoffs
+        assert a != self._delays(8)        # but actually jittered
+        assert all(0.1 <= d <= 2.0 for d in a)
+        assert len(set(a)) > 1             # not a fixed list
+
+    def test_time_budget_stops_retries(self):
+        clk = ManualClock()
+        pol = RetryPolicy(backoffs=(0.6, 0.6, 0.6), budget=1.0, clock=clk)
+        sched = pol.schedule()
+        assert not sched.give_up()         # slept 0.6, budget remains
+        assert clk.now() == 0.6
+        assert sched.give_up()             # 0.6 + 0.6 would breach 1.0
+
+    def test_deadline_caps_the_schedule(self):
+        clk = ManualClock()
+        pol = RetryPolicy(backoffs=(0.5, 0.5), clock=clk)
+        sched = pol.schedule(Deadline(0.3, clock=clk))
+        assert sched.give_up()             # a 0.5s wait cannot fit 0.3s
+
+    def test_retry_after_is_a_floor(self):
+        clk = RecordingClock()
+        pol = RetryPolicy(backoffs=(0.1, 0.1), clock=clk)
+        sched = pol.schedule()
+        assert not sched.give_up(retry_after="2.5")   # header string ok
+        assert clk.sleeps == [2.5]
+
+    def test_call_retries_exceptions_then_raises(self):
+        clk = ManualClock()
+        calls = {"n": 0}
+
+        def flaky():
+            calls["n"] += 1
+            if calls["n"] < 3:
+                raise ValueError("transient")
+            return 42
+
+        assert RetryPolicy(max_attempts=5, clock=clk).call(flaky) == 42
+        assert calls["n"] == 3
+
+        def always():
+            raise ValueError("permanent")
+
+        with pytest.raises(ValueError):
+            RetryPolicy(max_attempts=2, clock=clk).call(always)
+
+
+# ---------------------------------------------------------------------------
+# Deadline
+# ---------------------------------------------------------------------------
+
+class TestDeadline:
+    def test_header_round_trip_and_expiry(self):
+        clk = ManualClock()
+        d = Deadline(1.5, clock=clk)
+        assert d.to_header() == "1500"
+        d2 = Deadline.from_headers({Deadline.HEADER: d.to_header()},
+                                   clock=clk)
+        assert abs(d2.remaining() - 1.5) < 1e-9
+        clk.advance(1.6)
+        assert d2.expired
+        with pytest.raises(DeadlineExceeded):
+            d2.check("unit test")
+
+    def test_absent_or_malformed_header_means_no_deadline(self):
+        assert Deadline.from_headers({}) is None
+        assert Deadline.from_headers({Deadline.HEADER: "soon"}) is None
+
+    def test_expired_deadline_encodes_zero(self):
+        clk = ManualClock()
+        d = Deadline(0.1, clock=clk)
+        clk.advance(5)
+        assert d.to_header() == "0"
+
+
+# ---------------------------------------------------------------------------
+# CircuitBreaker
+# ---------------------------------------------------------------------------
+
+class TestCircuitBreaker:
+    def test_full_cycle_on_injected_clock(self):
+        clk = ManualClock()
+        br = CircuitBreaker(failure_threshold=3, reset_timeout=10.0,
+                            clock=clk, name="dep")
+        assert br.state == "closed"
+        for _ in range(2):
+            br.record_failure()
+        assert br.state == "closed"        # below threshold
+        br.record_failure()
+        assert br.state == "open" and br.n_opened == 1
+        assert not br.allow()              # open: instant refusal
+        assert br.n_rejected == 1
+
+        clk.advance(10.0)
+        assert br.state == "half_open"
+        assert br.allow()                  # one probe admitted
+        assert not br.allow()              # concurrent probes bounded
+        br.record_failure()                # probe failed
+        assert br.state == "open"          # re-opened, timer restarted
+        assert not br.allow()
+
+        clk.advance(10.0)
+        assert br.allow()
+        br.record_success()                # probe succeeded
+        assert br.state == "closed"
+        assert br.allow()
+
+    def test_success_resets_the_failure_streak(self):
+        br = CircuitBreaker(failure_threshold=2, clock=ManualClock())
+        br.record_failure()
+        br.record_success()
+        br.record_failure()
+        assert br.state == "closed"        # 2 non-consecutive failures
+
+    def test_board_keys_and_states(self):
+        clk = ManualClock()
+        board = BreakerBoard(clock=clk, failure_threshold=1)
+        board.get("a").record_failure()
+        assert board.states() == {"a": "open"}
+        assert board.get("b").state == "closed"
+
+
+# ---------------------------------------------------------------------------
+# FaultPlan
+# ---------------------------------------------------------------------------
+
+class TestFaultPlan:
+    def test_scripted_schedule_and_counters(self):
+        plan = FaultPlan(script={"m": ["drop", "503", "delay:0.2", "ok",
+                                       "fail"]})
+        faults = [plan.at("m") for _ in range(7)]
+        assert [f.kind for f in faults] == [
+            "drop", "status", "delay", "ok", "fail", "ok", "ok"]
+        assert faults[1].status == 503
+        assert faults[2].delay == 0.2
+        s = plan.summary()
+        assert s["injected"]["m"] == {"drop": 1, "status": 1, "delay": 1,
+                                      "fail": 1}
+        assert s["calls"]["m"] == 7
+
+    def test_seeded_schedule_is_reproducible(self):
+        def seq(seed):
+            plan = FaultPlan(seed=seed,
+                             rates={"http": {"drop": 0.3, "status": 0.2}})
+            return [plan.at("http").kind for _ in range(50)]
+
+        assert seq(5) == seq(5)
+        assert seq(5) != seq(6)
+        assert "drop" in seq(5) and "ok" in seq(5)
+
+    def test_bad_spec_rejected(self):
+        with pytest.raises(ValueError, match="unknown fault"):
+            FaultPlan(script={"m": ["explode"]})
+        assert Fault.parse("429").status == 429
+
+
+# ---------------------------------------------------------------------------
+# Policy-driven HTTP handler under injected faults (no sockets at all)
+# ---------------------------------------------------------------------------
+
+class TestPolicyHandlerChaos:
+    def test_drops_and_5xx_are_retried_to_success(self):
+        clk = ManualClock()
+        plan = FaultPlan(script={"http": ["drop", "503"]})
+        sess = FaultySession(plan=plan, clock=clk)
+        pol = RetryPolicy(max_attempts=5, base=0.05, cap=1.0, seed=3,
+                          clock=clk)
+        resp = policy_handler(sess, HTTPRequestData(url="http://svc.test/x"),
+                              policy=pol)
+        assert resp.status_code == 200
+        assert sess.n_sent == 1            # only the clean attempt "sent"
+        assert plan.summary()["injected"]["http"] == {"drop": 1,
+                                                      "status": 1}
+        assert clk.now() > 0               # backoffs on the injected clock
+
+    def test_budget_exhaustion_returns_last_failure(self):
+        clk = ManualClock()
+        sess = FaultySession(plan=FaultPlan(script={"http": ["drop"] * 10}),
+                             clock=clk)
+        resp = policy_handler(
+            sess, HTTPRequestData(url="http://svc.test/x"),
+            policy=RetryPolicy(max_attempts=3, clock=clk))
+        assert resp.status_code == 0
+        assert "drop" in resp.reason
+
+    def test_per_host_breaker_opens_then_recovers(self):
+        clk = ManualClock()
+        plan = FaultPlan(script={"http": ["drop", "drop"]})
+        sess = FaultySession(plan=plan, clock=clk)
+        board = BreakerBoard(clock=clk, failure_threshold=2,
+                             reset_timeout=5.0)
+        client = HTTPClient(policy=RetryPolicy(max_attempts=1, clock=clk),
+                            breakers=board, session=sess)
+        reqs = [HTTPRequestData(url="http://down.test/a") for _ in range(3)]
+        resps = client.send(reqs)
+        assert [r.status_code for r in resps] == [0, 0, 0]
+        assert "circuit open" in resps[2].reason
+        assert plan.summary()["calls"]["http"] == 2   # 3rd never sent
+        assert board.get("down.test").state == "open"
+        clk.advance(5.0)                   # reset timeout elapses
+        ok = client.send([HTTPRequestData(url="http://down.test/a")])[0]
+        assert ok.status_code == 200       # half-open probe (script done)
+        assert board.get("down.test").state == "closed"
+
+    def test_budget_keeps_the_configured_handler_semantics(self):
+        # a deadline must NOT silently swap handler="basic" for the
+        # default retrying policy: a 500 through basic + budget comes
+        # back as-is, exactly once (regression: the budget= path once
+        # rerouted through RetryPolicy() and retried 5xx)
+        plan = FaultPlan(script={"http": ["500", "500", "500"]})
+        client = HTTPClient(handler=basic_handler,
+                            session=FaultySession(plan=plan))
+        resp = client.send([HTTPRequestData(url="http://svc.test/x")],
+                           deadline=Deadline(30.0))[0]
+        assert resp.status_code == 500
+        assert plan.summary()["calls"]["http"] == 1   # no retries
+
+    def test_deadline_bounds_the_exchange(self):
+        clk = ManualClock()
+        sess = FaultySession(plan=FaultPlan(script={"http": ["drop"] * 10}),
+                             clock=clk)
+        deadline = Deadline(0.2, clock=clk)
+        resp = policy_handler(
+            sess, HTTPRequestData(url="http://svc.test/x"),
+            policy=RetryPolicy(max_attempts=50, base=0.15, cap=0.15,
+                               clock=clk),
+            deadline=deadline)
+        assert resp.status_code == 0
+        assert clk.now() <= 0.5            # gave up near the budget
+
+
+# ---------------------------------------------------------------------------
+# Serving degradation: shedding, deadlines, health, drain
+# ---------------------------------------------------------------------------
+
+def _gated_doubler():
+    gate = threading.Event()
+    entered = threading.Event()
+    calls = []
+
+    class Gated(Transformer):
+        def transform(self, df):
+            calls.append(df.num_rows)
+            entered.set()
+            gate.wait(5)
+            return df.with_column(
+                "y", np.asarray(df["x"], dtype=np.float64) * 2)
+
+    return Gated(), gate, entered, calls
+
+
+def _post(srv, payload, out, key, headers=None):
+    def run():
+        out[key] = requests.post(srv.address, json=payload,
+                                 headers=headers or {}, timeout=10)
+    t = threading.Thread(target=run)
+    t.start()
+    return t
+
+
+class TestServingDegradation:
+    def test_queue_overflow_sheds_with_retry_after(self):
+        model, gate, entered, calls = _gated_doubler()
+        srv = ServingServer(model, max_batch_size=1, max_latency_ms=0,
+                            max_queue=2, shed_retry_after=0.25).start()
+        out = {}
+        try:
+            threads = [_post(srv, {"x": 1}, out, "a")]
+            entered.wait(5)                   # batch 1 is now in the model
+            threads.append(_post(srv, {"x": 2}, out, "b"))
+            threads.append(_post(srv, {"x": 3}, out, "c"))
+            wait_until(lambda: srv._queue.qsize() >= 2, what="queue full")
+            shed = requests.post(srv.address, json={"x": 4}, timeout=10)
+            assert shed.status_code == 429
+            assert shed.headers["Retry-After"] == "0.25"
+            assert shed.json() == {"error": "overloaded"}
+            gate.set()
+            for t in threads:
+                t.join()
+            assert {out[k].status_code for k in "abc"} == {200}
+            assert srv.n_shed == 1
+            base = srv.address.rsplit("/", 1)[0]
+            status = requests.get(f"{base}/status", timeout=10).json()
+            assert status["n_shed"] == 1 and status["max_queue"] == 2
+        finally:
+            gate.set()
+            srv.stop()
+
+    def test_replays_succeed_even_when_shedding(self):
+        # shedding must refuse NEW work only: a retry of a committed
+        # request costs no inference and returns its journaled reply
+        model, gate, entered, calls = _gated_doubler()
+        gate.set()                            # first request sails through
+        srv = ServingServer(model, max_batch_size=1, max_latency_ms=0,
+                            max_queue=1).start()
+        try:
+            h = {"X-Request-Id": "keep"}
+            r1 = requests.post(srv.address, json={"x": 5}, headers=h,
+                               timeout=10)
+            assert r1.status_code == 200
+            gate.clear()
+            entered.clear()
+            out = {}
+            t = _post(srv, {"x": 6}, out, "blocker")
+            entered.wait(5)
+            t2 = _post(srv, {"x": 7}, out, "queued")
+            wait_until(lambda: srv._queue.qsize() >= 1, what="queued")
+            shed = requests.post(srv.address, json={"x": 8}, timeout=10)
+            assert shed.status_code == 429    # new work refused...
+            replay = requests.post(srv.address, json={"x": 5}, headers=h,
+                                   timeout=10)
+            assert replay.status_code == 200  # ...replay still served
+            assert replay.headers.get("X-Replayed") == "1"
+            gate.set()
+            t.join()
+            t2.join()
+        finally:
+            gate.set()
+            srv.stop()
+
+    def test_deadline_expired_in_queue_is_504_without_dispatch(self):
+        clk = ManualClock()
+        model, gate, entered, calls = _gated_doubler()
+        srv = ServingServer(model, max_batch_size=1, max_latency_ms=0,
+                            clock=clk).start()
+        out = {}
+        try:
+            t1 = _post(srv, {"x": 1}, out, "slow")
+            entered.wait(5)                   # model busy with batch 1
+            t2 = _post(srv, {"x": 2}, out, "doomed",
+                       headers={"X-Deadline-Ms": "100"})
+            wait_until(lambda: srv._queue.qsize() >= 1, what="queued")
+            clk.advance(0.2)                  # its budget expires in queue
+            gate.set()
+            t1.join()
+            t2.join()
+            assert out["slow"].status_code == 200
+            assert out["doomed"].status_code == 504
+            assert "before dispatch" in out["doomed"].json()["error"]
+            assert sum(calls) == 1            # the model never saw it
+            assert srv.n_deadline_expired == 1
+        finally:
+            gate.set()
+            srv.stop()
+
+    def test_dead_on_arrival_deadline_is_504(self):
+        model, gate, entered, calls = _gated_doubler()
+        gate.set()
+        with ServingServer(model, max_latency_ms=0) as srv:
+            r = requests.post(srv.address, json={"x": 1},
+                              headers={"X-Deadline-Ms": "0"}, timeout=10)
+            assert r.status_code == 504
+            assert sum(calls) == 0
+            # an expired-deadline 504 is never journaled: a fresh-budget
+            # retry with the same rid executes for real
+            h = {"X-Request-Id": "doa", "X-Deadline-Ms": "0"}
+            assert requests.post(srv.address, json={"x": 1}, headers=h,
+                                 timeout=10).status_code == 504
+            ok = requests.post(srv.address, json={"x": 1},
+                               headers={"X-Request-Id": "doa"}, timeout=10)
+            assert ok.status_code == 200
+            assert "X-Replayed" not in ok.headers
+
+    def test_healthz_readyz_and_graceful_drain(self):
+        model, gate, entered, calls = _gated_doubler()
+        srv = ServingServer(model, max_batch_size=1,
+                            max_latency_ms=0).start()
+        base = srv.address.rsplit("/", 1)[0]
+        assert requests.get(f"{base}/healthz", timeout=10).status_code == 200
+        ready = requests.get(f"{base}/readyz", timeout=10)
+        assert ready.status_code == 200 and ready.json()["ready"]
+
+        out = {}
+        t = _post(srv, {"x": 9}, out, "inflight")
+        entered.wait(5)
+        stopper = threading.Thread(target=srv.stop)
+        stopper.start()
+        wait_until(srv._draining.is_set, what="draining")
+        # readiness flips BEFORE the listener goes away...
+        assert requests.get(f"{base}/readyz", timeout=10).status_code == 503
+        # ...new work is refused with a retry hint...
+        refused = requests.post(srv.address, json={"x": 10}, timeout=10)
+        assert refused.status_code == 503
+        assert "Retry-After" in refused.headers
+        gate.set()
+        stopper.join()
+        t.join()
+        # ...and the accepted request was answered, not dropped
+        assert out["inflight"].status_code == 200
+        assert out["inflight"].json() == {"y": 18.0}
+
+
+# ---------------------------------------------------------------------------
+# Exactly-once under injected model faults
+# ---------------------------------------------------------------------------
+
+class TestServingExactlyOnce:
+    def test_injected_model_fault_is_500_then_retry_commits_once(self):
+        calls = []
+
+        class Doubler(Transformer):
+            def transform(self, df):
+                calls.append(df.num_rows)
+                return df.with_column(
+                    "y", np.asarray(df["x"], dtype=np.float64) * 2)
+
+        plan = FaultPlan(script={"model": ["fail"]})
+        model = FaultyModel(Doubler(), plan)
+        with ServingServer(model, max_latency_ms=0) as srv:
+            h = {"X-Request-Id": "chaos-1"}
+            r1 = requests.post(srv.address, json={"x": 3}, headers=h,
+                               timeout=10)
+            assert r1.status_code == 500          # injected batch fault
+            r2 = requests.post(srv.address, json={"x": 3}, headers=h,
+                               timeout=10)
+            assert r2.status_code == 200          # errors not journaled
+            assert "X-Replayed" not in r2.headers
+            r3 = requests.post(srv.address, json={"x": 3}, headers=h,
+                               timeout=10)
+            assert r3.status_code == 200
+            assert r3.headers.get("X-Replayed") == "1"
+            assert r3.content == r2.content
+            assert sum(calls) == 1                # inference ran ONCE
+            assert model.n_transforms == 1
+            assert plan.summary()["injected"]["model"] == {"fail": 1}
+
+
+# ---------------------------------------------------------------------------
+# Client failover under worker death
+# ---------------------------------------------------------------------------
+
+def _counting_server(**kw):
+    calls = []
+
+    class Doubler(Transformer):
+        def transform(self, df):
+            calls.append(df.num_rows)
+            return df.with_column(
+                "y", np.asarray(df["x"], dtype=np.float64) * 2)
+
+    return ServingServer(Doubler(), max_latency_ms=0, **kw).start(), calls
+
+
+class TestServingClientFailover:
+    def test_worker_kill_fails_over_without_duplicate_side_effects(self):
+        coord = ServingCoordinator().start()
+        s1, calls1 = _counting_server()
+        s2, calls2 = _counting_server()
+        try:
+            curl = f"http://{coord.host}:{coord.port}"
+            for s in (s1, s2):
+                ServingCoordinator.register_worker(curl, s.host, s.port)
+            client = ServingClient(curl, timeout=5)
+            assert len(client._workers) == 2
+            for i in range(4):
+                assert client.predict({"x": i}) == {"y": 2.0 * i}
+            assert sum(calls1) + sum(calls2) == 4     # round-robined
+
+            s1.stop(drain=False)                      # worker dies
+            for i in range(4, 10):
+                assert client.predict({"x": i}) == {"y": 2.0 * i}
+            # every accepted request computed exactly once, no re-runs
+            assert sum(calls1) + sum(calls2) == 10
+            assert len(client._dead) == 1
+            assert client.n_failovers >= 1
+
+            # an idempotent duplicate after failover replays, not re-runs
+            before = sum(calls1) + sum(calls2)
+            assert client.predict({"x": 42}, request_id="dup-1") \
+                == {"y": 84.0}
+            assert client.predict({"x": 42}, request_id="dup-1") \
+                == {"y": 84.0}
+            assert sum(calls1) + sum(calls2) == before + 1
+        finally:
+            s2.stop()
+            coord.stop()
+
+    def test_worker_5xx_burst_fails_over_with_backoff(self):
+        coord = ServingCoordinator().start()
+        calls = []
+
+        class Doubler(Transformer):
+            def transform(self, df):
+                calls.append(df.num_rows)
+                return df.with_column(
+                    "y", np.asarray(df["x"], dtype=np.float64) * 2)
+
+        plan = FaultPlan(script={"model": ["fail", "fail"]})
+        bad, _ = ServingServer(FaultyModel(Doubler(), plan),
+                               max_latency_ms=0).start(), None
+        good, good_calls = _counting_server()
+        try:
+            curl = f"http://{coord.host}:{coord.port}"
+            ServingCoordinator.register_worker(curl, bad.host, bad.port)
+            ServingCoordinator.register_worker(curl, good.host, good.port)
+            client = ServingClient(
+                curl, timeout=5,
+                retry_policy=RetryPolicy(max_attempts=6, base=0.01,
+                                         cap=0.05))
+            for i in range(4):    # 5xx bursts ride the retry budget
+                assert client.predict({"x": i}) == {"y": 2.0 * i}
+        finally:
+            bad.stop()
+            good.stop()
+            coord.stop()
+
+    def test_budget_exhaustion_raises_with_cause(self):
+        coord = ServingCoordinator().start()
+        srv, _ = _counting_server()
+        try:
+            curl = f"http://{coord.host}:{coord.port}"
+            ServingCoordinator.register_worker(curl, srv.host, srv.port)
+            srv.stop(drain=False)            # the only worker is dead
+            client = ServingClient(
+                curl, timeout=2,
+                retry_policy=RetryPolicy(max_attempts=2, base=0.01,
+                                         cap=0.02))
+            with pytest.raises(RuntimeError, match="unreachable"):
+                client.predict({"x": 1})
+        finally:
+            coord.stop()
+
+
+# ---------------------------------------------------------------------------
+# Trainer: bounded restarts from the latest checkpoint
+# ---------------------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def blobs():
+    rng = np.random.default_rng(42)
+    n = 64
+    x0 = rng.normal(loc=-2.0, size=(n, 4)).astype(np.float32)
+    x1 = rng.normal(loc=+2.0, size=(n, 4)).astype(np.float32)
+    x = np.concatenate([x0, x1])
+    y = np.concatenate([np.zeros(n), np.ones(n)]).astype(np.int64)
+    perm = rng.permutation(len(x))
+    return DataFrame({"features": x[perm], "label": y[perm]})
+
+
+def _learner_cfg(**kw):
+    cfg = dict(arch={"builder": "mlp", "hidden": [8], "num_outputs": 2},
+               optimizer="adam", learning_rate=0.01, epochs=3,
+               batch_size=64, seed=11, log_every=0)
+    cfg.update(kw)
+    return cfg
+
+
+def _params_of(model):
+    import jax
+    return jax.device_get(model.model.params)
+
+
+@pytest.fixture(scope="module")
+def clean_params(blobs):
+    """The uninterrupted reference run (3 epochs x 2 steps = 6 steps),
+    shared by every parameter-equality assertion."""
+    from mmlspark_tpu.models.trainer import NNLearner
+    return _params_of(NNLearner(**_learner_cfg()).fit(blobs))
+
+
+class TestTrainerChaos:
+    def test_injected_step_fault_resumes_to_identical_params(
+            self, blobs, clean_params, tmp_path):
+        from mmlspark_tpu.models.trainer import NNLearner
+        import jax
+
+        fired = {"n": 0}
+
+        def fault(global_step):
+            if global_step == 5 and fired["n"] == 0:
+                fired["n"] += 1
+                raise InjectedFault("simulated preemption at step 5")
+
+        chaotic = NNLearner(**_learner_cfg(
+            checkpoint_dir=str(tmp_path / "ck"), checkpoint_every=2,
+            max_restarts=2, fault_injector=fault)).fit(blobs)
+
+        assert fired["n"] == 1                 # the fault really fired
+        diffs = jax.tree.map(lambda a, b: float(np.abs(a - b).max()),
+                             clean_params, _params_of(chaotic))
+        assert max(jax.tree_util.tree_leaves(diffs)) < 1e-6, \
+            "restart must reach the exact same params as an " \
+            "uninterrupted run (same shuffle stream, restored opt state)"
+
+    def test_fault_plan_hook_and_restart_exhaustion(self, blobs, tmp_path):
+        from mmlspark_tpu.models.trainer import NNLearner
+
+        plan = FaultPlan(script={"train_step": ["ok", "ok", "fail", "ok",
+                                                "ok", "fail", "fail",
+                                                "fail", "fail"]})
+        with pytest.raises(InjectedFault):
+            NNLearner(**_learner_cfg(
+                checkpoint_dir=str(tmp_path / "ck2"), checkpoint_every=2,
+                max_restarts=1,
+                fault_injector=plan.step_fault())).fit(blobs)
+        assert plan.summary()["injected"]["train_step"]["fail"] >= 2
+
+    def test_no_checkpointing_means_fail_fast(self, blobs):
+        from mmlspark_tpu.models.trainer import NNLearner
+
+        def fault(global_step):
+            raise InjectedFault("boom")
+
+        with pytest.raises(InjectedFault):
+            NNLearner(**_learner_cfg(max_restarts=5,
+                                     fault_injector=fault)).fit(blobs)
+
+    def test_checkpoint_write_fault_rides_the_restart_path(
+            self, blobs, clean_params, tmp_path, monkeypatch):
+        from mmlspark_tpu.models.trainer import NNLearner
+
+        plan = FaultPlan(script={"checkpoint": ["ok", "fail"]})
+        orig = NNLearner._checkpoint_manager
+
+        def faulty_mngr(self):
+            mngr = orig(self)
+            return FaultyCheckpointManager(mngr, plan) \
+                if mngr is not None else None
+
+        monkeypatch.setattr(NNLearner, "_checkpoint_manager", faulty_mngr)
+        chaotic = NNLearner(**_learner_cfg(
+            checkpoint_dir=str(tmp_path / "ck3"), checkpoint_every=2,
+            max_restarts=2)).fit(blobs)
+
+        import jax
+        assert plan.summary()["injected"]["checkpoint"] == {"fail": 1}
+        diffs = jax.tree.map(lambda a, b: float(np.abs(a - b).max()),
+                             clean_params, _params_of(chaotic))
+        assert max(jax.tree_util.tree_leaves(diffs)) < 1e-6

@@ -58,9 +58,10 @@ class TestManifests:
         assert env["COORDINATOR_URL"]["value"] == expected
         assert env["POD_IP"]["valueFrom"]["fieldRef"]["fieldPath"] \
             == "status.podIP"
-        # probes hit the server's real observability endpoint
-        assert c["readinessProbe"]["httpGet"]["path"] == "/status"
-        assert c["livenessProbe"]["httpGet"]["path"] == "/status"
+        # readiness must be the drain-aware endpoint (flips 503 while
+        # the pod still answers), liveness the bare process probe
+        assert c["readinessProbe"]["httpGet"]["path"] == "/readyz"
+        assert c["livenessProbe"]["httpGet"]["path"] == "/healthz"
 
 
 class TestRenderTool:

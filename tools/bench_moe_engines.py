@@ -30,24 +30,13 @@ import numpy as np  # noqa: E402
 
 
 def _engine(mode, h_rep, top, wf, E, C, dt):
+    # both engines come FROM the transformer: the A/B times exactly the
+    # dispatch code `_moe_capacity` runs, and cannot drift from it
     import mmlspark_tpu.models.transformer as TT
-    d = h_rep.shape[1]
     if mode == "sort":
         return TT._sorted_capacity_queues(h_rep.astype(dt), top, wf,
                                           E, C, dt)
-    onehot = jax.nn.one_hot(top, E, dtype=jnp.int32)
-    pos = jnp.cumsum(onehot, axis=0) * onehot - 1
-    slot = jnp.take_along_axis(pos, top[:, None], axis=1)[:, 0]
-    keep = slot < C
-    slot_c = jnp.where(keep, slot, C)
-    disp = jnp.zeros((E, C + 1, d), dt).at[top, slot_c].set(
-        h_rep.astype(dt))[:, :C]
-
-    def combine(y):
-        y = jnp.pad(y, ((0, 0), (0, 1), (0, 0)))
-        return y[top, slot_c] * (keep * wf)[:, None]
-
-    return disp, combine
+    return TT._scatter_capacity_queues(h_rep, top, wf, E, C, dt)
 
 
 def time_engine(mode: str, E: int, Tk: int = 16384, d: int = 512,
