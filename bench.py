@@ -574,11 +574,20 @@ def bench_serving_throughput():
     errors = [0] * n_clients
     with ServingServer(_identity_model(), max_latency_ms=2,
                        max_batch_size=128) as srv:
+        # dispatch every shape bucket once before the timed window, so
+        # the number is the pipelined plane's steady state (with a real
+        # jitted model this is where the compiles land); the recompile
+        # counter must then stay flat across the run
+        srv.warmup({"x": 0.0})
+        recompiles_warm = srv.n_recompiles
 
         def client(ci, deadline):
             conn = http.client.HTTPConnection(srv.host, srv.port,
                                               timeout=10)
-            body = json.dumps({"x": ci}).encode()
+            # float payloads, matching warmup(): the payload dtype is
+            # part of the dispatch shape (an int column would honestly
+            # be a different compiled executable)
+            body = json.dumps({"x": float(ci)}).encode()
             hdrs = {"Content-Type": "application/json"}
             while time.perf_counter() < deadline:
                 # a dead thread would silently undercount; every failed
@@ -622,6 +631,11 @@ def bench_serving_throughput():
             # clients and server share this host's cores: on a 1-core
             # dev box the number is a floor, not the stack's ceiling
             "host_cores": cores,
+            "pipeline": srv.pipeline, "bucket_batches": srv.bucket_batches,
+            # 0 = the bucketed plane never retraced after warm-up
+            # (tools/bench_serving_pipeline.py asserts this under
+            # varying-batch-size load)
+            "recompiles_after_warmup": srv.n_recompiles - recompiles_warm,
             "baseline": baseline,
             "vs_baseline": round(rps / baseline, 3), "chip": _chip()}
 

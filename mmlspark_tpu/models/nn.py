@@ -25,7 +25,8 @@ from mmlspark_tpu.core.stage import Model
 from mmlspark_tpu.core import schema
 from mmlspark_tpu.models.function import NNFunction
 from mmlspark_tpu.parallel import (
-    build_mesh, batch_sharding, replicated_sharding, pad_to_multiple, unpad,
+    build_mesh, batch_sharding, replicated_sharding, padded_device_batch,
+    unpad,
 )
 
 
@@ -341,9 +342,11 @@ class NNModel(Model, HasInputCol, HasOutputCol):
             store = [] if store_this_pass else None
             for start in range(0, n_rows, bs):
                 chunk = x[start:start + bs]
-                padded, n = pad_to_multiple(chunk, bs)
-                if store is not None or in_sharding is not None:
-                    padded = _device_put(padded, placement)
+                padded, n = padded_device_batch(
+                    chunk, bs,
+                    placement=(placement if store is not None
+                               or in_sharding is not None else None),
+                    put=_device_put)
                 if store is not None:
                     store.append((padded, n))
                 yield padded, n
@@ -385,11 +388,10 @@ class NNModel(Model, HasInputCol, HasOutputCol):
             if x.ndim > 1:
                 # same dtype as real batches, or this compiles a second
                 # (float32-input) variant of the forward just for width
-                dummy, _ = pad_to_multiple(
+                dummy, _ = padded_device_batch(
                     np.zeros((1, *x.shape[1:]), self._transfer_dtype()),
-                    max(n_shards, 1))
-                if in_sharding is not None:
-                    dummy = jax.device_put(dummy, in_sharding)
+                    max(n_shards, 1), placement=in_sharding,
+                    put=_device_put)
                 width_out = np.asarray(self._jitted(params, dummy))
                 result = np.zeros((0, *width_out.shape[1:]), dtype=np.float32)
             else:

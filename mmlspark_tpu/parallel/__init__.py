@@ -6,10 +6,12 @@ from mmlspark_tpu.parallel.topology import (
 )
 from mmlspark_tpu.parallel.sharding import (
     batch_sharding,
+    bucket_target,
     replicated_sharding,
     named_sharding,
     pad_to_bucket,
     pad_to_multiple,
+    padded_device_batch,
     shard_batch,
     unpad,
 )
@@ -40,8 +42,10 @@ __all__ = [
     "batch_sharding",
     "replicated_sharding",
     "named_sharding",
+    "bucket_target",
     "pad_to_bucket",
     "pad_to_multiple",
+    "padded_device_batch",
     "shard_batch",
     "unpad",
 ]

@@ -29,43 +29,99 @@ def replicated_sharding(mesh):
     return NamedSharding(mesh, PartitionSpec())
 
 
+def _pad_axis(arr: np.ndarray, extra: int, axis: int, pad_value,
+              pad_mode: str) -> np.ndarray:
+    widths = [(0, 0)] * arr.ndim
+    widths[axis] = (0, extra)
+    if pad_mode == "edge" and arr.shape[axis] > 0:
+        # repeat the last row: stays valid for object/string columns and
+        # for models that choke on all-zero rows (serving pad policy)
+        return np.pad(arr, widths, mode="edge")
+    return np.pad(arr, widths, constant_values=pad_value)
+
+
 def pad_to_multiple(arr: np.ndarray, multiple: int,
-                    axis: int = 0, pad_value=0) -> Tuple[np.ndarray, int]:
+                    axis: int = 0, pad_value=0,
+                    pad_mode: str = "constant") -> Tuple[np.ndarray, int]:
     """Pad ``axis`` up to a multiple (XLA needs static, divisible shapes).
 
     Returns (padded, original_length). The padding strategy for ragged
     batch tails — chosen once here, used by every engine (SURVEY.md §7
-    "dynamic shapes vs XLA" risk).
+    "dynamic shapes vs XLA" risk). ``pad_mode="edge"`` repeats the last
+    row instead of writing ``pad_value`` (valid for any dtype, including
+    object columns).
     """
     n = arr.shape[axis]
     target = ((n + multiple - 1) // multiple) * multiple
     if target == n:
         return arr, n
-    widths = [(0, 0)] * arr.ndim
-    widths[axis] = (0, target - n)
-    return np.pad(arr, widths, constant_values=pad_value), n
+    return _pad_axis(arr, target - n, axis, pad_value, pad_mode), n
 
 
 def pad_to_bucket(arr: np.ndarray, cap: int = 1024,
-                  axis: int = 0, pad_value=0) -> Tuple[np.ndarray, int]:
+                  axis: int = 0, pad_value=0,
+                  pad_mode: str = "constant") -> Tuple[np.ndarray, int]:
     """Pad ``axis`` to a bounded shape bucket for jit shape-cache reuse.
 
-    Small inputs round up to the next power of two (few distinct compiled
-    shapes for serving micro-batches of assorted sizes); inputs past
-    ``cap`` pad to a multiple of ``cap`` instead, bounding the waste for
-    large offline batches at ``cap - 1`` rows.
+    Small inputs round up to the next power of two, clamped at ``cap``
+    (few distinct compiled shapes for serving micro-batches of assorted
+    sizes, and never a dispatch larger than the operator's ceiling);
+    inputs past ``cap`` pad to a multiple of ``cap`` instead, bounding
+    the waste for large offline batches at ``cap - 1`` rows.
     """
     n = arr.shape[axis]
     if n > cap:
-        return pad_to_multiple(arr, cap, axis=axis, pad_value=pad_value)
+        return pad_to_multiple(arr, cap, axis=axis, pad_value=pad_value,
+                               pad_mode=pad_mode)
+    if n == 0:  # empty inputs still bucket to one row (a real jit shape)
+        return _pad_axis(arr, 1, axis, pad_value, "constant"), 0
+    return pad_to_multiple(arr, bucket_target(n, cap), axis=axis,
+                           pad_value=pad_value, pad_mode=pad_mode)
+
+
+def bucket_target(n: int, cap: int = 1024) -> int:
+    """The bucket a batch of ``n`` rows pads to: next power of two,
+    clamped at ``cap`` (a batch within the cap never pads past it —
+    ``cap`` is an operator ceiling, e.g. a serving memory budget); above
+    ``cap``, the next multiple of ``cap``. The single bucket policy
+    behind :func:`pad_to_bucket`, serving's shape-bucketed data plane,
+    and :class:`mmlspark_tpu.stages.batching.BucketBatcher` — one ladder,
+    so every layer warms the same compiled shapes."""
+    if n <= 0:
+        return 1
+    if n > cap:
+        return ((n + cap - 1) // cap) * cap
     target = 1
     while target < n:
         target *= 2
-    if n == 0:  # empty inputs still bucket to one row (a real jit shape)
-        widths = [(0, 0)] * arr.ndim
-        widths[axis] = (0, 1)
-        return np.pad(arr, widths, constant_values=pad_value), 0
-    return pad_to_multiple(arr, target, axis=axis, pad_value=pad_value)
+    return min(target, cap)
+
+
+def padded_device_batch(chunk: np.ndarray, size: int, placement=None,
+                        put=None, bucket: bool = False, axis: int = 0,
+                        pad_value=0, pad_mode: str = "constant",
+                        ) -> Tuple[Any, int]:
+    """Pad a batch to its static shape and (optionally) place it on device.
+
+    The one helper behind every ragged-tail call site: NNModel's scoring
+    minibatches and its empty-input width probe (``size`` = the static
+    minibatch), and the serving data plane's shape buckets
+    (``bucket=True``, ``size`` = the bucket cap). Returns
+    ``(padded, original_length)``; when ``placement`` is given the padded
+    array is uploaded via ``put`` (default :func:`jax.device_put`).
+    """
+    if bucket:
+        padded, n = pad_to_bucket(chunk, cap=size, axis=axis,
+                                  pad_value=pad_value, pad_mode=pad_mode)
+    else:
+        padded, n = pad_to_multiple(chunk, size, axis=axis,
+                                    pad_value=pad_value, pad_mode=pad_mode)
+    if placement is not None:
+        if put is None:
+            import jax
+            put = jax.device_put
+        padded = put(padded, placement)
+    return padded, n
 
 
 def unpad(arr, n: int, axis: int = 0):

@@ -21,8 +21,22 @@ Environment:
                    coordinator; defaults to the local hostname
   MAX_BATCH_SIZE / MAX_LATENCY_MS / JOURNAL_SIZE / JOURNAL_TTL /
   MAX_QUEUE        (worker, optional) ServingServer knobs (MAX_QUEUE
-                   bounds the batching queue: beyond it new requests
-                   shed with 429 + Retry-After, see docs/resilience.md)
+                   bounds the accepted-request backlog: beyond it new
+                   requests shed with 429 + Retry-After, see
+                   docs/resilience.md)
+  PIPELINE / BUCKET_BATCHES / ENCODER_THREADS
+                   (worker, optional) data-plane knobs: PIPELINE=0
+                   falls back to the serial plane, BUCKET_BATCHES=0
+                   disables shape-bucket padding (models then see exact
+                   live batch sizes, at the cost of per-size jit
+                   retraces), ENCODER_THREADS sizes the reply-encoder
+                   pool — see docs/serving.md "The data plane"
+  WARMUP_PAYLOAD   (worker, optional) a JSON example payload; when set,
+                   the worker dispatches one synthetic batch per shape
+                   bucket (ServingServer.warmup) BEFORE registering
+                   with the coordinator, so no live request ever pays a
+                   jit compile — without it the first request at each
+                   bucket size traces on the serving path
   JOURNAL_PATH     (worker, optional) durable replay-journal file (any
                    io.fs path — mount a PVC and point this at it, or
                    gs://...): committed replies survive pod restarts,
@@ -70,7 +84,21 @@ def run_worker() -> None:
         journal_size=int(_env_float("JOURNAL_SIZE", 4096)),
         journal_ttl=ttl if ttl > 0 else None,
         journal_path=os.environ.get("JOURNAL_PATH") or None,
-        max_queue=int(_env_float("MAX_QUEUE", 1024))).start()
+        max_queue=int(_env_float("MAX_QUEUE", 1024)),
+        pipeline=_env_float("PIPELINE", 1) != 0,
+        bucket_batches=_env_float("BUCKET_BATCHES", 1) != 0,
+        encoder_threads=int(_env_float("ENCODER_THREADS", 2)))
+    warm = os.environ.get("WARMUP_PAYLOAD")
+    if warm:
+        # warm BEFORE start(): the socket is already bound (early
+        # connects sit in the accept backlog), but no handler/executor
+        # thread is live yet, so warmup's model calls can never run
+        # concurrently with a real dispatch — and every bucket is
+        # compiled before the first request is read
+        import json as _json
+        sizes = srv.warmup(_json.loads(warm))
+        print(f"[serving] warmed buckets {sizes}", flush=True)
+    srv.start()
     print(f"[serving] worker serving {uri} on :{srv.port}", flush=True)
 
     coord_url = os.environ.get("COORDINATOR_URL")

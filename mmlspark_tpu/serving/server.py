@@ -1,4 +1,4 @@
-"""Serving: HTTP frontend -> batching queue -> jitted inference -> replies.
+"""Serving: HTTP frontend -> pipelined data plane -> jitted inference -> replies.
 
 Capability parity with Spark Serving (`HTTPSourceV2.scala:50,178,272`,
 `HTTPSinkV2.scala:20-106`, `DistributedHTTPSource.scala:89,244`,
@@ -8,6 +8,29 @@ requests are micro-batched into a columnar frame, pushed through any
 fitted Transformer (whose own jitted/sharded forward runs on TPU), and
 answered from the output columns. Request identity -> reply routing is
 the in-process equivalent of the reference's exchange-id state holder.
+
+The data plane is a staged pipeline (the TPU-side analogue of the
+reference's micro-batch assembly overlapping engine execution):
+
+1. **collect + assemble** — drain the request queue into a micro-batch,
+   run deadline check #1, build the columnar frame directly from the
+   payloads (no per-row dict round-trip for homogeneous JSON objects),
+   and pad it up to a power-of-two **shape bucket**
+   (:func:`mmlspark_tpu.parallel.sharding.pad_to_bucket`), so
+   steady-state traffic dispatches a fixed set of compiled shapes and
+   the jitted forward never retraces;
+2. **dispatch** — push the bucketed frame through the model and hand the
+   output straight to the encoders, so host work for batch N+1 overlaps
+   model execution for batch N;
+3. **encode + commit** — unpad, select ``reply_cols``, JSON-encode
+   (columnar fast path for scalar reply columns), run deadline check #2,
+   and commit replies/journal exactly as the serial plane did.
+
+``pipeline=False`` runs the same three stages inline on one thread (the
+pre-pipeline behavior; also the A/B baseline for
+``tools/bench_serving_pipeline.py``). Per-stage wall-clock timings and a
+recompile counter (new dispatch shapes seen) are exported via
+``GET /stats``.
 
 Multi-host: workers register with a :class:`ServingCoordinator` (parity:
 DriverServiceUtils' coordination server, `HTTPSourceV2.scala:111-167`).
@@ -22,13 +45,15 @@ import time
 import uuid
 from collections import OrderedDict
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
-from queue import Empty, Queue
+from queue import Empty, Full, Queue
 from typing import Any, Dict, List, Optional, Tuple
 
 import numpy as np
 
 from mmlspark_tpu.core.dataframe import DataFrame
 from mmlspark_tpu.core.logs import get_logger
+from mmlspark_tpu.core.profiling import StageTimings
+from mmlspark_tpu.parallel.sharding import bucket_target, padded_device_batch
 from mmlspark_tpu.core.resilience import (
     SYSTEM_CLOCK, BreakerBoard, Clock, Deadline, DeadlineExceeded,
     RetryPolicy,
@@ -47,12 +72,26 @@ class _Server(ThreadingHTTPServer):
     daemon_threads = True
 
 
+# anonymous request ids: a process-unique random prefix + a counter.
+# uuid4() costs an os.urandom syscall per request — pure overhead for
+# requests that never supplied an X-Request-Id (their rid only keys the
+# in-flight table, never crosses the wire)
+import itertools
+
+_RID_PREFIX = uuid.uuid4().hex[:16]
+_RID_COUNTER = itertools.count()    # .__next__ is atomic under the GIL
+
+#: cap on remembered dispatch shapes (recompile dedup / /stats evidence);
+#: a healthy bucketed worker uses ~log2(max_batch_size) of these
+_MAX_SHAPES_TRACKED = 1024
+
+
 class _PendingRequest:
     __slots__ = ("rid", "payload", "event", "reply", "status", "deadline")
 
     def __init__(self, payload: Any, rid: Optional[str] = None,
                  deadline: Optional[Deadline] = None):
-        self.rid = rid or uuid.uuid4().hex
+        self.rid = rid or f"{_RID_PREFIX}-{next(_RID_COUNTER):x}"
         self.payload = payload
         self.event = threading.Event()
         self.reply: Optional[bytes] = None
@@ -79,6 +118,10 @@ class ServingServer:
                  idle_timeout: Optional[float] = 60.0,
                  max_queue: int = 1024,
                  shed_retry_after: float = 0.1,
+                 pipeline: bool = True,
+                 bucket_batches: bool = True,
+                 encoder_threads: int = 2,
+                 max_inflight_batches: int = 2,
                  clock: Clock = SYSTEM_CLOCK):
         self.model = model
         self.api_path = api_path
@@ -86,6 +129,34 @@ class ServingServer:
         self.max_latency_ms = float(max_latency_ms)
         self.reply_cols = reply_cols
         self.request_timeout = request_timeout
+        # -- data plane: with ``pipeline`` (the default) collection,
+        # model dispatch, and reply encoding run as separate stages on
+        # their own threads, so host JSON/frame work for batch N+1
+        # overlaps model execution for batch N. ``bucket_batches`` pads
+        # every live batch up to the shared power-of-two bucket ladder
+        # (pad_to_bucket) so steady-state traffic hits a fixed set of
+        # compiled executables: models see padded row counts; replies
+        # are always unpadded. ``max_inflight_batches`` bounds the
+        # pipeline depth (backpressure to the collector), and
+        # ``encoder_threads`` sizes the reply-encoder pool.
+        self.pipeline = bool(pipeline)
+        self.bucket_batches = bool(bucket_batches)
+        self.encoder_threads = max(int(encoder_threads), 1)
+        self.max_inflight_batches = max(int(max_inflight_batches), 1)
+        self.timings = StageTimings()
+        self.n_recompiles = 0
+        self._shapes_seen: set = set()
+        self._stats_lock = threading.Lock()
+        # accepted-but-undispatched request count: the overload signal.
+        # The ingress queue alone no longer measures backlog — the
+        # pipelined collector drains it into the dispatch stage — so
+        # shedding counts every request that has been accepted but has
+        # not yet entered the model (ingress queue + staged batches).
+        self._n_backlog = 0
+        self._dispatch_q: "Queue[dict]" = Queue(
+            maxsize=self.max_inflight_batches)
+        self._encode_q: "Queue[dict]" = Queue(
+            maxsize=2 * self.max_inflight_batches)
         # None (stdlib idiom) and <= 0 both mean "no keep-alive reap"
         self.idle_timeout = (float(idle_timeout)
                              if idle_timeout is not None else 0.0)
@@ -186,6 +257,24 @@ class ServingServer:
             timeout = (serving.idle_timeout
                        if serving.idle_timeout > 0 else None)
 
+            # the Date header is formatted per reply by the stdlib
+            # (strftime + tuple math); at thousands of replies/sec that
+            # is real CPU for a value that changes once a second
+            _date_cache = [0.0, ""]
+
+            def date_time_string(self, timestamp=None):
+                if timestamp is not None:
+                    return super().date_time_string(timestamp)
+                cache = type(self)._date_cache
+                now = time.time()
+                if now - cache[0] >= 1.0:
+                    # value BEFORE timestamp: a concurrent reader that
+                    # sees the fresh timestamp must never read the old
+                    # (or startup-empty) string
+                    cache[1] = super().date_time_string(now)
+                    cache[0] = now
+                return cache[1]
+
             def _reply(self, status: int, body: bytes, replayed=False,
                        window_missed=False, retry_after=None):
                 self.send_response(status)
@@ -197,8 +286,19 @@ class ServingServer:
                 if retry_after is not None:
                     self.send_header("Retry-After", str(retry_after))
                 self.send_header("Content-Length", str(len(body)))
-                self.end_headers()
-                self.wfile.write(body)
+                # one write for status+headers+body: Nagle is disabled,
+                # so the stdlib's separate end_headers()/body writes
+                # would leave as separate packets. HTTP/0.9 requests
+                # (e.g. `nc`-style probes) never get a headers buffer —
+                # fall back to the stdlib path for them
+                buf = getattr(self, "_headers_buffer", None)
+                if buf:
+                    buf.append(b"\r\n")
+                    self.wfile.write(b"".join(buf) + body)
+                    self._headers_buffer = []
+                else:
+                    self.end_headers()
+                    self.wfile.write(body)
 
             def do_GET(self):
                 if self.path == "/healthz":
@@ -215,9 +315,33 @@ class ServingServer:
                                          b'"reason": "draining"}')
                         return
                     body = {"ready": True,
-                            "queue_depth": serving._queue.qsize(),
+                            "queue_depth": serving.backlog(),
                             "max_queue": serving.max_queue}
                     self._reply(200, json.dumps(body).encode())
+                    return
+                if self.path == "/stats":
+                    # data-plane observability: per-stage timings, the
+                    # bucket set actually dispatched, and the recompile
+                    # counter (a dispatch shape seen for the first time
+                    # forces a trace/compile in any jitted model) — the
+                    # evidence that the bucketed pipeline holds a fixed
+                    # compiled-shape set after warm-up
+                    with serving._stats_lock:
+                        stats = {
+                            "pipeline": serving.pipeline,
+                            "bucket_batches": serving.bucket_batches,
+                            "encoder_threads": serving.encoder_threads,
+                            "n_batches": serving.n_batches,
+                            "n_requests": serving.n_requests,
+                            "n_recompiles": serving.n_recompiles,
+                            "dispatch_sizes": sorted(
+                                {k[0] for k in serving._shapes_seen}),
+                            "inflight_batches": serving._active_batches,
+                            "queue_depth": serving._n_backlog,
+                            "stage_timings":
+                                serving.timings.snapshot(),
+                        }
+                    self._reply(200, json.dumps(stats).encode())
                     return
                 if self.path != "/status":
                     self.send_error(404)
@@ -231,7 +355,7 @@ class ServingServer:
                         "n_window_missed": serving.n_window_missed,
                         "n_shed": serving.n_shed,
                         "n_deadline_expired": serving.n_deadline_expired,
-                        "queue_depth": serving._queue.qsize(),
+                        "queue_depth": serving.backlog(),
                         "max_queue": serving.max_queue,
                         "draining": serving._draining.is_set(),
                         "journal_entries": len(serving._journal),
@@ -331,14 +455,17 @@ class ServingServer:
                     # request_timeout
                     pending.status = 504
                     pending.reply = b'{"error": "deadline exceeded"}'
-                    with serving._commit_lock:
+                    with serving._stats_lock:
                         serving.n_deadline_expired += 1
+                    with serving._commit_lock:
                         serving._inflight.pop(pending.rid, None)
                     pending.event.set()
                     self._reply(504, pending.reply)
                     return
 
                 if enqueue:
+                    with serving._stats_lock:
+                        serving._n_backlog += 1
                     serving._queue.put(pending)
                 if not pending.event.wait(serving.request_timeout):
                     self.send_error(504, "inference timed out")
@@ -357,15 +484,27 @@ class ServingServer:
 
     # -- batching loop -------------------------------------------------------
 
+    def backlog(self) -> int:
+        """Requests accepted but not yet dispatched into the model."""
+        with self._stats_lock:
+            return self._n_backlog
+
     def _overloaded(self) -> bool:
-        return self.max_queue > 0 and \
-            self._queue.qsize() >= self.max_queue
+        return self.max_queue > 0 and self.backlog() >= self.max_queue
 
     def _collect_batch(self) -> List[_PendingRequest]:
         try:
             first = self._queue.get(timeout=0.05)
         except Empty:
             return []
+        # the "collect" span starts at the FIRST request, so /stats
+        # reports the batch-mate gathering window (real latency cost),
+        # not the idle 0.05s polls of an unloaded server
+        with self.timings.span("collect"):
+            return self._collect_rest(first)
+
+    def _collect_rest(self, first: _PendingRequest
+                      ) -> List[_PendingRequest]:
         batch = [first]
         if self.max_latency_ms <= 0:
             # latency-first mode: take whatever is already queued and
@@ -393,52 +532,217 @@ class ServingServer:
         p.status = 504
         p.reply = json.dumps(
             {"error": f"deadline exceeded {where}"}).encode()
-        self.n_deadline_expired += 1
+        # under the stats lock: _expire now runs concurrently from the
+        # collector, executor, AND encoder-pool threads
+        with self._stats_lock:
+            self.n_deadline_expired += 1
         self._commit(p)
 
-    def _serve_batch(self, batch: List[_PendingRequest]) -> None:
-        # deadline check #1 — before dispatch: a request whose budget
-        # expired while queued must not occupy a batch slot or run
-        # through the model at all
+    # -- data plane stages ---------------------------------------------------
+    #
+    # Each batch travels through three stage functions; the pipelined
+    # plane runs them on separate threads (collector -> executor ->
+    # encoder pool), the serial plane (pipeline=False) runs them inline.
+    # A batch is a "job" dict: {"batch_n": total collected, "live":
+    # not-yet-expired requests, "df": the (bucket-padded) frame,
+    # "n_live": true row count, "out": model output, "error": the
+    # failure that 500s the batch}.
+
+    def _filter_expired(self, requests: List[_PendingRequest]
+                        ) -> List[_PendingRequest]:
+        """Deadline check #1: 504 the expired, return the survivors."""
         live = []
-        for p in batch:
+        for p in requests:
             if p.deadline is not None and p.deadline.expired:
                 self._expire(p, "before dispatch")
             else:
                 live.append(p)
-        try:
-            if live:
-                rows = [p.payload if isinstance(p.payload, dict) else
-                        {"value": p.payload} for p in live]
-                df = DataFrame.from_rows(rows)
-                out = self.model.transform(df)
-                if out.num_rows != len(live):
+        return live
+
+    def _refresh_live(self, job: dict,
+                      requests: List[_PendingRequest]) -> dict:
+        """Deadline check #1 over ``requests`` + (re)assembly of the
+        job's frame — the shared body of _stage_prepare and the
+        dispatch-time re-check."""
+        live = self._filter_expired(requests)
+        job["live"], job["n_live"] = live, len(live)
+        job["df"] = None
+        if live:
+            try:
+                with self.timings.span("assemble"):
+                    job["df"] = self._assemble_frame(live)
+            except Exception as e:  # noqa: BLE001 — bad payloads -> 500s
+                job["error"] = e
+        return job
+
+    def _stage_prepare(self, batch: List[_PendingRequest]) -> dict:
+        """Stage 1 (collector): deadline check #1 — before dispatch: a
+        request whose budget expired while queued must not occupy a
+        batch slot or run through the model at all — then columnar
+        frame assembly + shape-bucket padding."""
+        job = {"batch_n": len(batch), "live": [], "n_live": 0,
+               "df": None, "out": None, "error": None}
+        return self._refresh_live(job, batch)
+
+    def _assemble_frame(self, live: List[_PendingRequest]) -> DataFrame:
+        """Payloads -> columnar frame, padded up to the shared bucket.
+
+        ``DataFrame.from_rows`` builds one list per column straight off
+        the payload dicts (heterogeneous key sets raise -> batch 500,
+        the framework-wide row-assembly policy). With ``bucket_batches`` every
+        column is edge-padded (repeat last row: valid for object/string
+        columns) to the power-of-two bucket, so any live batch size maps
+        onto a bounded set of dispatch shapes.
+        """
+        payloads = [p.payload if isinstance(p.payload, dict)
+                    else {"value": p.payload} for p in live]
+        df = DataFrame.from_rows(payloads)
+        if self.bucket_batches and df.columns:
+            df = DataFrame({
+                n: padded_device_batch(df[n], self.max_batch_size,
+                                       bucket=True, pad_mode="edge")[0]
+                for n in df.columns})
+        return df
+
+    def _stage_dispatch(self, job: dict) -> dict:
+        """Stage 2 (executor): push the bucketed frame through the
+        model. New dispatch shapes are counted as recompiles (any jitted
+        model retraces exactly when the input shape set grows)."""
+        with self._stats_lock:
+            self._n_backlog -= job["batch_n"]
+        # deadline check #1 runs twice on the pipelined plane: once at
+        # collection (cheap early filter, saves the assembly) and again
+        # HERE, at true dispatch time — a request can expire while its
+        # batch waits behind a slow model, and it must still never reach
+        # the model. Only the (rare) expiry case pays a re-assembly.
+        if job["error"] is None and any(
+                p.deadline is not None and p.deadline.expired
+                for p in job["live"]):
+            self._refresh_live(job, job["live"])
+        df = job["df"]
+        if job["error"] is None and df is not None:
+            try:
+                key = (df.num_rows, tuple(sorted(df.schema().items())))
+                with self._stats_lock:
+                    if key not in self._shapes_seen:
+                        self.n_recompiles += 1
+                        # bounded: adversarial/heterogeneous schemas
+                        # (a new field name per request) must not grow
+                        # a long-lived worker's memory without limit —
+                        # past the cap, new shapes still count as
+                        # recompiles but are no longer remembered
+                        if len(self._shapes_seen) < _MAX_SHAPES_TRACKED:
+                            self._shapes_seen.add(key)
+                with self.timings.span("dispatch"):
+                    out = self.model.transform(df)
+                # df.num_rows < n_live only for degenerate frames (e.g.
+                # empty-object payloads -> a zero-column frame): still a
+                # row-count error, never a silent short batch
+                if out.num_rows != df.num_rows \
+                        or df.num_rows < job["n_live"]:
                     raise RuntimeError(
                         f"model returned {out.num_rows} rows for a "
-                        f"{len(live)}-request batch; serving models must "
-                        f"preserve row count")
-                cols = self.reply_cols or \
-                    [c for c in out.columns if c not in df.columns]
-                replies = []
-                for row in out.select(cols).rows():
-                    replies.append(json.dumps(_jsonify(row)).encode())
-                for p, r in zip(live, replies):
-                    # deadline check #2 — before commit: the client is
-                    # already gone, so the reply must not be journaled
-                    # as a committed (replayable) result
-                    if p.deadline is not None and p.deadline.expired:
-                        self._expire(p, "before commit")
-                        continue
-                    p.reply = r
-                    self._commit(p)
-        except Exception as e:  # noqa: BLE001 — any model failure -> 500s
-            err = json.dumps({"error": str(e)}).encode()
+                        f"{df.num_rows}-row dispatch ({job['n_live']} live "
+                        f"requests); serving models must preserve row "
+                        f"count")
+                job["out"] = out
+            except Exception as e:  # noqa: BLE001 — model failure -> 500s
+                job["error"] = e
+        return job
+
+    def _encode_replies(self, out: DataFrame, in_cols: List[str],
+                        n_live: int) -> List[bytes]:
+        """Unpad, select reply columns, JSON-encode. Scalar (1-D
+        numeric/bool) reply columns take the columnar fast path: one
+        ``tolist`` per column, plain-python dict per row — no per-row
+        numpy-scalar round trip."""
+        cols = self.reply_cols or \
+            [c for c in out.columns if c not in in_cols]
+        sub = out.select(cols)       # raises on missing reply_cols
+        if not cols:
+            return [b"{}"] * n_live
+        arrays = [sub[c] for c in cols]
+        if all(a.ndim == 1 and a.dtype.kind in "fiub" for a in arrays):
+            lists = [a[:n_live].tolist() for a in arrays]
+            return [json.dumps(dict(zip(cols, vals))).encode()
+                    for vals in zip(*lists)]
+        replies = []
+        for i in range(n_live):
+            row = {c: a[i] for c, a in zip(cols, arrays)}
+            replies.append(json.dumps(_jsonify(row)).encode())
+        return replies
+
+    def _stage_finish(self, job: dict) -> None:
+        """Stage 3 (encoder): encode replies, deadline check #2, commit."""
+        live = job["live"]
+        with self._stats_lock:
+            self.n_batches += 1
+            self.n_requests += job["batch_n"]
+        if not live:
+            return
+        replies = None
+        if job["error"] is None:
+            try:
+                with self.timings.span("encode"):
+                    replies = self._encode_replies(
+                        job["out"], job["df"].columns, job["n_live"])
+            except Exception as e:  # noqa: BLE001 — encode failure -> 500s
+                job["error"] = e
+        if job["error"] is not None:
+            err = json.dumps({"error": str(job["error"])}).encode()
             for p in live:
                 p.status = 500
                 p.reply = err
-                self._commit(p)
-        self.n_batches += 1
-        self.n_requests += len(batch)
+            self._commit_many(live)
+            return
+        to_commit = []
+        for p, r in zip(live, replies):
+            # deadline check #2 — before commit: the client is already
+            # gone, so the reply must not be journaled as a committed
+            # (replayable) result
+            if p.deadline is not None and p.deadline.expired:
+                self._expire(p, "before commit")
+                continue
+            p.reply = r
+            to_commit.append(p)
+        self._commit_many(to_commit)
+
+    def _serve_batch(self, batch: List[_PendingRequest]) -> None:
+        """The serial plane: all three stages inline (pipeline=False;
+        also the semantic reference the pipelined plane must match)."""
+        self._stage_finish(self._stage_dispatch(self._stage_prepare(batch)))
+
+    def warmup(self, payload: Any,
+               sizes: Optional[List[int]] = None) -> List[int]:
+        """Dispatch one synthetic batch per shape bucket, serially, in
+        the calling thread — after this, steady-state traffic with the
+        same payload schema never grows the compiled-shape set (the
+        ``n_recompiles`` counter in ``GET /stats`` stays flat).
+
+        Call it before exposing the worker to traffic — ideally before
+        ``start()`` (the listen socket is bound at construction, so
+        early connections just queue in the accept backlog): every jit
+        executable then exists before the first real request pays a
+        compile, and the model never runs concurrently with a live
+        dispatch. Synthetic requests carry no client request id, so
+        nothing is journaled; they do count in
+        ``n_batches``/``n_requests`` (they really ran the model).
+        Returns the dispatched batch sizes.
+        """
+        if sizes is None:
+            # one batch per reachable bucket: the pow2 ladder clamped at
+            # max_batch_size (buckets never exceed the cap)
+            cap = self.max_batch_size
+            sizes = sorted({bucket_target(k, cap)
+                            for k in range(1, cap + 1)})
+        for n in sizes:
+            batch = [_PendingRequest(payload) for _ in range(n)]
+            # the dispatch stage debits the backlog; synthetic requests
+            # never passed the ingress credit, so balance it here
+            with self._stats_lock:
+                self._n_backlog += len(batch)
+            self._serve_batch(batch)
+        return list(sizes)
 
     def _evict_locked(self, rid: str) -> None:
         # remember the id (not the reply) so a past-window retry is
@@ -580,34 +884,164 @@ class ServingServer:
                 logger.warning("journal append to %s failed",
                                self.journal_path, exc_info=True)
 
+    def _commit_locked(self, p: _PendingRequest) -> None:
+        if self._inflight.pop(p.rid, None) is not None \
+                and p.status == 200:
+            entry = (p.status, p.reply or b"{}", time.monotonic())
+            self._journal[p.rid] = entry
+            if self._journal_fh is not None:
+                # enqueue only: the writer thread does the file I/O
+                self._journal_queue.put(self._journal_line(
+                    p.rid, entry, time.time()).encode())
+            while len(self._journal) > self.journal_size:
+                old_rid, _ = self._journal.popitem(last=False)
+                self._evict_locked(old_rid)
+
     def _commit(self, p: _PendingRequest) -> None:
         """Commit a reply, then release waiters. Successful replies are
         journaled under the client request id (exactly-once); errors are
         not journaled, so a client may retry them."""
         with self._commit_lock:
-            if self._inflight.pop(p.rid, None) is not None \
-                    and p.status == 200:
-                entry = (p.status, p.reply or b"{}", time.monotonic())
-                self._journal[p.rid] = entry
-                if self._journal_fh is not None:
-                    # enqueue only: the writer thread does the file I/O
-                    self._journal_queue.put(self._journal_line(
-                        p.rid, entry, time.time()).encode())
-                while len(self._journal) > self.journal_size:
-                    old_rid, _ = self._journal.popitem(last=False)
-                    self._evict_locked(old_rid)
+            self._commit_locked(p)
             self._reap_expired_locked()
         p.event.set()
 
+    def _commit_many(self, ps: List[_PendingRequest]) -> None:
+        """Batch commit: one lock acquisition and one TTL reap for the
+        whole micro-batch (the per-request lock churn was measurable at
+        128-row batches), preserving in-batch journal order; waiters are
+        released outside the lock, in batch order."""
+        if not ps:
+            return
+        with self._commit_lock:
+            for p in ps:
+                self._commit_locked(p)
+            self._reap_expired_locked()
+        for p in ps:
+            p.event.set()
+
+    # -- pipeline loops ------------------------------------------------------
+
+    def _track_batch(self, n: int) -> None:
+        with self._stats_lock:
+            self._active_batches += n
+
+    def _handoff(self, q: "Queue[dict]", job: dict, on_stop) -> None:
+        """Put a job to the next stage. Once ``_stop`` is set the
+        consumer may already have exited, so a queued job could strand
+        its clients until request_timeout — resolve it via ``on_stop``
+        (in this thread) instead; stop()'s flush catches anything that
+        races past this check."""
+        while True:
+            if self._stop.is_set():
+                try:
+                    on_stop(job)
+                finally:
+                    self._track_batch(-1)
+                return
+            try:
+                q.put(job, timeout=0.1)
+                return
+            except Full:
+                continue
+
+    def _fail_undispatched(self, job: dict) -> None:
+        """Stop-path resolution for a job that never reached the model:
+        never dispatch from the collector thread — the executor may be
+        mid-``model.transform``, and a second concurrent call through a
+        non-thread-safe transformer could commit corrupt (journaled!)
+        replies. Fail the stragglers instead; ``_flush_pipeline``
+        dispatches the queued ones for real once every stage thread is
+        dead."""
+        if job["error"] is None:
+            job["error"] = RuntimeError("server stopping before dispatch")
+        # _stage_dispatch (skipped) is where the backlog debit lives
+        with self._stats_lock:
+            self._n_backlog -= job["batch_n"]
+        self._stage_finish(job)
+
     def _batch_loop(self):
+        """Collector thread: collect + assemble, then either run the
+        batch inline (serial plane) or hand it to the executor stage.
+        ``_active_batches`` counts a batch from collection until its
+        replies are committed, so drain (stop()) covers the whole
+        pipeline, not just this thread."""
         while not self._stop.is_set():
             batch = self._collect_batch()
-            if batch:
-                self._active_batches += 1
+            if not batch:
+                continue
+            self._track_batch(+1)
+            if not self.pipeline:
                 try:
                     self._serve_batch(batch)
                 finally:
-                    self._active_batches -= 1
+                    self._track_batch(-1)
+                continue
+            self._handoff(self._dispatch_q, self._stage_prepare(batch),
+                          self._fail_undispatched)
+
+    def _executor_loop(self):
+        """Executor thread: model dispatch only — it hands the output to
+        the encoder pool and immediately returns to the next batch, so
+        encode/commit for batch N overlaps model execution for N+1."""
+        while True:
+            try:
+                job = self._dispatch_q.get(timeout=0.05)
+            except Empty:
+                if self._stop.is_set():
+                    return
+                continue
+            try:
+                job = self._stage_dispatch(job)
+            except Exception as e:  # noqa: BLE001 — never kill the stage
+                job["error"] = job["error"] or e
+            # on stop, encoding inline is safe (no model call)
+            self._handoff(self._encode_q, job, self._stage_finish)
+
+    def _encoder_loop(self):
+        """Encoder-pool thread: unpad + encode + deadline check #2 +
+        commit. Pool size ``encoder_threads``: JSON encoding is the
+        dominant pure-python cost at high request rates, so it gets the
+        parallelism."""
+        while True:
+            try:
+                job = self._encode_q.get(timeout=0.05)
+            except Empty:
+                if self._stop.is_set():
+                    return
+                continue
+            try:
+                self._stage_finish(job)
+            except Exception:  # noqa: BLE001 — never kill the stage
+                logger.warning("encoder stage failed", exc_info=True)
+            finally:
+                self._track_batch(-1)
+
+    def _flush_pipeline(self) -> None:
+        """Finish any job still sitting in a stage queue after the
+        pipeline threads exited (a handoff can race the consumers'
+        shutdown): every accepted request gets its reply — or at worst
+        a 500 — instead of hanging to request_timeout. Runs in the
+        stop() thread after the joins, so nothing else is pulling from
+        these queues (and Queue.get is atomic regardless)."""
+        while True:
+            try:
+                job = self._dispatch_q.get_nowait()
+            except Empty:
+                break
+            try:
+                self._stage_finish(self._stage_dispatch(job))
+            finally:
+                self._track_batch(-1)
+        while True:
+            try:
+                job = self._encode_q.get_nowait()
+            except Empty:
+                break
+            try:
+                self._stage_finish(job)
+            finally:
+                self._track_batch(-1)
 
     # -- lifecycle -----------------------------------------------------------
 
@@ -618,6 +1052,19 @@ class ServingServer:
         t_http.start()
         t_batch.start()
         self._threads = [t_http, t_batch]
+        self._stage_threads = [t_batch]
+        if self.pipeline:
+            t_exec = threading.Thread(target=self._executor_loop,
+                                      daemon=True)
+            t_exec.start()
+            self._threads.append(t_exec)
+            self._stage_threads.append(t_exec)
+            for _ in range(self.encoder_threads):
+                t_enc = threading.Thread(target=self._encoder_loop,
+                                         daemon=True)
+                t_enc.start()
+                self._threads.append(t_enc)
+                self._stage_threads.append(t_enc)
         self._journal_thread = None
         if self._journal_fh is not None:
             self._journal_thread = threading.Thread(
@@ -634,15 +1081,32 @@ class ServingServer:
         rolling restart loses no accepted request."""
         self._draining.set()
         if drain:
+            # backlog(), not the ingress queue: a request the collector
+            # has already popped but not yet dispatched is still
+            # accepted work (it is only debited at dispatch), and the
+            # pipelined plane keeps work in stage queues the ingress
+            # queue never sees
             t_end = time.monotonic() + float(drain_timeout)
             while time.monotonic() < t_end and \
-                    (self._queue.qsize() > 0 or self._active_batches > 0):
+                    (self.backlog() > 0 or self._active_batches > 0):
                 time.sleep(0.005)
         self._stop.set()
         self._server.shutdown()
         self._server.server_close()
         for t in self._threads:
             t.join(timeout=5)
+        if any(t.is_alive() for t in getattr(self, "_stage_threads", [])):
+            # a stage thread is stuck (hung model / slow device): the
+            # flush's no-concurrent-consumer invariant doesn't hold, and
+            # running the model from this thread too could interleave
+            # two batches through a non-thread-safe transformer — leave
+            # the queues to the daemon threads instead
+            logger.warning(
+                "pipeline threads did not stop in 5s; skipping the "
+                "final stage-queue flush (stranded requests will 504 "
+                "at request_timeout)")
+        else:
+            self._flush_pipeline()
         if self._journal_fh is not None:
             jt = getattr(self, "_journal_thread", None)
             if jt is not None and jt.is_alive():

@@ -11,7 +11,7 @@ from mmlspark_tpu.stages.prep import (
     CleanMissingData, CleanMissingDataModel, DataConversion,
 )
 from mmlspark_tpu.stages.batching import (
-    FixedBatcher, DynamicBufferedBatcher, TimeIntervalBatcher,
+    BucketBatcher, FixedBatcher, DynamicBufferedBatcher, TimeIntervalBatcher,
     FixedMiniBatchTransformer, DynamicMiniBatchTransformer, FlattenBatch,
 )
 from mmlspark_tpu.stages.image import (
@@ -27,7 +27,8 @@ __all__ = [
     "EnsembleByKey", "SummarizeData",
     "ValueIndexer", "ValueIndexerModel", "IndexToValue",
     "CleanMissingData", "CleanMissingDataModel", "DataConversion",
-    "FixedBatcher", "DynamicBufferedBatcher", "TimeIntervalBatcher",
+    "BucketBatcher", "FixedBatcher", "DynamicBufferedBatcher",
+    "TimeIntervalBatcher",
     "FixedMiniBatchTransformer", "DynamicMiniBatchTransformer", "FlattenBatch",
     "ImageTransformer", "ResizeImageTransformer", "UnrollImage",
     "UnrollBinaryImage", "ImageSetAugmenter",

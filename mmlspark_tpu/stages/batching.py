@@ -91,6 +91,37 @@ class DynamicBufferedBatcher:
             raise error[0]
 
 
+class BucketBatcher:
+    """Group an iterator along the power-of-two bucket ladder:
+    1, 2, 4, ... up to ``cap``, then ``cap`` forever (final partial batch
+    as-is).
+
+    The streaming companion of the serving data plane's shape buckets
+    (:func:`mmlspark_tpu.parallel.sharding.bucket_target` — the same
+    ladder): pushing a stream through it dispatches every compiled
+    bucket shape exactly once on the way up, so it doubles as the
+    warm-up schedule for bucketed scorers and servers
+    (``tools/bench_serving_pipeline.py`` warms its workers with it).
+    """
+
+    def __init__(self, cap: int = 1024):
+        from mmlspark_tpu.parallel.sharding import bucket_target
+        self.cap = max(int(cap), 1)
+        self._target = bucket_target
+
+    def __call__(self, it: Iterable[Any]) -> Iterator[List[Any]]:
+        size = 1
+        batch: List[Any] = []
+        for x in it:
+            batch.append(x)
+            if len(batch) >= size:
+                yield batch
+                batch = []
+                size = min(self._target(size + 1, self.cap), self.cap)
+        if batch:
+            yield batch
+
+
 class TimeIntervalBatcher:
     """Emit a batch at most every ``interval`` seconds (parity: Batchers.scala:131)."""
 

@@ -826,7 +826,12 @@ class TestLatencyFirstMode:
                 return df.with_column(
                     "y", np.asarray(df["x"], dtype=np.float64))
 
-        with ServingServer(Count(), max_latency_ms=0) as srv:
+        # bucket_batches=False: this test counts the exact rows the
+        # model sees, and bucket padding (the default) rounds batch
+        # sizes up to powers of two — tests/test_serving_pipeline.py
+        # owns the bucketed-dispatch contract
+        with ServingServer(Count(), max_latency_ms=0,
+                           bucket_batches=False) as srv:
             r = requests.post(srv.address, json={"x": 1}, timeout=10)
             assert r.status_code == 200 and r.json() == {"y": 1.0}
             assert Count.batches[0] == 1  # served alone, no batch wait

@@ -62,6 +62,49 @@ class TestSharding:
         padded, _ = pad_to_bucket(np.zeros((5000, 2)), cap=1024)
         assert padded.shape[0] == 5120  # 5*1024, not 8192
 
+    def test_bucket_target_ladder(self):
+        from mmlspark_tpu.parallel import bucket_target, pad_to_bucket
+        assert [bucket_target(n, 8) for n in (0, 1, 2, 3, 5, 8)] == \
+            [1, 1, 2, 4, 8, 8]
+        assert bucket_target(9, 8) == 16          # above cap: cap multiple
+        assert bucket_target(100, 1024) == 128
+        assert bucket_target(5, 6) == 6           # clamped AT the cap,
+        assert bucket_target(7, 6) == 12          # never past it
+        # the policy pad_to_bucket actually applies, by construction
+        for n in range(1, 40):
+            padded, _ = pad_to_bucket(np.zeros((n, 2)), cap=16)
+            assert padded.shape[0] == bucket_target(n, 16)
+
+    def test_pad_mode_edge(self):
+        # edge mode repeats the last row — valid for object columns and
+        # models that reject zero rows (the serving bucket policy)
+        from mmlspark_tpu.parallel import pad_to_bucket
+        x = np.array([[1.0, 2.0], [3.0, 4.0], [5.0, 6.0]])
+        padded, n = pad_to_multiple(x, 4, pad_mode="edge")
+        assert n == 3 and padded.shape == (4, 2)
+        np.testing.assert_array_equal(padded[3], [5.0, 6.0])
+        objs = np.array(["a", "bb", "ccc"], dtype=object)
+        padded, n = pad_to_bucket(objs, cap=8, pad_mode="edge")
+        assert list(padded) == ["a", "bb", "ccc", "ccc"] and n == 3
+
+    def test_padded_device_batch_shared_helper(self):
+        # the one helper behind NNModel minibatches and serving buckets
+        from mmlspark_tpu.parallel import padded_device_batch
+        x = np.arange(10.0)
+        padded, n = padded_device_batch(x, 8)
+        assert padded.shape == (16,) and n == 10
+        assert isinstance(padded, np.ndarray)      # no placement: host
+        bucketed, n = padded_device_batch(np.zeros((5, 2)), 16,
+                                          bucket=True)
+        assert bucketed.shape[0] == 8 and n == 5
+        # placement uploads through the injected put (the hook
+        # tests/test_models.py counts NNModel uploads with)
+        calls = []
+        out, n = padded_device_batch(
+            x, 8, placement="dev",
+            put=lambda a, p: (calls.append(p), a)[1])
+        assert calls == ["dev"] and out.shape == (16,)
+
     def test_shard_batch(self):
         mesh = build_mesh()
         batch = {"x": np.random.randn(13, 4), "y": np.arange(13)}

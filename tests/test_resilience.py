@@ -344,7 +344,7 @@ class TestServingDegradation:
             entered.wait(5)                   # batch 1 is now in the model
             threads.append(_post(srv, {"x": 2}, out, "b"))
             threads.append(_post(srv, {"x": 3}, out, "c"))
-            wait_until(lambda: srv._queue.qsize() >= 2, what="queue full")
+            wait_until(lambda: srv.backlog() >= 2, what="backlog full")
             shed = requests.post(srv.address, json={"x": 4}, timeout=10)
             assert shed.status_code == 429
             assert shed.headers["Retry-After"] == "0.25"
@@ -379,7 +379,7 @@ class TestServingDegradation:
             t = _post(srv, {"x": 6}, out, "blocker")
             entered.wait(5)
             t2 = _post(srv, {"x": 7}, out, "queued")
-            wait_until(lambda: srv._queue.qsize() >= 1, what="queued")
+            wait_until(lambda: srv.backlog() >= 1, what="queued")
             shed = requests.post(srv.address, json={"x": 8}, timeout=10)
             assert shed.status_code == 429    # new work refused...
             replay = requests.post(srv.address, json={"x": 5}, headers=h,
@@ -404,7 +404,7 @@ class TestServingDegradation:
             entered.wait(5)                   # model busy with batch 1
             t2 = _post(srv, {"x": 2}, out, "doomed",
                        headers={"X-Deadline-Ms": "100"})
-            wait_until(lambda: srv._queue.qsize() >= 1, what="queued")
+            wait_until(lambda: srv.backlog() >= 1, what="queued")
             clk.advance(0.2)                  # its budget expires in queue
             gate.set()
             t1.join()

@@ -1,0 +1,175 @@
+"""A/B harness for the serving data plane: serial vs pipelined.
+
+Runs the SAME load (N keep-alive clients hammering one worker with
+varying-size JSON payload bursts) against a ``ServingServer`` in each
+mode and reports req/s, p50/p99 latency, and the server's own
+``/stats`` evidence (recompile counter, per-stage timings, bucket set):
+
+    python tools/bench_serving_pipeline.py            # full run
+    python tools/bench_serving_pipeline.py --smoke    # CPU-friendly, ~5s
+
+Modes:
+
+* ``serial``    — ``pipeline=False, bucket_batches=False``: the
+  pre-pipeline plane (collect -> transform -> encode on one thread,
+  exact batch shapes, a jit retrace per distinct size).
+* ``pipelined`` — the default plane: staged collector / executor /
+  encoder-pool threads + power-of-two shape buckets.
+
+Each worker is warmed with ``ServingServer.warmup`` (one synthetic batch
+per bucket shape) before the timed window, so the pipelined mode's
+steady state is measured, not its warm-up — and the harness asserts
+``n_recompiles`` stays flat across the timed window, which is the
+"0 recompiles after warm-up" acceptance check run as code.
+
+``--model nn`` swaps the trivial host-side model for a small jitted
+``NNModel`` MLP so the A/B includes real device dispatch (on CPU this
+exercises the same jit shape-cache the TPU path hits).
+"""
+
+from __future__ import annotations
+
+import argparse
+import http.client
+import json
+import threading
+import time
+
+import numpy as np
+
+
+def _identity_model():
+    from mmlspark_tpu.core.stage import Transformer
+
+    class Identity(Transformer):
+        def transform(self, df):
+            return df.with_column("y", np.asarray(df["x"], dtype=np.float64))
+
+    return Identity()
+
+
+def _nn_model():
+    from mmlspark_tpu.models.function import NNFunction
+    from mmlspark_tpu.models.nn import NNModel
+
+    fn = NNFunction.init({"builder": "mlp", "hidden": [32],
+                          "num_outputs": 4}, input_shape=(8,), seed=0)
+    return NNModel(model=fn, input_col="x", output_col="y", batch_size=64,
+                   cache_inputs=False, data_parallel=False)
+
+
+def _payload(model_kind: str, i: int) -> bytes:
+    if model_kind == "nn":
+        return json.dumps({"x": [float((i + j) % 7) for j in range(8)]}
+                          ).encode()
+    return json.dumps({"x": float(i)}).encode()
+
+
+def _client(srv, body, counts, lat, ci, deadline, burst):
+    conn = http.client.HTTPConnection(srv.host, srv.port, timeout=10)
+    hdrs = {"Content-Type": "application/json"}
+    while time.perf_counter() < deadline:
+        # varying-size bursts: each client pauses a beat between bursts
+        # so live batch sizes keep changing — the recompile trap the
+        # buckets exist to defuse
+        for _ in range(burst):
+            t0 = time.perf_counter()
+            try:
+                conn.request("POST", srv.api_path, body, hdrs)
+                resp = conn.getresponse()
+                resp.read()
+                ok = resp.status == 200
+            except OSError:
+                ok = False
+                conn.close()
+                conn = http.client.HTTPConnection(srv.host, srv.port,
+                                                  timeout=10)
+            if ok:
+                counts[ci] += 1
+                lat[ci].append(time.perf_counter() - t0)
+        time.sleep(0.001 * (1 + ci % 3))
+    conn.close()
+
+
+def _stats(srv) -> dict:
+    conn = http.client.HTTPConnection(srv.host, srv.port, timeout=10)
+    conn.request("GET", "/stats")
+    out = json.loads(conn.getresponse().read())
+    conn.close()
+    return out
+
+
+def run_mode(mode: str, model_kind: str, n_clients: int,
+             duration_s: float, max_batch_size: int,
+             burst: int) -> dict:
+    from mmlspark_tpu.serving import ServingServer
+
+    model = _nn_model() if model_kind == "nn" else _identity_model()
+    pipelined = mode == "pipelined"
+    counts = [0] * n_clients
+    lat = [[] for _ in range(n_clients)]
+    with ServingServer(model, max_latency_ms=2,
+                       max_batch_size=max_batch_size,
+                       pipeline=pipelined,
+                       bucket_batches=pipelined) as srv:
+        srv.warmup(json.loads(_payload(model_kind, 0)))
+        recompiles_warm = _stats(srv)["n_recompiles"]
+        deadline = time.perf_counter() + duration_s
+        threads = [threading.Thread(
+            target=_client,
+            args=(srv, _payload(model_kind, i), counts, lat, i, deadline,
+                  burst))
+            for i in range(n_clients)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        stats = _stats(srv)
+    all_lat = sorted(x for per in lat for x in per)
+    p = (lambda q: round(1000 * all_lat[int(q * (len(all_lat) - 1))], 3)) \
+        if all_lat else (lambda q: None)
+    return {
+        "mode": mode, "model": model_kind,
+        "rps": round(sum(counts) / duration_s, 1),
+        "p50_ms": p(0.50), "p99_ms": p(0.99),
+        "n_clients": n_clients, "duration_s": duration_s,
+        "recompiles_after_warmup": stats["n_recompiles"] - recompiles_warm,
+        "dispatch_sizes": stats["dispatch_sizes"],
+        "stage_timings": {k: v["mean_ms"] for k, v in
+                          stats["stage_timings"].items()},
+    }
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--smoke", action="store_true",
+                    help="CPU-friendly ~5s run (CI tier-1 smoke)")
+    ap.add_argument("--model", choices=("identity", "nn"),
+                    default="identity")
+    ap.add_argument("--clients", type=int, default=8)
+    ap.add_argument("--seconds", type=float, default=3.0)
+    ap.add_argument("--max-batch-size", type=int, default=128)
+    ap.add_argument("--burst", type=int, default=16,
+                    help="requests per client burst (varies batch sizes)")
+    args = ap.parse_args()
+    if args.smoke:
+        args.clients, args.seconds = min(args.clients, 4), 1.0
+        args.max_batch_size = min(args.max_batch_size, 32)
+    results = {}
+    for mode in ("serial", "pipelined"):
+        r = run_mode(mode, args.model, args.clients, args.seconds,
+                     args.max_batch_size, args.burst)
+        results[mode] = r
+        print(json.dumps(r), flush=True)
+    if results["pipelined"]["recompiles_after_warmup"] != 0:
+        raise SystemExit(
+            "FAIL: pipelined plane retraced after warm-up "
+            f"({results['pipelined']['recompiles_after_warmup']} new "
+            "dispatch shapes) — the bucket set is not closed")
+    speedup = results["pipelined"]["rps"] / max(results["serial"]["rps"], 1)
+    print(json.dumps({"metric": "serving_pipeline_ab",
+                      "speedup": round(speedup, 3)}), flush=True)
+
+
+if __name__ == "__main__":
+    main()
