@@ -29,6 +29,10 @@ Plus (no era analogue, utilization/latency evidence):
                                    attention's long-context regime)
  11. moe_train_v1                — experts-on train step (top-2 capacity
                                    dispatch + balance aux + z-loss)
+ 12. telemetry_overhead_v1       — metrics-registry hot path (ns per
+                                   counter inc / histogram observe; the
+                                   cost every serving batch, train step,
+                                   and HTTP send now carries)
 
 Every line carries chip metadata (platform/device kind/count) so the
 numbers are interpretable across hosts.
@@ -804,12 +808,55 @@ def bench_moe_train():
     return out
 
 
+def bench_telemetry_overhead():
+    """Telemetry hot-path overhead: ns per counter increment and per
+    histogram observe (plus a StageTimings span, the serving plane's
+    per-stage unit of work). The registry sits on every serving batch,
+    train step, and HTTP send, so a regression here taxes every hot
+    path at once — the acceptance budget is < 2 us (2000 ns) per
+    update; vs_baseline = budget / measured (counter).
+    """
+    from mmlspark_tpu.core.profiling import StageTimings
+    from mmlspark_tpu.core.telemetry import MetricsRegistry
+
+    def per_op_ns(fn, n=200_000, rounds=3):
+        best = float("inf")
+        for _ in range(rounds):
+            t0 = time.perf_counter_ns()
+            for _ in range(n):
+                fn()
+            best = min(best, (time.perf_counter_ns() - t0) / n)
+        return best
+
+    reg = MetricsRegistry()
+    counter = reg.counter("bench_total", labels=("k",)).labels("hot")
+    hist = reg.histogram("bench_ms").labels()
+    timings = StageTimings()
+
+    def span():
+        with timings.span("hot"):
+            pass
+
+    counter_ns = per_op_ns(counter.inc)
+    observe_ns = per_op_ns(lambda: hist.observe(3.7))
+    span_ns = per_op_ns(span, n=50_000)
+    budget = 2000.0
+    return {"metric": "telemetry_overhead_v1",
+            "value": round(counter_ns, 1), "unit": "ns/counter_inc",
+            "histogram_observe_ns": round(observe_ns, 1),
+            "stage_span_ns": round(span_ns, 1),
+            "baseline": budget,
+            "vs_baseline": round(budget / max(counter_ns, 1e-9), 3),
+            "chip": _chip()}
+
+
 BENCHES = [bench_gbdt_quantile, bench_adult_census, bench_cifar10_scoring,
            bench_cifar10_scoring_uint8, bench_imagenet_scoring,
            bench_transfer_learning, bench_distributed_sgd,
            bench_serving_latency, bench_serving_throughput,
            bench_transformer_train,
-           bench_transformer_train_long, bench_moe_train]
+           bench_transformer_train_long, bench_moe_train,
+           bench_telemetry_overhead]
 
 
 def main() -> None:

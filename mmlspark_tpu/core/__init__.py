@@ -17,9 +17,19 @@ from mmlspark_tpu.core.resilience import (
     ManualClock,
     RetryPolicy,
 )
+from mmlspark_tpu.core.telemetry import (
+    REGISTRY,
+    MetricsRegistry,
+    current_trace_id,
+    trace_context,
+)
 from mmlspark_tpu.core import schema
 
 __all__ = [
+    "REGISTRY",
+    "MetricsRegistry",
+    "current_trace_id",
+    "trace_context",
     "BreakerBoard",
     "CircuitBreaker",
     "CircuitOpen",

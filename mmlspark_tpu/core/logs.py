@@ -5,14 +5,86 @@ log4j2 loggers under one root. Here stdlib logging under the
 ``mmlspark_tpu`` root, with the level configurable via the ``logging``
 config namespace (``MMLSPARK_TPU_LOGGING_LEVEL=DEBUG`` or the config
 file — see ``core/config.py``).
+
+Observability extensions:
+
+* ``MMLSPARK_TPU_LOGGING_FORMAT=json`` (config key ``logging.format``)
+  switches every record to one structured JSON object per line — the
+  shape log pipelines (Loki, Stackdriver, `jq`) ingest without a parse
+  regex.
+* every record carries the ambient trace id
+  (:func:`mmlspark_tpu.core.telemetry.current_trace_id`): a handler
+  filter stamps ``record.trace_id``, the JSON format emits it as a
+  field, and the plain format appends ``trace=<id>`` only when a trace
+  is actually bound — grep one serving request's id across ingress,
+  dispatch, and egress log lines.
 """
 
 from __future__ import annotations
 
+import json as _json
 import logging as _logging
 
 _ROOT = "mmlspark_tpu"
 _configured = False
+
+
+class _TraceFilter(_logging.Filter):
+    """Stamp the ambient trace id onto every record at emit time."""
+
+    def filter(self, record: _logging.LogRecord) -> bool:
+        from mmlspark_tpu.core.telemetry import current_trace_id
+        record.trace_id = current_trace_id() or "-"
+        return True
+
+
+def _record_trace_id(record: _logging.LogRecord):
+    tid = getattr(record, "trace_id", None)
+    if tid is None:
+        # formatter used without the handler filter (tests formatting a
+        # bare record): resolve directly
+        from mmlspark_tpu.core.telemetry import current_trace_id
+        tid = current_trace_id() or "-"
+    return tid
+
+
+class _PlainFormatter(_logging.Formatter):
+    """The historical plain format, plus ``trace=<id>`` when one is
+    bound (no trailing noise for untraced records)."""
+
+    def __init__(self):
+        super().__init__("%(asctime)s %(name)s %(levelname)s: %(message)s")
+
+    def format(self, record: _logging.LogRecord) -> str:
+        out = super().format(record)
+        tid = _record_trace_id(record)
+        if tid and tid != "-":
+            out += f" trace={tid}"
+        return out
+
+
+class _JsonFormatter(_logging.Formatter):
+    """One JSON object per line: ts/level/logger/message/trace_id
+    (+ exc when an exception rode the record)."""
+
+    def format(self, record: _logging.LogRecord) -> str:
+        out = {
+            "ts": self.formatTime(record),
+            "level": record.levelname,
+            "logger": record.name,
+            "message": record.getMessage(),
+            "trace_id": _record_trace_id(record),
+        }
+        if record.exc_info:
+            out["exc"] = self.formatException(record.exc_info)
+        return _json.dumps(out, default=str)
+
+
+def make_formatter(fmt: str = "plain") -> _logging.Formatter:
+    """The formatter for a ``logging.format`` config value (``plain``
+    or ``json``; unknown values fall back to plain)."""
+    return _JsonFormatter() if str(fmt).lower() == "json" \
+        else _PlainFormatter()
 
 
 def _ensure_root() -> None:
@@ -20,16 +92,37 @@ def _ensure_root() -> None:
     if _configured:
         return
     from mmlspark_tpu.core.config import MMLConfig
+    cfg = MMLConfig.get("logging")
     root = _logging.getLogger(_ROOT)
     if not root.handlers:
         handler = _logging.StreamHandler()
-        handler.setFormatter(_logging.Formatter(
-            "%(asctime)s %(name)s %(levelname)s: %(message)s"))
+        handler.setFormatter(make_formatter(cfg.get("format", "plain")))
+        handler.addFilter(_TraceFilter())
         root.addHandler(handler)
         root.propagate = False
-    level = str(MMLConfig.get("logging").get("level", "INFO")).upper()
+    level = str(cfg.get("level", "INFO")).upper()
     root.setLevel(getattr(_logging, level, _logging.INFO))
     _configured = True
+
+
+def reconfigure() -> None:
+    """Re-read the ``logging`` config namespace (level + format) so a
+    long-lived process can flip to JSON logs without a restart. The
+    installed handler's formatter is swapped IN PLACE (one attribute
+    assignment) rather than removed-and-readded — concurrent request
+    threads never hit a handler-less, non-propagating root logger, so
+    no record is dropped mid-flip."""
+    global _configured
+    root = _logging.getLogger(_ROOT)
+    if not root.handlers:
+        _configured = False      # nothing installed: next get_logger runs
+        return                   # the full _ensure_root
+    from mmlspark_tpu.core.config import MMLConfig
+    cfg = MMLConfig.get("logging")
+    for h in root.handlers:
+        h.setFormatter(make_formatter(cfg.get("format", "plain")))
+    level = str(cfg.get("level", "INFO")).upper()
+    root.setLevel(getattr(_logging, level, _logging.INFO))
 
 
 def get_logger(namespace: str) -> _logging.Logger:

@@ -10,7 +10,6 @@ device traces viewable in TensorBoard/Perfetto via the jax profiler.
 from __future__ import annotations
 
 import contextlib
-import threading
 import time
 from typing import Callable, Dict, Iterator, Optional
 
@@ -56,12 +55,32 @@ class StageTimings:
     ``GET /stats``. Pure python (no jax import) so it costs nothing on
     hosts that never touch a device, and cheap enough (~1 us/span) to
     leave on in production.
+
+    Since the unified-telemetry work this is a thin view over a
+    :class:`mmlspark_tpu.core.telemetry.MetricsRegistry` histogram (one
+    child per stage name, millisecond log-scale buckets): the SAME
+    samples back both the ``GET /stats`` snapshot and the Prometheus
+    ``GET /metrics`` exposition. Pass ``registry`` to land the spans in
+    a shared registry (the serving plane passes its per-server one);
+    the default is a private registry, preserving the standalone
+    behavior.
     """
 
-    def __init__(self, clock: Callable[[], float] = time.perf_counter):
+    def __init__(self, clock: Callable[[], float] = time.perf_counter,
+                 registry=None, metric: str = "stage_duration_ms"):
+        from mmlspark_tpu.core.telemetry import MetricsRegistry
         self._clock = clock
-        self._lock = threading.Lock()
-        self._stats: Dict[str, list] = {}   # name -> [count, total_s, last_s]
+        self.registry = registry if registry is not None \
+            else MetricsRegistry()
+        self._hist = self.registry.histogram(
+            metric, "Per-stage wall-clock spans.", labels=("stage",))
+        self._children: Dict[str, object] = {}   # stage -> histogram child
+
+    def _child(self, name: str):
+        child = self._children.get(name)     # atomic under the GIL
+        if child is None:
+            child = self._children[name] = self._hist.labels(name)
+        return child
 
     @contextlib.contextmanager
     def span(self, name: str) -> Iterator[None]:
@@ -69,20 +88,58 @@ class StageTimings:
         try:
             yield
         finally:
-            dt = self._clock() - t0
-            with self._lock:
-                s = self._stats.setdefault(name, [0, 0.0, 0.0])
-                s[0] += 1
-                s[1] += dt
-                s[2] = dt
+            self._child(name).observe((self._clock() - t0) * 1000.0)
 
     def snapshot(self) -> Dict[str, Dict[str, float]]:
-        """``{stage: {count, total_ms, mean_ms, last_ms}}``, JSON-able."""
-        with self._lock:
-            return {
-                name: {"count": n,
-                       "total_ms": round(total * 1000.0, 3),
-                       "mean_ms": round(total / n * 1000.0, 4) if n else 0.0,
-                       "last_ms": round(last * 1000.0, 3)}
-                for name, (n, total, last) in self._stats.items()
+        """``{stage: {count, total_ms, mean_ms, last_ms, max_ms}}``,
+        JSON-able."""
+        out: Dict[str, Dict[str, float]] = {}
+        for key, child in self._hist.children():
+            s = child.stats()
+            n = s["count"]
+            out[key[0]] = {
+                "count": n,
+                "total_ms": round(s["sum"], 3),
+                "mean_ms": round(s["sum"] / n, 4) if n else 0.0,
+                "last_ms": round(s["last"], 3),
+                "max_ms": round(s["max"], 3),
             }
+        return out
+
+    def reset(self) -> None:
+        """Zero every stage's accumulators (chaos drills diff snapshots
+        across restarts; a long-soak harness resets between phases)."""
+        for _, child in self._hist.children():
+            child.reset()
+
+
+# -- process vitals (exported via GET /stats so chaos drills can spot
+# leaks and confirm restarts) ------------------------------------------------
+
+_PROCESS_START_MONO = time.monotonic()
+
+
+def process_uptime_s() -> float:
+    """Seconds since this module first loaded — effectively process
+    uptime; a restarted worker's counter visibly resets."""
+    return time.monotonic() - _PROCESS_START_MONO
+
+
+def process_rss_bytes() -> Optional[int]:
+    """Current resident set size. Linux reads ``/proc/self/status``
+    (current RSS); elsewhere falls back to ``ru_maxrss`` (PEAK RSS —
+    still monotone evidence for leak spotting) or None."""
+    try:
+        with open("/proc/self/status") as f:
+            for line in f:
+                if line.startswith("VmRSS:"):
+                    return int(line.split()[1]) * 1024
+    except (OSError, ValueError, IndexError):
+        pass
+    try:
+        import resource
+        import sys
+        peak = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss
+        return int(peak if sys.platform == "darwin" else peak * 1024)
+    except Exception:  # noqa: BLE001 — vitals are best-effort
+        return None

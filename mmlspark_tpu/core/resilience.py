@@ -75,6 +75,57 @@ SYSTEM_CLOCK = Clock()
 
 
 # ---------------------------------------------------------------------------
+# Telemetry hooks (lazy: telemetry imports Clock from this module, so the
+# metric families are resolved at first event, never at import time)
+# ---------------------------------------------------------------------------
+
+_METRICS: Optional[Dict[str, Any]] = None
+_BREAKER_STATE_VALUES = {"closed": 0, "half_open": 1, "open": 2}
+
+
+def _metrics() -> Dict[str, Any]:
+    global _METRICS
+    if _METRICS is None:
+        from mmlspark_tpu.core.telemetry import BoundedLabelSet, REGISTRY
+        _METRICS = {
+            # breaker names are per dependency (host, worker url): an
+            # unbounded fan-out must not grow the registry forever
+            "breaker_labels": BoundedLabelSet(256),
+            "retries": REGISTRY.counter(
+                "resilience_retries_total",
+                "Retry attempts actually scheduled (a backoff sleep was "
+                "taken) across every policy-driven caller."),
+            "breaker_transitions": REGISTRY.counter(
+                "breaker_transitions_total",
+                "Circuit-breaker state transitions.",
+                labels=("breaker", "to")),
+            "breaker_state": REGISTRY.gauge(
+                "breaker_state",
+                "Current breaker state per dependency: 0 closed, "
+                "1 half-open, 2 open.", labels=("breaker",)),
+        }
+    return _METRICS
+
+
+def _breaker_event(name: str, to_state: str) -> None:
+    """Record a breaker transition (called with the breaker lock held —
+    safe: telemetry takes only its own stripe locks and never calls
+    back). Telemetry must never break a failure path, hence the guard."""
+    try:
+        m = _metrics()
+        key, overflow = m["breaker_labels"].key(name or "unnamed")
+        m["breaker_transitions"].labels(key, to_state).inc()
+        # transitions aggregate sensibly under "other"; a shared state
+        # gauge does not (last-writer-wins across unrelated breakers
+        # would report closed while another overflow breaker is open)
+        if not overflow:
+            m["breaker_state"].labels(key).set(
+                _BREAKER_STATE_VALUES[to_state])
+    except Exception:  # noqa: BLE001 — observability is best-effort
+        pass
+
+
+# ---------------------------------------------------------------------------
 # Deadlines
 # ---------------------------------------------------------------------------
 
@@ -229,6 +280,10 @@ class RetrySchedule:
             return True
         if self.deadline is not None and wait >= self.deadline.remaining():
             return True     # the retry could never finish in time
+        try:
+            _metrics()["retries"].inc()
+        except Exception:  # noqa: BLE001 — observability is best-effort
+            pass
         clock.sleep(wait)
         return False
 
@@ -283,6 +338,7 @@ class CircuitBreaker:
                 self.clock.now() - self._opened_at >= self.reset_timeout:
             self._state = self.HALF_OPEN
             self._probes = 0
+            _breaker_event(self.name, self.HALF_OPEN)
 
     def allow(self) -> bool:
         """May a call proceed right now? Half-open admits a bounded
@@ -301,8 +357,11 @@ class CircuitBreaker:
 
     def record_success(self) -> None:
         with self._lock:
+            was_closed = self._state == self.CLOSED
             self._state = self.CLOSED
             self._failures = 0
+            if not was_closed:
+                _breaker_event(self.name, self.CLOSED)
 
     def record_failure(self) -> None:
         with self._lock:
@@ -316,6 +375,7 @@ class CircuitBreaker:
     def _trip_locked(self) -> None:
         if self._state != self.OPEN:
             self.n_opened += 1
+            _breaker_event(self.name, self.OPEN)
         self._state = self.OPEN
         self._opened_at = self.clock.now()
         self._failures = 0

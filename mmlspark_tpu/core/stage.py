@@ -107,12 +107,34 @@ def ml_fit(df: DataFrame, estimator: Estimator) -> Model:
     return estimator.fit(df)
 
 
+_STAGE_HIST = None
+
+
+def _stage_histogram():
+    """The shared per-stage span histogram: batch pipelines (this Timer)
+    and the serving plane's ``StageTimings`` report through the same
+    telemetry surface, so one ``/metrics`` scrape covers both. Cached
+    at module level so a Timer-wrapped transform pays one dict lookup,
+    not a registry-lock round trip per call."""
+    global _STAGE_HIST
+    if _STAGE_HIST is None:
+        from mmlspark_tpu.core.telemetry import REGISTRY
+        _STAGE_HIST = REGISTRY.histogram(
+            "pipeline_stage_duration_ms",
+            "Wall-clock of Timer-wrapped pipeline stage fits/transforms.",
+            labels=("stage", "phase"))
+    return _STAGE_HIST
+
+
 class Timer(Estimator):
     """Wraps a stage and logs wall-clock of its fit/transform.
 
     Parity: pipeline-stages Timer (an Estimator producing a TimerModel,
     `Timer.scala:14-90`). Fitting times the inner estimator's fit (or wraps
-    a transformer directly); the TimerModel times each transform.
+    a transformer directly); the TimerModel times each transform. Every
+    span also lands in the process-wide metrics registry
+    (``pipeline_stage_duration_ms{stage=...,phase=fit|transform}``), so
+    batch pipelines report through the same exposition as serving.
     """
 
     from mmlspark_tpu.core.params import Param as _P
@@ -123,8 +145,11 @@ class Timer(Estimator):
         if isinstance(inner, Estimator):
             t0 = time.time()
             inner = inner.fit(df)
+            dt = time.time() - t0
+            _stage_histogram().labels(
+                type(self.stage).__name__, "fit").observe(dt * 1000.0)
             print(f"[Timer] {type(self.stage).__name__}.fit took "
-                  f"{time.time() - t0:.3f}s")
+                  f"{dt:.3f}s")
         return TimerModel(stage=inner)
 
     def _save_extra(self, path, arrays):
@@ -141,8 +166,11 @@ class TimerModel(Model):
     def transform(self, df: DataFrame) -> DataFrame:
         t0 = time.time()
         out = self.stage.transform(df)
+        dt = time.time() - t0
+        _stage_histogram().labels(
+            type(self.stage).__name__, "transform").observe(dt * 1000.0)
         print(f"[Timer] {type(self.stage).__name__}.transform took "
-              f"{time.time() - t0:.3f}s")
+              f"{dt:.3f}s")
         return out
 
     def _save_extra(self, path, arrays):

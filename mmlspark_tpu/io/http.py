@@ -96,9 +96,52 @@ class HTTPResponseData:
 # (parity: HandlingUtils.basic/advanced, HTTPClients.scala:55,107-133)
 # ---------------------------------------------------------------------------
 
+def _metrics():
+    """Lazily-bound global telemetry families (module-cached so the
+    per-send cost is one dict lookup + a labels() cache hit)."""
+    global _HTTP_METRICS
+    if _HTTP_METRICS is None:
+        from mmlspark_tpu.core.telemetry import BoundedLabelSet, REGISTRY
+        _HTTP_METRICS = {
+            "requests": REGISTRY.counter(
+                "http_client_requests_total",
+                "Egress HTTP sends by host and status class (transport "
+                "failures land in class \"0xx\"; hosts beyond the "
+                "tracked-label cap fold into host=\"other\").",
+                labels=("host", "class")),
+            "retries": REGISTRY.counter(
+                "http_client_retries_total",
+                "Egress sends re-attempted under a retry policy.",
+                labels=("host",)),
+            # a URL column with thousands of distinct domains must not
+            # grow a long-lived worker's registry without limit
+            "hosts": BoundedLabelSet(256),
+        }
+    return _HTTP_METRICS
+
+
+_HTTP_METRICS = None
+
+
+def _host_label(host: str) -> str:
+    return _metrics()["hosts"].key(host)[0]
+
+
 def _send_once(session, req: HTTPRequestData,
                timeout: float) -> HTTPResponseData:
-    resp = session.request(req.method, req.url, headers=req.headers,
+    headers = req.headers
+    # header names are case-insensitive on the wire: a caller-supplied
+    # x-trace-id must suppress injection, or two conflicting trace
+    # headers would fork downstream correlation
+    if not any(k.lower() == "x-trace-id" for k in headers):
+        # flow the ambient trace id onto the wire: a serving request
+        # whose model fans out HTTP calls stays correlatable end-to-end
+        from mmlspark_tpu.core.telemetry import current_trace_id
+        tid = current_trace_id()
+        if tid:
+            headers = dict(headers)
+            headers["X-Trace-Id"] = tid
+    resp = session.request(req.method, req.url, headers=headers,
                            data=req.body, timeout=timeout)
     return HTTPResponseData(status_code=resp.status_code,
                             reason=resp.reason, body=resp.content,
@@ -125,6 +168,7 @@ def policy_handler(session, req: HTTPRequestData, timeout: float = 60.0,
     policy = policy or RetryPolicy()
     sched = policy.schedule(deadline)
     resp: Optional[HTTPResponseData] = None
+    host = _host_label(_host_of(req.url))   # invariant across attempts
     while True:
         if deadline is not None and deadline.expired:
             return resp or HTTPResponseData(
@@ -141,6 +185,8 @@ def policy_handler(session, req: HTTPRequestData, timeout: float = 60.0,
             resp = _send_once(session, req, attempt_timeout)
         except Exception as e:  # transport-level failure
             resp = HTTPResponseData(status_code=0, reason=str(e), body=None)
+        _metrics()["requests"].labels(
+            host, f"{resp.status_code // 100}xx").inc()
         # breaker health tracks the HOST: transport failures and server
         # errors count against it even when the policy itself would not
         # retry that status (e.g. the basic policy returns 5xx as-is)
@@ -154,6 +200,7 @@ def policy_handler(session, req: HTTPRequestData, timeout: float = 60.0,
         retry_after = resp.headers.get("Retry-After")
         if sched.give_up(retry_after):
             return resp
+        _metrics()["retries"].labels(host).inc()
 
 
 def basic_handler(session, req: HTTPRequestData, timeout: float = 60.0,

@@ -118,6 +118,24 @@ class FileStreamSource:
                             full, n, exc)
                         self._quarantined.add(key)
                     continue
+                # the file may have been mid-write at scan time (stat
+                # caught size 0 / an old mtime, the read then saw the
+                # settled content): journaling the STALE key would make
+                # the next poll re-process the same file under its
+                # settled key — a duplicate batch. A file whose stat
+                # CHANGED across the read is dropped and re-examined
+                # next poll; a file that VANISHED is delivered as read
+                # (read-then-archive producers delete immediately, and
+                # the gone file can never be re-examined — dropping it
+                # would be silent data loss).
+                try:
+                    st = os.stat(full)
+                    settled = f"{full}:{st.st_mtime_ns}:{st.st_size}"
+                except OSError:
+                    settled = key     # vanished: the read is final
+                if settled != key:
+                    frames.pop()      # drop the unverified read
+                    continue
                 keys.append(key)
             # drop stale fail counts (rewritten files get fresh keys every
             # poll; without pruning the dict grows without bound)

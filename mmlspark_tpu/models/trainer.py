@@ -18,6 +18,7 @@ from __future__ import annotations
 
 import math
 import os
+import time
 from typing import Any, Callable, Dict, Optional, Tuple
 
 import numpy as np
@@ -35,6 +36,44 @@ from mmlspark_tpu.parallel import (
 
 LOSSES = ("softmax_cross_entropy", "sigmoid_cross_entropy", "squared_error")
 OPTIMIZERS = ("sgd", "momentum", "adam", "adamw")
+
+_TRAINER_METRICS = None
+
+
+def _metrics():
+    """Process-registry training telemetry, bound lazily so importing
+    the trainer costs nothing."""
+    global _TRAINER_METRICS
+    if _TRAINER_METRICS is None:
+        from mmlspark_tpu.core.telemetry import REGISTRY, log_buckets
+        _TRAINER_METRICS = {
+            "step_ms": REGISTRY.histogram(
+                "trainer_step_ms",
+                "Host-loop wall-clock per train step (dispatch is "
+                "async: mostly host+transfer time, with periodic "
+                "device blocks when the in-flight window fills)."),
+            "examples_per_sec": REGISTRY.histogram(
+                "trainer_examples_per_sec",
+                "Real (unpadded) examples per second per host-loop "
+                "step.", buckets=log_buckets(1.0, 1e7)),
+            # wider ladder than the request-latency default: a
+            # multi-GB orbax save/restore routinely takes 30-120 s, and
+            # a 10 s top edge would collapse every sample into +Inf
+            "ckpt_save_ms": REGISTRY.histogram(
+                "trainer_checkpoint_save_ms",
+                "Checkpoint save call wall-clock (host serialize + "
+                "enqueue; orbax may complete the write async).",
+                buckets=log_buckets(10.0, 1e6)),
+            "ckpt_restore_ms": REGISTRY.histogram(
+                "trainer_checkpoint_restore_ms",
+                "Checkpoint restore wall-clock.",
+                buckets=log_buckets(10.0, 1e6)),
+            "restarts": REGISTRY.counter(
+                "trainer_restarts_total",
+                "Bounded in-process fit restarts (restore + "
+                "fast-forward) taken after step failures."),
+        }
+    return _TRAINER_METRICS
 
 
 def make_loss(name: str) -> Callable:
@@ -349,6 +388,7 @@ class NNLearner(Estimator, HasLabelCol, HasFeaturesCol):
                 if mngr is None or restarts >= self.max_restarts:
                     raise
                 restarts += 1
+                _metrics()["restarts"].inc()
                 latest = mngr.latest_step()
                 print(f"[NNLearner] step failed ({type(e).__name__}: {e});"
                       f" restoring "
@@ -380,6 +420,8 @@ class NNLearner(Estimator, HasLabelCol, HasFeaturesCol):
         import jax
 
         rng = np.random.default_rng(self.seed)
+        metrics = _metrics()
+        m_step, m_eps = metrics["step_ms"], metrics["examples_per_sec"]
         global_step = 0
         # bound the number of dispatched-but-unfinished steps: an
         # unthrottled loop queues every step at once, and XLA:CPU's
@@ -397,6 +439,7 @@ class NNLearner(Estimator, HasLabelCol, HasFeaturesCol):
                     continue  # fast-forward after resume (same shuffle stream)
                 if self.fault_injector is not None:
                     self.fault_injector(global_step)
+                t_step = time.perf_counter()
                 idx = order[s * bs:(s + 1) * bs]
                 # ragged tail: pad to the data-axis multiple, zero the pad
                 # rows' weights so they contribute nothing to the loss
@@ -413,6 +456,10 @@ class NNLearner(Estimator, HasLabelCol, HasFeaturesCol):
                 inflight.append(loss)
                 if len(inflight) > 2:
                     inflight.popleft().block_until_ready()
+                dt = time.perf_counter() - t_step
+                m_step.observe(dt * 1000.0)
+                if dt > 0:
+                    m_eps.observe(n_real / dt)
                 if self.log_every and global_step % self.log_every == 0:
                     print(f"[NNLearner] step {global_step} "
                           f"epoch {epoch + 1}/{self.epochs} "
@@ -436,9 +483,10 @@ class NNLearner(Estimator, HasLabelCol, HasFeaturesCol):
     def _checkpoint(self, mngr, step_num: int, params, opt_state) -> None:
         import jax
         import orbax.checkpoint as ocp
-        state = {"params": jax.device_get(params),
-                 "opt_state": jax.device_get(opt_state)}
-        mngr.save(step_num, args=ocp.args.StandardSave(state))
+        with _metrics()["ckpt_save_ms"].time():
+            state = {"params": jax.device_get(params),
+                     "opt_state": jax.device_get(opt_state)}
+            mngr.save(step_num, args=ocp.args.StandardSave(state))
 
     def _restore(self, mngr, template):
         """Restore the latest step against a host-side (params,
@@ -447,6 +495,8 @@ class NNLearner(Estimator, HasLabelCol, HasFeaturesCol):
         the donated live buffers are not safe to read after a fault."""
         import orbax.checkpoint as ocp
         latest = mngr.latest_step()
-        restored = mngr.restore(latest, args=ocp.args.StandardRestore(template))
+        with _metrics()["ckpt_restore_ms"].time():
+            restored = mngr.restore(
+                latest, args=ocp.args.StandardRestore(template))
         print(f"[NNLearner] resumed from step {latest}")
         return restored["params"], restored["opt_state"], latest

@@ -2,6 +2,13 @@
 
 Capability parity with Spark Serving (`src/io/http` serving sources/sinks)
 rebuilt for the TPU execution model — see :mod:`mmlspark_tpu.serving.server`.
+
+Observability: every worker serves ``GET /metrics`` (Prometheus text
+format) and carries ``X-Trace-Id`` through its whole data plane; the
+:class:`ServingCoordinator` aggregates the fleet — ``GET /fleet`` merges
+every worker's ``/stats`` (naming the slowest stage fleet-wide) and
+``GET /fleet/metrics`` merges their scrapes into one exposition. See
+``docs/observability.md``.
 """
 
 from mmlspark_tpu.serving.server import (

@@ -9,9 +9,13 @@ from ``$MODEL_URI`` (any io.fs path: local dir, gs://...), serve it
 ``tools/k8s/`` run (parity: the reference's spark-serving helm chart,
 `/root/reference/tools/helm/`); the readiness probe hits the server's
 ``GET /readyz`` (drain-aware), liveness ``GET /healthz``, counters
-``GET /status``. SIGTERM triggers the server's graceful drain
-(``ServingServer.stop``), so a pod delete finishes its accepted
-requests before the listener closes.
+``GET /status``, Prometheus exposition ``GET /metrics`` (point a
+scrape_config at the workers, or at the coordinator's
+``GET /fleet/metrics`` for the merged fleet — docs/observability.md).
+``MMLSPARK_TPU_LOGGING_FORMAT=json`` switches workers to structured
+JSON logs with per-request trace ids. SIGTERM triggers the server's
+graceful drain (``ServingServer.stop``), so a pod delete finishes its
+accepted requests before the listener closes.
 
 Environment:
   PORT             listen port (default 8000)

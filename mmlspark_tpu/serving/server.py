@@ -32,6 +32,17 @@ pre-pipeline behavior; also the A/B baseline for
 recompile counter (new dispatch shapes seen) are exported via
 ``GET /stats``.
 
+Telemetry (see ``docs/observability.md``): every worker serves a
+Prometheus text exposition at ``GET /metrics`` (per-stage span
+histograms, per-bucket dispatch latency, backlog/inflight gauges,
+shed/deadline/recompile counters, process vitals) from a per-server
+:class:`~mmlspark_tpu.core.telemetry.MetricsRegistry` plus the
+process-wide one; every request carries an ``X-Trace-Id`` (inbound or
+minted at ingress) through the staged pipeline, journal lines, log
+records, and any model-internal HTTP egress; and the coordinator's
+``GET /fleet`` / ``GET /fleet/metrics`` merge N workers into one view
+that names the fleet's slowest stage.
+
 Multi-host: workers register with a :class:`ServingCoordinator` (parity:
 DriverServiceUtils' coordination server, `HTTPSourceV2.scala:111-167`).
 """
@@ -52,7 +63,9 @@ import numpy as np
 
 from mmlspark_tpu.core.dataframe import DataFrame
 from mmlspark_tpu.core.logs import get_logger
-from mmlspark_tpu.core.profiling import StageTimings
+from mmlspark_tpu.core.profiling import (
+    StageTimings, process_rss_bytes, process_uptime_s,
+)
 from mmlspark_tpu.parallel.sharding import bucket_target, padded_device_batch
 from mmlspark_tpu.core.resilience import (
     SYSTEM_CLOCK, BreakerBoard, Clock, Deadline, DeadlineExceeded,
@@ -60,6 +73,12 @@ from mmlspark_tpu.core.resilience import (
 )
 from mmlspark_tpu.core.serialize import _jsonify
 from mmlspark_tpu.core.stage import Transformer
+from mmlspark_tpu.core.telemetry import (
+    CONTENT_TYPE as _METRICS_CONTENT_TYPE, MetricsRegistry, REGISTRY,
+    TRACE_HEADER, current_trace_id, merge_prometheus, new_trace_id,
+    render_registries, render_samples, trace_context,
+    trace_id_from_headers,
+)
 
 logger = get_logger("serving")
 
@@ -87,16 +106,23 @@ _MAX_SHAPES_TRACKED = 1024
 
 
 class _PendingRequest:
-    __slots__ = ("rid", "payload", "event", "reply", "status", "deadline")
+    __slots__ = ("rid", "payload", "event", "reply", "status", "deadline",
+                 "trace")
 
     def __init__(self, payload: Any, rid: Optional[str] = None,
-                 deadline: Optional[Deadline] = None):
+                 deadline: Optional[Deadline] = None,
+                 trace: Optional[str] = None):
         self.rid = rid or f"{_RID_PREFIX}-{next(_RID_COUNTER):x}"
         self.payload = payload
         self.event = threading.Event()
         self.reply: Optional[bytes] = None
         self.status = 200
         self.deadline = deadline
+        # the request's X-Trace-Id (inbound or minted at ingress):
+        # carried on the work item because the staged pipeline crosses
+        # threads, where contextvars do not follow — each stage
+        # re-enters trace_context from this field
+        self.trace = trace or new_trace_id()
 
 
 class ServingServer:
@@ -143,7 +169,25 @@ class ServingServer:
         self.bucket_batches = bool(bucket_batches)
         self.encoder_threads = max(int(encoder_threads), 1)
         self.max_inflight_batches = max(int(max_inflight_batches), 1)
-        self.timings = StageTimings()
+        # -- telemetry: a PER-SERVER registry (two workers in one test
+        # process must never mix counts) rendered by ``GET /metrics``
+        # together with the process-wide REGISTRY. StageTimings is a
+        # thin view over the same registry, so /stats and /metrics
+        # report the one set of samples. The pre-existing plain-int
+        # counters (n_shed, n_recompiles, ...) stay the source of truth
+        # — the registry exposes them through exposition-time callbacks,
+        # so the request hot path pays nothing for the counter surface;
+        # only the per-bucket dispatch histogram adds a (sub-us) observe
+        # per BATCH.
+        # the server's injectable clock feeds the registry too, so
+        # chaos tests drive Histogram.time() spans deterministically
+        self.registry = MetricsRegistry(clock=clock)
+        self.timings = StageTimings(registry=self.registry,
+                                    metric="serving_stage_duration_ms")
+        self._m_dispatch = self.registry.histogram(
+            "serving_dispatch_latency_ms",
+            "Model dispatch wall-clock per shape bucket (label = padded "
+            "row count actually dispatched).", labels=("bucket",))
         self.n_recompiles = 0
         self._shapes_seen: set = set()
         self._stats_lock = threading.Lock()
@@ -202,8 +246,9 @@ class ServingServer:
         self.journal_ttl = (float(journal_ttl)
                             if journal_ttl is not None and journal_ttl > 0
                             else None)
-        self._journal: "OrderedDict[str, Tuple[int, bytes, float]]" = \
-            OrderedDict()
+        # rid -> (status, reply, committed_at_mono, trace_id)
+        self._journal: "OrderedDict[str, Tuple[int, bytes, float, str]]" \
+            = OrderedDict()
         self._evicted: "OrderedDict[str, None]" = OrderedDict()
         self._inflight: Dict[str, _PendingRequest] = {}
         self._commit_lock = threading.Lock()
@@ -235,6 +280,61 @@ class ServingServer:
         self._journal_queue: "Queue[bytes]" = Queue()
         if journal_path:
             self._recover_journal()
+        self._register_metric_views()
+
+    def _register_metric_views(self) -> None:
+        """Expose the server's existing counters/state as registry
+        families via exposition-time callbacks: ``GET /metrics`` reads
+        them live, the hot paths keep their plain-int increments (int
+        reads are tear-free under the GIL)."""
+        m = self.registry
+        for name, help_, fn in (
+            ("serving_requests_total",
+             "Requests that entered a batch (includes synthetic warmup "
+             "rows).", lambda: self.n_requests),
+            ("serving_batches_total",
+             "Micro-batches processed.", lambda: self.n_batches),
+            ("serving_shed_total",
+             "New requests refused with 429 under overload.",
+             lambda: self.n_shed),
+            ("serving_deadline_missed_total",
+             "Requests 504ed because their X-Deadline-Ms budget expired "
+             "(at ingress, before dispatch, or before commit).",
+             lambda: self.n_deadline_expired),
+            ("serving_recompiles_total",
+             "Distinct dispatch shapes seen (each forces a jit retrace "
+             "in any jitted model).", lambda: self.n_recompiles),
+            ("serving_replayed_total",
+             "Requests answered from the exactly-once reply journal.",
+             lambda: self.n_replayed),
+            ("serving_journal_evicted_total",
+             "Journal entries evicted past the replay window.",
+             lambda: self.n_journal_evicted),
+            ("serving_window_missed_total",
+             "Retries that arrived after their journal entry was "
+             "evicted (re-executed).", lambda: self.n_window_missed),
+        ):
+            m.counter(name, help_).set_function(fn)
+        m.gauge("serving_backlog",
+                "Requests accepted but not yet dispatched into the "
+                "model (the shedding signal).").set_function(self.backlog)
+        m.gauge("serving_inflight_batches",
+                "Batches between collection and commit."
+                ).set_function(lambda: self._active_batches)
+        m.gauge("serving_journal_entries",
+                "Live replay-journal entries."
+                ).set_function(lambda: len(self._journal))
+        # process vitals belong to the PROCESS-wide registry: two
+        # co-hosted workers read the same RSS, and the fleet merge
+        # (which scrapes ?scope=server) must not sum it once per worker
+        REGISTRY.gauge(
+            "process_uptime_seconds",
+            "Seconds since process start (resets on restart)."
+        ).set_function(process_uptime_s)
+        REGISTRY.gauge(
+            "process_rss_bytes",
+            "Resident set size (leak evidence across chaos drills)."
+        ).set_function(lambda: process_rss_bytes() or 0)
 
     # -- HTTP side -----------------------------------------------------------
 
@@ -276,9 +376,14 @@ class ServingServer:
                 return cache[1]
 
             def _reply(self, status: int, body: bytes, replayed=False,
-                       window_missed=False, retry_after=None):
+                       window_missed=False, retry_after=None,
+                       trace=None, ctype="application/json"):
                 self.send_response(status)
-                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Type", ctype)
+                if trace:
+                    # echo the trace id so a client that did not supply
+                    # one can still correlate its reply with worker logs
+                    self.send_header(TRACE_HEADER, trace)
                 if replayed:
                     self.send_header("X-Replayed", "1")
                 if window_missed:
@@ -319,6 +424,21 @@ class ServingServer:
                             "max_queue": serving.max_queue}
                     self._reply(200, json.dumps(body).encode())
                     return
+                if self.path.split("?", 1)[0] == "/metrics":
+                    # Prometheus text exposition: the per-server
+                    # registry (stage/dispatch histograms + counter
+                    # views) plus the process-wide one (trainer, HTTP
+                    # egress, breakers, Timer stages).
+                    # ``?scope=server`` limits to the per-server
+                    # registry — the fleet merge scrapes that, so
+                    # co-hosted workers sharing one process REGISTRY
+                    # never double-count its families in the sum
+                    server_only = "scope=server" in self.path
+                    regs = (serving.registry,) if server_only \
+                        else (serving.registry, REGISTRY)
+                    body = render_registries(*regs).encode()
+                    self._reply(200, body, ctype=_METRICS_CONTENT_TYPE)
+                    return
                 if self.path == "/stats":
                     # data-plane observability: per-stage timings, the
                     # bucket set actually dispatched, and the recompile
@@ -340,6 +460,11 @@ class ServingServer:
                             "queue_depth": serving._n_backlog,
                             "stage_timings":
                                 serving.timings.snapshot(),
+                            # process vitals: chaos drills diff these
+                            # across kill/restart cycles — uptime
+                            # proves the restart, RSS spots the leak
+                            "uptime_s": round(process_uptime_s(), 3),
+                            "rss_bytes": process_rss_bytes(),
                         }
                     self._reply(200, json.dumps(stats).encode())
                     return
@@ -370,18 +495,32 @@ class ServingServer:
                 if self.path != serving.api_path:
                     self.send_error(404)
                     return
+                # trace ingress: adopt the inbound X-Trace-Id or mint
+                # one; bound for this handler thread's logs, carried on
+                # the pending request for the stage threads, echoed on
+                # every reply
+                tid = trace_id_from_headers(self.headers)
+                with trace_context(tid):
+                    self._do_predict(tid)
+
+            def _do_predict(self, tid):
                 if serving._draining.is_set():
                     # graceful drain: accepted work finishes, new work
                     # is refused so the orchestrator's retry lands on a
                     # live worker
                     self._reply(503, b'{"error": "draining"}',
-                                retry_after=serving.shed_retry_after)
+                                retry_after=serving.shed_retry_after,
+                                trace=tid)
                     return
                 length = int(self.headers.get("Content-Length", 0))
                 try:
                     payload = json.loads(self.rfile.read(length) or b"{}")
                 except ValueError:
-                    self.send_error(400, "invalid JSON")
+                    # _reply (not send_error): even a rejected request
+                    # must echo its trace id, or the client cannot
+                    # correlate the failure with worker logs
+                    self._reply(400, b'{"error": "invalid JSON"}',
+                                trace=tid)
                     return
 
                 deadline = Deadline.from_headers(self.headers,
@@ -414,7 +553,8 @@ class ServingServer:
                                 if window_missed:
                                     serving.n_window_missed += 1
                                 pending = _PendingRequest(payload, rid,
-                                                          deadline)
+                                                          deadline,
+                                                          trace=tid)
                                 serving._inflight[rid] = pending
                                 enqueue = True
                         else:
@@ -423,11 +563,12 @@ class ServingServer:
                             serving.n_replayed += 1
                     if committed is not None:
                         self._reply(committed[0], committed[1],
-                                    replayed=True)
+                                    replayed=True, trace=tid)
                         return
                     if shed:
                         self._reply(429, b'{"error": "overloaded"}',
-                                    retry_after=serving.shed_retry_after)
+                                    retry_after=serving.shed_retry_after,
+                                    trace=tid)
                         return
                     if window_missed:
                         logger.warning(
@@ -440,9 +581,11 @@ class ServingServer:
                         with serving._commit_lock:
                             serving.n_shed += 1
                         self._reply(429, b'{"error": "overloaded"}',
-                                    retry_after=serving.shed_retry_after)
+                                    retry_after=serving.shed_retry_after,
+                                    trace=tid)
                         return
-                    pending = _PendingRequest(payload, deadline=deadline)
+                    pending = _PendingRequest(payload, deadline=deadline,
+                                              trace=tid)
                     enqueue = True
 
                 if enqueue and deadline is not None and deadline.expired:
@@ -460,7 +603,7 @@ class ServingServer:
                     with serving._commit_lock:
                         serving._inflight.pop(pending.rid, None)
                     pending.event.set()
-                    self._reply(504, pending.reply)
+                    self._reply(504, pending.reply, trace=tid)
                     return
 
                 if enqueue:
@@ -468,14 +611,17 @@ class ServingServer:
                         serving._n_backlog += 1
                     serving._queue.put(pending)
                 if not pending.event.wait(serving.request_timeout):
-                    self.send_error(504, "inference timed out")
+                    # the stuck-batch timeout is the reply operators
+                    # most need to trace: echo the id here too
+                    self._reply(504, b'{"error": "inference timed out"}',
+                                trace=tid)
                     return
                 # a joined duplicate is only "replayed" if the reply was
                 # actually committed — errors are never journaled, so
                 # they must not carry the committed-replay marker
                 self._reply(pending.status, pending.reply or b"{}",
                             replayed=not enqueue and pending.status == 200,
-                            window_missed=window_missed)
+                            window_missed=window_missed, trace=tid)
 
             def log_message(self, *args):  # quiet
                 pass
@@ -633,7 +779,14 @@ class ServingServer:
                         # recompiles but are no longer remembered
                         if len(self._shapes_seen) < _MAX_SHAPES_TRACKED:
                             self._shapes_seen.add(key)
-                with self.timings.span("dispatch"):
+                # batch-representative trace (the first live request's):
+                # contextvars do not follow the thread handoff, so the
+                # executor re-binds here — model-internal logs and any
+                # io/http egress the model performs carry a trace id.
+                # Per-request exact ids ride the journal lines.
+                with trace_context(job["live"][0].trace), \
+                        self.timings.span("dispatch"), \
+                        self._m_dispatch.labels(df.num_rows).time():
                     out = self.model.transform(df)
                 # df.num_rows < n_live only for degenerate frames (e.g.
                 # empty-object payloads -> a zero-column frame): still a
@@ -683,7 +836,8 @@ class ServingServer:
         replies = None
         if job["error"] is None:
             try:
-                with self.timings.span("encode"):
+                with trace_context(live[0].trace), \
+                        self.timings.span("encode"):
                     replies = self._encode_replies(
                         job["out"], job["df"].columns, job["n_live"])
             except Exception as e:  # noqa: BLE001 — encode failure -> 500s
@@ -786,7 +940,8 @@ class ServingServer:
                 if self.journal_ttl is not None and age > self.journal_ttl:
                     continue
                 self._journal.pop(rid, None)      # newest record wins
-                self._journal[rid] = (status, reply, now_mono - age)
+                self._journal[rid] = (status, reply, now_mono - age,
+                                      str(rec.get("trace", "")))
             while len(self._journal) > self.journal_size:
                 self._journal.popitem(last=False)
             self.n_journal_recovered = len(self._journal)
@@ -797,9 +952,14 @@ class ServingServer:
 
     @staticmethod
     def _journal_line(rid, entry, t_wall) -> str:
+        # the trace id rides every journal line, so a committed reply
+        # correlates with its ingress/dispatch/egress log records even
+        # after a restart replays the file
         return json.dumps({"rid": rid, "status": entry[0],
                            "reply": entry[1].decode(),
-                           "t": round(t_wall, 3)}) + "\n"
+                           "t": round(t_wall, 3),
+                           "trace": entry[3] if len(entry) > 3 else ""
+                           }) + "\n"
 
     def _compact_journal(self) -> None:
         """Rewrite the file to exactly the live in-memory window and
@@ -887,7 +1047,7 @@ class ServingServer:
     def _commit_locked(self, p: _PendingRequest) -> None:
         if self._inflight.pop(p.rid, None) is not None \
                 and p.status == 200:
-            entry = (p.status, p.reply or b"{}", time.monotonic())
+            entry = (p.status, p.reply or b"{}", time.monotonic(), p.trace)
             self._journal[p.rid] = entry
             if self._journal_fh is not None:
                 # enqueue only: the writer thread does the file I/O
@@ -1191,14 +1351,25 @@ class ServingCoordinator:
                 self.wfile.write(b"{}")
 
             def do_GET(self):
-                if self.path != "/services":
+                if self.path == "/fleet":
+                    # one-stop fleet observability: polls every live
+                    # worker's /stats + /metrics and serves the merged
+                    # view (slowest stage, widest bucket, totals)
+                    body = json.dumps(coordinator.fleet_stats()).encode()
+                    ctype = "application/json"
+                elif self.path == "/fleet/metrics":
+                    body = coordinator.fleet_metrics().encode()
+                    ctype = _METRICS_CONTENT_TYPE
+                elif self.path == "/services":
+                    with coordinator._lock:
+                        coordinator._prune_stale_locked()
+                        body = json.dumps(coordinator._services).encode()
+                    ctype = "application/json"
+                else:
                     self.send_error(404)
                     return
-                with coordinator._lock:
-                    coordinator._prune_stale_locked()
-                    body = json.dumps(coordinator._services).encode()
                 self.send_response(200)
-                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Type", ctype)
                 self.send_header("Content-Length", str(len(body)))
                 self.end_headers()
                 self.wfile.write(body)
@@ -1237,6 +1408,115 @@ class ServingCoordinator:
         with self._lock:
             self._prune_stale_locked()
             return list(self._services)
+
+    # -- fleet-level stats aggregation ---------------------------------------
+
+    def _poll_workers(self, path: str, timeout: float
+                      ) -> List[Tuple[str, Any, Optional[str]]]:
+        """``(worker_key, parsed_or_text, error)`` per registered
+        worker; a dead worker contributes its error instead of failing
+        the whole fleet view. Polls run CONCURRENTLY so k unreachable
+        pods cost one connect timeout, not k of them — a fleet view
+        must stay fast exactly when workers are failing."""
+        import requests
+        from concurrent.futures import ThreadPoolExecutor
+
+        def poll(s):
+            wk = f"{s.get('host')}:{s.get('port')}"
+            try:
+                r = requests.get(f"http://{wk}{path}", timeout=timeout)
+                r.raise_for_status()
+                return (wk, r.json() if path == "/stats" else r.text,
+                        None)
+            except Exception as e:  # noqa: BLE001 — worker down/old
+                return (wk, None, str(e))
+
+        services = self.services()
+        if not services:
+            return []
+        with ThreadPoolExecutor(
+                max_workers=min(len(services), 16)) as pool:
+            return list(pool.map(poll, services))
+
+    def fleet_stats(self, timeout: float = 5.0) -> Dict[str, Any]:
+        """Poll every worker's ``/stats`` and merge them into one fleet
+        view — the single place a fleet's slowest stage is visible
+        (closing the ROADMAP item): per-stage timings are combined
+        (counts and totals sum, maxes max), and ``slowest_stage`` names
+        the stage with the highest merged mean AND the worker whose
+        per-worker mean for it is worst. ``widest_bucket`` is the
+        largest dispatch shape any worker compiled.
+        """
+        per_worker: Dict[str, Any] = {}
+        merged: Dict[str, Dict[str, float]] = {}
+        totals = {k: 0 for k in (
+            "n_requests", "n_batches", "n_recompiles", "queue_depth",
+            "inflight_batches")}
+        widest = 0
+        worst: Dict[str, Tuple[float, str]] = {}   # stage -> (mean, worker)
+        n_live = 0
+        for wk, stats, err in self._poll_workers("/stats", timeout):
+            if err is not None:
+                per_worker[wk] = {"error": err}
+                continue
+            n_live += 1
+            per_worker[wk] = stats
+            for k in totals:
+                totals[k] += int(stats.get(k) or 0)
+            sizes = stats.get("dispatch_sizes") or []
+            widest = max(widest, max(sizes, default=0))
+            for stage, t in (stats.get("stage_timings") or {}).items():
+                m = merged.setdefault(stage, {"count": 0, "total_ms": 0.0,
+                                              "max_ms": 0.0})
+                m["count"] += t.get("count", 0)
+                m["total_ms"] += t.get("total_ms", 0.0)
+                m["max_ms"] = max(m["max_ms"],
+                                  t.get("max_ms", t.get("last_ms", 0.0)))
+                mean = t.get("mean_ms", 0.0)
+                if mean > worst.get(stage, (-1.0, ""))[0]:
+                    worst[stage] = (mean, wk)
+        for m in merged.values():
+            m["mean_ms"] = round(m["total_ms"] / m["count"], 4) \
+                if m["count"] else 0.0
+            m["total_ms"] = round(m["total_ms"], 3)
+        slowest = None
+        if merged:
+            stage = max(merged, key=lambda s: merged[s]["mean_ms"])
+            slowest = {"stage": stage,
+                       "mean_ms": merged[stage]["mean_ms"],
+                       "max_ms": merged[stage]["max_ms"],
+                       "worker": worst[stage][1],
+                       "worker_mean_ms": round(worst[stage][0], 4)}
+        return {"n_workers": len(per_worker), "n_responding": n_live,
+                "totals": totals, "stage_timings": merged,
+                "slowest_stage": slowest, "widest_bucket": widest,
+                "workers": per_worker}
+
+    def fleet_metrics(self, timeout: float = 5.0) -> str:
+        """Poll every worker's ``/metrics`` and serve ONE merged
+        exposition: sample values summed per (name, labels) — exact for
+        counters and histogram buckets, fleet totals for gauges (see
+        :func:`mmlspark_tpu.core.telemetry.merge_prometheus`). Scraping
+        the coordinator thus covers the fleet with one target.
+
+        Scrapes ``?scope=server`` (each worker's own registry): the
+        process-wide REGISTRY would be summed once per worker when
+        several workers share a process, double-counting its families —
+        process-level metrics stay on the individual workers'
+        unscoped ``/metrics``.
+
+        Every registered worker contributes a
+        ``serving_worker_up{worker=...}`` sample (1 scraped, 0 failed):
+        when a worker drops out, the merged counters dip (Prometheus
+        reads that as a counter reset), and this is the signal that the
+        dip means "incomplete sum", not "restarted fleet"."""
+        polls = self._poll_workers("/metrics?scope=server", timeout)
+        merged = merge_prometheus(
+            body for _, body, err in polls if err is None)
+        for wk, _, err in polls:
+            merged[("serving_worker_up", (("worker", wk),))] = \
+                0.0 if err is not None else 1.0
+        return render_samples(merged)
 
     @staticmethod
     def register_worker(coordinator_url: str, host: str, port: int):
@@ -1333,6 +1613,11 @@ class ServingClient:
                 timeout_budget: Optional[float] = None) -> Any:
         import requests
         rid = request_id or uuid.uuid4().hex
+        # one trace id per LOGICAL request (adopting the ambient one
+        # when the caller is already inside a trace): every failover/
+        # retry attempt carries the same id, so the whole schedule is
+        # one line-set in worker logs
+        trace = current_trace_id() or new_trace_id()
         deadline = (Deadline(timeout_budget, clock=self.clock)
                     if timeout_budget is not None else None)
         sched = self.policy.schedule(deadline)
@@ -1347,7 +1632,7 @@ class ServingClient:
                 self.n_failovers += 1
             breaker = self.breakers.get(url)
             retry_after = None
-            headers = {"X-Request-Id": rid}
+            headers = {"X-Request-Id": rid, TRACE_HEADER: trace}
             if deadline is not None:
                 headers[Deadline.HEADER] = deadline.to_header()
             # attempt 0, plus one same-worker retry after a timeout: the

@@ -4,7 +4,7 @@ from mmlspark_tpu.stages.basic import (
     DropColumns, SelectColumns, RenameColumn, Repartition, Cacher,
     CheckpointData, Explode, Lambda, UDFTransformer, TextPreprocessor,
     UnicodeNormalize, ClassBalancer, ClassBalancerModel, PartitionSample,
-    MultiColumnAdapter, EnsembleByKey, SummarizeData,
+    MultiColumnAdapter, EnsembleByKey, SummarizeData, Timer, TimerModel,
 )
 from mmlspark_tpu.stages.prep import (
     ValueIndexer, ValueIndexerModel, IndexToValue,
@@ -24,7 +24,7 @@ __all__ = [
     "CheckpointData", "Explode", "Lambda", "UDFTransformer",
     "TextPreprocessor", "UnicodeNormalize", "ClassBalancer",
     "ClassBalancerModel", "PartitionSample", "MultiColumnAdapter",
-    "EnsembleByKey", "SummarizeData",
+    "EnsembleByKey", "SummarizeData", "Timer", "TimerModel",
     "ValueIndexer", "ValueIndexerModel", "IndexToValue",
     "CleanMissingData", "CleanMissingDataModel", "DataConversion",
     "BucketBatcher", "FixedBatcher", "DynamicBufferedBatcher",

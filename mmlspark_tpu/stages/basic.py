@@ -29,6 +29,13 @@ from mmlspark_tpu.core.params import (
     HasLabelCol, in_set, in_range,
 )
 from mmlspark_tpu.core.stage import Transformer, Estimator, Model, PipelineStage
+# re-exported here because this module is the parity home of the
+# reference's pipeline-stages (`Timer.scala:14-90`): the Timer wraps any
+# stage, logs its fit/transform wall-clock, AND records every span into
+# the process-wide metrics registry (pipeline_stage_duration_ms), so
+# batch pipelines and the serving plane report through one telemetry
+# surface — see docs/observability.md
+from mmlspark_tpu.core.stage import Timer, TimerModel  # noqa: F401
 
 
 class DropColumns(Transformer):

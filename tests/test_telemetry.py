@@ -1,0 +1,719 @@
+"""Unified telemetry: registry primitives, exposition, trace ids,
+fleet aggregation (ISSUE 3).
+
+Contracts under test:
+
+* **histogram bucket edges** — a sample exactly on an edge lands in
+  that ``le`` bucket (Prometheus ``le`` is inclusive), cumulative
+  rendering is correct, and the running sum/count/last/max track;
+* **concurrency** — N threads hammering one counter child lose no
+  increments (the lock-striped hot path is actually locked);
+* **exposition golden** — ``render()`` is byte-stable valid Prometheus
+  text format;
+* **trace propagation** — an inbound ``X-Trace-Id`` is echoed on the
+  reply, stamped into journal lines, injected into log records, and
+  minted when absent;
+* **fleet merge** — the coordinator's merged view sums per-worker
+  counters exactly and names the slowest stage across >= 2 workers;
+* **overhead** (perf-marked) — counter/histogram hot-path updates stay
+  under the 2 us budget that lets telemetry run in production.
+"""
+
+import json
+import logging
+import threading
+import time
+
+import numpy as np
+import pytest
+import requests
+
+from mmlspark_tpu.core.telemetry import (
+    DEFAULT_LATENCY_BUCKETS_MS, MetricsRegistry, current_trace_id,
+    log_buckets, merge_prometheus, new_trace_id, parse_prometheus,
+    trace_context, trace_id_from_headers,
+)
+
+
+# ---------------------------------------------------------------------------
+# Primitives
+# ---------------------------------------------------------------------------
+
+class TestCounter:
+
+    def test_inc_and_value(self):
+        r = MetricsRegistry()
+        c = r.counter("c_total", "help")
+        c.inc()
+        c.inc(2.5)
+        assert c.value == 3.5
+
+    def test_negative_inc_rejected(self):
+        c = MetricsRegistry().counter("c_total")
+        with pytest.raises(ValueError):
+            c.inc(-1)
+
+    def test_labels_children_independent(self):
+        c = MetricsRegistry().counter("c_total", labels=("k",))
+        c.labels("a").inc()
+        c.labels("a").inc()
+        c.labels("b").inc()
+        assert c.labels("a").value == 2
+        assert c.labels("b").value == 1
+
+    def test_label_arity_enforced(self):
+        c = MetricsRegistry().counter("c_total", labels=("k",))
+        with pytest.raises(ValueError):
+            c.labels("a", "b")
+
+    def test_set_function_view(self):
+        state = {"n": 0}
+        c = MetricsRegistry().counter("c_total")
+        c.set_function(lambda: state["n"])
+        state["n"] = 41
+        assert c.value == 41
+
+    def test_concurrent_increments_lose_nothing(self):
+        """8 threads x 5000 incs on ONE child: the exact total
+        survives (a bare ``+=`` on a float would drop updates under
+        bytecode interleaving)."""
+        c = MetricsRegistry().counter("c_total")
+        child = c.labels()
+        n_threads, n_incs = 8, 5000
+
+        def worker():
+            for _ in range(n_incs):
+                child.inc()
+
+        threads = [threading.Thread(target=worker)
+                   for _ in range(n_threads)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert c.value == n_threads * n_incs
+
+
+class TestGauge:
+
+    def test_set_inc_dec(self):
+        g = MetricsRegistry().gauge("g")
+        g.set(10)
+        g.inc(5)
+        g.dec(2)
+        assert g.value == 13
+
+    def test_set_function_live_view(self):
+        depth = [3]
+        g = MetricsRegistry().gauge("g")
+        g.set_function(lambda: depth[0])
+        assert g.value == 3
+        depth[0] = 9
+        assert g.value == 9
+
+
+class TestHistogram:
+
+    def test_bucket_edges_inclusive(self):
+        """Prometheus ``le`` semantics: a sample EXACTLY on an edge
+        belongs to that bucket; one epsilon above spills to the next."""
+        r = MetricsRegistry()
+        h = r.histogram("h_ms", buckets=(1.0, 10.0, 100.0))
+        for v in (1.0, 10.0, 100.0, 1.0000001, 0.1, 1e9):
+            h.observe(v)
+        s = h.stats()
+        # non-cumulative per-slot counts: [<=1, <=10, <=100, +Inf]
+        assert s["buckets"] == [2, 2, 1, 1]
+        assert s["count"] == 6
+        assert s["max"] == 1e9
+        assert s["last"] == 1e9
+
+    def test_render_is_cumulative(self):
+        r = MetricsRegistry()
+        h = r.histogram("h_ms", buckets=(1.0, 10.0))
+        for v in (0.5, 5.0, 50.0):
+            h.observe(v)
+        text = r.render()
+        assert 'h_ms_bucket{le="1"} 1' in text
+        assert 'h_ms_bucket{le="10"} 2' in text
+        assert 'h_ms_bucket{le="+Inf"} 3' in text
+        assert "h_ms_count 3" in text
+
+    def test_unsorted_buckets_rejected(self):
+        with pytest.raises(ValueError):
+            MetricsRegistry().histogram("h", buckets=(10.0, 1.0))
+
+    def test_time_context_manager_observes_ms(self):
+        from mmlspark_tpu.core.resilience import ManualClock
+        clock = ManualClock()
+        r = MetricsRegistry(clock=clock)
+        h = r.histogram("h_ms")
+        with h.time():
+            clock.advance(0.25)          # 250 ms on the injected clock
+        s = h.stats()
+        assert s["count"] == 1
+        assert abs(s["last"] - 250.0) < 1e-6
+
+    def test_reset(self):
+        h = MetricsRegistry().histogram("h_ms")
+        h.observe(5.0)
+        h.labels().reset()
+        assert h.stats()["count"] == 0
+
+    def test_default_buckets_are_log_scale_ms(self):
+        assert DEFAULT_LATENCY_BUCKETS_MS[0] == 0.1
+        assert DEFAULT_LATENCY_BUCKETS_MS[-1] == 10000.0
+        assert list(DEFAULT_LATENCY_BUCKETS_MS) == \
+            sorted(DEFAULT_LATENCY_BUCKETS_MS)
+
+    def test_log_buckets_helper(self):
+        assert log_buckets(1.0, 100.0) == \
+            (1.0, 2.5, 5.0, 10.0, 25.0, 50.0, 100.0)
+        with pytest.raises(ValueError):
+            log_buckets(10.0, 1.0)
+
+
+class TestRegistry:
+
+    def test_get_or_create_same_family(self):
+        r = MetricsRegistry()
+        assert r.counter("x_total") is r.counter("x_total")
+
+    def test_kind_mismatch_raises(self):
+        r = MetricsRegistry()
+        r.counter("x_total")
+        with pytest.raises(ValueError):
+            r.gauge("x_total")
+
+    def test_label_mismatch_raises(self):
+        r = MetricsRegistry()
+        r.counter("x_total", labels=("a",))
+        with pytest.raises(ValueError):
+            r.counter("x_total", labels=("b",))
+
+    def test_histogram_bucket_mismatch_raises(self):
+        r = MetricsRegistry()
+        r.histogram("h_ms", buckets=(1.0, 10.0))
+        with pytest.raises(ValueError):
+            r.histogram("h_ms", buckets=(1.0, 10.0, 100.0))
+        # same ladder re-registers fine
+        assert r.histogram("h_ms", buckets=(1.0, 10.0)) is not None
+
+    def test_reset_preserves_cached_family_references(self):
+        """reset() zeroes values in place: a call site holding the
+        family (the io/http / trainer caching pattern) keeps feeding
+        the SAME exposition afterwards — no orphaned updates."""
+        r = MetricsRegistry()
+        c = r.counter("c_total")
+        c.inc(5)
+        h = r.histogram("h_ms")
+        h.observe(3.0)
+        r.reset()
+        assert c.value == 0 and h.stats()["count"] == 0
+        c.inc()                              # the cached ref still counts
+        assert "c_total 1" in r.render()
+        assert r.counter("c_total") is c     # no second family
+
+    def test_invalid_names_rejected(self):
+        r = MetricsRegistry()
+        with pytest.raises(ValueError):
+            r.counter("bad name")
+        with pytest.raises(ValueError):
+            r.counter("ok_total", labels=("bad-label",))
+
+
+# ---------------------------------------------------------------------------
+# Exposition
+# ---------------------------------------------------------------------------
+
+class TestExposition:
+
+    def test_golden(self):
+        """Byte-stable golden: the full Prometheus text format for a
+        registry with all three kinds, labels, and escaping."""
+        r = MetricsRegistry()
+        c = r.counter("requests_total", "Total requests.",
+                      labels=("path", "status"))
+        c.labels("/predict", "200").inc(3)
+        c.labels('/we"ird', "500").inc()
+        r.gauge("backlog", "Accepted, undispatched.").set(7)
+        h = r.histogram("latency_ms", "Request latency.",
+                        buckets=(1.0, 2.5))
+        h.observe(0.5)
+        h.observe(2.5)
+        h.observe(99.0)
+        assert r.render() == (
+            '# HELP backlog Accepted, undispatched.\n'
+            '# TYPE backlog gauge\n'
+            'backlog 7\n'
+            '# HELP latency_ms Request latency.\n'
+            '# TYPE latency_ms histogram\n'
+            'latency_ms_bucket{le="1"} 1\n'
+            'latency_ms_bucket{le="2.5"} 2\n'
+            'latency_ms_bucket{le="+Inf"} 3\n'
+            'latency_ms_sum 102\n'
+            'latency_ms_count 3\n'
+            '# HELP requests_total Total requests.\n'
+            '# TYPE requests_total counter\n'
+            'requests_total{path="/predict",status="200"} 3\n'
+            'requests_total{path="/we\\"ird",status="500"} 1\n'
+        )
+
+    def test_parse_round_trip(self):
+        r = MetricsRegistry()
+        r.counter("x_total", labels=("k",)).labels("v").inc(4)
+        samples = parse_prometheus(r.render())
+        assert ("x_total", (("k", "v"),), 4.0) in samples
+
+    def test_merge_sums_across_scrapes(self):
+        r1, r2 = MetricsRegistry(), MetricsRegistry()
+        r1.counter("x_total").inc(2)
+        r2.counter("x_total").inc(5)
+        r2.counter("only_here_total").inc()
+        merged = merge_prometheus([r1.render(), r2.render()])
+        assert merged[("x_total", ())] == 7.0
+        assert merged[("only_here_total", ())] == 1.0
+
+    def test_parse_round_trips_hostile_label_values(self):
+        """Values containing '}', quotes, literal backslashes, and
+        backslash-n must survive render -> parse exactly (the fleet
+        merge depends on it)."""
+        for hostile in ('x}y', 'a"b', 'a\\nb', 'a\nb', 'tr{icky},v'):
+            r = MetricsRegistry()
+            r.counter("x_total", labels=("k",)).labels(hostile).inc()
+            samples = parse_prometheus(r.render())
+            assert ("x_total", (("k", hostile),), 1.0) in samples, hostile
+
+    def test_render_samples_round_trips_merge(self):
+        """parse -> merge -> render_samples -> parse is a fixed point,
+        including hostile label values (the /fleet/metrics path)."""
+        from mmlspark_tpu.core.telemetry import render_samples
+        r = MetricsRegistry()
+        r.counter("x_total", labels=("k",)).labels('new\nline').inc(2)
+        r.gauge("g").set(1.5)
+        merged = merge_prometheus([r.render(), r.render()])
+        text = render_samples(merged)
+        assert merge_prometheus([text]) == merged
+
+
+# ---------------------------------------------------------------------------
+# Trace ids
+# ---------------------------------------------------------------------------
+
+class TestTraceContext:
+
+    def test_bind_and_reset(self):
+        assert current_trace_id() is None
+        with trace_context("abc") as tid:
+            assert tid == "abc"
+            assert current_trace_id() == "abc"
+            with trace_context() as inner:
+                assert current_trace_id() == inner != "abc"
+            assert current_trace_id() == "abc"
+        assert current_trace_id() is None
+
+    def test_new_ids_unique(self):
+        ids = {new_trace_id() for _ in range(1000)}
+        assert len(ids) == 1000
+
+    def test_from_headers_adopts_and_sanitizes(self):
+        assert trace_id_from_headers({"X-Trace-Id": "keep-me"}) == "keep-me"
+        weird = trace_id_from_headers({"X-Trace-Id": ' a"b\\c\nd '})
+        assert weird == "abcd"
+        minted = trace_id_from_headers({})
+        assert minted and minted != trace_id_from_headers(None)
+
+    def test_does_not_cross_threads(self):
+        """Contextvars stay thread-local: the staged pipeline must
+        re-bind from the work item (which ServingServer does)."""
+        seen = []
+        with trace_context("outer"):
+            t = threading.Thread(
+                target=lambda: seen.append(current_trace_id()))
+            t.start()
+            t.join()
+        assert seen == [None]
+
+
+class TestLogIntegration:
+
+    def _record(self, msg="hello"):
+        return logging.LogRecord("mmlspark_tpu.test", logging.INFO,
+                                 __file__, 1, msg, (), None)
+
+    def test_json_formatter_carries_trace(self):
+        from mmlspark_tpu.core.logs import make_formatter
+        fmt = make_formatter("json")
+        with trace_context("tid-1"):
+            out = json.loads(fmt.format(self._record()))
+        assert out["message"] == "hello"
+        assert out["trace_id"] == "tid-1"
+        assert out["level"] == "INFO"
+
+    def test_plain_formatter_appends_trace_only_when_bound(self):
+        from mmlspark_tpu.core.logs import make_formatter
+        fmt = make_formatter("plain")
+        assert "trace=" not in fmt.format(self._record())
+        with trace_context("tid-2"):
+            assert fmt.format(self._record()).endswith("trace=tid-2")
+
+    def test_filter_stamps_records(self):
+        from mmlspark_tpu.core.logs import _TraceFilter
+        rec = self._record()
+        with trace_context("tid-3"):
+            assert _TraceFilter().filter(rec)
+        assert rec.trace_id == "tid-3"
+
+    def test_reconfigure_swaps_format_without_dropping_handler(self):
+        """The runtime log-format flip keeps the handler installed
+        throughout (records emitted mid-flip are never dropped) and
+        round-trips plain -> json -> plain."""
+        import os
+        from mmlspark_tpu.core import logs
+        logs.get_logger("telemetry-test")       # ensure configured
+        root = logging.getLogger("mmlspark_tpu")
+        n_handlers = len(root.handlers)
+        assert n_handlers >= 1
+        os.environ["MMLSPARK_TPU_LOGGING_FORMAT"] = "json"
+        try:
+            logs.reconfigure()
+            assert len(root.handlers) == n_handlers
+            out = json.loads(root.handlers[0].formatter.format(
+                self._record("flip")))
+            assert out["message"] == "flip"
+        finally:
+            del os.environ["MMLSPARK_TPU_LOGGING_FORMAT"]
+            logs.reconfigure()
+        assert "flip" in root.handlers[0].formatter.format(
+            self._record("flip"))
+        assert len(root.handlers) == n_handlers
+
+
+# ---------------------------------------------------------------------------
+# StageTimings as a registry view
+# ---------------------------------------------------------------------------
+
+class TestStageTimings:
+
+    def test_snapshot_has_max_and_reset(self):
+        from mmlspark_tpu.core.profiling import StageTimings
+        clock = iter([0.0, 0.010, 1.0, 1.002]).__next__
+        t = StageTimings(clock=clock)
+        with t.span("s"):
+            pass
+        with t.span("s"):
+            pass
+        snap = t.snapshot()["s"]
+        assert snap["count"] == 2
+        assert snap["max_ms"] == 10.0
+        assert snap["last_ms"] == 2.0
+        assert snap["total_ms"] == 12.0
+        t.reset()
+        assert t.snapshot()["s"]["count"] == 0
+
+    def test_shares_registry_with_metrics(self):
+        from mmlspark_tpu.core.profiling import StageTimings
+        r = MetricsRegistry()
+        t = StageTimings(registry=r, metric="spans_ms")
+        with t.span("collect"):
+            pass
+        assert 'spans_ms_count{stage="collect"} 1' in r.render()
+
+    def test_process_vitals(self):
+        from mmlspark_tpu.core.profiling import (
+            process_rss_bytes, process_uptime_s)
+        assert process_uptime_s() > 0
+        rss = process_rss_bytes()
+        assert rss is None or rss > 1024 * 1024
+
+
+# ---------------------------------------------------------------------------
+# Live server: /metrics + trace end-to-end
+# ---------------------------------------------------------------------------
+
+class _Doubler:
+    pass
+
+
+def _doubler():
+    from mmlspark_tpu.core.stage import Transformer
+
+    class Doubler(Transformer):
+        def transform(self, df):
+            return df.with_column(
+                "y", np.asarray(df["x"], dtype=np.float64) * 2)
+
+    return Doubler()
+
+
+class TestServingTelemetry:
+
+    def test_metrics_endpoint_valid_and_consistent(self):
+        from mmlspark_tpu.serving import ServingServer
+        with ServingServer(_doubler(), max_batch_size=4,
+                           max_latency_ms=5) as srv:
+            srv.warmup({"x": 0.0})
+            for i in range(3):
+                requests.post(srv.address, json={"x": float(i)},
+                              timeout=10)
+            base = srv.address.rsplit("/", 1)[0]
+            resp = requests.get(base + "/metrics", timeout=10)
+            assert resp.status_code == 200
+            assert resp.headers["Content-Type"].startswith("text/plain")
+            samples = dict(
+                ((n, l), v) for n, l, v in parse_prometheus(resp.text))
+            stats = requests.get(base + "/stats", timeout=10).json()
+            # the registry views and /stats read the same state
+            assert samples[("serving_requests_total", ())] == \
+                stats["n_requests"]
+            assert samples[("serving_recompiles_total", ())] == \
+                stats["n_recompiles"]
+            assert samples[("serving_batches_total", ())] == \
+                stats["n_batches"]
+            # per-bucket dispatch histogram covers every warmed bucket
+            for b in stats["dispatch_sizes"]:
+                assert samples[("serving_dispatch_latency_ms_count",
+                                (("bucket", str(b)),))] > 0
+            # stage spans appear in BOTH surfaces with equal counts
+            for stage, t in stats["stage_timings"].items():
+                assert samples[("serving_stage_duration_ms_count",
+                                (("stage", stage),))] == t["count"]
+            assert samples[("process_uptime_seconds", ())] > 0
+
+    def test_stats_gains_vitals_keeps_existing_keys(self):
+        from mmlspark_tpu.serving import ServingServer
+        with ServingServer(_doubler(), max_batch_size=4) as srv:
+            base = srv.address.rsplit("/", 1)[0]
+            stats = requests.get(base + "/stats", timeout=10).json()
+        for key in ("pipeline", "bucket_batches", "encoder_threads",
+                    "n_batches", "n_requests", "n_recompiles",
+                    "dispatch_sizes", "inflight_batches", "queue_depth",
+                    "stage_timings", "uptime_s", "rss_bytes"):
+            assert key in stats
+
+    def test_trace_id_echoed_and_minted(self):
+        from mmlspark_tpu.serving import ServingServer
+        with ServingServer(_doubler(), max_batch_size=4,
+                           max_latency_ms=5) as srv:
+            srv.warmup({"x": 0.0})
+            r = requests.post(srv.address, json={"x": 1.0},
+                              headers={"X-Trace-Id": "client-trace-7"},
+                              timeout=10)
+            assert r.headers["X-Trace-Id"] == "client-trace-7"
+            assert r.json() == {"y": 2.0}
+            r2 = requests.post(srv.address, json={"x": 2.0}, timeout=10)
+            assert r2.headers.get("X-Trace-Id")  # minted at ingress
+
+    def test_trace_id_lands_in_journal_lines(self, tmp_path):
+        from mmlspark_tpu.serving import ServingServer
+        path = str(tmp_path / "journal.jsonl")
+        srv = ServingServer(_doubler(), max_batch_size=4,
+                            max_latency_ms=5, journal_path=path)
+        srv.warmup({"x": 0.0})
+        srv.start()
+        try:
+            r = requests.post(
+                srv.address, json={"x": 5.0},
+                headers={"X-Trace-Id": "journal-trace",
+                         "X-Request-Id": "rid-1"}, timeout=10)
+            assert r.status_code == 200
+        finally:
+            srv.stop()
+        recs = [json.loads(l) for l in open(path) if l.strip()]
+        mine = [rec for rec in recs if rec["rid"] == "rid-1"]
+        assert mine and mine[0]["trace"] == "journal-trace"
+
+    def test_trace_replayed_after_journal_recovery(self, tmp_path):
+        from mmlspark_tpu.serving import ServingServer
+        path = str(tmp_path / "journal.jsonl")
+        srv = ServingServer(_doubler(), max_batch_size=4,
+                            max_latency_ms=5, journal_path=path)
+        srv.start()
+        try:
+            requests.post(srv.address, json={"x": 5.0},
+                          headers={"X-Trace-Id": "t-orig",
+                                   "X-Request-Id": "rid-2"}, timeout=10)
+        finally:
+            srv.stop()
+        srv2 = ServingServer(_doubler(), max_batch_size=4,
+                             journal_path=path)
+        assert srv2.n_journal_recovered == 1
+        assert srv2._journal["rid-2"][3] == "t-orig"
+
+
+# ---------------------------------------------------------------------------
+# Fleet aggregation
+# ---------------------------------------------------------------------------
+
+class TestFleetView:
+
+    def _slow_doubler(self, delay_s):
+        from mmlspark_tpu.core.stage import Transformer
+
+        class Slow(Transformer):
+            def transform(self, df):
+                time.sleep(delay_s)
+                return df.with_column(
+                    "y", np.asarray(df["x"], dtype=np.float64) * 2)
+
+        return Slow()
+
+    def test_merge_over_two_workers(self):
+        """The merged fleet view sums per-worker counters exactly,
+        identifies the slowest stage, and attributes it to the slow
+        worker — the ROADMAP item this subsystem closes."""
+        from mmlspark_tpu.serving import ServingCoordinator, ServingServer
+        fast = ServingServer(_doubler(), max_batch_size=4,
+                             max_latency_ms=2)
+        slow = ServingServer(self._slow_doubler(0.05), max_batch_size=8,
+                             max_latency_ms=2)
+        for s in (fast, slow):
+            s.warmup({"x": 0.0})
+            s.start()
+        coord = ServingCoordinator().start()
+        curl = f"http://{coord.host}:{coord.port}"
+        try:
+            for s in (fast, slow):
+                ServingCoordinator.register_worker(curl, s.host, s.port)
+            for s in (fast, slow):
+                for i in range(2):
+                    requests.post(f"http://{s.host}:{s.port}/predict",
+                                  json={"x": float(i)}, timeout=10)
+            fleet = requests.get(curl + "/fleet", timeout=10).json()
+            assert fleet["n_workers"] == 2
+            assert fleet["n_responding"] == 2
+            stats_f = requests.get(
+                f"http://{fast.host}:{fast.port}/stats", timeout=10).json()
+            stats_s = requests.get(
+                f"http://{slow.host}:{slow.port}/stats", timeout=10).json()
+            assert fleet["totals"]["n_requests"] == \
+                stats_f["n_requests"] + stats_s["n_requests"]
+            assert fleet["totals"]["n_batches"] == \
+                stats_f["n_batches"] + stats_s["n_batches"]
+            # the slow worker's 50 ms model dominates: dispatch is the
+            # fleet's slowest stage and is attributed to that worker
+            assert fleet["slowest_stage"]["stage"] == "dispatch"
+            assert fleet["slowest_stage"]["worker"] == \
+                f"{slow.host}:{slow.port}"
+            # widest compiled bucket across the fleet (slow has cap 8)
+            assert fleet["widest_bucket"] == 8
+            # merged stage timings: counts sum across workers
+            merged_dispatch = fleet["stage_timings"]["dispatch"]
+            assert merged_dispatch["count"] == \
+                stats_f["stage_timings"]["dispatch"]["count"] + \
+                stats_s["stage_timings"]["dispatch"]["count"]
+            # merged exposition: counters sum exactly
+            fm = requests.get(curl + "/fleet/metrics", timeout=10).text
+            merged = dict(((n, l), v) for n, l, v in parse_prometheus(fm))
+            assert merged[("serving_requests_total", ())] == \
+                stats_f["n_requests"] + stats_s["n_requests"]
+        finally:
+            coord.stop()
+            fast.stop()
+            slow.stop()
+
+    def test_fleet_metrics_excludes_shared_process_registry(self):
+        """Two workers in ONE process share the global REGISTRY: the
+        merged fleet exposition must not sum its families once per
+        worker (it scrapes ?scope=server), while each worker's own
+        /metrics still includes them."""
+        from mmlspark_tpu.core.telemetry import REGISTRY
+        from mmlspark_tpu.serving import ServingCoordinator, ServingServer
+        marker = REGISTRY.counter("test_fleet_dedupe_total")
+        marker.labels()        # ensure the family renders
+        s1 = ServingServer(_doubler(), max_batch_size=4)
+        s2 = ServingServer(_doubler(), max_batch_size=4)
+        coord = ServingCoordinator().start()
+        curl = f"http://{coord.host}:{coord.port}"
+        try:
+            s1.start()
+            s2.start()
+            for s in (s1, s2):
+                ServingCoordinator.register_worker(curl, s.host, s.port)
+            full = requests.get(
+                f"http://{s1.host}:{s1.port}/metrics", timeout=10).text
+            assert "test_fleet_dedupe_total" in full
+            scoped = requests.get(
+                f"http://{s1.host}:{s1.port}/metrics?scope=server",
+                timeout=10).text
+            assert "test_fleet_dedupe_total" not in scoped
+            assert "serving_requests_total" in scoped
+            fm = requests.get(curl + "/fleet/metrics", timeout=10).text
+            assert "test_fleet_dedupe_total" not in fm
+            assert "serving_requests_total" in fm
+        finally:
+            coord.stop()
+            s1.stop()
+            s2.stop()
+
+    def test_dead_worker_does_not_fail_fleet_view(self):
+        from mmlspark_tpu.serving import ServingCoordinator, ServingServer
+        srv = ServingServer(_doubler(), max_batch_size=4)
+        srv.start()
+        coord = ServingCoordinator().start()
+        curl = f"http://{coord.host}:{coord.port}"
+        try:
+            ServingCoordinator.register_worker(curl, srv.host, srv.port)
+            # a registered-but-dead worker (nothing listens on port 9)
+            requests.post(curl + "/register",
+                          json={"host": "127.0.0.1", "port": 9},
+                          timeout=10)
+            fleet = coord.fleet_stats(timeout=2.0)
+            assert fleet["n_workers"] == 2
+            assert fleet["n_responding"] == 1
+            assert "error" in fleet["workers"]["127.0.0.1:9"]
+            # the merged exposition flags the dead worker instead of
+            # silently summing an incomplete fleet
+            merged = dict(
+                ((n, l), v) for n, l, v in
+                parse_prometheus(coord.fleet_metrics(timeout=2.0)))
+            assert merged[("serving_worker_up",
+                           (("worker", "127.0.0.1:9"),))] == 0.0
+            assert merged[("serving_worker_up",
+                           (("worker", f"{srv.host}:{srv.port}"),))] == 1.0
+        finally:
+            coord.stop()
+            srv.stop()
+
+
+# ---------------------------------------------------------------------------
+# Hot-path overhead
+# ---------------------------------------------------------------------------
+
+@pytest.mark.perf
+class TestOverhead:
+    """The <2 us/update budget that makes always-on telemetry viable
+    (headline numbers live in bench.py's ``telemetry_overhead_v1``)."""
+
+    BUDGET_NS = 2000
+
+    def _per_op_ns(self, fn, n=20000, rounds=3):
+        best = float("inf")
+        for _ in range(rounds):
+            t0 = time.perf_counter_ns()
+            for _ in range(n):
+                fn()
+            best = min(best, (time.perf_counter_ns() - t0) / n)
+        return best
+
+    def test_counter_inc_under_budget(self):
+        child = MetricsRegistry().counter("c_total", labels=("k",)) \
+                                 .labels("hot")
+        assert self._per_op_ns(child.inc) < self.BUDGET_NS
+
+    def test_histogram_observe_under_budget(self):
+        child = MetricsRegistry().histogram("h_ms").labels()
+        assert self._per_op_ns(lambda: child.observe(3.7)) < self.BUDGET_NS
+
+    def test_stage_timings_span_under_budget(self):
+        from mmlspark_tpu.core.profiling import StageTimings
+        t = StageTimings()
+
+        def one():
+            with t.span("hot"):
+                pass
+
+        # a span adds generator-contextmanager machinery + two clock
+        # reads on top of the observe; it runs per BATCH (not per
+        # request), so its budget is looser: 4x
+        assert self._per_op_ns(one) < 4 * self.BUDGET_NS

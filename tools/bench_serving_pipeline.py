@@ -25,6 +25,11 @@ steady state is measured, not its warm-up — and the harness asserts
 ``--model nn`` swaps the trivial host-side model for a small jitted
 ``NNModel`` MLP so the A/B includes real device dispatch (on CPU this
 exercises the same jit shape-cache the TPU path hits).
+
+``--metrics-dump PATH`` additionally writes each mode's post-run
+``GET /metrics`` Prometheus scrape to ``PATH.<mode>.prom`` — the full
+histogram/counter evidence behind the A/B summary (see
+docs/observability.md).
 """
 
 from __future__ import annotations
@@ -99,9 +104,17 @@ def _stats(srv) -> dict:
     return out
 
 
+def _metrics_text(srv) -> str:
+    conn = http.client.HTTPConnection(srv.host, srv.port, timeout=10)
+    conn.request("GET", "/metrics")
+    out = conn.getresponse().read().decode()
+    conn.close()
+    return out
+
+
 def run_mode(mode: str, model_kind: str, n_clients: int,
              duration_s: float, max_batch_size: int,
-             burst: int) -> dict:
+             burst: int, metrics_dump: str = "") -> dict:
     from mmlspark_tpu.serving import ServingServer
 
     model = _nn_model() if model_kind == "nn" else _identity_model()
@@ -125,6 +138,14 @@ def run_mode(mode: str, model_kind: str, n_clients: int,
         for t in threads:
             t.join()
         stats = _stats(srv)
+        dump_path = None
+        if metrics_dump:
+            # the post-run Prometheus scrape, written next to the A/B
+            # numbers: the full histogram/counter evidence behind the
+            # summary line (promtool-checkable, diffable across runs)
+            dump_path = f"{metrics_dump}.{mode}.prom"
+            with open(dump_path, "w") as f:
+                f.write(_metrics_text(srv))
     all_lat = sorted(x for per in lat for x in per)
     p = (lambda q: round(1000 * all_lat[int(q * (len(all_lat) - 1))], 3)) \
         if all_lat else (lambda q: None)
@@ -137,6 +158,7 @@ def run_mode(mode: str, model_kind: str, n_clients: int,
         "dispatch_sizes": stats["dispatch_sizes"],
         "stage_timings": {k: v["mean_ms"] for k, v in
                           stats["stage_timings"].items()},
+        **({"metrics_dump": dump_path} if dump_path else {}),
     }
 
 
@@ -151,6 +173,9 @@ def main() -> None:
     ap.add_argument("--max-batch-size", type=int, default=128)
     ap.add_argument("--burst", type=int, default=16,
                     help="requests per client burst (varies batch sizes)")
+    ap.add_argument("--metrics-dump", default="", metavar="PATH",
+                    help="write each mode's post-run GET /metrics scrape "
+                         "to PATH.<mode>.prom next to the A/B numbers")
     args = ap.parse_args()
     if args.smoke:
         args.clients, args.seconds = min(args.clients, 4), 1.0
@@ -158,7 +183,7 @@ def main() -> None:
     results = {}
     for mode in ("serial", "pipelined"):
         r = run_mode(mode, args.model, args.clients, args.seconds,
-                     args.max_batch_size, args.burst)
+                     args.max_batch_size, args.burst, args.metrics_dump)
         results[mode] = r
         print(json.dumps(r), flush=True)
     if results["pipelined"]["recompiles_after_warmup"] != 0:
