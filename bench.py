@@ -33,6 +33,10 @@ Plus (no era analogue, utilization/latency evidence):
                                    counter inc / histogram observe; the
                                    cost every serving batch, train step,
                                    and HTTP send now carries)
+ 13. tracing_overhead_v1         — span start+finish hot path (ns per
+                                   recorded span, flight-recorder ring
+                                   throughput; the cost every traced
+                                   request, stage, and train step adds)
 
 Every line carries chip metadata (platform/device kind/count) so the
 numbers are interpretable across hosts.
@@ -850,13 +854,65 @@ def bench_telemetry_overhead():
             "chip": _chip()}
 
 
+def bench_tracing_overhead():
+    """Span-tracing hot-path overhead: ns per recorded span (start +
+    finish, landing in the flight recorder's ring) for child spans, the
+    contextmanager form, and completed-child ``add`` (the serving
+    plane's per-request per-stage record), plus the ring's sustained
+    record throughput. The tracer now sits on every serving request,
+    pipeline stage, and train step — budget < 4 us (4000 ns) per span
+    lifecycle: 2x the metrics-update budget, because a span is two
+    timed clock reads + an object + a striped ring store where a
+    counter inc is one locked add (same 2x precedent as the
+    StageTimings span). vs_baseline = budget / measured (start+finish).
+    """
+    from mmlspark_tpu.core.tracing import Tracer
+
+    def per_op_ns(fn, n=100_000, rounds=3):
+        best = float("inf")
+        for _ in range(rounds):
+            t0 = time.perf_counter_ns()
+            for _ in range(n):
+                fn()
+            best = min(best, (time.perf_counter_ns() - t0) / n)
+        return best
+
+    tracer = Tracer(default_slow_ms=None)   # never capture: hot path only
+    root = tracer.start("bench_root", route="bench")
+
+    def start_finish():
+        tracer.finish(tracer.start("child", parent=root))
+
+    def ctx():
+        with tracer.span("child"):
+            pass
+
+    now = tracer.clock.now()
+
+    def add():
+        tracer.add("child", now, now, parent=root)
+
+    span_ns = per_op_ns(start_finish)
+    ctx_ns = per_op_ns(ctx, n=50_000)
+    add_ns = per_op_ns(add)
+    budget = 4000.0
+    return {"metric": "tracing_overhead_v1",
+            "value": round(span_ns, 1), "unit": "ns/span",
+            "ctx_span_ns": round(ctx_ns, 1),
+            "add_child_ns": round(add_ns, 1),
+            "ring_records_per_s": round(1e9 / max(add_ns, 1e-9), 0),
+            "baseline": budget,
+            "vs_baseline": round(budget / max(span_ns, 1e-9), 3),
+            "chip": _chip()}
+
+
 BENCHES = [bench_gbdt_quantile, bench_adult_census, bench_cifar10_scoring,
            bench_cifar10_scoring_uint8, bench_imagenet_scoring,
            bench_transfer_learning, bench_distributed_sgd,
            bench_serving_latency, bench_serving_throughput,
            bench_transformer_train,
            bench_transformer_train_long, bench_moe_train,
-           bench_telemetry_overhead]
+           bench_telemetry_overhead, bench_tracing_overhead]
 
 
 def main() -> None:

@@ -13,11 +13,13 @@ Observability extensions:
   shape log pipelines (Loki, Stackdriver, `jq`) ingest without a parse
   regex.
 * every record carries the ambient trace id
-  (:func:`mmlspark_tpu.core.telemetry.current_trace_id`): a handler
-  filter stamps ``record.trace_id``, the JSON format emits it as a
-  field, and the plain format appends ``trace=<id>`` only when a trace
-  is actually bound — grep one serving request's id across ingress,
-  dispatch, and egress log lines.
+  (:func:`mmlspark_tpu.core.telemetry.current_trace_id`) and span name
+  (:func:`mmlspark_tpu.core.tracing.current_span_name`): a handler
+  filter stamps ``record.trace_id`` / ``record.span_name``, the JSON
+  format emits both as fields, and the plain format appends
+  ``trace=<id> span=<name>`` only when actually bound — grep one
+  serving request's id across ingress, dispatch, and egress log lines
+  and see which stage each line came from.
 """
 
 from __future__ import annotations
@@ -30,11 +32,16 @@ _configured = False
 
 
 class _TraceFilter(_logging.Filter):
-    """Stamp the ambient trace id onto every record at emit time."""
+    """Stamp the ambient trace id AND span name onto every record at
+    emit time — a log line inside a serving dispatch reads
+    ``trace=<id> span=dispatch``, so grep finds not just the request
+    but the stage it was in."""
 
     def filter(self, record: _logging.LogRecord) -> bool:
         from mmlspark_tpu.core.telemetry import current_trace_id
+        from mmlspark_tpu.core.tracing import current_span_name
         record.trace_id = current_trace_id() or "-"
+        record.span_name = current_span_name() or "-"
         return True
 
 
@@ -48,9 +55,17 @@ def _record_trace_id(record: _logging.LogRecord):
     return tid
 
 
+def _record_span_name(record: _logging.LogRecord):
+    name = getattr(record, "span_name", None)
+    if name is None:
+        from mmlspark_tpu.core.tracing import current_span_name
+        name = current_span_name() or "-"
+    return name
+
+
 class _PlainFormatter(_logging.Formatter):
-    """The historical plain format, plus ``trace=<id>`` when one is
-    bound (no trailing noise for untraced records)."""
+    """The historical plain format, plus ``trace=<id>`` / ``span=<name>``
+    when bound (no trailing noise for untraced records)."""
 
     def __init__(self):
         super().__init__("%(asctime)s %(name)s %(levelname)s: %(message)s")
@@ -60,11 +75,14 @@ class _PlainFormatter(_logging.Formatter):
         tid = _record_trace_id(record)
         if tid and tid != "-":
             out += f" trace={tid}"
+        span = _record_span_name(record)
+        if span and span != "-":
+            out += f" span={span}"
         return out
 
 
 class _JsonFormatter(_logging.Formatter):
-    """One JSON object per line: ts/level/logger/message/trace_id
+    """One JSON object per line: ts/level/logger/message/trace_id/span
     (+ exc when an exception rode the record)."""
 
     def format(self, record: _logging.LogRecord) -> str:
@@ -74,6 +92,7 @@ class _JsonFormatter(_logging.Formatter):
             "logger": record.name,
             "message": record.getMessage(),
             "trace_id": _record_trace_id(record),
+            "span": _record_span_name(record),
         }
         if record.exc_info:
             out["exc"] = self.formatException(record.exc_info)

@@ -143,8 +143,14 @@ class Timer(Estimator):
     def fit(self, df: DataFrame) -> "TimerModel":
         inner = self.stage
         if isinstance(inner, Estimator):
+            from mmlspark_tpu.core.tracing import ambient_tracer
             t0 = time.time()
-            inner = inner.fit(df)
+            # the span nests under any ambient trace (a traced batch
+            # job sees Timer-wrapped fits in its captured timeline)
+            with ambient_tracer().span(
+                    f"fit:{type(self.stage).__name__}",
+                    route="pipeline"):
+                inner = inner.fit(df)
             dt = time.time() - t0
             _stage_histogram().labels(
                 type(self.stage).__name__, "fit").observe(dt * 1000.0)
@@ -164,8 +170,12 @@ class TimerModel(Model):
     stage = _P(None, "the fitted stage to time", complex=True)
 
     def transform(self, df: DataFrame) -> DataFrame:
+        from mmlspark_tpu.core.tracing import ambient_tracer
         t0 = time.time()
-        out = self.stage.transform(df)
+        with ambient_tracer().span(
+                f"transform:{type(self.stage).__name__}",
+                route="pipeline"):
+            out = self.stage.transform(df)
         dt = time.time() - t0
         _stage_histogram().labels(
             type(self.stage).__name__, "transform").observe(dt * 1000.0)

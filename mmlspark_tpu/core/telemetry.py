@@ -52,8 +52,10 @@ import contextlib
 import contextvars
 import itertools
 import math
+import os
 import re
 import threading
+import time
 import uuid
 from bisect import bisect_left
 from typing import (
@@ -67,7 +69,8 @@ __all__ = [
     "MetricsRegistry", "REGISTRY",
     "DEFAULT_LATENCY_BUCKETS_MS", "log_buckets",
     "render_registries", "parse_prometheus", "merge_prometheus",
-    "render_samples",
+    "render_samples", "MetricsSnapshot", "snapshot_registries",
+    "CONTENT_TYPE", "OPENMETRICS_CONTENT_TYPE",
     "TRACE_HEADER", "new_trace_id", "current_trace_id", "trace_context",
     "trace_id_from_headers",
 ]
@@ -245,10 +248,18 @@ class _HistogramChild:
 
     ``observe`` is the hot path: one C-speed ``bisect`` over the edge
     tuple, then four updates under the stripe lock.
+
+    Exemplars: when a trace id is bound, the observation's bucket
+    remembers ``(trace_id, value, unix_ts)`` — last-traced-observation
+    sampling, written OUTSIDE the stripe lock (one list-slot store,
+    atomic under the GIL; a torn read across the tuple is impossible
+    because the tuple is built first and the slot swap is one
+    bytecode). A p99 bucket in the exposition then links straight to a
+    captured trace (see :mod:`mmlspark_tpu.core.tracing`).
     """
 
     __slots__ = ("_lock", "_edges", "_counts", "_sum", "_count",
-                 "_last", "_max", "_clock")
+                 "_last", "_max", "_clock", "_exemplars")
 
     def __init__(self, edges: Tuple[float, ...], clock: Clock):
         self._lock = _next_stripe()
@@ -259,6 +270,9 @@ class _HistogramChild:
         self._last = 0.0
         self._max = 0.0
         self._clock = clock
+        # one optional (trace_id, value, unix_ts) per bucket, +Inf incl.
+        self._exemplars: List[Optional[Tuple[str, float, float]]] = \
+            [None] * (len(edges) + 1)
 
     def observe(self, value: float) -> None:
         i = bisect_left(self._edges, value)
@@ -269,6 +283,14 @@ class _HistogramChild:
             self._last = value
             if value > self._max:
                 self._max = value
+        # exemplar write stays OUTSIDE the lock stripe: the contextvar
+        # read is the only cost untraced hot paths pay
+        tid = _trace_id.get()
+        if tid is not None:
+            self._exemplars[i] = (tid, value, time.time())
+
+    def exemplars(self) -> List[Optional[Tuple[str, float, float]]]:
+        return list(self._exemplars)
 
     @contextlib.contextmanager
     def time(self, scale: float = 1000.0) -> Iterator[None]:
@@ -293,6 +315,7 @@ class _HistogramChild:
             self._count = 0
             self._last = 0.0
             self._max = 0.0
+            self._exemplars = [None] * (len(self._edges) + 1)
 
 
 # ---------------------------------------------------------------------------
@@ -356,14 +379,16 @@ class _Family:
         pairs += [f'{n}="{_escape_label(v)}"' for n, v in extra]
         return "{" + ",".join(pairs) + "}" if pairs else ""
 
-    def render(self) -> List[str]:
+    def render(self, exemplars: bool = False) -> List[str]:
         lines = [f"# HELP {self.name} {_escape_help(self.help)}",
                  f"# TYPE {self.name} {self.kind}"]
         for key, child in sorted(self.children()):
-            lines.extend(self._render_child(key, child))
+            lines.extend(self._render_child(key, child,
+                                            exemplars=exemplars))
         return lines
 
-    def _render_child(self, key, child) -> List[str]:
+    def _render_child(self, key, child, exemplars: bool = False
+                      ) -> List[str]:
         return [f"{self.name}{self._label_str(key)} {_fmt(child.value)}"]
 
 
@@ -433,19 +458,40 @@ class Histogram(_Family):
     def stats(self) -> Dict[str, Any]:
         return self._default().stats()
 
-    def _render_child(self, key, child) -> List[str]:
+    @staticmethod
+    def _exemplar_suffix(ex) -> str:
+        """OpenMetrics exemplar: ``# {trace_id="..."} value ts`` after
+        a bucket sample. Emitted ONLY in the OpenMetrics exposition
+        (``render(exemplars=True)``): the classic 0.0.4 text-format
+        grammar allows nothing after the value but a timestamp, and a
+        vanilla Prometheus scraper fails the WHOLE scrape on the ``#``
+        token. The in-house parser and the fleet merge take the value
+        as the first post-label token and ignore the trailer either
+        way."""
+        if ex is None:
+            return ""
+        tid, value, ts = ex
+        return (f' # {{trace_id="{_escape_label(tid)}"}} '
+                f"{_fmt(value)} {_fmt(round(ts, 3))}")
+
+    def _render_child(self, key, child, exemplars: bool = False
+                      ) -> List[str]:
         s = child.stats()
+        ex = child.exemplars() if exemplars else \
+            [None] * (len(self.buckets) + 1)
         lines = []
         cum = 0
-        for edge, n in zip(self.buckets, s["buckets"]):
+        for i, (edge, n) in enumerate(zip(self.buckets, s["buckets"])):
             cum += n
             lines.append(
                 f"{self.name}_bucket"
-                f"{self._label_str(key, (('le', _fmt(edge)),))} {cum}")
+                f"{self._label_str(key, (('le', _fmt(edge)),))} {cum}"
+                f"{self._exemplar_suffix(ex[i])}")
         cum += s["buckets"][-1]
         lines.append(
             f"{self.name}_bucket"
-            f"{self._label_str(key, (('le', '+Inf'),))} {cum}")
+            f"{self._label_str(key, (('le', '+Inf'),))} {cum}"
+            f"{self._exemplar_suffix(ex[-1])}")
         lines.append(
             f"{self.name}_sum{self._label_str(key)} {_fmt(s['sum'])}")
         lines.append(
@@ -523,12 +569,17 @@ class MetricsRegistry:
         with self._lock:
             return sorted(self._families.values(), key=lambda f: f.name)
 
-    def render(self) -> str:
+    def render(self, exemplars: bool = False) -> str:
         """Prometheus text exposition (version 0.0.4): families sorted
-        by name, children by label values — byte-stable for goldens."""
+        by name, children by label values — byte-stable for goldens.
+        ``exemplars=True`` appends OpenMetrics exemplar trailers to
+        histogram bucket lines — serve that ONLY under the OpenMetrics
+        content type (:data:`OPENMETRICS_CONTENT_TYPE`): the classic
+        format's grammar rejects the trailer and a strict scraper
+        would fail the whole scrape."""
         lines: List[str] = []
         for fam in self.families():
-            lines.extend(fam.render())
+            lines.extend(fam.render(exemplars=exemplars))
         return "\n".join(lines) + ("\n" if lines else "")
 
     def reset(self) -> None:
@@ -551,11 +602,122 @@ REGISTRY = MetricsRegistry()
 #: the exposition content type.
 CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
 
+#: the OpenMetrics content type — the exposition a scraper must
+#: negotiate (Accept header) to receive histogram exemplars.
+OPENMETRICS_CONTENT_TYPE = \
+    "application/openmetrics-text; version=1.0.0; charset=utf-8"
 
-def render_registries(*registries: MetricsRegistry) -> str:
+
+def render_registries(*registries: MetricsRegistry,
+                      exemplars: bool = False) -> str:
     """Concatenate several registries' expositions (a worker's
     ``/metrics`` = its own registry + the process-wide one)."""
-    return "".join(r.render() for r in registries)
+    return "".join(r.render(exemplars=exemplars) for r in registries)
+
+
+# ---------------------------------------------------------------------------
+# Metrics snapshots (batch jobs that exit before a scrape)
+# ---------------------------------------------------------------------------
+
+def snapshot_registries(directory: str, tag: Optional[str] = None,
+                        registries: Iterable[MetricsRegistry] = (),
+                        prefix: str = "metrics", keep: int = 0) -> str:
+    """Write one exposition scrape to ``directory/<prefix>-<tag>.prom``
+    (any io.fs target — a checkpoint dir, gs://...). ``tag`` defaults
+    to a UTC timestamp; ``keep > 0`` prunes the directory to the
+    newest ``keep`` snapshots (tags sort lexically: both timestamps
+    and zero-padded step tags order correctly). Returns the path."""
+    from mmlspark_tpu.io import fs as _fs
+    regs = tuple(registries) or (REGISTRY,)
+    if tag is None:
+        tag = time.strftime("%Y%m%dT%H%M%SZ", time.gmtime())
+    _fs.makedirs(directory)
+    path = _fs.join(directory, f"{prefix}-{tag}.prom")
+    _fs.write_text(path, render_registries(*regs))
+    if keep > 0:
+        mine = sorted(
+            p for p in _fs.find_files(directory, recursive=False)
+            if os.path.basename(p).startswith(prefix + "-")
+            and p.endswith(".prom"))
+        for old in mine[:-keep]:
+            try:
+                if _fs.is_remote(old):
+                    fs_obj, p = _fs.get_fs(old)
+                    fs_obj.rm(p)
+                else:
+                    os.remove(old)
+            except Exception:  # noqa: BLE001 — pruning is best-effort
+                pass
+    return path
+
+
+class MetricsSnapshot:
+    """Periodic registry-scrape dumper for batch jobs.
+
+    A Prometheus server scrapes long-lived workers, but a training or
+    ETL job that exits between scrapes leaves no telemetry behind.
+    ``MetricsSnapshot`` writes the exposition to a directory on an
+    interval (daemon thread) and once more on :meth:`stop`, so the
+    job's final counters always land on disk — the in-repo stand-in
+    for a push gateway. The trainer also drops a scrape next to every
+    checkpoint (``metrics-step<NNNNNNNN>.prom``), so a preempted fit's
+    telemetry survives exactly as far as its checkpoints do.
+
+    Usage::
+
+        with MetricsSnapshot("/ckpt/telemetry", interval_s=60):
+            run_job()
+    """
+
+    def __init__(self, directory: str,
+                 registries: Iterable[MetricsRegistry] = (),
+                 interval_s: float = 60.0, keep: int = 24,
+                 prefix: str = "metrics"):
+        self.directory = directory
+        self.registries = tuple(registries) or (REGISTRY,)
+        self.interval_s = float(interval_s)
+        self.keep = int(keep)
+        self.prefix = prefix
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def write_now(self, tag: Optional[str] = None) -> str:
+        return snapshot_registries(self.directory, tag, self.registries,
+                                   self.prefix, self.keep)
+
+    def _loop(self) -> None:
+        while not self._stop.wait(self.interval_s):
+            try:
+                self.write_now()
+            except Exception:  # noqa: BLE001 — telemetry never kills jobs
+                from mmlspark_tpu.core.logs import get_logger
+                get_logger("telemetry").warning(
+                    "metrics snapshot to %s failed", self.directory,
+                    exc_info=True)
+
+    def start(self) -> "MetricsSnapshot":
+        self._stop.clear()
+        self._thread = threading.Thread(target=self._loop, daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        """Stop the writer and flush one final snapshot (the scrape a
+        batch job exists to leave behind)."""
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+            self._thread = None
+        try:
+            self.write_now()
+        except Exception:  # noqa: BLE001
+            pass
+
+    def __enter__(self) -> "MetricsSnapshot":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.stop()
 
 
 # ---------------------------------------------------------------------------

@@ -1,0 +1,518 @@
+"""Span tracing + flight recorder: per-request span trees, tail capture.
+
+PR 3's trace ids made a request *correlatable* (one ``X-Trace-Id``
+across logs, journal lines, egress headers); this module makes it
+*inspectable*. The TPU-pod scaling literature (MLPerf on TPU-v3 pods,
+arxiv 1909.09756; TensorFlow's timeline-driven performance work, arxiv
+1605.08695) is unambiguous that step- and op-level *timelines*, not
+aggregate counters, are what make straggler and pipeline-bubble
+diagnosis tractable — so every layer that already carries a trace id
+now also records :class:`Span` s into a per-process **flight
+recorder**:
+
+* a :class:`Span` is name + start/end (on an injectable
+  :class:`~mmlspark_tpu.core.resilience.Clock`) + attributes + status,
+  nested parent->child; the ambient span rides a contextvar next to
+  the trace-id one, and (exactly like trace ids) is handed across the
+  serving stage threads on the work item, never through the contextvar;
+* finished spans land in a **lock-striped ring buffer**
+  (:class:`FlightRecorder`): recording is a clock read + one striped
+  append (~hundreds of ns, budget-tested like the metrics hot path),
+  and the stripe is chosen by trace id so one trace's spans colocate
+  and gathering them scans a single stripe;
+* **tail-based capture**: when a ROOT span finishes, the completed
+  trace is retained in a bounded LRU store only if it was slow (root
+  duration over the per-route threshold) or ended non-ok
+  (error/shed/deadline/timeout) — everything else ages out of the ring
+  unexamined. ``GET /trace/<id>`` serves a retained trace's span tree,
+  ``GET /traces`` lists the store, and :func:`to_perfetto` renders any
+  retained trace as Chrome ``trace_event`` JSON for
+  ``chrome://tracing`` / Perfetto (``tools/trace_dump.py``).
+
+Histogram exemplars close the loop from the *other* direction: every
+:class:`~mmlspark_tpu.core.telemetry.Histogram` bucket remembers the
+last traced observation's trace id and exposes it in the Prometheus
+exposition (OpenMetrics ``# {trace_id="..."}`` syntax), so a p99
+outlier bucket links straight to its captured trace.
+
+Usage::
+
+    from mmlspark_tpu.core.tracing import TRACER
+
+    with TRACER.span("load", route="batch") as sp:
+        with TRACER.span("parse", rows=1000):
+            parse()
+
+    TRACER.get_trace(sp.trace_id)       # retained iff slow or non-ok
+
+Caveat — trace ids are the correlation key everywhere here (ring
+stripe, gather, capture store), and serving adopts inbound
+``X-Trace-Id`` headers verbatim (the PR 3 contract): a buggy client
+that reuses one id across many requests will colocate all of them on
+one stripe and, when any of them is captured, produce a merged tree of
+every same-id span still in the ring. Ids must be unique per logical
+request — that is the protocol, not something this layer can repair.
+"""
+
+from __future__ import annotations
+
+import contextvars
+import itertools
+import json
+import os
+import threading
+import time
+from collections import OrderedDict
+from typing import Any, Dict, List, Optional
+
+from mmlspark_tpu.core.resilience import Clock, SYSTEM_CLOCK
+from mmlspark_tpu.core.telemetry import current_trace_id, new_trace_id
+# the raw trace-id contextvar (not the trace_context contextmanager):
+# span scopes bind trace + span together on the hot path, and a
+# generator-contextmanager pair per span would triple the span budget
+from mmlspark_tpu.core.telemetry import _trace_id
+
+__all__ = [
+    "Span", "FlightRecorder", "Tracer", "TRACER",
+    "current_span", "current_span_name", "ambient_tracer",
+    "span_tree", "to_perfetto", "dump_perfetto",
+]
+
+_SPAN_COUNTER = itertools.count(1)
+
+_current_span: "contextvars.ContextVar[Optional[Span]]" = \
+    contextvars.ContextVar("mmlspark_tpu_span", default=None)
+
+# the tracer that bound the ambient span: layers that record spans from
+# arbitrary call sites (pipeline stages, HTTP egress, trainer) resolve
+# it via ambient_tracer(), so a server wired with a PRIVATE tracer
+# captures its model-internal spans too — recording those through the
+# global TRACER would parent them correctly but land them in the wrong
+# recorder, and the private capture would silently miss them
+_current_tracer: "contextvars.ContextVar[Optional[Tracer]]" = \
+    contextvars.ContextVar("mmlspark_tpu_tracer", default=None)
+
+
+def current_span() -> Optional["Span"]:
+    """The span bound to this context, or None outside any span."""
+    return _current_span.get()
+
+
+def current_span_name() -> Optional[str]:
+    sp = _current_span.get()
+    return sp.name if sp is not None else None
+
+
+def ambient_tracer() -> "Tracer":
+    """The tracer that bound the ambient span, falling back to the
+    process-wide :data:`TRACER` — what framework layers record
+    through."""
+    return _current_tracer.get() or TRACER
+
+
+class Span:
+    """One timed operation in a trace.
+
+    ``t0``/``t1`` are seconds on the owning tracer's clock (monotonic
+    by default); ``thread`` is the recording thread's ident, so the
+    Perfetto export lays the serving pipeline's collector/executor/
+    encoder work out on separate lanes. Spans are plain mutable records
+    — the tracer, not the span, owns lifecycle (:meth:`Tracer.finish`).
+
+    Hot-path notes (the <2 us/span bench budget, ``tracing_overhead_v1``):
+    span ids are plain process-unique ints (no per-span string format),
+    and ``attrs`` stays ``None`` until someone actually attaches one —
+    most child spans never allocate a dict.
+    """
+
+    __slots__ = ("trace_id", "span_id", "parent_id", "name",
+                 "t0", "t1", "status", "attrs", "thread")
+
+    def __init__(self, name: str, trace_id: str,
+                 parent_id: Optional[int], t0: float,
+                 attrs: Optional[Dict[str, Any]] = None):
+        self.name = name
+        self.trace_id = trace_id
+        self.span_id = next(_SPAN_COUNTER)
+        self.parent_id = parent_id
+        self.t0 = t0
+        self.t1: Optional[float] = None
+        self.status = "ok"
+        self.attrs: Optional[Dict[str, Any]] = attrs
+        self.thread = threading.get_ident()
+
+    @property
+    def duration_ms(self) -> float:
+        return ((self.t1 or self.t0) - self.t0) * 1000.0
+
+    def set_attr(self, key: str, value: Any) -> None:
+        if self.attrs is None:
+            self.attrs = {}
+        self.attrs[key] = value
+
+    def to_dict(self, origin: float = 0.0) -> Dict[str, Any]:
+        """JSON-able record; times relative to ``origin`` (the trace's
+        first span start) so exported trees read from 0."""
+        return {
+            "name": self.name,
+            "span_id": self.span_id,
+            "parent_id": self.parent_id,
+            "start_ms": round((self.t0 - origin) * 1000.0, 3),
+            "duration_ms": round(self.duration_ms, 3),
+            "status": self.status,
+            "attrs": self.attrs or {},
+            "thread": self.thread,
+        }
+
+    def __repr__(self) -> str:
+        return (f"Span({self.name!r}, trace={self.trace_id}, "
+                f"id={self.span_id}, parent={self.parent_id}, "
+                f"status={self.status})")
+
+
+class _SpanScope:
+    """``with tracer.span(...)``: binds the span + its trace id + its
+    tracer on enter, finishes (status ``error`` on exception) on
+    exit."""
+
+    __slots__ = ("_tracer", "span", "_tok_span", "_tok_trace",
+                 "_tok_tracer")
+
+    def __init__(self, tracer: "Tracer", span: "Span"):
+        self._tracer = tracer
+        self.span = span
+
+    def __enter__(self) -> "Span":
+        self._tok_span = _current_span.set(self.span)
+        self._tok_trace = _trace_id.set(self.span.trace_id)
+        self._tok_tracer = _current_tracer.set(self._tracer)
+        return self.span
+
+    def __exit__(self, exc_type, exc, tb) -> bool:
+        _current_tracer.reset(self._tok_tracer)
+        _trace_id.reset(self._tok_trace)
+        _current_span.reset(self._tok_span)
+        self._tracer.finish(self.span,
+                            status="error" if exc_type is not None
+                            else None)
+        return False
+
+
+class _BindScope:
+    """``with tracer.bind(span)``: ambient span + trace id + tracer
+    for the block; ``None`` span binds nothing (no-op)."""
+
+    __slots__ = ("_tracer", "span", "_tok_span", "_tok_trace",
+                 "_tok_tracer")
+
+    def __init__(self, tracer: "Tracer", span: Optional["Span"]):
+        self._tracer = tracer
+        self.span = span
+
+    def __enter__(self) -> Optional["Span"]:
+        if self.span is not None:
+            self._tok_span = _current_span.set(self.span)
+            self._tok_trace = _trace_id.set(self.span.trace_id)
+            self._tok_tracer = _current_tracer.set(self._tracer)
+        return self.span
+
+    def __exit__(self, exc_type, exc, tb) -> bool:
+        if self.span is not None:
+            _current_tracer.reset(self._tok_tracer)
+            _trace_id.reset(self._tok_trace)
+            _current_span.reset(self._tok_span)
+        return False
+
+
+class FlightRecorder:
+    """Per-process lock-striped ring buffer of finished spans.
+
+    Stripes are keyed by trace id, so (a) two busy traces almost never
+    contend on a lock and (b) gathering one trace's spans scans exactly
+    one stripe's ring, not the whole recorder. Each stripe is a
+    fixed-size list used circularly — recording is one store + one
+    index bump under the stripe lock, and old spans are overwritten in
+    place (a flight recorder, not a log: history exists to be *seized*
+    at capture time, not kept)."""
+
+    def __init__(self, capacity: int = 8192, stripes: int = 16):
+        self.stripes = max(int(stripes), 1)
+        per = max(int(capacity) // self.stripes, 16)
+        self.capacity = per * self.stripes
+        self._rings: List[List[Optional[Span]]] = [
+            [None] * per for _ in range(self.stripes)]
+        self._idx = [0] * self.stripes
+        self._locks = [threading.Lock() for _ in range(self.stripes)]
+        self._per = per
+
+    def _stripe(self, trace_id: str) -> int:
+        return hash(trace_id) % self.stripes
+
+    def record(self, span: Span) -> None:
+        s = hash(span.trace_id) % self.stripes
+        with self._locks[s]:
+            self._rings[s][self._idx[s] % self._per] = span
+            self._idx[s] += 1
+
+    def gather(self, trace_id: str) -> List[Span]:
+        """Every recorded span of ``trace_id`` still in its ring,
+        sorted by start time. Best-effort by design: spans evicted by
+        ring wraparound are simply absent from the capture."""
+        s = self._stripe(trace_id)
+        with self._locks[s]:
+            found = [sp for sp in self._rings[s]
+                     if sp is not None and sp.trace_id == trace_id]
+        found.sort(key=lambda sp: sp.t0)
+        return found
+
+
+class Tracer:
+    """Span factory + flight recorder + tail-sampled slow-trace store.
+
+    One process-wide :data:`TRACER` serves every layer (the per-route
+    thresholds keep serving/trainer/pipeline captures independently
+    tuned); tests build private tracers with a
+    :class:`~mmlspark_tpu.core.resilience.ManualClock` to drive span
+    durations deterministically.
+    """
+
+    def __init__(self, clock: Clock = SYSTEM_CLOCK,
+                 capacity: int = 8192, store_capacity: int = 128,
+                 default_slow_ms: Optional[float] = 250.0):
+        self.clock = clock
+        self.recorder = FlightRecorder(capacity)
+        self.store_capacity = int(store_capacity)
+        self.default_slow_ms = default_slow_ms
+        self._thresholds: Dict[str, float] = {}
+        self._store: "OrderedDict[str, Dict[str, Any]]" = OrderedDict()
+        self._store_lock = threading.Lock()
+        # hot-path bindings (one attribute + descriptor resolve saved
+        # per call — real money at <2 us/span)
+        self._now = clock.now
+        self._record = self.recorder.record
+
+    # -- thresholds ---------------------------------------------------------
+
+    def set_threshold(self, route: str, slow_ms: Optional[float]) -> None:
+        """Per-route tail-capture threshold (ms). ``<= 0`` retains every
+        completed trace on that route (trace-everything mode for
+        harnesses); ``None`` retains only non-ok traces."""
+        self._thresholds[route] = slow_ms
+
+    def threshold(self, route: str) -> Optional[float]:
+        return self._thresholds.get(route, self.default_slow_ms)
+
+    # -- span lifecycle -----------------------------------------------------
+
+    def start(self, name: str, trace_id: Optional[str] = None,
+              parent: Optional[Span] = None, **attrs) -> Span:
+        """Begin a span. Parent defaults to the ambient span; the trace
+        id resolves explicit > parent's > ambient trace id > fresh."""
+        if parent is None:
+            parent = _current_span.get()
+        if parent is not None:
+            tid = trace_id or parent.trace_id
+            pid = parent.span_id
+        else:
+            tid = trace_id or current_trace_id() or new_trace_id()
+            pid = None
+        return Span(name, tid, pid, self._now(), attrs or None)
+
+    def finish(self, span: Span, status: Optional[str] = None,
+               capture: bool = True, **attrs) -> None:
+        """End + record a span; a finishing ROOT span (no parent) runs
+        the tail-capture decision for its whole trace. ``capture=False``
+        suppresses that for spans that are parentless only because the
+        ambient span did not cross a boundary (e.g. an HTTP egress
+        attempt inside a client's ``trace_context``): they belong to a
+        larger trace whose real root will run the decision."""
+        if span.t1 is not None:
+            return                       # double-finish: first one wins
+        if attrs:
+            if span.attrs is None:
+                span.attrs = attrs
+            else:
+                span.attrs.update(attrs)
+        if status is not None:
+            span.status = status
+        span.t1 = self._now()
+        self._record(span)
+        if capture and span.parent_id is None:
+            self._maybe_capture(span)
+
+    def add(self, name: str, t0: float, t1: float, parent: Span,
+            status: str = "ok", **attrs) -> Span:
+        """Record an already-completed child span with explicit
+        timestamps — the shape the serving pipeline needs, where one
+        batch-level measurement (assemble, dispatch, encode) becomes a
+        child of every live request's root without re-running clocks
+        per request."""
+        sp = Span(name, parent.trace_id, parent.span_id, t0, attrs or None)
+        sp.t1 = t1
+        sp.status = status
+        self._record(sp)
+        return sp
+
+    def span(self, name: str, **attrs) -> "_SpanScope":
+        """Scoped span: nests under the ambient span, binds itself (and
+        its trace id) for the block, finishes on exit — with status
+        ``error`` when the block raises. A class-based context manager,
+        not a generator one: two generator frames per span would eat
+        most of the <2 us budget by themselves."""
+        return _SpanScope(self, self.start(name, **attrs))
+
+    def bind(self, span: Optional[Span]) -> "_BindScope":
+        """Re-bind an existing span (and its trace id, and this tracer)
+        as the ambient parent — the cross-thread handoff: contextvars
+        do not follow the serving pipeline's stage threads, so each
+        stage re-binds from the span carried on the work item. ``None``
+        is a no-op (synthetic warmup work records nothing)."""
+        return _BindScope(self, span)
+
+    # -- tail-based capture -------------------------------------------------
+
+    def _maybe_capture(self, root: Span) -> None:
+        route = str((root.attrs or {}).get("route") or root.name)
+        dur = root.duration_ms
+        if root.status != "ok":
+            reason = root.status
+        else:
+            thr = self.threshold(route)
+            if thr is None or dur < thr:
+                return                   # the tail-sampling drop path
+            reason = "slow"
+        spans = self.recorder.gather(root.trace_id)
+        if not spans:
+            spans = [root]
+        origin = spans[0].t0
+        trace = {
+            "trace_id": root.trace_id,
+            "root": root.name,
+            "route": route,
+            "duration_ms": round(dur, 3),
+            "status": root.status,
+            "reason": reason,
+            "captured_at": round(time.time(), 3),
+            "n_spans": len(spans),
+            "spans": [sp.to_dict(origin) for sp in spans],
+        }
+        with self._store_lock:
+            self._store.pop(root.trace_id, None)
+            self._store[root.trace_id] = trace
+            # per-reason quota: an overload storm produces THOUSANDS of
+            # identical shed/error captures per second, and pure global
+            # LRU would churn out the genuinely interesting slow traces
+            # within seconds of an incident starting — exactly when the
+            # operator needs them. Each reason evicts its own oldest
+            # first; the global cap still bounds the store.
+            quota = max(self.store_capacity // 4, 8)
+            same = [t["trace_id"] for t in self._store.values()
+                    if t["reason"] == trace["reason"]]
+            if len(same) > quota:
+                self._store.pop(same[0], None)
+            while len(self._store) > self.store_capacity:
+                self._store.popitem(last=False)
+
+    # -- read side ----------------------------------------------------------
+
+    def get_trace(self, trace_id: str) -> Optional[Dict[str, Any]]:
+        """A retained trace (summary + flat span list), or None if it
+        was never captured / already evicted."""
+        with self._store_lock:
+            return self._store.get(trace_id)
+
+    def traces(self, slow_only: bool = False) -> List[Dict[str, Any]]:
+        """Summaries of every retained trace, most recent first.
+        ``slow_only`` filters to threshold-retained captures (drops the
+        error/shed/deadline ones)."""
+        with self._store_lock:
+            items = list(self._store.values())
+        items.reverse()
+        return [{k: t[k] for k in ("trace_id", "root", "route",
+                                   "duration_ms", "status", "reason",
+                                   "captured_at", "n_spans")}
+                for t in items
+                if not slow_only or t["reason"] == "slow"]
+
+    def clear(self) -> None:
+        """Drop every retained trace (tests; the ring is left alone —
+        it self-overwrites)."""
+        with self._store_lock:
+            self._store.clear()
+
+
+# ---------------------------------------------------------------------------
+# Exporters
+# ---------------------------------------------------------------------------
+
+def span_tree(trace: Dict[str, Any]) -> Dict[str, Any]:
+    """Nest a captured trace's flat span list into its parent->child
+    tree. Spans whose parent fell out of the ring before capture attach
+    under the root (best-effort flight-recorder semantics, never an
+    error); the root is the parentless span, or the earliest span when
+    even the root was evicted."""
+    spans = [dict(sp) for sp in trace["spans"]]
+    for sp in spans:
+        sp["children"] = []
+    by_id = {sp["span_id"]: sp for sp in spans}
+    roots = [sp for sp in spans if sp["parent_id"] is None]
+    root = roots[0] if roots else spans[0]
+    for sp in spans:
+        if sp is root:
+            continue
+        parent = by_id.get(sp["parent_id"])
+        if parent is None or parent is sp:
+            parent = root                # orphan: parent left the ring
+        parent["children"].append(sp)
+    return root
+
+
+def to_perfetto(trace: Dict[str, Any]) -> Dict[str, Any]:
+    """A captured trace as Chrome ``trace_event`` JSON — load the file
+    in ``chrome://tracing`` or https://ui.perfetto.dev. Complete
+    (``ph: "X"``) events, microsecond timestamps relative to the
+    trace's first span, one lane per recording thread (the serving
+    pipeline's collector/executor/encoder stages separate visually)."""
+    pid = os.getpid()
+    events: List[Dict[str, Any]] = []
+    threads = sorted({sp["thread"] for sp in trace["spans"]})
+    lane = {t: i for i, t in enumerate(threads)}
+    for i, t in enumerate(threads):
+        events.append({"ph": "M", "pid": pid, "tid": i,
+                       "name": "thread_name",
+                       "args": {"name": f"thread-{t}"}})
+    for sp in trace["spans"]:
+        args = dict(sp["attrs"])
+        args["trace_id"] = trace["trace_id"]
+        args["status"] = sp["status"]
+        args["span_id"] = sp["span_id"]
+        events.append({
+            "ph": "X",
+            "name": sp["name"],
+            "cat": trace["route"],
+            "pid": pid,
+            "tid": lane[sp["thread"]],
+            "ts": int(round(sp["start_ms"] * 1000.0)),
+            "dur": max(int(round(sp["duration_ms"] * 1000.0)), 1),
+            "args": args,
+        })
+    return {"traceEvents": events, "displayTimeUnit": "ms",
+            "otherData": {"trace_id": trace["trace_id"],
+                          "root": trace["root"],
+                          "reason": trace["reason"]}}
+
+
+def dump_perfetto(trace: Dict[str, Any], path: str) -> str:
+    """Write :func:`to_perfetto` JSON to ``path`` (any io.fs target)."""
+    from mmlspark_tpu.io import fs as _fs
+    parent = os.path.dirname(path)
+    if parent:
+        _fs.makedirs(parent)
+    _fs.write_text(path, json.dumps(to_perfetto(trace)))
+    return path
+
+
+#: the process-wide tracer every layer records through. Per-component
+#: isolation comes from routes (thresholds) and trace ids, not from
+#: separate recorders — one flight recorder per process is the point.
+TRACER = Tracer()

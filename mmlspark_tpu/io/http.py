@@ -141,8 +141,31 @@ def _send_once(session, req: HTTPRequestData,
         if tid:
             headers = dict(headers)
             headers["X-Trace-Id"] = tid
-    resp = session.request(req.method, req.url, headers=headers,
-                           data=req.body, timeout=timeout)
+    # one egress span per attempt, nested under the ambient span (a
+    # served request whose model fans out HTTP shows each send in its
+    # captured timeline, carrying the same injected trace id); a
+    # transport failure finishes it with status=error before the
+    # exception reaches the policy layer. A bound trace id WITHOUT an
+    # ambient span (ServingClient's one-trace-per-failover-schedule
+    # pattern) means this span is mid-trace, not a root: suppress the
+    # capture decision, or a retry storm would churn the trace store
+    # with one-span "http_egress" captures
+    from mmlspark_tpu.core.telemetry import current_trace_id
+    from mmlspark_tpu.core.tracing import ambient_tracer, current_span
+    tracer = ambient_tracer()
+    mid_trace = current_trace_id() is not None and current_span() is None
+    span = tracer.start("http_egress", host=_host_of(req.url),
+                        method=req.method)
+    try:
+        resp = session.request(req.method, req.url, headers=headers,
+                               data=req.body, timeout=timeout)
+    except BaseException:
+        tracer.finish(span, status="error", capture=not mid_trace)
+        raise
+    tracer.finish(span,
+                  status="ok" if resp.status_code < 500 else "error",
+                  capture=not mid_trace,
+                  status_code=resp.status_code)
     return HTTPResponseData(status_code=resp.status_code,
                             reason=resp.reason, body=resp.content,
                             headers=dict(resp.headers))

@@ -260,12 +260,19 @@ class NNLearner(Estimator, HasLabelCol, HasFeaturesCol):
         opt_state = tx.init(params)
         rng = np.random.default_rng(self.seed)
         n_use = steps_per_epoch * bs
+        from mmlspark_tpu.core.tracing import ambient_tracer
+        TRACER = ambient_tracer()
         for epoch in range(self.epochs):
             perm = rng.permutation(len(x))[:n_use].astype(np.int32) \
                 .reshape(steps_per_epoch, bs)
             key = jax.random.PRNGKey(self.seed * 100003 + epoch)
-            params, opt_state, losses = epoch_jit(
-                params, opt_state, key, jnp.asarray(perm))
+            # the scanned fit's unit of work is the EPOCH (one dispatch
+            # + one loss fetch), so that is its span granularity
+            with TRACER.span("train_epoch", route="trainer",
+                             epoch=epoch + 1,
+                             steps=int(steps_per_epoch)):
+                params, opt_state, losses = epoch_jit(
+                    params, opt_state, key, jnp.asarray(perm))
             if self.log_every:
                 print(f"[NNLearner] epoch {epoch + 1}/{self.epochs} "
                       f"mean loss {float(jnp.mean(losses)):.5f}")
@@ -419,10 +426,18 @@ class NNLearner(Estimator, HasLabelCol, HasFeaturesCol):
         uninterrupted run does)."""
         import jax
 
+        from mmlspark_tpu.core.tracing import ambient_tracer
+        TRACER = ambient_tracer()
+
         rng = np.random.default_rng(self.seed)
         metrics = _metrics()
         m_step, m_eps = metrics["step_ms"], metrics["examples_per_sec"]
         global_step = 0
+        # per-attempt dispatch-shape memory: a batch shape this attempt
+        # has not dispatched yet forces a jit retrace, and the step's
+        # span marks it (recompile=True) so a captured slow step says
+        # WHY it was slow (the ragged tail batch is the usual culprit)
+        shapes_seen: set = set()
         # bound the number of dispatched-but-unfinished steps: an
         # unthrottled loop queues every step at once, and XLA:CPU's
         # cross-device collective rendezvous can deadlock when executions
@@ -437,36 +452,59 @@ class NNLearner(Estimator, HasLabelCol, HasFeaturesCol):
                 global_step += 1
                 if global_step <= start_step:
                     continue  # fast-forward after resume (same shuffle stream)
-                if self.fault_injector is not None:
-                    self.fault_injector(global_step)
-                t_step = time.perf_counter()
-                idx = order[s * bs:(s + 1) * bs]
-                # ragged tail: pad to the data-axis multiple, zero the pad
-                # rows' weights so they contribute nothing to the loss
-                xp, n_real = pad_to_multiple(x[idx], n_data)
-                yp, _ = pad_to_multiple(y[idx], n_data)
-                wp, _ = pad_to_multiple(w[idx], n_data)
-                if n_real < len(wp):
-                    wp = wp.copy()
-                    wp[n_real:] = 0.0
-                xb = jax.device_put(xp, shard)
-                yb = jax.device_put(yp, shard)
-                wb = jax.device_put(wp, shard)
-                params, opt_state, loss = step(params, opt_state, xb, yb, wb)
-                inflight.append(loss)
-                if len(inflight) > 2:
-                    inflight.popleft().block_until_ready()
-                dt = time.perf_counter() - t_step
-                m_step.observe(dt * 1000.0)
-                if dt > 0:
-                    m_eps.observe(n_real / dt)
-                if self.log_every and global_step % self.log_every == 0:
-                    print(f"[NNLearner] step {global_step} "
-                          f"epoch {epoch + 1}/{self.epochs} "
-                          f"loss {float(loss):.5f}")
-                if (mngr is not None and self.checkpoint_every
-                        and global_step % self.checkpoint_every == 0):
-                    self._checkpoint(mngr, global_step, params, opt_state)
+                # one root span per step (route "trainer"): a chaos
+                # fault raised inside finishes it with status=error, so
+                # failed steps are tail-captured with their timeline;
+                # the step_ms observe below runs inside the span, so
+                # the histogram's exemplar links a slow bucket straight
+                # to the captured step trace
+                with TRACER.span("train_step", route="trainer",
+                                 step=global_step,
+                                 epoch=epoch + 1) as sp:
+                    if self.fault_injector is not None:
+                        self.fault_injector(global_step)
+                    t_step = time.perf_counter()
+                    idx = order[s * bs:(s + 1) * bs]
+                    # ragged tail: pad to the data-axis multiple, zero
+                    # the pad rows' weights so they contribute nothing
+                    # to the loss
+                    xp, n_real = pad_to_multiple(x[idx], n_data)
+                    yp, _ = pad_to_multiple(y[idx], n_data)
+                    wp, _ = pad_to_multiple(w[idx], n_data)
+                    if n_real < len(wp):
+                        wp = wp.copy()
+                        wp[n_real:] = 0.0
+                    recompile = xp.shape not in shapes_seen
+                    if recompile:
+                        shapes_seen.add(xp.shape)
+                    t_disp = TRACER.clock.now()
+                    xb = jax.device_put(xp, shard)
+                    yb = jax.device_put(yp, shard)
+                    wb = jax.device_put(wp, shard)
+                    params, opt_state, loss = step(params, opt_state,
+                                                   xb, yb, wb)
+                    inflight.append(loss)
+                    if len(inflight) > 2:
+                        inflight.popleft().block_until_ready()
+                    # dispatch is async: this child is transfer +
+                    # enqueue time, plus the periodic device block when
+                    # the in-flight window fills (and the whole trace/
+                    # compile, on a recompile=True step)
+                    TRACER.add("step_dispatch", t_disp,
+                               TRACER.clock.now(), parent=sp,
+                               recompile=recompile, batch=int(len(xp)))
+                    dt = time.perf_counter() - t_step
+                    m_step.observe(dt * 1000.0)
+                    if dt > 0:
+                        m_eps.observe(n_real / dt)
+                    if self.log_every and global_step % self.log_every == 0:
+                        print(f"[NNLearner] step {global_step} "
+                              f"epoch {epoch + 1}/{self.epochs} "
+                              f"loss {float(loss):.5f}")
+                    if (mngr is not None and self.checkpoint_every
+                            and global_step % self.checkpoint_every == 0):
+                        self._checkpoint(mngr, global_step, params,
+                                         opt_state)
         if mngr is not None:
             self._checkpoint(mngr, global_step, params, opt_state)
             mngr.wait_until_finished()
@@ -483,10 +521,27 @@ class NNLearner(Estimator, HasLabelCol, HasFeaturesCol):
     def _checkpoint(self, mngr, step_num: int, params, opt_state) -> None:
         import jax
         import orbax.checkpoint as ocp
-        with _metrics()["ckpt_save_ms"].time():
+        from mmlspark_tpu.core.tracing import ambient_tracer
+        TRACER = ambient_tracer()
+        with TRACER.span("checkpoint_save", step=step_num), \
+                _metrics()["ckpt_save_ms"].time():
             state = {"params": jax.device_get(params),
                      "opt_state": jax.device_get(opt_state)}
             mngr.save(step_num, args=ocp.args.StandardSave(state))
+        # a scrape rides every checkpoint: batch fits usually exit (or
+        # are preempted) before any Prometheus scrape, so the registry
+        # state lands next to the step it describes — under telemetry/
+        # (NOT the checkpoint root: orbax owns that namespace's step
+        # listing). Best-effort: telemetry must never fail a save.
+        try:
+            from mmlspark_tpu.core.telemetry import snapshot_registries
+            from mmlspark_tpu.io import fs as _fs
+            snapshot_registries(_fs.join(self.checkpoint_dir, "telemetry"),
+                                tag=f"step{step_num:08d}", keep=8)
+        except Exception:  # noqa: BLE001
+            from mmlspark_tpu.core.logs import get_logger
+            get_logger("trainer").warning(
+                "checkpoint metrics snapshot failed", exc_info=True)
 
     def _restore(self, mngr, template):
         """Restore the latest step against a host-side (params,
@@ -494,8 +549,11 @@ class NNLearner(Estimator, HasLabelCol, HasFeaturesCol):
         round-trip intact. The template must predate the first step:
         the donated live buffers are not safe to read after a fault."""
         import orbax.checkpoint as ocp
+        from mmlspark_tpu.core.tracing import ambient_tracer
+        TRACER = ambient_tracer()
         latest = mngr.latest_step()
-        with _metrics()["ckpt_restore_ms"].time():
+        with TRACER.span("checkpoint_restore", step=latest), \
+                _metrics()["ckpt_restore_ms"].time():
             restored = mngr.restore(
                 latest, args=ocp.args.StandardRestore(template))
         print(f"[NNLearner] resumed from step {latest}")

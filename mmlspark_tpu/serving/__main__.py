@@ -45,6 +45,12 @@ Environment:
                    io.fs path — mount a PVC and point this at it, or
                    gs://...): committed replies survive pod restarts,
                    reported as ``journal_recovered`` in GET /status
+  SLOW_TRACE_MS    (worker, optional) tail-capture threshold for this
+                   worker's route (default 250): requests slower than
+                   this — or that end in error/shed/deadline — retain
+                   their span tree at ``GET /trace/<id>`` (Perfetto
+                   export via ``?format=perfetto``; 0 captures every
+                   request — see docs/observability.md "Tracing")
 """
 
 import os
@@ -91,7 +97,8 @@ def run_worker() -> None:
         max_queue=int(_env_float("MAX_QUEUE", 1024)),
         pipeline=_env_float("PIPELINE", 1) != 0,
         bucket_batches=_env_float("BUCKET_BATCHES", 1) != 0,
-        encoder_threads=int(_env_float("ENCODER_THREADS", 2)))
+        encoder_threads=int(_env_float("ENCODER_THREADS", 2)),
+        slow_trace_ms=_env_float("SLOW_TRACE_MS", 250.0))
     warm = os.environ.get("WARMUP_PAYLOAD")
     if warm:
         # warm BEFORE start(): the socket is already bound (early

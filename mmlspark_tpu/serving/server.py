@@ -74,11 +74,14 @@ from mmlspark_tpu.core.resilience import (
 from mmlspark_tpu.core.serialize import _jsonify
 from mmlspark_tpu.core.stage import Transformer
 from mmlspark_tpu.core.telemetry import (
-    CONTENT_TYPE as _METRICS_CONTENT_TYPE, MetricsRegistry, REGISTRY,
+    CONTENT_TYPE as _METRICS_CONTENT_TYPE,
+    OPENMETRICS_CONTENT_TYPE as _OPENMETRICS_CONTENT_TYPE,
+    MetricsRegistry, REGISTRY,
     TRACE_HEADER, current_trace_id, merge_prometheus, new_trace_id,
     render_registries, render_samples, trace_context,
     trace_id_from_headers,
 )
+from mmlspark_tpu.core.tracing import TRACER, span_tree, to_perfetto
 
 logger = get_logger("serving")
 
@@ -107,7 +110,7 @@ _MAX_SHAPES_TRACKED = 1024
 
 class _PendingRequest:
     __slots__ = ("rid", "payload", "event", "reply", "status", "deadline",
-                 "trace")
+                 "trace", "span", "t_enqueue")
 
     def __init__(self, payload: Any, rid: Optional[str] = None,
                  deadline: Optional[Deadline] = None,
@@ -123,6 +126,12 @@ class _PendingRequest:
         # threads, where contextvars do not follow — each stage
         # re-enters trace_context from this field
         self.trace = trace or new_trace_id()
+        # the request's ROOT span (and enqueue timestamp): carried for
+        # the same cross-thread reason — each stage records its child
+        # spans (queue_wait/assemble/dispatch/encode/commit) under this
+        # parent. None for synthetic warmup work, which records nothing.
+        self.span = None
+        self.t_enqueue: Optional[float] = None
 
 
 class ServingServer:
@@ -148,6 +157,8 @@ class ServingServer:
                  bucket_batches: bool = True,
                  encoder_threads: int = 2,
                  max_inflight_batches: int = 2,
+                 slow_trace_ms: Optional[float] = 250.0,
+                 tracer=None,
                  clock: Clock = SYSTEM_CLOCK):
         self.model = model
         self.api_path = api_path
@@ -184,6 +195,20 @@ class ServingServer:
         self.registry = MetricsRegistry(clock=clock)
         self.timings = StageTimings(registry=self.registry,
                                     metric="serving_stage_duration_ms")
+        # -- tracing: one root span per request, child spans per stage,
+        # recorded into the process-wide flight recorder. Tail capture:
+        # a completed trace is RETAINED (GET /trace/<id>) only when its
+        # root exceeded ``slow_trace_ms`` (per-route threshold, keyed by
+        # api_path) or ended non-ok (error/shed/deadline/timeout);
+        # everything else is dropped after the histograms have their
+        # samples. ``tracer`` is injectable so tests drive captures with
+        # a ManualClock-backed private tracer. NOTE: thresholds are
+        # per-(tracer, route) — two servers sharing the process TRACER
+        # and one api_path share one threshold (last constructed wins);
+        # inject private tracers where that matters (tests, A/B tools).
+        self.tracer = tracer if tracer is not None else TRACER
+        self.slow_trace_ms = slow_trace_ms
+        self.tracer.set_threshold(api_path, slow_trace_ms)
         self._m_dispatch = self.registry.histogram(
             "serving_dispatch_latency_ms",
             "Model dispatch wall-clock per shape bucket (label = padded "
@@ -432,12 +457,27 @@ class ServingServer:
                     # ``?scope=server`` limits to the per-server
                     # registry — the fleet merge scrapes that, so
                     # co-hosted workers sharing one process REGISTRY
-                    # never double-count its families in the sum
+                    # never double-count its families in the sum.
+                    # Exemplars ride ONLY the OpenMetrics exposition
+                    # (Accept-negotiated, or forced via ?exemplars=1):
+                    # the classic 0.0.4 grammar has no exemplar
+                    # production and a strict scraper would fail the
+                    # whole scrape on the trailer
                     server_only = "scope=server" in self.path
                     regs = (serving.registry,) if server_only \
                         else (serving.registry, REGISTRY)
-                    body = render_registries(*regs).encode()
-                    self._reply(200, body, ctype=_METRICS_CONTENT_TYPE)
+                    accept = self.headers.get("Accept", "")
+                    openmetrics = ("application/openmetrics-text"
+                                   in accept
+                                   or "exemplars=1" in self.path)
+                    body = render_registries(
+                        *regs, exemplars=openmetrics)
+                    if openmetrics:
+                        body += "# EOF\n"
+                    self._reply(200, body.encode(),
+                                ctype=_OPENMETRICS_CONTENT_TYPE
+                                if openmetrics
+                                else _METRICS_CONTENT_TYPE)
                     return
                 if self.path == "/stats":
                     # data-plane observability: per-stage timings, the
@@ -467,6 +507,38 @@ class ServingServer:
                             "rss_bytes": process_rss_bytes(),
                         }
                     self._reply(200, json.dumps(stats).encode())
+                    return
+                if self.path.split("?", 1)[0] == "/traces":
+                    # the tail-capture store: every retained trace was
+                    # slow or ended non-ok; ?slow=1 keeps only the
+                    # threshold-retained ones
+                    body = json.dumps(serving.tracer.traces(
+                        slow_only="slow=1" in self.path)).encode()
+                    self._reply(200, body)
+                    return
+                if self.path.startswith("/trace/"):
+                    tid, _, query = \
+                        self.path[len("/trace/"):].partition("?")
+                    tr = serving.tracer.get_trace(tid)
+                    if tr is None:
+                        self._reply(404, json.dumps(
+                            {"error": "trace not retained (fast + ok "
+                                      "traces are tail-dropped)",
+                             "trace_id": tid}).encode())
+                        return
+                    if "format=perfetto" in query:
+                        # Chrome trace_event JSON: load the body in
+                        # chrome://tracing or ui.perfetto.dev (see
+                        # tools/trace_dump.py)
+                        body = json.dumps(to_perfetto(tr)).encode()
+                    else:
+                        out = {k: tr[k] for k in
+                               ("trace_id", "root", "route",
+                                "duration_ms", "status", "reason",
+                                "captured_at", "n_spans")}
+                        out["tree"] = span_tree(tr)
+                        body = json.dumps(out).encode()
+                    self._reply(200, body)
                     return
                 if self.path != "/status":
                     self.send_error(404)
@@ -498,12 +570,24 @@ class ServingServer:
                 # trace ingress: adopt the inbound X-Trace-Id or mint
                 # one; bound for this handler thread's logs, carried on
                 # the pending request for the stage threads, echoed on
-                # every reply
+                # every reply. The request's ROOT span opens here and
+                # closes when the reply is written — finishing it runs
+                # the tail-capture decision (slow or non-ok traces are
+                # retained for GET /trace/<id>).
                 tid = trace_id_from_headers(self.headers)
                 with trace_context(tid):
-                    self._do_predict(tid)
+                    root = serving.tracer.start(
+                        "request", trace_id=tid, route=serving.api_path)
+                    status = "error"
+                    try:
+                        status = self._do_predict(tid, root)
+                    finally:
+                        serving.tracer.finish(root, status=status)
 
-            def _do_predict(self, tid):
+            def _do_predict(self, tid, root):
+                """Serve one POST; returns the root span's terminal
+                status (``ok``/``shed``/``deadline``/``timeout``/
+                ``error`` — everything but ``ok`` is tail-captured)."""
                 if serving._draining.is_set():
                     # graceful drain: accepted work finishes, new work
                     # is refused so the orchestrator's retry lands on a
@@ -511,7 +595,7 @@ class ServingServer:
                     self._reply(503, b'{"error": "draining"}',
                                 retry_after=serving.shed_retry_after,
                                 trace=tid)
-                    return
+                    return "shed"
                 length = int(self.headers.get("Content-Length", 0))
                 try:
                     payload = json.loads(self.rfile.read(length) or b"{}")
@@ -521,7 +605,7 @@ class ServingServer:
                     # correlate the failure with worker logs
                     self._reply(400, b'{"error": "invalid JSON"}',
                                 trace=tid)
-                    return
+                    return "error"
 
                 deadline = Deadline.from_headers(self.headers,
                                                  clock=serving.clock)
@@ -561,15 +645,17 @@ class ServingServer:
                             enqueue = False
                         if committed is not None:
                             serving.n_replayed += 1
+                    root.set_attr("rid", rid)
                     if committed is not None:
+                        root.set_attr("replayed", True)
                         self._reply(committed[0], committed[1],
                                     replayed=True, trace=tid)
-                        return
+                        return "ok"
                     if shed:
                         self._reply(429, b'{"error": "overloaded"}',
                                     retry_after=serving.shed_retry_after,
                                     trace=tid)
-                        return
+                        return "shed"
                     if window_missed:
                         logger.warning(
                             "request id %s retried after its journal "
@@ -583,7 +669,7 @@ class ServingServer:
                         self._reply(429, b'{"error": "overloaded"}',
                                     retry_after=serving.shed_retry_after,
                                     trace=tid)
-                        return
+                        return "shed"
                     pending = _PendingRequest(payload, deadline=deadline,
                                               trace=tid)
                     enqueue = True
@@ -604,9 +690,14 @@ class ServingServer:
                         serving._inflight.pop(pending.rid, None)
                     pending.event.set()
                     self._reply(504, pending.reply, trace=tid)
-                    return
+                    return "deadline"
 
                 if enqueue:
+                    # the root span rides the work item across the
+                    # stage threads (exactly as the trace id does);
+                    # t_enqueue anchors the queue_wait child span
+                    pending.span = root
+                    pending.t_enqueue = serving.tracer.clock.now()
                     with serving._stats_lock:
                         serving._n_backlog += 1
                     serving._queue.put(pending)
@@ -615,13 +706,15 @@ class ServingServer:
                     # most need to trace: echo the id here too
                     self._reply(504, b'{"error": "inference timed out"}',
                                 trace=tid)
-                    return
+                    return "timeout"
                 # a joined duplicate is only "replayed" if the reply was
                 # actually committed — errors are never journaled, so
                 # they must not carry the committed-replay marker
                 self._reply(pending.status, pending.reply or b"{}",
                             replayed=not enqueue and pending.status == 200,
                             window_missed=window_missed, trace=tid)
+                return ("ok" if pending.status == 200 else
+                        "deadline" if pending.status == 504 else "error")
 
             def log_message(self, *args):  # quiet
                 pass
@@ -705,6 +798,18 @@ class ServingServer:
                 live.append(p)
         return live
 
+    def _add_spans(self, requests: List[_PendingRequest], name: str,
+                   t0: float, t1: float, status: str = "ok",
+                   **attrs) -> None:
+        """Record one batch-level measurement as a child span of every
+        traced request's root: the batch does the work once, but each
+        request's trace must show its own full timeline. Synthetic
+        warmup requests carry no root span and record nothing."""
+        for p in requests:
+            if p.span is not None:
+                self.tracer.add(name, t0, t1, parent=p.span,
+                                status=status, **attrs)
+
     def _refresh_live(self, job: dict,
                       requests: List[_PendingRequest]) -> dict:
         """Deadline check #1 over ``requests`` + (re)assembly of the
@@ -714,11 +819,15 @@ class ServingServer:
         job["live"], job["n_live"] = live, len(live)
         job["df"] = None
         if live:
+            t0 = self.tracer.clock.now()
             try:
                 with self.timings.span("assemble"):
                     job["df"] = self._assemble_frame(live)
             except Exception as e:  # noqa: BLE001 — bad payloads -> 500s
                 job["error"] = e
+            self._add_spans(live, "assemble", t0, self.tracer.clock.now(),
+                            status="ok" if job["error"] is None
+                            else "error")
         return job
 
     def _stage_prepare(self, batch: List[_PendingRequest]) -> dict:
@@ -726,6 +835,14 @@ class ServingServer:
         request whose budget expired while queued must not occupy a
         batch slot or run through the model at all — then columnar
         frame assembly + shape-bucket padding."""
+        # queue_wait: enqueue -> the moment the collector owns the
+        # batch; recorded for EVERY collected request (the expired ones
+        # below waited too — that wait is usually why they expired)
+        now = self.tracer.clock.now()
+        for p in batch:
+            if p.span is not None and p.t_enqueue is not None:
+                self.tracer.add("queue_wait", p.t_enqueue, now,
+                                parent=p.span)
         job = {"batch_n": len(batch), "live": [], "n_live": 0,
                "df": None, "out": None, "error": None}
         return self._refresh_live(job, batch)
@@ -767,6 +884,7 @@ class ServingServer:
             self._refresh_live(job, job["live"])
         df = job["df"]
         if job["error"] is None and df is not None:
+            t0 = self.tracer.clock.now()
             try:
                 key = (df.num_rows, tuple(sorted(df.schema().items())))
                 with self._stats_lock:
@@ -779,12 +897,17 @@ class ServingServer:
                         # recompiles but are no longer remembered
                         if len(self._shapes_seen) < _MAX_SHAPES_TRACKED:
                             self._shapes_seen.add(key)
-                # batch-representative trace (the first live request's):
-                # contextvars do not follow the thread handoff, so the
-                # executor re-binds here — model-internal logs and any
-                # io/http egress the model performs carry a trace id.
-                # Per-request exact ids ride the journal lines.
+                # batch-representative trace AND span (the first live
+                # request's): contextvars do not follow the thread
+                # handoff, so the executor re-binds here — model-
+                # internal logs, pipeline-stage spans, and any io/http
+                # egress the model performs nest under that request's
+                # root (and the dispatch histogram's exemplar picks up
+                # its trace id). Per-request exact ids ride the journal
+                # lines; per-request dispatch child spans are recorded
+                # for every live root below.
                 with trace_context(job["live"][0].trace), \
+                        self.tracer.bind(job["live"][0].span), \
                         self.timings.span("dispatch"), \
                         self._m_dispatch.labels(df.num_rows).time():
                     out = self.model.transform(df)
@@ -801,6 +924,10 @@ class ServingServer:
                 job["out"] = out
             except Exception as e:  # noqa: BLE001 — model failure -> 500s
                 job["error"] = e
+            self._add_spans(
+                job["live"], "dispatch", t0, self.tracer.clock.now(),
+                status="ok" if job["error"] is None else "error",
+                bucket=df.num_rows)
         return job
 
     def _encode_replies(self, out: DataFrame, in_cols: List[str],
@@ -835,13 +962,18 @@ class ServingServer:
             return
         replies = None
         if job["error"] is None:
+            t0 = self.tracer.clock.now()
             try:
                 with trace_context(live[0].trace), \
+                        self.tracer.bind(live[0].span), \
                         self.timings.span("encode"):
                     replies = self._encode_replies(
                         job["out"], job["df"].columns, job["n_live"])
             except Exception as e:  # noqa: BLE001 — encode failure -> 500s
                 job["error"] = e
+            self._add_spans(live, "encode", t0, self.tracer.clock.now(),
+                            status="ok" if job["error"] is None
+                            else "error")
         if job["error"] is not None:
             err = json.dumps({"error": str(job["error"])}).encode()
             for p in live:
@@ -1061,9 +1193,14 @@ class ServingServer:
         """Commit a reply, then release waiters. Successful replies are
         journaled under the client request id (exactly-once); errors are
         not journaled, so a client may retry them."""
+        t0 = self.tracer.clock.now()
         with self._commit_lock:
             self._commit_locked(p)
             self._reap_expired_locked()
+        # the commit child span must hit the recorder BEFORE the event
+        # releases the handler thread — the handler finishes the ROOT
+        # on wake, and capture only gathers spans already recorded
+        self._add_spans([p], "commit", t0, self.tracer.clock.now())
         p.event.set()
 
     def _commit_many(self, ps: List[_PendingRequest]) -> None:
@@ -1073,10 +1210,13 @@ class ServingServer:
         released outside the lock, in batch order."""
         if not ps:
             return
+        t0 = self.tracer.clock.now()
         with self._commit_lock:
             for p in ps:
                 self._commit_locked(p)
             self._reap_expired_locked()
+        # record commit children before ANY event fires (see _commit)
+        self._add_spans(ps, "commit", t0, self.tracer.clock.now())
         for p in ps:
             p.event.set()
 
@@ -1316,6 +1456,10 @@ class ServingCoordinator:
         self.stale_after = (float(stale_after)
                             if stale_after and stale_after > 0 else None)
         self._lock = threading.Lock()
+        # previous poll's merged counters: GET /fleet reports
+        # rate()-style deltas alongside the lifetime totals (trend
+        # needs two scrapes — the ROADMAP fleet-rate item)
+        self._prev_totals: Optional[Tuple[float, Dict[str, int]]] = None
         coordinator = self
 
         class Handler(BaseHTTPRequestHandler):
@@ -1487,8 +1631,33 @@ class ServingCoordinator:
                        "max_ms": merged[stage]["max_ms"],
                        "worker": worst[stage][1],
                        "worker_mean_ms": round(worst[stage][0], 4)}
+        # rate()-style deltas between this poll and the previous one:
+        # the merged counters are lifetime totals, so trend needs two
+        # scrapes — held here so ANY /fleet consumer gets rates for
+        # free. Counters only (queue_depth/inflight are gauges, a delta
+        # of those is noise); clamped at 0 so a worker restart's
+        # counter reset reads as "no traffic", not negative traffic.
+        # The baseline advances at most once per second: a second
+        # consumer (an operator's curl next to the dashboard's poll)
+        # must not shrink everyone's window to near-zero, where the
+        # quantized counter deltas read as spikes. Rates stay correct
+        # over whatever interval is reported — rate_interval_s says
+        # which.
+        now = time.monotonic()
+        with self._lock:
+            prev = self._prev_totals
+            if prev is None or now - prev[0] >= 1.0:
+                self._prev_totals = (now, dict(totals))
+        rates: Optional[Dict[str, float]] = None
+        interval = None
+        if prev is not None and now > prev[0]:
+            interval = round(now - prev[0], 3)
+            rates = {k: round(max(totals[k] - prev[1].get(k, 0), 0)
+                              / (now - prev[0]), 3)
+                     for k in ("n_requests", "n_batches", "n_recompiles")}
         return {"n_workers": len(per_worker), "n_responding": n_live,
-                "totals": totals, "stage_timings": merged,
+                "totals": totals, "rates_per_s": rates,
+                "rate_interval_s": interval, "stage_timings": merged,
                 "slowest_stage": slowest, "widest_bucket": widest,
                 "workers": per_worker}
 

@@ -30,6 +30,13 @@ exercises the same jit shape-cache the TPU path hits).
 ``GET /metrics`` Prometheus scrape to ``PATH.<mode>.prom`` — the full
 histogram/counter evidence behind the A/B summary (see
 docs/observability.md).
+
+``--trace-dump PATH`` runs each mode with trace-everything tail
+capture (``slow_trace_ms=0``) and writes the SLOWEST captured
+request's Perfetto ``trace_event`` JSON to ``PATH.<mode>.trace.json``
+— open it in ``chrome://tracing``/ui.perfetto.dev to see exactly
+where that mode's worst request spent its time (queue wait vs pad vs
+dispatch vs encode).
 """
 
 from __future__ import annotations
@@ -114,17 +121,26 @@ def _metrics_text(srv) -> str:
 
 def run_mode(mode: str, model_kind: str, n_clients: int,
              duration_s: float, max_batch_size: int,
-             burst: int, metrics_dump: str = "") -> dict:
+             burst: int, metrics_dump: str = "",
+             trace_dump: str = "") -> dict:
     from mmlspark_tpu.serving import ServingServer
 
     model = _nn_model() if model_kind == "nn" else _identity_model()
     pipelined = mode == "pipelined"
     counts = [0] * n_clients
     lat = [[] for _ in range(n_clients)]
+    # --trace-dump: a PRIVATE trace-everything tracer per mode (the
+    # slowest request of THIS mode, not of whichever mode ran last)
+    tracer = None
+    if trace_dump:
+        from mmlspark_tpu.core.tracing import Tracer
+        tracer = Tracer(store_capacity=512)
     with ServingServer(model, max_latency_ms=2,
                        max_batch_size=max_batch_size,
                        pipeline=pipelined,
-                       bucket_batches=pipelined) as srv:
+                       bucket_batches=pipelined,
+                       **({"tracer": tracer, "slow_trace_ms": 0.0}
+                          if tracer else {})) as srv:
         srv.warmup(json.loads(_payload(model_kind, 0)))
         recompiles_warm = _stats(srv)["n_recompiles"]
         deadline = time.perf_counter() + duration_s
@@ -146,6 +162,18 @@ def run_mode(mode: str, model_kind: str, n_clients: int,
             dump_path = f"{metrics_dump}.{mode}.prom"
             with open(dump_path, "w") as f:
                 f.write(_metrics_text(srv))
+        trace_path = slowest_ms = None
+        if tracer is not None:
+            # the slowest captured request of this mode, as Perfetto
+            # trace_event JSON — the timeline behind the p99 number
+            from mmlspark_tpu.core.tracing import dump_perfetto
+            retained = tracer.traces()
+            if retained:
+                worst = max(retained, key=lambda t: t["duration_ms"])
+                slowest_ms = worst["duration_ms"]
+                trace_path = dump_perfetto(
+                    tracer.get_trace(worst["trace_id"]),
+                    f"{trace_dump}.{mode}.trace.json")
     all_lat = sorted(x for per in lat for x in per)
     p = (lambda q: round(1000 * all_lat[int(q * (len(all_lat) - 1))], 3)) \
         if all_lat else (lambda q: None)
@@ -159,6 +187,8 @@ def run_mode(mode: str, model_kind: str, n_clients: int,
         "stage_timings": {k: v["mean_ms"] for k, v in
                           stats["stage_timings"].items()},
         **({"metrics_dump": dump_path} if dump_path else {}),
+        **({"trace_dump": trace_path,
+            "slowest_trace_ms": slowest_ms} if trace_path else {}),
     }
 
 
@@ -176,6 +206,10 @@ def main() -> None:
     ap.add_argument("--metrics-dump", default="", metavar="PATH",
                     help="write each mode's post-run GET /metrics scrape "
                          "to PATH.<mode>.prom next to the A/B numbers")
+    ap.add_argument("--trace-dump", default="", metavar="PATH",
+                    help="capture every request (slow_trace_ms=0) and "
+                         "write the slowest one's Perfetto trace_event "
+                         "JSON to PATH.<mode>.trace.json")
     args = ap.parse_args()
     if args.smoke:
         args.clients, args.seconds = min(args.clients, 4), 1.0
@@ -183,7 +217,8 @@ def main() -> None:
     results = {}
     for mode in ("serial", "pipelined"):
         r = run_mode(mode, args.model, args.clients, args.seconds,
-                     args.max_batch_size, args.burst, args.metrics_dump)
+                     args.max_batch_size, args.burst, args.metrics_dump,
+                     args.trace_dump)
         results[mode] = r
         print(json.dumps(r), flush=True)
     if results["pipelined"]["recompiles_after_warmup"] != 0:

@@ -1,0 +1,109 @@
+"""Fetch tail-captured traces from a serving worker and render them.
+
+A worker retains every slow (over its ``slow_trace_ms`` route
+threshold) or non-ok (error/shed/deadline/timeout) trace in its
+flight-recorder store (see docs/observability.md "Tracing"). This CLI
+lists that store, pretty-prints one trace's span tree, or writes the
+Chrome ``trace_event`` JSON that ``chrome://tracing`` and
+https://ui.perfetto.dev open directly:
+
+    python tools/trace_dump.py http://worker:8000 --list
+    python tools/trace_dump.py http://worker:8000 --list --slow
+    python tools/trace_dump.py http://worker:8000 <trace-id>
+    python tools/trace_dump.py http://worker:8000 <trace-id> -o t.json
+    python tools/trace_dump.py http://worker:8000 --slowest -o t.json
+
+stdlib-only on the wire (urllib): runs anywhere the worker is
+reachable, no client deps.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from urllib.error import HTTPError
+from urllib.request import urlopen
+
+
+def _get_json(url: str, timeout: float = 10.0):
+    with urlopen(url, timeout=timeout) as resp:
+        return json.loads(resp.read().decode())
+
+
+def _print_tree(node: dict, depth: int = 0) -> None:
+    flag = "" if node["status"] == "ok" else f"  [{node['status']}]"
+    attrs = node.get("attrs") or {}
+    extra = "".join(f" {k}={v}" for k, v in sorted(attrs.items())
+                    if k != "route")
+    print(f"{'  ' * depth}{node['name']:<{max(24 - 2 * depth, 1)}} "
+          f"@{node['start_ms']:>9.3f}ms  {node['duration_ms']:>9.3f}ms"
+          f"{extra}{flag}")
+    for child in sorted(node.get("children", []),
+                        key=lambda c: c["start_ms"]):
+        _print_tree(child, depth + 1)
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("worker", help="worker base url, e.g. "
+                                   "http://127.0.0.1:8000")
+    ap.add_argument("trace_id", nargs="?",
+                    help="trace to fetch (see --list)")
+    ap.add_argument("--list", action="store_true",
+                    help="list retained traces and exit")
+    ap.add_argument("--slow", action="store_true",
+                    help="with --list: only threshold-retained traces "
+                         "(drop error/shed/deadline captures)")
+    ap.add_argument("--slowest", action="store_true",
+                    help="pick the longest retained trace instead of "
+                         "naming one")
+    ap.add_argument("-o", "--out", metavar="PATH",
+                    help="write Perfetto/chrome://tracing trace_event "
+                         "JSON here instead of printing the span tree")
+    args = ap.parse_args()
+    base = args.worker.rstrip("/")
+
+    if args.list or args.slowest:
+        traces = _get_json(f"{base}/traces"
+                           + ("?slow=1" if args.slow else ""))
+        if args.list:
+            for t in traces:
+                print(f"{t['trace_id']:<34} {t['root']:<12} "
+                      f"{t['duration_ms']:>10.3f}ms  {t['reason']:<9} "
+                      f"spans={t['n_spans']}")
+            if not traces:
+                print("(no retained traces — nothing slow or failed "
+                      "yet)", file=sys.stderr)
+            return
+        if not traces:
+            raise SystemExit("no retained traces to pick --slowest from")
+        args.trace_id = max(traces,
+                            key=lambda t: t["duration_ms"])["trace_id"]
+
+    if not args.trace_id:
+        raise SystemExit("need a trace id, --list, or --slowest")
+
+    try:
+        if args.out:
+            pf = _get_json(f"{base}/trace/{args.trace_id}?format=perfetto")
+            with open(args.out, "w") as f:
+                json.dump(pf, f)
+            print(f"wrote {len(pf['traceEvents'])} events to {args.out} "
+                  f"(open in chrome://tracing or ui.perfetto.dev)")
+        else:
+            tr = _get_json(f"{base}/trace/{args.trace_id}")
+            print(f"trace {tr['trace_id']}  route={tr['route']}  "
+                  f"status={tr['status']}  reason={tr['reason']}  "
+                  f"{tr['duration_ms']}ms")
+            _print_tree(tr["tree"])
+    except HTTPError as e:
+        if e.code == 404:
+            raise SystemExit(
+                f"trace {args.trace_id} not retained (fast + ok traces "
+                f"are tail-dropped; see --list)") from e
+        raise
+
+
+if __name__ == "__main__":
+    main()
