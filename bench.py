@@ -37,6 +37,10 @@ Plus (no era analogue, utilization/latency evidence):
                                    recorded span, flight-recorder ring
                                    throughput; the cost every traced
                                    request, stage, and train step adds)
+ 14. trace_propagation_overhead_v1 — distributed-trace context
+                                   inject+extract per egress attempt
+                                   (the header tax every cross-process
+                                   hop pays; budget 2 us/hop)
 
 Every line carries chip metadata (platform/device kind/count) so the
 numbers are interpretable across hosts.
@@ -906,13 +910,68 @@ def bench_tracing_overhead():
             "chip": _chip()}
 
 
+def bench_trace_propagation():
+    """Distributed-trace context propagation overhead: ns per
+    inject+extract round trip — the full header tax one cross-process
+    hop pays (egress stamps ``X-Trace-Id`` + ``X-Parent-Span-Id`` onto
+    the request's headers; ingress sanitizes the trace id and strictly
+    parses the parent span id). This runs once per egress ATTEMPT, so
+    a failover schedule pays it per worker tried — budget < 2 us/hop
+    (the telemetry-update budget: propagation must stay invisible next
+    to any real network send). vs_baseline = budget / measured.
+    """
+    from mmlspark_tpu.core.tracing import (
+        Tracer, extract_span_context, inject_span_context,
+    )
+
+    # best-of-rounds: the quantity is the code's cost, not the host's
+    # scheduling noise — a loaded box swings per-op times ~2x between
+    # rounds, and a budget check must not flake on that
+    def per_op_ns(fn, n=100_000, rounds=7):
+        best = float("inf")
+        for _ in range(rounds):
+            t0 = time.perf_counter_ns()
+            for _ in range(n):
+                fn()
+            best = min(best, (time.perf_counter_ns() - t0) / n)
+        return best
+
+    tracer = Tracer(default_slow_ms=None)
+    span = tracer.start("http_egress", trace_id="bench-hop-trace",
+                        route="bench")
+    base_headers = {"Content-Type": "application/json",
+                    "X-Request-Id": "bench-rid"}
+
+    def hop():
+        extract_span_context(inject_span_context(base_headers, span))
+
+    def inject_only():
+        inject_span_context(base_headers, span)
+
+    wired = inject_span_context(base_headers, span)
+
+    def extract_only():
+        extract_span_context(wired)
+
+    hop_ns = per_op_ns(hop)
+    budget = 2000.0
+    return {"metric": "trace_propagation_overhead_v1",
+            "value": round(hop_ns, 1), "unit": "ns/hop",
+            "inject_ns": round(per_op_ns(inject_only), 1),
+            "extract_ns": round(per_op_ns(extract_only), 1),
+            "baseline": budget,
+            "vs_baseline": round(budget / max(hop_ns, 1e-9), 3),
+            "chip": _chip()}
+
+
 BENCHES = [bench_gbdt_quantile, bench_adult_census, bench_cifar10_scoring,
            bench_cifar10_scoring_uint8, bench_imagenet_scoring,
            bench_transfer_learning, bench_distributed_sgd,
            bench_serving_latency, bench_serving_throughput,
            bench_transformer_train,
            bench_transformer_train_long, bench_moe_train,
-           bench_telemetry_overhead, bench_tracing_overhead]
+           bench_telemetry_overhead, bench_tracing_overhead,
+           bench_trace_propagation]
 
 
 def main() -> None:

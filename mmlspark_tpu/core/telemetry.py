@@ -70,9 +70,10 @@ __all__ = [
     "DEFAULT_LATENCY_BUCKETS_MS", "log_buckets",
     "render_registries", "parse_prometheus", "merge_prometheus",
     "render_samples", "MetricsSnapshot", "snapshot_registries",
+    "MetricsPusher", "quantile_from_buckets",
     "CONTENT_TYPE", "OPENMETRICS_CONTENT_TYPE",
     "TRACE_HEADER", "new_trace_id", "current_trace_id", "trace_context",
-    "trace_id_from_headers",
+    "trace_id_from_headers", "sanitize_trace_id",
 ]
 
 
@@ -110,6 +111,28 @@ def log_buckets(lo: float, hi: float) -> Tuple[float, ...]:
                 out.append(edge)
         decade *= 10.0
     return tuple(out)
+
+
+def quantile_from_buckets(edges: Tuple[float, ...],
+                          counts: List[int], q: float) -> Optional[float]:
+    """Estimate the ``q``-quantile of a fixed-bucket histogram from
+    its per-bucket counts (``len(edges) + 1`` entries, +Inf last) with
+    linear interpolation inside the landing bucket — the
+    ``histogram_quantile()`` PromQL estimate, computed locally. A rank
+    landing in the +Inf bucket returns the top edge (the ladder's
+    honest maximum); ``None`` on an empty histogram."""
+    total = sum(counts)
+    if total <= 0 or not edges:
+        return None
+    rank = q * total
+    cum = 0
+    lo = 0.0
+    for edge, n in zip(edges, counts):
+        if cum + n >= rank and n > 0:
+            return lo + (rank - cum) / n * (edge - lo)
+        cum += n
+        lo = edge
+    return float(edges[-1])
 
 
 #: fixed log-scale latency ladder, in milliseconds: 0.1 ms .. 10 s.
@@ -721,6 +744,133 @@ class MetricsSnapshot:
 
 
 # ---------------------------------------------------------------------------
+# Remote-write: push the exposition to a live gateway
+# ---------------------------------------------------------------------------
+
+class MetricsPusher:
+    """Background remote-write: POST the registry exposition to a
+    push-gateway URL on an interval, and once more on :meth:`stop`.
+
+    :class:`MetricsSnapshot` leaves scrapes on *disk*;
+    ``MetricsPusher`` closes the remaining gap to a LIVE Prometheus —
+    point ``url`` at a Pushgateway job path
+    (``http://gw:9091/metrics/job/<job>``) or any remote-write-shim
+    endpoint that accepts the text exposition. Sends go through
+    :mod:`mmlspark_tpu.io.http`'s resilient client: a jittered/bounded
+    :class:`~mmlspark_tpu.core.resilience.RetryPolicy` per push and a
+    circuit breaker on the gateway host, so a dead gateway costs one
+    short retry schedule per interval (then an instant breaker-refused
+    attempt), never a hung telemetry thread. Push failures are counted
+    (``n_errors``) and logged — telemetry must never kill the job.
+
+    Usage::
+
+        with MetricsPusher("http://gw:9091/metrics/job/train",
+                           interval_s=30):
+            run_job()                  # final flush on exit
+    """
+
+    def __init__(self, url: str,
+                 registries: Iterable[MetricsRegistry] = (),
+                 interval_s: float = 30.0, timeout: float = 5.0,
+                 policy=None, headers: Optional[Dict[str, str]] = None,
+                 session=None):
+        self.url = url
+        self.registries = tuple(registries) or (REGISTRY,)
+        self.interval_s = float(interval_s)
+        self.timeout = float(timeout)
+        self.headers = dict(headers or {})
+        self.n_pushes = 0
+        self.n_errors = 0
+        self.last_status: Optional[int] = None
+        self._policy = policy
+        self._session = session
+        self._client = None
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def _get_client(self):
+        # lazy: io.http imports this module, so the cycle must resolve
+        # at call time; a pusher that never pushes imports nothing
+        if self._client is None:
+            from mmlspark_tpu.core.resilience import (
+                BreakerBoard, RetryPolicy,
+            )
+            from mmlspark_tpu.io.http import HTTPClient
+            policy = self._policy or RetryPolicy(
+                max_attempts=3, base=0.2, cap=2.0)
+            # a PRIVATE breaker board: the push gateway's health must
+            # not open the process-wide SHARED_BREAKERS entry some
+            # model egress may share, and vice versa
+            self._client = HTTPClient(
+                timeout=self.timeout, policy=policy,
+                breakers=BreakerBoard(failure_threshold=5,
+                                      reset_timeout=30.0),
+                session=self._session)
+        return self._client
+
+    def push_now(self) -> bool:
+        """One synchronous push; True iff the gateway answered 2xx
+        (after the retry schedule). Never raises."""
+        from mmlspark_tpu.io.http import HTTPRequestData
+        body = render_registries(*self.registries).encode()
+        h = {"Content-Type": CONTENT_TYPE}
+        h.update(self.headers)
+        req = HTTPRequestData(url=self.url, method="POST", headers=h,
+                              body=body)
+        # bind a trace id with no ambient span: egress spans then mark
+        # themselves mid-trace and a flaky gateway cannot churn the
+        # trace store with one-span error captures every interval
+        with trace_context():
+            resp = self._get_client().send([req])[0]
+        self.last_status = resp.status_code if resp is not None else None
+        ok = resp is not None and 200 <= resp.status_code < 300
+        if ok:
+            self.n_pushes += 1
+        else:
+            self.n_errors += 1
+            from mmlspark_tpu.core.logs import get_logger
+            get_logger("telemetry").warning(
+                "metrics push to %s failed (status=%s reason=%s)",
+                self.url, getattr(resp, "status_code", None),
+                getattr(resp, "reason", "no response"))
+        return ok
+
+    def _loop(self) -> None:
+        while not self._stop.wait(self.interval_s):
+            try:
+                self.push_now()
+            except Exception:  # noqa: BLE001 — telemetry never kills jobs
+                from mmlspark_tpu.core.logs import get_logger
+                get_logger("telemetry").warning(
+                    "metrics push to %s raised", self.url, exc_info=True)
+
+    def start(self) -> "MetricsPusher":
+        self._stop.clear()
+        self._thread = threading.Thread(target=self._loop, daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        """Stop the pusher and flush one final push — the scrape that
+        carries a batch job's terminal counters to the gateway."""
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=2 * self.timeout + 5)
+            self._thread = None
+        try:
+            self.push_now()
+        except Exception:  # noqa: BLE001
+            pass
+
+    def __enter__(self) -> "MetricsPusher":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.stop()
+
+
+# ---------------------------------------------------------------------------
 # Scrape parsing + fleet merge
 # ---------------------------------------------------------------------------
 
@@ -838,16 +988,32 @@ def trace_context(trace_id: Optional[str] = None) -> Iterator[str]:
         _trace_id.reset(token)
 
 
+_TRACE_ID_OK_RE = re.compile(r"[A-Za-z0-9._-]{1,128}")
+
+
+def sanitize_trace_id(raw) -> Optional[str]:
+    """Sanitize an inbound trace id to ``[A-Za-z0-9._-]`` (<= 128
+    chars), ``None`` when nothing survives. Spaces and ``=`` would let
+    a client inject spoofed ``key=value`` tokens into the worker's own
+    plain-format log lines — the PR 3 ingress contract, shared with
+    :func:`mmlspark_tpu.core.tracing.extract_span_context`. A clean id
+    (the overwhelmingly common case — our own ids always are) passes
+    on one C-speed fullmatch; only dirty input pays the per-char
+    scrub. The fast path keeps context extraction inside the
+    2 us/hop ``trace_propagation_overhead_v1`` budget."""
+    if not raw:
+        return None
+    if type(raw) is not str:
+        raw = str(raw)
+    if _TRACE_ID_OK_RE.fullmatch(raw):
+        return raw
+    raw = "".join(ch for ch in raw.strip()[:128]
+                  if ch.isalnum() or ch in "._-")
+    return raw or None
+
+
 def trace_id_from_headers(headers) -> str:
     """Adopt the inbound ``X-Trace-Id`` (sanitized — it lands in logs
-    and journal lines) or mint a fresh one. The charset is restricted
-    to ``[A-Za-z0-9._-]``: spaces and ``=`` would let a client inject
-    spoofed ``key=value`` tokens into the worker's own plain-format
-    log lines."""
+    and journal lines) or mint a fresh one."""
     raw = headers.get(TRACE_HEADER) if headers is not None else None
-    if raw:
-        raw = "".join(ch for ch in str(raw).strip()[:128]
-                      if ch.isalnum() or ch in "._-")
-        if raw:
-            return raw
-    return new_trace_id()
+    return sanitize_trace_id(raw) or new_trace_id()

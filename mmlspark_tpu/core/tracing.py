@@ -60,13 +60,20 @@ import contextvars
 import itertools
 import json
 import os
+import re
 import threading
 import time
+import uuid
 from collections import OrderedDict
-from typing import Any, Dict, List, Optional
+from typing import Any, Dict, List, Optional, Tuple
 
 from mmlspark_tpu.core.resilience import Clock, SYSTEM_CLOCK
-from mmlspark_tpu.core.telemetry import current_trace_id, new_trace_id
+from mmlspark_tpu.core.telemetry import (
+    TRACE_HEADER, current_trace_id, new_trace_id, sanitize_trace_id,
+)
+# the clean-id regex itself (not just the sanitize wrapper): ingress
+# extraction fast-paths already-clean ids with one fullmatch
+from mmlspark_tpu.core.telemetry import _TRACE_ID_OK_RE
 # the raw trace-id contextvar (not the trace_context contextmanager):
 # span scopes bind trace + span together on the hot path, and a
 # generator-contextmanager pair per span would triple the span budget
@@ -76,9 +83,21 @@ __all__ = [
     "Span", "FlightRecorder", "Tracer", "TRACER",
     "current_span", "current_span_name", "ambient_tracer",
     "span_tree", "to_perfetto", "dump_perfetto",
+    "PARENT_SPAN_HEADER", "format_span_id", "parse_span_id",
+    "inject_span_context", "extract_span_context",
+    "merge_traces", "AdaptiveThreshold",
 ]
 
 _SPAN_COUNTER = itertools.count(1)
+
+# span ids must stay unambiguous when traces MERGE across processes
+# (the coordinator stitches N workers' span lists into one tree, and a
+# worker root's parent_id names a span in the CALLER's process): plain
+# per-process counters would collide at 1, so every process draws its
+# ids from a random 63-bit base + the counter — still one integer add
+# per span, still monotonic within the process, collision probability
+# across a fleet ~2^-39 even at a billion spans per worker
+_SPAN_ID_BASE = uuid.uuid4().int & 0x7FFF_FFFF_FF00_0000
 
 _current_span: "contextvars.ContextVar[Optional[Span]]" = \
     contextvars.ContextVar("mmlspark_tpu_span", default=None)
@@ -126,20 +145,24 @@ class Span:
     """
 
     __slots__ = ("trace_id", "span_id", "parent_id", "name",
-                 "t0", "t1", "status", "attrs", "thread")
+                 "t0", "t1", "status", "attrs", "thread", "remote")
 
     def __init__(self, name: str, trace_id: str,
                  parent_id: Optional[int], t0: float,
                  attrs: Optional[Dict[str, Any]] = None):
         self.name = name
         self.trace_id = trace_id
-        self.span_id = next(_SPAN_COUNTER)
+        self.span_id = _SPAN_ID_BASE + next(_SPAN_COUNTER)
         self.parent_id = parent_id
         self.t0 = t0
         self.t1: Optional[float] = None
         self.status = "ok"
         self.attrs: Optional[Dict[str, Any]] = attrs
         self.thread = threading.get_ident()
+        # True when parent_id names a span in ANOTHER process (adopted
+        # from an inbound header): the span is still a capture root
+        # locally — its real parent finishes elsewhere
+        self.remote = False
 
     @property
     def duration_ms(self) -> float:
@@ -153,7 +176,7 @@ class Span:
     def to_dict(self, origin: float = 0.0) -> Dict[str, Any]:
         """JSON-able record; times relative to ``origin`` (the trace's
         first span start) so exported trees read from 0."""
-        return {
+        d = {
             "name": self.name,
             "span_id": self.span_id,
             "parent_id": self.parent_id,
@@ -163,6 +186,9 @@ class Span:
             "attrs": self.attrs or {},
             "thread": self.thread,
         }
+        if self.remote:
+            d["remote"] = True
+        return d
 
     def __repr__(self) -> str:
         return (f"Span({self.name!r}, trace={self.trace_id}, "
@@ -305,9 +331,14 @@ class Tracer:
     # -- span lifecycle -----------------------------------------------------
 
     def start(self, name: str, trace_id: Optional[str] = None,
-              parent: Optional[Span] = None, **attrs) -> Span:
+              parent: Optional[Span] = None,
+              remote_parent: Optional[int] = None, **attrs) -> Span:
         """Begin a span. Parent defaults to the ambient span; the trace
-        id resolves explicit > parent's > ambient trace id > fresh."""
+        id resolves explicit > parent's > ambient trace id > fresh.
+        ``remote_parent`` is a span id adopted from an inbound header
+        (:func:`extract_span_context`): the new span records that
+        cross-process parent link but is still a LOCAL capture root —
+        its real parent finishes in the caller's process."""
         if parent is None:
             parent = _current_span.get()
         if parent is not None:
@@ -315,8 +346,11 @@ class Tracer:
             pid = parent.span_id
         else:
             tid = trace_id or current_trace_id() or new_trace_id()
-            pid = None
-        return Span(name, tid, pid, self._now(), attrs or None)
+            pid = remote_parent
+        sp = Span(name, tid, pid, self._now(), attrs or None)
+        if parent is None and remote_parent is not None:
+            sp.remote = True
+        return sp
 
     def finish(self, span: Span, status: Optional[str] = None,
                capture: bool = True, **attrs) -> None:
@@ -337,7 +371,7 @@ class Tracer:
             span.status = status
         span.t1 = self._now()
         self._record(span)
-        if capture and span.parent_id is None:
+        if capture and (span.parent_id is None or span.remote):
             self._maybe_capture(span)
 
     def add(self, name: str, t0: float, t1: float, parent: Span,
@@ -385,6 +419,7 @@ class Tracer:
         if not spans:
             spans = [root]
         origin = spans[0].t0
+        wall = time.time()
         trace = {
             "trace_id": root.trace_id,
             "root": root.name,
@@ -392,7 +427,12 @@ class Tracer:
             "duration_ms": round(dur, 3),
             "status": root.status,
             "reason": reason,
-            "captured_at": round(time.time(), 3),
+            "captured_at": round(wall, 3),
+            # wall-clock anchor of the trace's first local span: span
+            # t0/t1 are per-process monotonic and NOT comparable across
+            # workers, so a distributed merge aligns each part by this
+            # anchor instead (best-effort — as good as the hosts' NTP)
+            "origin_unix": round(wall - max(self._now() - origin, 0.0), 6),
             "n_spans": len(spans),
             "spans": [sp.to_dict(origin) for sp in spans],
         }
@@ -442,6 +482,268 @@ class Tracer:
 
 
 # ---------------------------------------------------------------------------
+# Cross-process span context (the distributed-tracing wire contract)
+# ---------------------------------------------------------------------------
+
+#: W3C traceparent-style parent link, split across two headers so the
+#: existing ``X-Trace-Id`` contract is untouched: the trace id rides
+#: ``X-Trace-Id`` (sanitized, PR 3 semantics) and the CALLER's span id
+#: rides ``X-Parent-Span-Id`` as lowercase hex. A worker that adopts
+#: the pair parents its root "request" span under the caller's egress
+#: span, so a client's whole failover schedule and every worker-side
+#: tree stitch into one distributed trace.
+PARENT_SPAN_HEADER = "X-Parent-Span-Id"
+
+_SPAN_ID_RE = re.compile(r"^[0-9a-fA-F]{1,16}$")
+
+
+def format_span_id(span_id: int) -> str:
+    """A span id as it travels on the wire (lowercase hex, <= 16
+    chars)."""
+    return format(span_id, "x")
+
+
+def parse_span_id(raw: Optional[str]) -> Optional[int]:
+    """Parse an inbound ``X-Parent-Span-Id``. Strict by design — the
+    value becomes a parent link in retained trees and a key in merged
+    exports, so anything malformed (non-hex, overlong, zero, empty) is
+    REJECTED to ``None`` rather than sanitized into a wrong link.
+    (The int() fallback path never runs: the regex admits only plain
+    hex, rejecting the whitespace/sign/underscore forms int() itself
+    would accept.)"""
+    if not raw:
+        return None
+    if type(raw) is not str:
+        raw = str(raw)
+    if not _SPAN_ID_RE.match(raw):          # clean wire value: one
+        raw = raw.strip()                   # C-speed match, no strip
+        if not _SPAN_ID_RE.match(raw):
+            return None
+    return int(raw, 16) or None
+
+
+def inject_span_context(headers: Dict[str, str], span: Span,
+                        _trace: str = TRACE_HEADER,
+                        _parent: str = PARENT_SPAN_HEADER
+                        ) -> Dict[str, str]:
+    """Headers + the span's trace context (``X-Trace-Id`` +
+    ``X-Parent-Span-Id``). Caller-supplied headers win (names compared
+    case-insensitively — two conflicting trace headers would fork
+    downstream correlation); the input dict is never mutated."""
+    # the scan runs on every egress attempt: a length prefilter skips
+    # unrelated keys on one int compare, and only length-10/-16 keys
+    # (candidate context headers) pay an equality or lower() check
+    trace_val = None
+    has_trace = has_parent = False
+    for k in headers:
+        lk = len(k)
+        if lk == 10:
+            if k == _trace or k.lower() == "x-trace-id":
+                has_trace = True
+                trace_val = headers[k]
+        elif lk == 16:
+            if k == _parent or k.lower() == "x-parent-span-id":
+                has_parent = True
+    if has_trace and has_parent:
+        return headers
+    if has_trace and trace_val != span.trace_id:
+        # the caller aimed this request at a DIFFERENT trace: our span
+        # id would be a cross-trace parent link — worse than no link
+        # (the receiver would forever hold a dangling parent). Leave
+        # the caller's context alone.
+        return headers
+    out = dict(headers)
+    if not has_trace:
+        out[_trace] = span.trace_id
+    if not has_parent:
+        out[_parent] = format(span.span_id, "x")
+    return out
+
+
+def extract_span_context(headers,
+                         _tid_ok=_TRACE_ID_OK_RE.fullmatch,
+                         _sid_ok=_SPAN_ID_RE.match,
+                         _th: str = TRACE_HEADER,
+                         _ph: str = PARENT_SPAN_HEADER
+                         ) -> Tuple[str, Optional[int]]:
+    """Adopt inbound trace context: ``(trace_id, parent_span_id)``.
+
+    The trace id is sanitized exactly like
+    :func:`~mmlspark_tpu.core.telemetry.trace_id_from_headers` (or
+    minted fresh when absent/empty); the parent span id is parsed
+    strictly (:func:`parse_span_id`) and is honored ONLY when the trace
+    id itself was adopted — a parent link without the trace it belongs
+    to is meaningless and is dropped. Runs at every ingress: a clean
+    inbound pair costs two C-speed regex checks (the 2 us/hop
+    ``trace_propagation_overhead_v1`` budget)."""
+    # bound-method/constant defaults: the fast paths resolve with zero
+    # per-call global or attribute lookups — this runs at every ingress
+    raw = headers.get(_th) if headers is not None else None
+    if not raw:
+        return new_trace_id(), None
+    if type(raw) is str and _tid_ok(raw):
+        tid = raw                            # clean id: no scrub pass
+    else:
+        tid = sanitize_trace_id(raw)
+        if tid is None:
+            return new_trace_id(), None
+    sid = headers.get(_ph)
+    if not sid:
+        return tid, None
+    if type(sid) is str and _sid_ok(sid):    # clean wire value:
+        return tid, int(sid, 16) or None     # parse_span_id inlined
+    return tid, parse_span_id(sid)
+
+
+def merge_traces(parts: List[Tuple[str, Dict[str, Any]]]
+                 ) -> Optional[Dict[str, Any]]:
+    """Stitch one logical trace's per-process captures into a single
+    span list: ``parts`` is ``[(worker_label, captured_trace), ...]``
+    for ONE trace id (e.g. the client's capture plus every worker's,
+    fetched via ``GET /trace/<id>?format=raw``).
+
+    Each part's spans carry per-process monotonic-relative times, so
+    parts are aligned by their ``origin_unix`` wall-clock anchors
+    (best-effort: as accurate as the hosts' clock sync) and re-zeroed
+    to the earliest span. Every merged span gains a ``worker`` label
+    (its originating part) for per-worker attribution and Perfetto
+    lanes; span ids are globally unique, so cross-process
+    ``parent_id`` links resolve and :func:`span_tree` nests worker
+    roots under the caller's egress spans."""
+    parts = [(lbl, t) for lbl, t in parts if t]
+    if not parts:
+        return None
+    origins = [t.get("origin_unix") for _, t in parts
+               if t.get("origin_unix") is not None]
+    zero = min(origins) if origins else 0.0
+    spans: List[Dict[str, Any]] = []
+    seen: set = set()
+    workers: List[str] = []
+    owner_of: Dict[int, int] = {}        # span_id -> part index
+    for pi, (lbl, t) in enumerate(parts):
+        off_ms = ((t.get("origin_unix") or zero) - zero) * 1000.0
+        if lbl not in workers:
+            workers.append(lbl)
+        for sp in t.get("spans", ()):
+            if sp["span_id"] in seen:
+                continue                 # a part polled twice
+            seen.add(sp["span_id"])
+            owner_of[sp["span_id"]] = pi
+            s = dict(sp)
+            s["start_ms"] = round(s["start_ms"] + off_ms, 3)
+            s["worker"] = lbl
+            spans.append(s)
+    if not spans:
+        return None
+    spans.sort(key=lambda s: s["start_ms"])
+    base = spans[0]["start_ms"]
+    if base:
+        for s in spans:
+            s["start_ms"] = round(s["start_ms"] - base, 3)
+    # the distributed root: parentless AND not remote-parented (a
+    # worker root's parent finished in another process — it is a root
+    # only of its local part); fall back to the earliest span when the
+    # caller's part was never captured
+    roots = [s for s in spans
+             if s["parent_id"] is None and not s.get("remote")]
+    root = roots[0] if roots else spans[0]
+    owner = parts[owner_of[root["span_id"]]][1]
+    end = max(s["start_ms"] + s["duration_ms"] for s in spans)
+    return {
+        "trace_id": owner["trace_id"],
+        "root": root["name"],
+        "route": owner.get("route", root["name"]),
+        "duration_ms": round(end, 3),
+        "status": root["status"],
+        "reason": owner.get("reason", root["status"]),
+        "captured_at": max(t.get("captured_at", 0.0) for _, t in parts),
+        "n_spans": len(spans),
+        "workers": workers,
+        "spans": spans,
+    }
+
+
+# ---------------------------------------------------------------------------
+# Adaptive slow-trace thresholds
+# ---------------------------------------------------------------------------
+
+class AdaptiveThreshold:
+    """Derive a route's ``slow_trace_ms`` from its own latency
+    histogram instead of a fixed number.
+
+    A fixed 250 ms threshold captures *everything* on a route whose
+    p50 is 300 ms and *nothing* on one whose p99 is 40 ms. This tracks
+    the route's observed ``quantile`` (default p95, read from the
+    histogram's bucket counts with in-bucket linear interpolation),
+    pads it by ``margin``, clamps to ``[floor_ms, ceiling_ms]``, and
+    installs the result via :meth:`Tracer.set_threshold` — so tail
+    capture always means "slower than this route usually is".
+
+    Off the hot path by construction: :meth:`tick` is one integer
+    bump per batch; only every ``refresh_every``-th tick walks the
+    histogram's (bounded) bucket counts. Below ``min_count`` total
+    observations nothing changes — the configured fixed threshold
+    keeps ruling until the route has a believable distribution
+    (the warm-up contract).
+
+    ``stats_fn`` returns ``[(edges, counts), ...]`` pairs — one per
+    histogram child when the family is labeled (e.g. the serving
+    dispatch histogram's per-bucket children merge into one route
+    distribution).
+    """
+
+    def __init__(self, tracer: "Tracer", route: str, stats_fn,
+                 quantile: float = 0.95, margin: float = 1.25,
+                 floor_ms: float = 25.0, ceiling_ms: float = 5000.0,
+                 min_count: int = 50, refresh_every: int = 32):
+        self.tracer = tracer
+        self.route = route
+        self.stats_fn = stats_fn
+        self.quantile = float(quantile)
+        self.margin = float(margin)
+        self.floor_ms = float(floor_ms)
+        self.ceiling_ms = float(ceiling_ms)
+        self.min_count = int(min_count)
+        self.refresh_every = max(int(refresh_every), 1)
+        self.value: Optional[float] = None       # last installed, ms
+        self.n_refreshes = 0
+        self._since = 0
+
+    def tick(self, n: int = 1) -> Optional[float]:
+        """Count ``n`` units of work; refresh when ``refresh_every``
+        accumulate. Racy by design (plain int, no lock): a lost tick
+        delays a refresh by one batch, which is free compared to a
+        lock on the commit path."""
+        self._since += n
+        if self._since < self.refresh_every:
+            return None
+        self._since = 0
+        return self.refresh()
+
+    def refresh(self) -> Optional[float]:
+        """Recompute and install the threshold now; ``None`` when the
+        route is still warming up (below ``min_count`` samples)."""
+        from mmlspark_tpu.core.telemetry import quantile_from_buckets
+        edges = None
+        merged: Optional[List[int]] = None
+        for e, counts in self.stats_fn():
+            if merged is None:
+                edges, merged = e, list(counts)
+            else:
+                merged = [a + b for a, b in zip(merged, counts)]
+        if not merged or sum(merged) < self.min_count:
+            return None
+        q = quantile_from_buckets(edges, merged, self.quantile)
+        if q is None:
+            return None
+        thr = min(max(q * self.margin, self.floor_ms), self.ceiling_ms)
+        self.tracer.set_threshold(self.route, thr)
+        self.value = thr
+        self.n_refreshes += 1
+        return thr
+
+
+# ---------------------------------------------------------------------------
 # Exporters
 # ---------------------------------------------------------------------------
 
@@ -472,26 +774,59 @@ def to_perfetto(trace: Dict[str, Any]) -> Dict[str, Any]:
     in ``chrome://tracing`` or https://ui.perfetto.dev. Complete
     (``ph: "X"``) events, microsecond timestamps relative to the
     trace's first span, one lane per recording thread (the serving
-    pipeline's collector/executor/encoder stages separate visually)."""
-    pid = os.getpid()
+    pipeline's collector/executor/encoder stages separate visually).
+
+    A MERGED distributed trace (:func:`merge_traces` — its spans carry
+    ``worker`` labels) renders each worker as its own *process* lane
+    (``pid`` per worker, named via ``process_name`` metadata) with its
+    threads nested inside, so the client's failover schedule and every
+    worker's stage work read side by side on one timebase."""
+    spans = trace["spans"]
+    distributed = any("worker" in sp for sp in spans)
     events: List[Dict[str, Any]] = []
-    threads = sorted({sp["thread"] for sp in trace["spans"]})
-    lane = {t: i for i, t in enumerate(threads)}
-    for i, t in enumerate(threads):
-        events.append({"ph": "M", "pid": pid, "tid": i,
-                       "name": "thread_name",
-                       "args": {"name": f"thread-{t}"}})
-    for sp in trace["spans"]:
+    if distributed:
+        workers: List[str] = []
+        for sp in spans:
+            w = sp.get("worker", "")
+            if w not in workers:
+                workers.append(w)
+        wlane = {w: i for i, w in enumerate(workers)}
+        lane: Dict[Any, Tuple[int, int]] = {}
+        for w in workers:
+            pid = wlane[w]
+            events.append({"ph": "M", "pid": pid, "tid": 0,
+                           "name": "process_name",
+                           "args": {"name": w or "local"}})
+            threads = sorted({sp["thread"] for sp in spans
+                              if sp.get("worker", "") == w})
+            for ti, t in enumerate(threads):
+                lane[(w, t)] = (pid, ti)
+                events.append({"ph": "M", "pid": pid, "tid": ti,
+                               "name": "thread_name",
+                               "args": {"name": f"thread-{t}"}})
+    else:
+        pid = os.getpid()
+        threads = sorted({sp["thread"] for sp in spans})
+        lane = {("", t): (pid, i) for i, t in enumerate(threads)}
+        for i, t in enumerate(threads):
+            events.append({"ph": "M", "pid": pid, "tid": i,
+                           "name": "thread_name",
+                           "args": {"name": f"thread-{t}"}})
+    for sp in spans:
         args = dict(sp["attrs"])
         args["trace_id"] = trace["trace_id"]
         args["status"] = sp["status"]
         args["span_id"] = sp["span_id"]
+        if distributed:
+            args["worker"] = sp.get("worker", "")
+        epid, etid = lane[(sp.get("worker", "") if distributed else "",
+                           sp["thread"])]
         events.append({
             "ph": "X",
             "name": sp["name"],
             "cat": trace["route"],
-            "pid": pid,
-            "tid": lane[sp["thread"]],
+            "pid": epid,
+            "tid": etid,
             "ts": int(round(sp["start_ms"] * 1000.0)),
             "dur": max(int(round(sp["duration_ms"] * 1000.0)), 1),
             "args": args,

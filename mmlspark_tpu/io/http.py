@@ -129,18 +129,6 @@ def _host_label(host: str) -> str:
 
 def _send_once(session, req: HTTPRequestData,
                timeout: float) -> HTTPResponseData:
-    headers = req.headers
-    # header names are case-insensitive on the wire: a caller-supplied
-    # x-trace-id must suppress injection, or two conflicting trace
-    # headers would fork downstream correlation
-    if not any(k.lower() == "x-trace-id" for k in headers):
-        # flow the ambient trace id onto the wire: a serving request
-        # whose model fans out HTTP calls stays correlatable end-to-end
-        from mmlspark_tpu.core.telemetry import current_trace_id
-        tid = current_trace_id()
-        if tid:
-            headers = dict(headers)
-            headers["X-Trace-Id"] = tid
     # one egress span per attempt, nested under the ambient span (a
     # served request whose model fans out HTTP shows each send in its
     # captured timeline, carrying the same injected trace id); a
@@ -151,11 +139,24 @@ def _send_once(session, req: HTTPRequestData,
     # capture decision, or a retry storm would churn the trace store
     # with one-span "http_egress" captures
     from mmlspark_tpu.core.telemetry import current_trace_id
-    from mmlspark_tpu.core.tracing import ambient_tracer, current_span
+    from mmlspark_tpu.core.tracing import (
+        ambient_tracer, current_span, inject_span_context,
+    )
     tracer = ambient_tracer()
-    mid_trace = current_trace_id() is not None and current_span() is None
+    tid = current_trace_id()
+    mid_trace = tid is not None and current_span() is None
     span = tracer.start("http_egress", host=_host_of(req.url),
                         method=req.method)
+    headers = req.headers
+    if tid:
+        # distributed-trace context on the wire: the trace id PLUS this
+        # attempt span's id as X-Parent-Span-Id, so an mmlspark_tpu
+        # worker on the other end parents its root "request" span under
+        # this exact attempt and the trees merge into one distributed
+        # trace. Caller-supplied headers win (names are
+        # case-insensitive on the wire — two conflicting trace headers
+        # would fork downstream correlation).
+        headers = inject_span_context(headers, span)
     try:
         resp = session.request(req.method, req.url, headers=headers,
                                data=req.body, timeout=timeout)

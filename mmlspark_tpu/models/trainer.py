@@ -141,6 +141,19 @@ class NNLearner(Estimator, HasLabelCol, HasFeaturesCol):
     mesh_shape = Param(None, "mesh axes dict, e.g. {'data': -1}", ptype=dict)
     checkpoint_dir = Param(None, "orbax step-checkpoint directory", ptype=str)
     checkpoint_every = Param(0, "steps between checkpoints (0 = off)", ptype=int)
+    push_gateway_url = Param(None, "optional metrics remote-write URL "
+                             "(Prometheus Pushgateway job path or any "
+                             "endpoint accepting the text exposition): "
+                             "a MetricsPusher POSTs the process "
+                             "registry there on an interval during "
+                             "fit, with a final flush when the fit "
+                             "ends — a batch fit's telemetry reaches a "
+                             "LIVE Prometheus even though the job "
+                             "exits between scrapes (checkpoint-side "
+                             ".prom snapshots remain the on-disk "
+                             "fallback)", ptype=str)
+    push_interval_s = Param(30.0, "seconds between remote-write pushes",
+                            ptype=float, validator=in_range(lo=1.0))
     max_restarts = Param(2, "bounded in-process auto-restarts: when a "
                          "train step fails and checkpointing is "
                          "configured, restore the latest orbax "
@@ -301,6 +314,21 @@ class NNLearner(Estimator, HasLabelCol, HasFeaturesCol):
     # -- fit ----------------------------------------------------------------
 
     def fit(self, df: DataFrame) -> NNModel:
+        if not self.push_gateway_url:
+            return self._fit(df)
+        # remote-write rides the whole fit: periodic pushes while the
+        # host loop runs, one final flush in the finally (success OR
+        # failure — a crashed fit's last counters are exactly the
+        # telemetry worth having). Step/egress spans carry trace
+        # context on any HTTP the fit fans out (io/http injects the
+        # ambient train_step span), so pushed exemplars and captured
+        # step traces stay correlated.
+        from mmlspark_tpu.core.telemetry import MetricsPusher
+        with MetricsPusher(self.push_gateway_url,
+                           interval_s=self.push_interval_s):
+            return self._fit(df)
+
+    def _fit(self, df: DataFrame) -> NNModel:
         import jax
         import optax
 

@@ -51,6 +51,20 @@ Environment:
                    their span tree at ``GET /trace/<id>`` (Perfetto
                    export via ``?format=perfetto``; 0 captures every
                    request — see docs/observability.md "Tracing")
+  ADAPTIVE_SLOW_TRACE
+                   (worker, optional) 0 pins the tail-capture
+                   threshold at SLOW_TRACE_MS forever; by default
+                   (1) the threshold tracks the route's own dispatch-
+                   latency p95 (floor/ceiling clamped) once enough
+                   samples accumulate — see docs/observability.md
+                   "Distributed tracing"
+  PUSH_GATEWAY_URL / PUSH_INTERVAL_S
+                   (worker, optional) remote-write: POST the worker's
+                   metrics exposition (per-server + process registry)
+                   to this URL every PUSH_INTERVAL_S seconds (default
+                   30) through the resilient HTTP client, with a
+                   final flush on shutdown — telemetry for fleets
+                   without a scraping Prometheus
 """
 
 import os
@@ -98,7 +112,8 @@ def run_worker() -> None:
         pipeline=_env_float("PIPELINE", 1) != 0,
         bucket_batches=_env_float("BUCKET_BATCHES", 1) != 0,
         encoder_threads=int(_env_float("ENCODER_THREADS", 2)),
-        slow_trace_ms=_env_float("SLOW_TRACE_MS", 250.0))
+        slow_trace_ms=_env_float("SLOW_TRACE_MS", 250.0),
+        adaptive_slow_trace=_env_float("ADAPTIVE_SLOW_TRACE", 1) != 0)
     warm = os.environ.get("WARMUP_PAYLOAD")
     if warm:
         # warm BEFORE start(): the socket is already bound (early
@@ -111,6 +126,15 @@ def run_worker() -> None:
         print(f"[serving] warmed buckets {sizes}", flush=True)
     srv.start()
     print(f"[serving] worker serving {uri} on :{srv.port}", flush=True)
+
+    pusher = None
+    push_url = os.environ.get("PUSH_GATEWAY_URL")
+    if push_url:
+        from mmlspark_tpu.core.telemetry import REGISTRY, MetricsPusher
+        pusher = MetricsPusher(
+            push_url, registries=(srv.registry, REGISTRY),
+            interval_s=_env_float("PUSH_INTERVAL_S", 30.0)).start()
+        print(f"[serving] pushing metrics to {push_url}", flush=True)
 
     coord_url = os.environ.get("COORDINATOR_URL")
     if coord_url:
@@ -134,7 +158,15 @@ def run_worker() -> None:
                     pass           # keep serving, retry next tick
 
         threading.Thread(target=heartbeat, daemon=True).start()
-    _wait_forever(srv.stop)
+
+    def shutdown():
+        # drain first (accepted requests finish), then flush the final
+        # metrics push so the gateway sees the worker's terminal counts
+        srv.stop()
+        if pusher is not None:
+            pusher.stop()
+
+    _wait_forever(shutdown)
 
 
 def _wait_forever(stop) -> None:

@@ -79,9 +79,12 @@ from mmlspark_tpu.core.telemetry import (
     MetricsRegistry, REGISTRY,
     TRACE_HEADER, current_trace_id, merge_prometheus, new_trace_id,
     render_registries, render_samples, trace_context,
-    trace_id_from_headers,
 )
-from mmlspark_tpu.core.tracing import TRACER, span_tree, to_perfetto
+from mmlspark_tpu.core.tracing import (
+    PARENT_SPAN_HEADER, TRACER, AdaptiveThreshold, ambient_tracer,
+    extract_span_context, format_span_id, merge_traces, span_tree,
+    to_perfetto,
+)
 
 logger = get_logger("serving")
 
@@ -158,6 +161,10 @@ class ServingServer:
                  encoder_threads: int = 2,
                  max_inflight_batches: int = 2,
                  slow_trace_ms: Optional[float] = 250.0,
+                 adaptive_slow_trace: bool = True,
+                 adaptive_floor_ms: float = 25.0,
+                 adaptive_ceiling_ms: float = 5000.0,
+                 adaptive_min_count: int = 50,
                  tracer=None,
                  clock: Clock = SYSTEM_CLOCK):
         self.model = model
@@ -213,6 +220,26 @@ class ServingServer:
             "serving_dispatch_latency_ms",
             "Model dispatch wall-clock per shape bucket (label = padded "
             "row count actually dispatched).", labels=("bucket",))
+        # -- adaptive tail-capture threshold: once the route has enough
+        # dispatch-latency samples (adaptive_min_count — until then the
+        # configured slow_trace_ms keeps ruling), the threshold tracks
+        # the route's own p95 (clamped to [floor, ceiling]), refreshed
+        # every few batches from the encoder thread — a route whose
+        # baseline is 8 ms captures its 40 ms outliers, one whose
+        # baseline is 400 ms stops capturing everything. Disabled when
+        # adaptation is off or the fixed threshold is a sentinel
+        # (0 = trace-everything harness mode, None = errors only).
+        self.adaptive: Optional[AdaptiveThreshold] = None
+        if adaptive_slow_trace and slow_trace_ms is not None \
+                and slow_trace_ms > 0:
+            fam = self._m_dispatch
+            self.adaptive = AdaptiveThreshold(
+                self.tracer, api_path,
+                lambda: [(fam.buckets, c.stats()["buckets"])
+                         for _, c in fam.children()],
+                floor_ms=adaptive_floor_ms,
+                ceiling_ms=adaptive_ceiling_ms,
+                min_count=adaptive_min_count)
         self.n_recompiles = 0
         self._shapes_seen: set = set()
         self._stats_lock = threading.Lock()
@@ -500,6 +527,13 @@ class ServingServer:
                             "queue_depth": serving._n_backlog,
                             "stage_timings":
                                 serving.timings.snapshot(),
+                            # the LIVE tail-capture threshold (adaptive
+                            # refreshes move it; fixed config pins it)
+                            "slow_trace_ms":
+                                serving.tracer.threshold(
+                                    serving.api_path),
+                            "adaptive_slow_trace":
+                                serving.adaptive is not None,
                             # process vitals: chaos drills diff these
                             # across kill/restart cycles — uptime
                             # proves the restart, RSS spots the leak
@@ -511,10 +545,13 @@ class ServingServer:
                 if self.path.split("?", 1)[0] == "/traces":
                     # the tail-capture store: every retained trace was
                     # slow or ended non-ok; ?slow=1 keeps only the
-                    # threshold-retained ones
-                    body = json.dumps(serving.tracer.traces(
-                        slow_only="slow=1" in self.path)).encode()
-                    self._reply(200, body)
+                    # threshold-retained ones. Slowest first (root
+                    # duration descending), so the capture an operator
+                    # wants tops the list without fetching every tree
+                    items = serving.tracer.traces(
+                        slow_only="slow=1" in self.path)
+                    items.sort(key=lambda t: -t["duration_ms"])
+                    self._reply(200, json.dumps(items).encode())
                     return
                 if self.path.startswith("/trace/"):
                     tid, _, query = \
@@ -526,7 +563,12 @@ class ServingServer:
                                       "traces are tail-dropped)",
                              "trace_id": tid}).encode())
                         return
-                    if "format=perfetto" in query:
+                    if "format=raw" in query:
+                        # the stored capture verbatim (flat span list +
+                        # origin_unix anchor): what the coordinator's
+                        # distributed merge consumes
+                        body = json.dumps(tr).encode()
+                    elif "format=perfetto" in query:
                         # Chrome trace_event JSON: load the body in
                         # chrome://tracing or ui.perfetto.dev (see
                         # tools/trace_dump.py)
@@ -573,11 +615,18 @@ class ServingServer:
                 # every reply. The request's ROOT span opens here and
                 # closes when the reply is written — finishing it runs
                 # the tail-capture decision (slow or non-ok traces are
-                # retained for GET /trace/<id>).
-                tid = trace_id_from_headers(self.headers)
+                # retained for GET /trace/<id>). An inbound
+                # X-Parent-Span-Id (strictly validated; malformed
+                # values are dropped, never sanitized into a wrong
+                # link) parents this root under the CALLER's egress
+                # span, so the worker-side tree stitches into the
+                # caller's distributed trace at GET /fleet/trace/<id>.
+                tid, parent_sid = extract_span_context(self.headers)
                 with trace_context(tid):
                     root = serving.tracer.start(
-                        "request", trace_id=tid, route=serving.api_path)
+                        "request", trace_id=tid,
+                        remote_parent=parent_sid,
+                        route=serving.api_path)
                     status = "error"
                     try:
                         status = self._do_predict(tid, root)
@@ -958,6 +1007,11 @@ class ServingServer:
         with self._stats_lock:
             self.n_batches += 1
             self.n_requests += job["batch_n"]
+        # adaptive-threshold upkeep rides the encoder stage — off the
+        # request path; one int bump per batch, a histogram walk every
+        # refresh_every-th batch
+        if self.adaptive is not None:
+            self.adaptive.tick()
         if not live:
             return
         replies = None
@@ -1446,7 +1500,8 @@ class ServingCoordinator:
     """
 
     def __init__(self, host: str = "127.0.0.1", port: int = 0,
-                 stale_after: Optional[float] = None):
+                 stale_after: Optional[float] = None,
+                 tracer=None):
         # stale_after: drop workers not re-registered within this many
         # seconds — workers heartbeat (`python -m mmlspark_tpu.serving
         # worker` re-registers every REGISTER_INTERVAL), so dead pods
@@ -1455,6 +1510,12 @@ class ServingCoordinator:
         self._seen: Dict[Tuple[Any, Any], float] = {}
         self.stale_after = (float(stale_after)
                             if stale_after and stale_after > 0 else None)
+        # the coordinator usually runs next to the driver/client, whose
+        # OWN tracer holds the client side of a distributed trace (the
+        # predict root + per-attempt egress spans); fleet_trace() folds
+        # that store in as the "client" part, so merged trees include
+        # the failover schedule, not just the worker fragments
+        self.tracer = tracer if tracer is not None else TRACER
         self._lock = threading.Lock()
         # previous poll's merged counters: GET /fleet reports
         # rate()-style deltas alongside the lifetime totals (trend
@@ -1504,6 +1565,49 @@ class ServingCoordinator:
                 elif self.path == "/fleet/metrics":
                     body = coordinator.fleet_metrics().encode()
                     ctype = _METRICS_CONTENT_TYPE
+                elif self.path == "/fleet/traces":
+                    # every worker's retained slow/error captures in
+                    # one listing (concurrent polls; a dead worker
+                    # degrades to an error entry, never a 5xx here)
+                    body = json.dumps(
+                        coordinator.fleet_traces()).encode()
+                    ctype = "application/json"
+                elif self.path.startswith("/fleet/trace/"):
+                    raw, _, query = \
+                        self.path[len("/fleet/trace/"):].partition("?")
+                    # same charset as trace ids: the id is spliced into
+                    # per-worker URLs and must not smuggle a path/query
+                    tid = "".join(ch for ch in raw[:128]
+                                  if ch.isalnum() or ch in "._-")
+                    merged, errors = coordinator.fleet_trace(tid)
+                    if merged is None:
+                        body = json.dumps(
+                            {"error": "trace not retained by any "
+                                      "worker (fast + ok traces are "
+                                      "tail-dropped)",
+                             "trace_id": tid,
+                             "workers_failed": errors}).encode()
+                        self.send_response(404)
+                        self.send_header("Content-Type",
+                                         "application/json")
+                        self.send_header("Content-Length",
+                                         str(len(body)))
+                        self.end_headers()
+                        self.wfile.write(body)
+                        return
+                    if "format=perfetto" in query:
+                        # per-worker lanes: each process renders as its
+                        # own pid with named process_name metadata
+                        body = json.dumps(to_perfetto(merged)).encode()
+                    else:
+                        out = {k: merged[k] for k in
+                               ("trace_id", "root", "route",
+                                "duration_ms", "status", "reason",
+                                "captured_at", "n_spans", "workers")}
+                        out["tree"] = span_tree(merged)
+                        out["workers_failed"] = errors
+                        body = json.dumps(out).encode()
+                    ctype = "application/json"
                 elif self.path == "/services":
                     with coordinator._lock:
                         coordinator._prune_stale_locked()
@@ -1570,8 +1674,9 @@ class ServingCoordinator:
             try:
                 r = requests.get(f"http://{wk}{path}", timeout=timeout)
                 r.raise_for_status()
-                return (wk, r.json() if path == "/stats" else r.text,
-                        None)
+                json_paths = ("/stats", "/traces", "/trace/")
+                return (wk, r.json() if path.startswith(json_paths)
+                        else r.text, None)
             except Exception as e:  # noqa: BLE001 — worker down/old
                 return (wk, None, str(e))
 
@@ -1687,6 +1792,77 @@ class ServingCoordinator:
                 0.0 if err is not None else 1.0
         return render_samples(merged)
 
+    # -- fleet-level trace aggregation ---------------------------------------
+
+    def fleet_traces(self, timeout: float = 5.0) -> Dict[str, Any]:
+        """Every worker's retained-trace listing in one place: polls
+        each worker's ``GET /traces`` concurrently and flattens the
+        summaries with per-worker attribution (``worker: host:port``
+        on every entry), slowest first. A dead worker contributes an
+        entry in ``errors`` instead of failing the view — exactly when
+        workers are dying is when an operator reads this."""
+        traces: List[Dict[str, Any]] = []
+        errors: Dict[str, str] = {}
+        polls = self._poll_workers("/traces", timeout)
+        for wk, items, err in polls:
+            if err is not None:
+                errors[wk] = err
+                continue
+            for t in items:
+                entry = dict(t)
+                entry["worker"] = wk
+                traces.append(entry)
+        traces.sort(key=lambda t: -t.get("duration_ms", 0.0))
+        return {"n_workers": len(polls),
+                "n_responding": len(polls) - len(errors),
+                "traces": traces, "errors": errors}
+
+    def fleet_trace(self, trace_id: str, timeout: float = 5.0
+                    ) -> Tuple[Optional[Dict[str, Any]], Dict[str, str]]:
+        """Fetch-and-merge one distributed trace: every worker's
+        retained capture of ``trace_id`` (``GET /trace/<id>?format=raw``,
+        polled concurrently) plus this process's own tracer store (the
+        ``client`` part — the driver-side predict root and failover
+        egress spans), stitched by
+        :func:`mmlspark_tpu.core.tracing.merge_traces` so worker roots
+        nest under the caller's egress spans. Returns ``(merged,
+        errors)``; merged is None when no part retained the trace. A
+        404 from a worker means "not retained there" — normal
+        tail-capture behavior, not an error."""
+        import requests
+        from concurrent.futures import ThreadPoolExecutor
+
+        def poll(s):
+            wk = f"{s.get('host')}:{s.get('port')}"
+            try:
+                r = requests.get(
+                    f"http://{wk}/trace/{trace_id}?format=raw",
+                    timeout=timeout)
+                if r.status_code == 404:
+                    return (wk, None, None)
+                r.raise_for_status()
+                return (wk, r.json(), None)
+            except Exception as e:  # noqa: BLE001 — worker down/old
+                return (wk, None, str(e))
+
+        services = self.services()
+        polls: List[Tuple[str, Any, Optional[str]]] = []
+        if services:
+            with ThreadPoolExecutor(
+                    max_workers=min(len(services), 16)) as pool:
+                polls = list(pool.map(poll, services))
+        parts: List[Tuple[str, Dict[str, Any]]] = []
+        local = self.tracer.get_trace(trace_id) \
+            if self.tracer is not None else None
+        if local is not None:
+            parts.append(("client", local))
+        parts.extend((wk, tr) for wk, tr, err in polls
+                     if tr is not None)
+        errors = {wk: err for wk, _, err in polls if err is not None}
+        if not parts:
+            return None, errors
+        return merge_traces(parts), errors
+
     @staticmethod
     def register_worker(coordinator_url: str, host: str, port: int):
         import requests
@@ -1736,10 +1912,17 @@ class ServingClient:
                  timeout: float = 15.0,
                  retry_policy: Optional[RetryPolicy] = None,
                  breakers: Optional[BreakerBoard] = None,
+                 tracer=None,
                  clock: Clock = SYSTEM_CLOCK):
         self.coordinator_url = coordinator_url.rstrip("/")
         self.api_path = api_path
         self.timeout = timeout
+        # spans record through this tracer (None = the ambient one at
+        # call time, falling back to the process TRACER): one "predict"
+        # root per logical request with an egress child per attempt,
+        # whose id travels as X-Parent-Span-Id so every worker-side
+        # tree stitches under the failover schedule
+        self.tracer = tracer
         self.clock = clock
         self.policy = retry_policy or RetryPolicy(
             max_attempts=6, base=0.02, cap=0.5, clock=clock)
@@ -1780,13 +1963,37 @@ class ServingClient:
 
     def predict(self, payload: Any, request_id: Optional[str] = None,
                 timeout_budget: Optional[float] = None) -> Any:
-        import requests
         rid = request_id or uuid.uuid4().hex
         # one trace id per LOGICAL request (adopting the ambient one
         # when the caller is already inside a trace): every failover/
         # retry attempt carries the same id, so the whole schedule is
         # one line-set in worker logs
         trace = current_trace_id() or new_trace_id()
+        tracer = self.tracer if self.tracer is not None \
+            else ambient_tracer()
+        # one client-side ROOT span over the whole failover schedule:
+        # each wire attempt nests under it, and every worker-side tree
+        # parents under those attempts in the merged distributed trace
+        # (GET /fleet/trace/<id>). Tail capture follows the tracer's
+        # "serving_client" route threshold.
+        root = tracer.start("predict", trace_id=trace,
+                            route="serving_client", rid=rid)
+        status = "error"
+        try:
+            out = self._predict_attempts(payload, rid, trace,
+                                         timeout_budget, tracer, root)
+            status = "ok"
+            return out
+        except DeadlineExceeded:
+            status = "deadline"
+            raise
+        finally:
+            tracer.finish(root, status=status)
+
+    def _predict_attempts(self, payload: Any, rid: str, trace: str,
+                          timeout_budget: Optional[float],
+                          tracer, root) -> Any:
+        import requests
         deadline = (Deadline(timeout_budget, clock=self.clock)
                     if timeout_budget is not None else None)
         sched = self.policy.schedule(deadline)
@@ -1808,18 +2015,40 @@ class ServingClient:
             # worker may be alive-but-slow, and only ITS journal can
             # replay the reply without re-running inference
             for attempt in range(2):
+                # one egress span per wire attempt; its id travels as
+                # X-Parent-Span-Id so the worker's root "request" span
+                # parents under THIS attempt, not just the same trace
+                att = tracer.start("http_egress", parent=root,
+                                   host=url)
+                headers[PARENT_SPAN_HEADER] = \
+                    format_span_id(att.span_id)
                 try:
                     r = requests.post(url, json=payload,
                                       timeout=self.timeout,
                                       headers=headers)
                 except requests.ConnectionError as e:
+                    tracer.finish(att, status="error")
                     last_err = e
                     breaker.record_failure()
                     self._dead.add(url)  # dead: fail over immediately
                     break
                 except requests.Timeout as e:
+                    tracer.finish(att, status="timeout")
                     last_err = e
                     continue
+                except BaseException:
+                    # anything else (mid-body resets, redirect loops,
+                    # bad URLs) propagates to the caller — but the
+                    # attempt span must still land in the recorder, or
+                    # the captured trace would omit the one attempt
+                    # that explains the failure
+                    tracer.finish(att, status="error")
+                    raise
+                tracer.finish(
+                    att,
+                    status="shed" if r.status_code == 429 else
+                    "error" if r.status_code >= 400 else "ok",
+                    status_code=r.status_code)
                 if r.status_code == 429 or r.status_code >= 500:
                     # shed/erroring worker: not dead, but this request
                     # should back off and go elsewhere. 504 is excluded

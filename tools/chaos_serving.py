@@ -39,7 +39,8 @@ class Doubler(Transformer):
         return df.with_column("y", np.asarray(df["x"], dtype=np.float64) * 2)
 
 srv = ServingServer(Doubler(), max_latency_ms=1,
-                    journal_path=sys.argv[2]).start()
+                    journal_path=sys.argv[2],
+                    slow_trace_ms=0.0).start()
 ServingCoordinator.register_worker(sys.argv[1], srv.host, srv.port)
 print(srv.port, flush=True)
 while True:
@@ -109,6 +110,20 @@ def main() -> int:
                 stats["killed_at"] = i
                 restart_at = i + args.restart_after
             if restart_at is not None and i == restart_at:
+                # with worker 0 still dead, the coordinator's fleet
+                # trace view must DEGRADE, not fail: the dead worker
+                # becomes an error entry and the survivors' captures
+                # (every request — the workers trace everything) are
+                # still listed with worker attribution
+                import requests
+                ft = requests.get(coord_url + "/fleet/traces",
+                                  timeout=10).json()
+                live_workers = {t["worker"] for t in ft["traces"]}
+                stats["fleet_dead_errors"] = len(ft["errors"])
+                stats["fleet_live_captures"] = len(ft["traces"])
+                stats["fleet_traces_ok"] = (
+                    len(ft["errors"]) >= 1
+                    and f"127.0.0.1:{workers[1].port}" in live_workers)
                 workers[0] = spawn_worker(
                     coord_url, os.path.join(tmp, "w0.jsonl"))
                 client.refresh()
@@ -153,7 +168,8 @@ def main() -> int:
         ok = (stats["n_ok"] == args.requests
               and stats["n_wrong"] == 0
               and not stats["failed_rids"]
-              and recovered)
+              and recovered
+              and stats.get("fleet_traces_ok", True))
         print("RESULT:", "PASS" if ok else "FAIL")
         return 0 if ok else 1
     finally:

@@ -1,10 +1,12 @@
-"""Fetch tail-captured traces from a serving worker and render them.
+"""Fetch tail-captured traces from a serving worker (or a whole fleet
+via its coordinator) and render them.
 
 A worker retains every slow (over its ``slow_trace_ms`` route
-threshold) or non-ok (error/shed/deadline/timeout) trace in its
-flight-recorder store (see docs/observability.md "Tracing"). This CLI
-lists that store, pretty-prints one trace's span tree, or writes the
-Chrome ``trace_event`` JSON that ``chrome://tracing`` and
+threshold — adaptive by default, tracking the route's p95) or non-ok
+(error/shed/deadline/timeout) trace in its flight-recorder store (see
+docs/observability.md "Tracing"). This CLI lists that store,
+pretty-prints one trace's span tree, or writes the Chrome
+``trace_event`` JSON that ``chrome://tracing`` and
 https://ui.perfetto.dev open directly:
 
     python tools/trace_dump.py http://worker:8000 --list
@@ -12,6 +14,18 @@ https://ui.perfetto.dev open directly:
     python tools/trace_dump.py http://worker:8000 <trace-id>
     python tools/trace_dump.py http://worker:8000 <trace-id> -o t.json
     python tools/trace_dump.py http://worker:8000 --slowest -o t.json
+
+With ``--fleet`` the URL names a ServingCoordinator instead: ``--list``
+shows every worker's captures in one listing (worker-attributed,
+slowest first, dead workers reported on stderr), and fetching a trace
+returns the MERGED distributed tree — the client's failover schedule
+with each worker's span tree stitched under its egress attempt
+(``GET /fleet/traces`` / ``GET /fleet/trace/<id>``; the Perfetto
+export renders each worker in its own lane):
+
+    python tools/trace_dump.py --fleet http://coordinator:8000 --list
+    python tools/trace_dump.py --fleet http://coordinator:8000 <trace-id>
+    python tools/trace_dump.py --fleet http://coordinator:8000 --slowest -o t.json
 
 stdlib-only on the wire (urllib): runs anywhere the worker is
 reachable, no client deps.
@@ -33,28 +47,48 @@ def _get_json(url: str, timeout: float = 10.0):
 
 def _print_tree(node: dict, depth: int = 0) -> None:
     flag = "" if node["status"] == "ok" else f"  [{node['status']}]"
+    worker = node.get("worker")
+    wtag = f"  ({worker})" if worker else ""
     attrs = node.get("attrs") or {}
     extra = "".join(f" {k}={v}" for k, v in sorted(attrs.items())
                     if k != "route")
     print(f"{'  ' * depth}{node['name']:<{max(24 - 2 * depth, 1)}} "
           f"@{node['start_ms']:>9.3f}ms  {node['duration_ms']:>9.3f}ms"
-          f"{extra}{flag}")
+          f"{extra}{wtag}{flag}")
     for child in sorted(node.get("children", []),
                         key=lambda c: c["start_ms"]):
         _print_tree(child, depth + 1)
 
 
+def _print_listing(traces: list, fleet: bool) -> None:
+    for t in traces:
+        wcol = f" {t.get('worker', ''):<22}" if fleet else ""
+        print(f"{t['trace_id']:<34}{wcol} {t['root']:<12} "
+              f"{t.get('route', ''):<14} "
+              f"{t['duration_ms']:>10.3f}ms  {t['reason']:<9} "
+              f"spans={t['n_spans']}")
+    if not traces:
+        print("(no retained traces — nothing slow or failed yet)",
+              file=sys.stderr)
+
+
 def main() -> None:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("worker", help="worker base url, e.g. "
-                                   "http://127.0.0.1:8000")
+                                   "http://127.0.0.1:8000 (a "
+                                   "coordinator url with --fleet)")
     ap.add_argument("trace_id", nargs="?",
                     help="trace to fetch (see --list)")
+    ap.add_argument("--fleet", action="store_true",
+                    help="URL is a ServingCoordinator: list every "
+                         "worker's captures, fetch MERGED distributed "
+                         "traces (per-worker Perfetto lanes)")
     ap.add_argument("--list", action="store_true",
                     help="list retained traces and exit")
     ap.add_argument("--slow", action="store_true",
                     help="with --list: only threshold-retained traces "
-                         "(drop error/shed/deadline captures)")
+                         "(drop error/shed/deadline captures; worker "
+                         "mode only)")
     ap.add_argument("--slowest", action="store_true",
                     help="pick the longest retained trace instead of "
                          "naming one")
@@ -63,21 +97,25 @@ def main() -> None:
                          "JSON here instead of printing the span tree")
     args = ap.parse_args()
     base = args.worker.rstrip("/")
+    trace_base = f"{base}/fleet/trace" if args.fleet else f"{base}/trace"
 
     if args.list or args.slowest:
-        traces = _get_json(f"{base}/traces"
-                           + ("?slow=1" if args.slow else ""))
+        if args.fleet:
+            fleet = _get_json(f"{base}/fleet/traces")
+            traces = fleet["traces"]
+            for wk, err in sorted(fleet.get("errors", {}).items()):
+                print(f"(worker {wk} unreachable: {err})",
+                      file=sys.stderr)
+        else:
+            traces = _get_json(f"{base}/traces"
+                               + ("?slow=1" if args.slow else ""))
         if args.list:
-            for t in traces:
-                print(f"{t['trace_id']:<34} {t['root']:<12} "
-                      f"{t['duration_ms']:>10.3f}ms  {t['reason']:<9} "
-                      f"spans={t['n_spans']}")
-            if not traces:
-                print("(no retained traces — nothing slow or failed "
-                      "yet)", file=sys.stderr)
+            _print_listing(traces, args.fleet)
             return
         if not traces:
             raise SystemExit("no retained traces to pick --slowest from")
+        # both listings arrive slowest-first, but stay explicit: the
+        # choice must not depend on a server-side sort contract
         args.trace_id = max(traces,
                             key=lambda t: t["duration_ms"])["trace_id"]
 
@@ -86,16 +124,23 @@ def main() -> None:
 
     try:
         if args.out:
-            pf = _get_json(f"{base}/trace/{args.trace_id}?format=perfetto")
+            pf = _get_json(
+                f"{trace_base}/{args.trace_id}?format=perfetto")
             with open(args.out, "w") as f:
                 json.dump(pf, f)
             print(f"wrote {len(pf['traceEvents'])} events to {args.out} "
                   f"(open in chrome://tracing or ui.perfetto.dev)")
         else:
-            tr = _get_json(f"{base}/trace/{args.trace_id}")
+            tr = _get_json(f"{trace_base}/{args.trace_id}")
+            workers = tr.get("workers")
+            wline = f"  workers={','.join(workers)}" if workers else ""
             print(f"trace {tr['trace_id']}  route={tr['route']}  "
                   f"status={tr['status']}  reason={tr['reason']}  "
-                  f"{tr['duration_ms']}ms")
+                  f"{tr['duration_ms']}ms{wline}")
+            for wk, err in sorted(
+                    (tr.get("workers_failed") or {}).items()):
+                print(f"(worker {wk} unreachable: {err})",
+                      file=sys.stderr)
             _print_tree(tr["tree"])
     except HTTPError as e:
         if e.code == 404:
