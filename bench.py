@@ -23,8 +23,10 @@ Plus (no era analogue, utilization/latency evidence):
   6. imagenet_scoring_v1         — ResNet-50 bf16 device scoring + MFU
   7. serving_latency_v1          — serving-stack p50/p99 request latency
   8. transformer_train_v1        — SPMD transformer LM step tokens/sec + MFU
-  9. serving_throughput_v1       — serving-stack req/sec under 8
-                                   concurrent keep-alive clients
+  9. serving_throughput_v1       — serving-stack req/sec under
+                                   concurrent keep-alive load, measured
+                                   for BOTH socket edges in one run
+                                   (eventloop headline, threaded A/B)
  10. transformer_train_long_v1   — same model at seq 4096 (folded flash
                                    attention's long-context regime)
  11. moe_train_v1                — experts-on train step (top-2 capacity
@@ -41,6 +43,12 @@ Plus (no era analogue, utilization/latency evidence):
                                    inject+extract per egress attempt
                                    (the header tax every cross-process
                                    hop pays; budget 2 us/hop)
+ 15. serving_concurrency_v1      — 1,000 concurrent keep-alive
+                                   connections against one worker
+                                   (event-loop frontend headline +
+                                   threaded comparison): req/s,
+                                   p50/p99, connection-reuse rate,
+                                   zero connection-level errors
 
 Every line carries chip metadata (platform/device kind/count) so the
 numbers are interpretable across hosts.
@@ -50,6 +58,7 @@ from __future__ import annotations
 
 import json
 import time
+from typing import Optional
 
 import numpy as np
 
@@ -568,88 +577,121 @@ def bench_serving_latency():
             "chip": _chip()}
 
 
-def bench_serving_throughput():
-    """Serving-stack sustained throughput under concurrency: 8 keep-alive
-    clients hammering one worker (the batching queue's reason to exist —
-    `max_latency_ms` trades a bounded wait for micro-batched model
-    calls). Same trivial host-side model as ``serving_latency_v1`` so
-    the number is the STACK's ceiling, not a model's. Proxy baseline:
-    1000 req/s — a Spark-era continuous-serving executor handling ~1
-    request/ms end-to-end.
-    """
-    import http.client
-    import threading
+def _drive_serving(frontend: str, n_connections: int,
+                   duration_s: Optional[float] = None,
+                   requests_per_conn: Optional[int] = None) -> dict:
+    """One timed window against a fresh worker on the given socket edge
+    (same staged data plane either way), driven by the many-connection
+    keep-alive loop in ``mmlspark_tpu.testing.load`` — the client that
+    doesn't hit its own concurrency ceiling before the server's."""
     from mmlspark_tpu.serving import ServingServer
+    from mmlspark_tpu.testing.load import drive_keepalive
 
-    n_clients, duration_s = 8, 3.0
-    counts = [0] * n_clients
-    errors = [0] * n_clients
     with ServingServer(_identity_model(), max_latency_ms=2,
-                       max_batch_size=128) as srv:
+                       max_batch_size=256, max_queue=4096,
+                       frontend=frontend) as srv:
         # dispatch every shape bucket once before the timed window, so
         # the number is the pipelined plane's steady state (with a real
         # jitted model this is where the compiles land); the recompile
         # counter must then stay flat across the run
         srv.warmup({"x": 0.0})
         recompiles_warm = srv.n_recompiles
+        out = drive_keepalive(
+            srv.host, srv.port, srv.api_path, b'{"x": 0.0}',
+            n_connections=n_connections, duration_s=duration_s,
+            requests_per_conn=requests_per_conn)
+        out["recompiles_after_warmup"] = \
+            srv.n_recompiles - recompiles_warm
+        out["frontend"] = frontend
+    return out
 
-        def client(ci, deadline):
-            conn = http.client.HTTPConnection(srv.host, srv.port,
-                                              timeout=10)
-            # float payloads, matching warmup(): the payload dtype is
-            # part of the dispatch shape (an int column would honestly
-            # be a different compiled executable)
-            body = json.dumps({"x": float(ci)}).encode()
-            hdrs = {"Content-Type": "application/json"}
-            while time.perf_counter() < deadline:
-                # a dead thread would silently undercount; every failed
-                # request is recorded and surfaced in the output instead
-                try:
-                    conn.request("POST", srv.api_path, body, hdrs)
-                    resp = conn.getresponse()
-                    resp.read()
-                    ok = resp.status == 200
-                except OSError:
-                    ok = False
-                    conn.close()
-                    conn = http.client.HTTPConnection(
-                        srv.host, srv.port, timeout=10)
-                if ok:
-                    counts[ci] += 1
-                else:
-                    errors[ci] += 1
-            conn.close()
 
-        warm = threading.Thread(
-            target=client, args=(0, time.perf_counter() + 0.5))
-        warm.start()
-        warm.join()                     # warm sockets + code paths
-        counts[0] = errors[0] = 0
-        deadline = time.perf_counter() + duration_s
-        threads = [threading.Thread(target=client, args=(i, deadline))
-                   for i in range(n_clients)]
-        for t in threads:
-            t.start()
-        for t in threads:
-            t.join()
-    rps = sum(counts) / duration_s
+def bench_serving_throughput():
+    """Serving-stack sustained throughput under concurrent keep-alive
+    load, measured for BOTH socket edges in one run: the event-loop
+    frontend (headline) and the thread-per-connection http.server
+    baseline (``ab_threaded``), each fed the same way at 8 connections
+    (the pre-eventloop bench shape, for cross-run continuity) and 64
+    (past the thread plane's comfort zone, where the edges separate).
+    Same trivial host-side model as ``serving_latency_v1`` so the
+    number is the STACK's ceiling, not a model's.
+
+    Proxy baseline: 1000 req/s — a Spark-era continuous-serving
+    executor handling ~1 request/ms end-to-end. NOTE on dev-box
+    absolutes: client and server share this host, and on sandboxed
+    kernels (gVisor-class, ~50-100 us per syscall) the ~6 syscalls a
+    strictly serial request/response cycle costs bound the whole box
+    well below the stack's ceiling on bare metal — the A/B ratio and
+    the zero-error/zero-recompile evidence travel; the absolute req/s
+    does not.
+    """
+    results = {}
+    for fe in ("eventloop", "threaded"):
+        for conns in (8, 64):
+            results[(fe, conns)] = _drive_serving(
+                fe, conns, duration_s=3.0)
+    head = results[("eventloop", 64)]
+    ab = results[("threaded", 64)]
     baseline = 1000.0
     import os
     cores = (len(os.sched_getaffinity(0))
              if hasattr(os, "sched_getaffinity") else os.cpu_count())
-    return {"metric": "serving_throughput_v1", "value": round(rps, 1),
-            "unit": "req/sec", "n_clients": n_clients,
-            "n_errors": sum(errors),
-            # clients and server share this host's cores: on a 1-core
+    rps = head["rps"]
+    return {"metric": "serving_throughput_v1", "value": rps,
+            "unit": "req/sec", "n_connections": 64,
+            "frontend": "eventloop",
+            "p50_ms": head["p50_ms"], "p99_ms": head["p99_ms"],
+            "n_errors": head["conn_errors"] + head["http_errors"],
+            "eventloop_8conn_rps": results[("eventloop", 8)]["rps"],
+            "ab_threaded": {
+                "rps": ab["rps"], "p50_ms": ab["p50_ms"],
+                "p99_ms": ab["p99_ms"],
+                "rps_8conn": results[("threaded", 8)]["rps"]},
+            "frontend_speedup": round(rps / max(ab["rps"], 1e-9), 3),
+            # clients and server share this host's cores: on a small
             # dev box the number is a floor, not the stack's ceiling
             "host_cores": cores,
-            "pipeline": srv.pipeline, "bucket_batches": srv.bucket_batches,
             # 0 = the bucketed plane never retraced after warm-up
             # (tools/bench_serving_pipeline.py asserts this under
             # varying-batch-size load)
-            "recompiles_after_warmup": srv.n_recompiles - recompiles_warm,
+            "recompiles_after_warmup": head["recompiles_after_warmup"],
             "baseline": baseline,
             "vs_baseline": round(rps / baseline, 3), "chip": _chip()}
+
+
+def bench_serving_concurrency():
+    """1,000 concurrent keep-alive connections against one worker: the
+    many-users shape the event-loop frontend exists for. Each
+    connection runs 25 strictly serial (pipelining-free) request/
+    response cycles; the acceptance gates are ZERO connection-level
+    errors (no resets, refusals, or unexpected closes at 1k live
+    sockets) and a connection-reuse rate above 95% (keep-alive held:
+    reuse = 1 - 1/cycles = 0.96 when no connection is ever dropped).
+    The threaded frontend runs the same 1k connections for 5 cycles as
+    the A/B comparison — it holds them, but pays a thread per
+    connection (~8 MB of stacks and a scheduler fight the loop never
+    enters).
+    """
+    head = _drive_serving("eventloop", 1000, requests_per_conn=25)
+    ab = _drive_serving("threaded", 1000, requests_per_conn=5)
+    ok = (head["conn_errors"] == 0 and head["http_errors"] == 0
+          and head["reuse_rate"] > 0.95)
+    return {"metric": "serving_concurrency_v1",
+            "value": head["rps"], "unit": "req/sec @1k conns",
+            "frontend": "eventloop",
+            "n_connections": head["n_connections"],
+            "requests": head["requests"],
+            "p50_ms": head["p50_ms"], "p99_ms": head["p99_ms"],
+            "conn_errors": head["conn_errors"],
+            "http_errors": head["http_errors"],
+            "reuse_rate": head["reuse_rate"],
+            "passed": ok,
+            "ab_threaded": {
+                "rps": ab["rps"], "p50_ms": ab["p50_ms"],
+                "p99_ms": ab["p99_ms"],
+                "conn_errors": ab["conn_errors"],
+                "reuse_rate": ab["reuse_rate"]},
+            "chip": _chip()}
 
 
 def _transformer_train_bench(metric: str, batch: int, seq: int):
@@ -968,7 +1010,7 @@ BENCHES = [bench_gbdt_quantile, bench_adult_census, bench_cifar10_scoring,
            bench_cifar10_scoring_uint8, bench_imagenet_scoring,
            bench_transfer_learning, bench_distributed_sgd,
            bench_serving_latency, bench_serving_throughput,
-           bench_transformer_train,
+           bench_serving_concurrency, bench_transformer_train,
            bench_transformer_train_long, bench_moe_train,
            bench_telemetry_overhead, bench_tracing_overhead,
            bench_trace_propagation]

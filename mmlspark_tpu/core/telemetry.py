@@ -774,12 +774,22 @@ class MetricsPusher:
                  registries: Iterable[MetricsRegistry] = (),
                  interval_s: float = 30.0, timeout: float = 5.0,
                  policy=None, headers: Optional[Dict[str, str]] = None,
+                 header_provider: Optional[
+                     Callable[[], Optional[Dict[str, str]]]] = None,
                  session=None):
         self.url = url
         self.registries = tuple(registries) or (REGISTRY,)
         self.interval_s = float(interval_s)
         self.timeout = float(timeout)
+        # auth surface: ``headers`` are static (set once, sent on every
+        # push); ``header_provider`` is re-invoked per push and its
+        # result layered on top, so short-lived bearer tokens rotate
+        # without restarting the pusher. Provider failures are counted
+        # + logged and the push proceeds with the static set — a broken
+        # token refresher degrades to 401s at the gateway (visible in
+        # last_status), never a dead telemetry thread.
         self.headers = dict(headers or {})
+        self.header_provider = header_provider
         self.n_pushes = 0
         self.n_errors = 0
         self.last_status: Optional[int] = None
@@ -816,6 +826,15 @@ class MetricsPusher:
         body = render_registries(*self.registries).encode()
         h = {"Content-Type": CONTENT_TYPE}
         h.update(self.headers)
+        if self.header_provider is not None:
+            try:
+                h.update(self.header_provider() or {})
+            except Exception:  # noqa: BLE001 — a broken token refresher
+                self.n_errors += 1     # must not kill the push cadence
+                from mmlspark_tpu.core.logs import get_logger
+                get_logger("telemetry").warning(
+                    "metrics push header_provider raised; pushing with "
+                    "static headers only", exc_info=True)
         req = HTTPRequestData(url=self.url, method="POST", headers=h,
                               body=body)
         # bind a trace id with no ambient span: egress spans then mark

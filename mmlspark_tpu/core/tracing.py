@@ -145,7 +145,8 @@ class Span:
     """
 
     __slots__ = ("trace_id", "span_id", "parent_id", "name",
-                 "t0", "t1", "status", "attrs", "thread", "remote")
+                 "t0", "t1", "status", "attrs", "thread", "remote",
+                 "force")
 
     def __init__(self, name: str, trace_id: str,
                  parent_id: Optional[int], t0: float,
@@ -163,6 +164,11 @@ class Span:
         # from an inbound header): the span is still a capture root
         # locally — its real parent finishes elsewhere
         self.remote = False
+        # force-capture (the X-Capture wire hint): a forced ROOT is
+        # retained regardless of the route's slow-trace threshold, and
+        # the flag inherits parent -> child so egress spans know to
+        # propagate the hint on the wire
+        self.force = False
 
     @property
     def duration_ms(self) -> float:
@@ -188,6 +194,8 @@ class Span:
         }
         if self.remote:
             d["remote"] = True
+        if self.force:
+            d["forced"] = True
         return d
 
     def __repr__(self) -> str:
@@ -350,6 +358,8 @@ class Tracer:
         sp = Span(name, tid, pid, self._now(), attrs or None)
         if parent is None and remote_parent is not None:
             sp.remote = True
+        if parent is not None and parent.force:
+            sp.force = True
         return sp
 
     def finish(self, span: Span, status: Optional[str] = None,
@@ -410,6 +420,10 @@ class Tracer:
         dur = root.duration_ms
         if root.status != "ok":
             reason = root.status
+        elif root.force:
+            # the X-Capture wire hint: this request asked to be kept,
+            # threshold or not (one-request debugging in production)
+            reason = "forced"
         else:
             thr = self.threshold(route)
             if thr is None or dur < thr:
@@ -494,6 +508,13 @@ class Tracer:
 #: tree stitch into one distributed trace.
 PARENT_SPAN_HEADER = "X-Parent-Span-Id"
 
+#: force-capture wire hint: a request carrying ``X-Capture: 1`` is
+#: retained end to end regardless of slow-trace thresholds — honored at
+#: every ingress (the root span is flagged ``force``) and re-emitted on
+#: every egress whose span inherited the flag, so one marked request
+#: leaves a capture on every worker it touched.
+CAPTURE_HEADER = "X-Capture"
+
 _SPAN_ID_RE = re.compile(r"^[0-9a-fA-F]{1,16}$")
 
 
@@ -545,7 +566,8 @@ def inject_span_context(headers: Dict[str, str], span: Span,
             if k == _parent or k.lower() == "x-parent-span-id":
                 has_parent = True
     if has_trace and has_parent:
-        return headers
+        return (_with_capture_hint(headers, span) if span.force
+                else headers)
     if has_trace and trace_val != span.trace_id:
         # the caller aimed this request at a DIFFERENT trace: our span
         # id would be a cross-trace parent link — worse than no link
@@ -557,7 +579,33 @@ def inject_span_context(headers: Dict[str, str], span: Span,
         out[_trace] = span.trace_id
     if not has_parent:
         out[_parent] = format(span.span_id, "x")
+    if span.force:
+        return _with_capture_hint(out, span, copied=out is not headers)
     return out
+
+
+def _with_capture_hint(headers: Dict[str, str], span: Span,
+                       copied: bool = False) -> Dict[str, str]:
+    """Add ``X-Capture: 1`` to a forced span's egress headers. Callers
+    gate on ``span.force`` BEFORE calling (the check is inlined at the
+    call sites: a function call per hop is real money against the 2 us
+    propagation budget)."""
+    for k in headers:
+        if len(k) == 9 and (k == CAPTURE_HEADER
+                            or k.lower() == "x-capture"):
+            return headers               # caller's hint wins
+    out = headers if copied else dict(headers)
+    out[CAPTURE_HEADER] = "1"
+    return out
+
+
+def capture_hint(headers) -> bool:
+    """True iff the inbound request carries the force-capture hint
+    (``X-Capture: 1``; any other value is ignored — the hint is a
+    boolean, not a knob)."""
+    if headers is None:
+        return False
+    return headers.get(CAPTURE_HEADER) == "1"
 
 
 def extract_span_context(headers,

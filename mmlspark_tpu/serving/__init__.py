@@ -3,6 +3,12 @@
 Capability parity with Spark Serving (`src/io/http` serving sources/sinks)
 rebuilt for the TPU execution model — see :mod:`mmlspark_tpu.serving.server`.
 
+The socket edge is selectable: the default event-loop frontend
+(:mod:`mmlspark_tpu.serving.frontend` — keep-alive connection reuse,
+zero-copy framing, ``SO_REUSEPORT`` acceptors) or the threaded
+``http.server`` baseline (``frontend="threaded"``). See
+``docs/serving.md`` "The socket edge".
+
 Observability: every worker serves ``GET /metrics`` (Prometheus text
 format) and carries ``X-Trace-Id`` through its whole data plane; the
 :class:`ServingCoordinator` aggregates the fleet — ``GET /fleet`` merges
@@ -15,6 +21,7 @@ from mmlspark_tpu.serving.server import (
     ServingClient, ServingCoordinator, ServingServer,
 )
 from mmlspark_tpu.serving.consolidator import PartitionConsolidator
+from mmlspark_tpu.serving.frontend import EventLoopFrontend
 
 __all__ = ["ServingServer", "ServingCoordinator", "ServingClient",
-           "PartitionConsolidator"]
+           "PartitionConsolidator", "EventLoopFrontend"]

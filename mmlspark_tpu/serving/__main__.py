@@ -58,6 +58,19 @@ Environment:
                    latency p95 (floor/ceiling clamped) once enough
                    samples accumulate — see docs/observability.md
                    "Distributed tracing"
+  FRONTEND         (both, optional) the socket edge: "eventloop" (the
+                   default — selectors-based keep-alive frontend, see
+                   docs/serving.md "The socket edge") or "threaded"
+                   (the thread-per-connection http.server baseline)
+  ACCEPTORS        (worker, optional) number of SO_REUSEPORT accept/
+                   event loops sharing the port (default 1). Raise it
+                   when /metrics shows serving_accept_loop_busy_ratio
+                   pinned near 1.0; setting it > 1 implies REUSE_PORT=1
+                   unless REUSE_PORT=0 is forced (which then fails
+                   fast at startup)
+  IDLE_TIMEOUT     (worker, optional) seconds a keep-alive connection
+                   may sit idle between requests (default 60; also the
+                   slow-loris mid-request reap clock; 0 disables)
   PUSH_GATEWAY_URL / PUSH_INTERVAL_S
                    (worker, optional) remote-write: POST the worker's
                    metrics exposition (per-server + process registry)
@@ -84,8 +97,9 @@ def run_coordinator() -> None:
     from mmlspark_tpu.serving.server import ServingCoordinator
     port = int(os.environ.get("PORT", "8000"))
     stale = _env_float("STALE_AFTER", 0.0)   # 0 = never expire
-    coord = ServingCoordinator(host="0.0.0.0", port=port,
-                               stale_after=stale or None).start()
+    coord = ServingCoordinator(
+        host="0.0.0.0", port=port, stale_after=stale or None,
+        frontend=os.environ.get("FRONTEND", "eventloop")).start()
     print(f"[serving] coordinator listening on :{coord.port}", flush=True)
     _wait_forever(coord.stop)
 
@@ -101,6 +115,7 @@ def run_worker() -> None:
     model = PipelineStage.load(uri)
     port = int(os.environ.get("PORT", "8000"))
     ttl = _env_float("JOURNAL_TTL", 0.0)
+    acceptors = int(_env_float("ACCEPTORS", 1))
     srv = ServingServer(
         model, host="0.0.0.0", port=port,
         max_batch_size=int(_env_float("MAX_BATCH_SIZE", 64)),
@@ -113,7 +128,14 @@ def run_worker() -> None:
         bucket_batches=_env_float("BUCKET_BATCHES", 1) != 0,
         encoder_threads=int(_env_float("ENCODER_THREADS", 2)),
         slow_trace_ms=_env_float("SLOW_TRACE_MS", 250.0),
-        adaptive_slow_trace=_env_float("ADAPTIVE_SLOW_TRACE", 1) != 0)
+        adaptive_slow_trace=_env_float("ADAPTIVE_SLOW_TRACE", 1) != 0,
+        frontend=os.environ.get("FRONTEND", "eventloop"),
+        acceptors=acceptors,
+        # ACCEPTORS > 1 needs SO_REUSEPORT (N loops cannot share one
+        # listener); default it on so the one knob is enough
+        reuse_port=_env_float("REUSE_PORT",
+                              1 if acceptors > 1 else 0) != 0,
+        idle_timeout=_env_float("IDLE_TIMEOUT", 60.0))
     warm = os.environ.get("WARMUP_PAYLOAD")
     if warm:
         # warm BEFORE start(): the socket is already bound (early

@@ -1,0 +1,941 @@
+"""Event-loop serving frontend: the socket edge of the data plane.
+
+Replaces the ``ThreadingHTTPServer``/``BaseHTTPRequestHandler`` ingress
+(one thread per connection, line-at-a-time header parsing, three writes
+per reply) with a :mod:`selectors`-based non-blocking frontend built for
+the rates the staged pipeline (PR 2) can already sustain:
+
+* **keep-alive connection reuse** — HTTP/1.1 persistent connections are
+  the steady state, not an option: a connection parks in the loop
+  between requests at the cost of one registered fd, never a thread;
+* **incremental zero-copy framing** — requests are parsed straight out
+  of a per-connection receive buffer: one ``\\r\\n\\r\\n`` scan finds the
+  header block, one pre-compiled regex pass over it extracts the
+  headers (no per-line split, no per-line decode — header values are
+  decoded lazily, only when someone reads them), and the body is sliced
+  out once via ``memoryview``;
+* **vectored single-syscall replies** — the response head is assembled
+  from cached blocks (per-status line, a once-a-second Date header,
+  common Content-Type lines) and handed to ``socket.sendmsg([head,
+  body])``: one syscall per reply, no head+body concatenation copy;
+* **multi-acceptor ``SO_REUSEPORT`` loops** — ``acceptors=N`` with
+  ``reuse_port=True`` binds N listening sockets to the one port and
+  runs N independent event loops; the kernel load-balances accepted
+  connections across them, so the socket edge scales past one loop's
+  ceiling while every loop feeds the same staged
+  collect/assemble -> dispatch -> encode executor.
+
+The frontend is transport only. It speaks to its application through a
+three-method protocol (duck-typed — :class:`ServingServer` and
+:class:`ServingCoordinator` both implement it):
+
+``app.handle_request(method, path, headers, body, reply) -> bool``
+    Handle one request. ``reply(status, body, ctype=..., extra=...)``
+    must be called EXACTLY ONCE — synchronously, or later from any
+    thread (the serving pipeline's encoder threads call it at commit
+    time). Return ``False`` for an unknown route (the frontend sends
+    the 404). The frontend guarantees a late/duplicate ``reply`` (e.g.
+    racing the request-timeout sweep) is dropped, never misdelivered
+    to a newer request on the same connection.
+
+Timeouts (all enforced by a per-loop sweep, not per-socket timers):
+
+* ``idle_timeout`` — a connection parked *between* requests longer than
+  this is closed, and a connection stuck *mid-request* (the slow-loris
+  shape: headers or body dribbling in forever) is reaped on the same
+  clock. ``<= 0`` disables reaping, matching the threaded frontend.
+* ``request_timeout`` — a dispatched request whose ``reply`` has not
+  arrived within this budget is answered 504 by the sweep (the
+  stuck-batch contract the threaded frontend implements with
+  ``Event.wait``); the eventual real reply is dropped by generation.
+
+Protocol guardrails (each satisfies one of the framing edge cases the
+frontend must not inherit from ``http.server``): header blocks beyond
+``max_header_bytes`` are rejected 431; POST bodies need a valid
+``Content-Length`` (missing -> 411, unparseable -> 400, beyond
+``max_body_bytes`` -> 413); ``Connection: close`` (and HTTP/1.0 without
+``keep-alive``) is honored; ``Transfer-Encoding: chunked`` is refused
+501 (the serving wire contract is Content-Length-framed JSON).
+
+See ``docs/serving.md`` ("The socket edge") for operator-facing knobs
+and ``docs/observability.md`` for the connection gauges.
+"""
+
+from __future__ import annotations
+
+import errno
+import re
+import selectors
+import socket
+import threading
+import time
+from collections import deque
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+from mmlspark_tpu.core.logs import get_logger
+
+logger = get_logger("serving.frontend")
+
+__all__ = ["EventLoopFrontend", "Headers"]
+
+
+# ---------------------------------------------------------------------------
+# Request framing
+# ---------------------------------------------------------------------------
+
+#: one pass over the header block: RFC 7230 token names, optional
+#: whitespace, value to end-of-line. Compiled once; runs directly on
+#: the connection's ``bytearray`` receive buffer (re accepts any buffer
+#: object), so the scan itself copies nothing — only the matched
+#: name/value groups materialize, and values stay bytes until someone
+#: reads them.
+_HDR_RE = re.compile(rb"([!#$%&'*+\-.^_`|~0-9A-Za-z]+):[ \t]*([^\r\n]*)")
+
+_CRLF2 = b"\r\n\r\n"
+
+
+class Headers:
+    """Case-insensitive, decode-lazy view over parsed header bytes.
+
+    ``get`` mirrors the stdlib message API the rest of the stack codes
+    against (``headers.get("X-Trace-Id")`` in
+    :func:`~mmlspark_tpu.core.tracing.extract_span_context`,
+    ``Deadline.from_headers``...), decoding a value (latin-1, the HTTP
+    wire charset) only when it is actually read."""
+
+    __slots__ = ("_raw",)
+
+    def __init__(self, raw: Dict[bytes, bytes]):
+        self._raw = raw
+
+    def get(self, name: str, default: Optional[str] = None
+            ) -> Optional[str]:
+        v = self._raw.get(name.lower().encode("ascii"))
+        return default if v is None else v.decode("latin-1")
+
+    def get_bytes(self, lname: bytes, default: bytes = b"") -> bytes:
+        return self._raw.get(lname, default)
+
+    def __contains__(self, name: str) -> bool:
+        return name.lower().encode("ascii") in self._raw
+
+    def items(self):
+        return [(k.decode("latin-1"), v.decode("latin-1"))
+                for k, v in self._raw.items()]
+
+    def __repr__(self) -> str:
+        return f"Headers({self._raw!r})"
+
+
+def parse_head(buf, head_end: int) -> Tuple[bytes, str, bytes, Headers]:
+    """Parse ``buf[:head_end]`` (request line + header block, no final
+    CRLFCRLF) into ``(method, path, version, headers)``.
+
+    ``buf`` is the connection's receive buffer (bytes/bytearray —
+    bytearray in production: ``find`` and the regex scan both run on it
+    directly, so nothing is sliced or copied but the request line); the
+    header scan is ONE pre-compiled regex pass bounded by pos/endpos —
+    no line split, no per-line decode, no buffer slice. Raises
+    ``ValueError`` on a malformed request line; malformed header lines
+    (no colon) are skipped rather than fatal — lenient like the stdlib
+    parser."""
+    line_end = buf.find(b"\r\n", 0, head_end)
+    if line_end < 0:
+        line_end = head_end
+    line = bytes(buf[:line_end])
+    sp1 = line.find(b" ")
+    sp2 = line.rfind(b" ")
+    if sp1 <= 0 or sp2 <= sp1:
+        raise ValueError(f"malformed request line: {line[:80]!r}")
+    method = line[:sp1]
+    path = line[sp1 + 1:sp2].decode("latin-1")
+    version = line[sp2 + 1:]
+    raw: Dict[bytes, bytes] = {}
+    for m in _HDR_RE.finditer(buf, line_end + 2, head_end):
+        raw[m.group(1).lower()] = m.group(2)
+    return method, path, version, Headers(raw)
+
+
+# ---------------------------------------------------------------------------
+# Cached reply blocks
+# ---------------------------------------------------------------------------
+
+_PHRASES = {
+    200: b"HTTP/1.1 200 OK\r\n",
+    400: b"HTTP/1.1 400 Bad Request\r\n",
+    404: b"HTTP/1.1 404 Not Found\r\n",
+    408: b"HTTP/1.1 408 Request Timeout\r\n",
+    411: b"HTTP/1.1 411 Length Required\r\n",
+    413: b"HTTP/1.1 413 Payload Too Large\r\n",
+    429: b"HTTP/1.1 429 Too Many Requests\r\n",
+    431: b"HTTP/1.1 431 Request Header Fields Too Large\r\n",
+    500: b"HTTP/1.1 500 Internal Server Error\r\n",
+    501: b"HTTP/1.1 501 Not Implemented\r\n",
+    503: b"HTTP/1.1 503 Service Unavailable\r\n",
+    504: b"HTTP/1.1 504 Gateway Timeout\r\n",
+}
+
+
+def _status_line(status: int) -> bytes:
+    line = _PHRASES.get(status)
+    if line is None:
+        line = b"HTTP/1.1 %d Status\r\n" % status
+        _PHRASES[status] = line
+    return line
+
+
+# the Date header changes once a second; format it at most that often
+# (shared across every loop and connection — wall clock is process-wide)
+_DATE_CACHE: List[Any] = [0.0, b""]
+
+
+def _date_line() -> bytes:
+    now = time.time()
+    if now - _DATE_CACHE[0] >= 1.0:
+        from email.utils import formatdate
+        # value BEFORE timestamp: a racing reader that sees the fresh
+        # timestamp must never read the stale (or empty) bytes
+        _DATE_CACHE[1] = ("Date: " + formatdate(now, usegmt=True)
+                          + "\r\n").encode("ascii")
+        _DATE_CACHE[0] = now
+    return _DATE_CACHE[1]
+
+
+_CTYPE_JSON = b"Content-Type: application/json\r\n"
+_CONN_CLOSE = b"Connection: close\r\n"
+_CL_PREFIX = b"Content-Length: "
+
+#: Content-Length lines for small bodies, interned once: the common
+#: replies (~10-200 byte JSON) skip the int->bytes format entirely
+_CL_CACHE = [b"Content-Length: %d\r\n" % n for n in range(1024)]
+
+
+def build_head(status: int, body_len: int,
+               ctype: str = "application/json",
+               extra: Tuple[Tuple[str, str], ...] = (),
+               close: bool = False) -> bytes:
+    """Assemble a response head from cached blocks. One ``join`` — the
+    body is NOT concatenated here; ``sendmsg([head, body])`` carries
+    both in one syscall without the copy."""
+    parts = [_status_line(status), _date_line(),
+             _CTYPE_JSON if ctype == "application/json"
+             else b"Content-Type: " + ctype.encode("latin-1") + b"\r\n",
+             _CL_CACHE[body_len] if body_len < 1024
+             else _CL_PREFIX + str(body_len).encode("ascii") + b"\r\n"]
+    for k, v in extra:
+        parts.append(f"{k}: {v}\r\n".encode("latin-1"))
+    if close:
+        parts.append(_CONN_CLOSE)
+    parts.append(b"\r\n")
+    return b"".join(parts)
+
+
+# ---------------------------------------------------------------------------
+# Connection state machine
+# ---------------------------------------------------------------------------
+
+_HEAD, _BODY, _AWAIT, _CLOSING = 0, 1, 2, 3
+
+
+class _Conn:
+    __slots__ = ("sock", "fd", "buf", "scanned", "state", "gen", "out",
+                 "t_last", "t_req_start", "t_await", "n_requests",
+                 "keep_alive", "method", "path", "headers", "body_start",
+                 "body_len", "want_write", "advancing")
+
+    def __init__(self, sock: socket.socket):
+        self.sock = sock
+        self.fd = sock.fileno()
+        self.buf = bytearray()
+        self.scanned = 0            # CRLFCRLF search resume offset
+        self.state = _HEAD
+        # reply generation: bumped every time the in-flight request slot
+        # is consumed (reply delivered OR timed out/aborted), so a stale
+        # reply callback can never answer a LATER request on this socket
+        self.gen = 0
+        self.out = bytearray()      # unwritten reply bytes (rare path)
+        self.t_last = 0.0           # last byte received (idle reaping)
+        self.t_req_start = 0.0      # first byte of the current request
+        self.t_await = 0.0          # when the current request dispatched
+        self.n_requests = 0
+        self.keep_alive = True
+        self.method = b""
+        self.path = ""
+        self.headers: Optional[Headers] = None
+        self.body_start = 0
+        self.body_len = 0
+        self.want_write = False
+        self.advancing = False
+
+
+class _Loop(threading.Thread):
+    """One acceptor + event loop: a listening socket, a selector, the
+    connections the kernel handed this loop, and a thread-safe reply
+    queue fed by the pipeline's commit callbacks."""
+
+    def __init__(self, frontend: "EventLoopFrontend", index: int,
+                 listener: socket.socket):
+        super().__init__(daemon=True,
+                         name=f"{frontend.name}-frontend-{index}")
+        self.frontend = frontend
+        self.index = index
+        self.listener = listener
+        self.sel = selectors.DefaultSelector()
+        self.conns: Dict[int, _Conn] = {}
+        self._replies: deque = deque()
+        self._wake_r, self._wake_w = socket.socketpair()
+        self._wake_r.setblocking(False)
+        self._wake_w.setblocking(False)
+        self._wake_pending = False
+        self._accepting = True
+        self._stopping = False
+        # busy-ratio window: time spent processing events vs wall time,
+        # refreshed every ~2 s — the accept-loop saturation gauge
+        self.busy_ratio = 0.0
+        self._win_t0 = time.monotonic()
+        self._win_busy = 0.0
+
+    # -- cross-thread entry points ------------------------------------------
+
+    def post_reply(self, conn: _Conn, gen: int, head: bytes,
+                   body: bytes, close_after: bool) -> None:
+        """Queue a reply for delivery by the loop thread; safe from any
+        thread. In-loop callers deliver inline (no queue round-trip)."""
+        if threading.get_ident() == self.ident:
+            self._deliver(conn, gen, head, body, close_after)
+            return
+        self._replies.append((conn, gen, head, body, close_after))
+        self.wake()
+
+    def wake(self) -> None:
+        # one pending byte is enough to wake the selector; the flag
+        # keeps a burst of commits from paying one syscall each (reads
+        # and writes of a bool are atomic under the GIL; a lost race
+        # costs one harmless extra byte)
+        if not self._wake_pending:
+            self._wake_pending = True
+            try:
+                self._wake_w.send(b"\x01")
+            except OSError:
+                pass
+
+    def pause_accept(self) -> None:
+        self._accepting = False
+        self.wake()
+
+    def request_stop(self) -> None:
+        self._stopping = True
+        self.wake()
+
+    # -- the loop ------------------------------------------------------------
+
+    def run(self) -> None:
+        fe = self.frontend
+        self.sel.register(self.listener, selectors.EVENT_READ, "accept")
+        self.sel.register(self._wake_r, selectors.EVENT_READ, "wake")
+        last_sweep = time.monotonic()
+        # sweep often enough that short idle timeouts (tests use 0.3 s)
+        # reap within a fraction of their budget
+        tick = 0.05 if 0 < fe.idle_timeout <= 1.0 else 0.25
+        try:
+            while True:
+                t_sel = time.monotonic()
+                events = self.sel.select(timeout=tick)
+                t0 = time.monotonic()
+                if self._stopping:
+                    break
+                self._wake_pending = False
+                for key, mask in events:
+                    what = key.data
+                    if what == "accept":
+                        self._accept_burst()
+                    elif what == "wake":
+                        self._drain_wake()
+                    else:
+                        conn = what
+                        if mask & selectors.EVENT_WRITE:
+                            self._on_writable(conn)
+                        if mask & selectors.EVENT_READ and \
+                                conn.fd in self.conns:
+                            self._on_readable(conn)
+                self._drain_replies()
+                if not self._accepting and self.listener is not None:
+                    self._close_listener()
+                now = time.monotonic()
+                if now - last_sweep >= tick:
+                    self._sweep(now)
+                    last_sweep = now
+                # busy-ratio bookkeeping (saturation telemetry): the
+                # fraction of wall time NOT spent blocked in select()
+                self._win_busy += now - t0
+                if now - self._win_t0 >= 2.0:
+                    span = max(now - self._win_t0, 1e-9)
+                    self.busy_ratio = min(self._win_busy / span, 1.0)
+                    self._win_t0, self._win_busy = now, 0.0
+                _ = t_sel
+        except Exception:  # noqa: BLE001 — a dead loop strands its fds
+            logger.error("frontend loop %d crashed", self.index,
+                         exc_info=True)
+        finally:
+            self._shutdown()
+
+    # -- accept --------------------------------------------------------------
+
+    def _accept_burst(self) -> None:
+        fe = self.frontend
+        if self.listener is None:
+            return
+        for _ in range(256):          # bounded: never starve live conns
+            try:
+                sock, _addr = self.listener.accept()
+            except (BlockingIOError, InterruptedError):
+                return
+            except OSError:
+                return
+            if not self._accepting:
+                sock.close()
+                continue
+            sock.setblocking(False)
+            try:
+                sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            except OSError:
+                pass                  # AF_UNIX etc.
+            conn = _Conn(sock)
+            conn.t_last = conn.t_req_start = time.monotonic()
+            self.conns[conn.fd] = conn
+            fe.n_connections += 1
+            self.sel.register(sock, selectors.EVENT_READ, conn)
+
+    def _drain_wake(self) -> None:
+        try:
+            while self._wake_r.recv(4096):
+                pass
+        except (BlockingIOError, InterruptedError):
+            pass
+        except OSError:
+            pass
+
+    def _close_listener(self) -> None:
+        if self.listener is None:
+            return
+        try:
+            self.sel.unregister(self.listener)
+        except (KeyError, ValueError):
+            pass
+        try:
+            self.listener.close()
+        except OSError:
+            pass
+        self.listener = None
+
+    # -- read + parse --------------------------------------------------------
+
+    def _on_readable(self, conn: _Conn) -> None:
+        try:
+            data = conn.sock.recv(65536)
+        except (BlockingIOError, InterruptedError):
+            return
+        except OSError:
+            self._close(conn)
+            return
+        if not data:
+            self._close(conn)         # peer closed (maybe mid-request)
+            return
+        now = time.monotonic()
+        conn.t_last = now
+        if conn.state == _HEAD and not conn.buf:
+            conn.t_req_start = now
+        conn.buf += data
+        if len(conn.buf) > self.frontend.max_header_bytes + \
+                self.frontend.max_body_bytes:
+            # a client flooding bytes while a request is in flight (or
+            # ignoring every reject) must not grow the buffer unbounded
+            self._close(conn)
+            return
+        self._advance(conn)
+
+    def _advance(self, conn: _Conn) -> None:
+        """Drive the state machine as far as the buffered bytes allow.
+        One while-iteration per complete request, so a pipelining
+        client is served in order without waiting for read events: a
+        synchronous reply flips the state back to ``_HEAD`` mid-loop
+        and the next buffered request parses immediately. The
+        ``advancing`` flag keeps that re-entry iterative — ``_deliver``
+        never recurses into an ``_advance`` that is already on the
+        stack (a deep pipeline burst must not grow the C stack)."""
+        if conn.advancing:
+            return
+        conn.advancing = True
+        try:
+            self._advance_inner(conn)
+        finally:
+            conn.advancing = False
+
+    def _advance_inner(self, conn: _Conn) -> None:
+        fe = self.frontend
+        while conn.state in (_HEAD, _BODY) and not conn.out:
+            buf = conn.buf
+            if conn.state == _HEAD:
+                # tolerate stray CRLFs between requests (RFC 7230 3.5)
+                while buf[:2] == b"\r\n":
+                    del buf[:2]
+                if not buf:
+                    return
+                # resume the terminator scan where the last one left
+                # off (minus 3: the terminator may straddle the chunks)
+                head_end = buf.find(_CRLF2, max(conn.scanned - 3, 0))
+                if head_end < 0:
+                    conn.scanned = len(buf)
+                    if len(buf) > fe.max_header_bytes:
+                        fe.n_parse_errors += 1
+                        self._reject(conn, 431,
+                                     b'{"error": "header block too '
+                                     b'large"}')
+                    return
+                if head_end > fe.max_header_bytes:
+                    # the whole oversized block landed in one recv:
+                    # finding the terminator does not make it admissible
+                    fe.n_parse_errors += 1
+                    self._reject(conn, 431,
+                                 b'{"error": "header block too '
+                                 b'large"}')
+                    return
+                conn.scanned = 0
+                try:
+                    method, path, version, headers = parse_head(
+                        buf, head_end)
+                except ValueError:
+                    fe.n_parse_errors += 1
+                    self._reject(conn, 400,
+                                 b'{"error": "malformed request"}')
+                    return
+                conn.method, conn.path, conn.headers = \
+                    method, path, headers
+                # keep-alive: HTTP/1.1 default-on, 1.0 default-off,
+                # Connection header overrides either way
+                tok = headers.get_bytes(b"connection").lower()
+                if version == b"HTTP/1.0":
+                    conn.keep_alive = tok == b"keep-alive"
+                else:
+                    conn.keep_alive = tok != b"close"
+                if headers.get_bytes(b"transfer-encoding"):
+                    fe.n_parse_errors += 1
+                    self._reject(conn, 501,
+                                 b'{"error": "chunked transfer encoding '
+                                 b'not supported"}')
+                    return
+                raw_cl = headers.get_bytes(b"content-length", None)
+                if raw_cl is None:
+                    if method == b"POST" or method == b"PUT":
+                        # a body-bearing method MUST declare its length:
+                        # the serving wire contract is length-framed
+                        fe.n_parse_errors += 1
+                        self._reject(conn, 411,
+                                     b'{"error": "Content-Length '
+                                     b'required"}')
+                        return
+                    clen = 0
+                else:
+                    try:
+                        clen = int(raw_cl)
+                        if clen < 0:
+                            raise ValueError
+                    except ValueError:
+                        fe.n_parse_errors += 1
+                        self._reject(conn, 400,
+                                     b'{"error": "invalid '
+                                     b'Content-Length"}')
+                        return
+                if clen > fe.max_body_bytes:
+                    fe.n_parse_errors += 1
+                    self._reject(conn, 413,
+                                 b'{"error": "body too large"}')
+                    return
+                conn.body_start = head_end + 4
+                conn.body_len = clen
+                conn.state = _BODY
+            # _BODY: wait for the full declared length
+            total = conn.body_start + conn.body_len
+            if len(conn.buf) < total:
+                return
+            body = bytes(memoryview(conn.buf)[conn.body_start:total])
+            del conn.buf[:total]
+            conn.scanned = 0
+            self._dispatch(conn, body)
+
+    def _dispatch(self, conn: _Conn, body: bytes) -> None:
+        fe = self.frontend
+        conn.n_requests += 1
+        fe.n_requests += 1
+        if conn.n_requests > 1:
+            fe.n_keepalive_reuses += 1
+        conn.state = _AWAIT
+        conn.t_await = time.monotonic()
+        gen = conn.gen
+        ka = conn.keep_alive
+        loop = self
+
+        def reply(status: int, rbody: bytes = b"",
+                  ctype: str = "application/json",
+                  extra: Tuple[Tuple[str, str], ...] = ()) -> None:
+            head = build_head(status, len(rbody), ctype, extra,
+                              close=not ka)
+            loop.post_reply(conn, gen, head, rbody, not ka)
+
+        method = conn.method.decode("latin-1")
+        try:
+            handled = fe.app.handle_request(method, conn.path,
+                                            conn.headers, body, reply)
+        except Exception as e:  # noqa: BLE001 — app bug, not a conn bug
+            logger.warning("handle_request failed for %s %s",
+                           method, conn.path, exc_info=True)
+            err = ('{"error": %s}'
+                   % _json_str(str(e) or "internal error")).encode()
+            self._deliver(conn, gen,
+                          build_head(500, len(err), close=not ka),
+                          err, not ka)
+            return
+        if not handled:
+            nf = b'{"error": "not found"}'
+            self._deliver(conn, gen,
+                          build_head(404, len(nf), close=not ka),
+                          nf, not ka)
+
+    # -- reject / reply / write ---------------------------------------------
+
+    def _reject(self, conn: _Conn, status: int, body: bytes) -> None:
+        """Protocol-error reply: always ``Connection: close`` (the
+        framing is broken; resynchronizing the stream is hopeless)."""
+        conn.state = _CLOSING
+        conn.gen += 1
+        conn.buf.clear()
+        head = build_head(status, len(body), close=True)
+        self._write(conn, head, body, close_after=True)
+
+    def _deliver(self, conn: _Conn, gen: int, head: bytes, body: bytes,
+                 close_after: bool) -> None:
+        """Deliver a reply IF its request is still current (generation
+        match): a reply racing the timeout sweep or a closed socket is
+        dropped here, never written to the wrong request."""
+        if conn.fd not in self.conns or conn.gen != gen \
+                or conn.state != _AWAIT:
+            return
+        conn.gen += 1
+        conn.state = _CLOSING if close_after else _HEAD
+        # the slow-loris reap clock restarts here: any bytes of the
+        # NEXT request that arrived while this one was in flight must
+        # be aged from this reply, not from the previous request's
+        # first byte
+        conn.t_req_start = time.monotonic()
+        self._write(conn, head, body, close_after)
+        if conn.fd in self.conns and conn.state == _HEAD \
+                and not conn.out:
+            conn.t_last = time.monotonic()
+            self._advance(conn)       # serve pipelined follow-ups
+
+    def _drain_replies(self) -> None:
+        while True:
+            try:
+                conn, gen, head, body, close_after = \
+                    self._replies.popleft()
+            except IndexError:
+                return
+            self._deliver(conn, gen, head, body, close_after)
+
+    def _write(self, conn: _Conn, head: bytes, body: bytes,
+               close_after: bool) -> None:
+        if conn.out:
+            conn.out += head
+            conn.out += body
+        else:
+            try:
+                # the vectored single-syscall reply: status+headers and
+                # body leave in one sendmsg, no concatenation copy
+                n = conn.sock.sendmsg((head, body) if body else (head,))
+            except (BlockingIOError, InterruptedError):
+                n = 0
+            except OSError:
+                self._close(conn)
+                return
+            total = len(head) + len(body)
+            if n >= total:
+                if close_after:
+                    self._close(conn)
+                return
+            rest = head + body
+            conn.out += rest[n:]      # rare: kernel buffer full
+        self._want_write(conn, True)
+
+    def _on_writable(self, conn: _Conn) -> None:
+        if conn.out:
+            try:
+                n = conn.sock.send(conn.out)
+            except (BlockingIOError, InterruptedError):
+                return
+            except OSError:
+                self._close(conn)
+                return
+            del conn.out[:n]
+        if not conn.out:
+            self._want_write(conn, False)
+            if conn.state == _CLOSING:
+                self._close(conn)
+            elif conn.state == _HEAD:
+                self._advance(conn)
+
+    def _want_write(self, conn: _Conn, want: bool) -> None:
+        if conn.want_write == want or conn.fd not in self.conns:
+            return
+        conn.want_write = want
+        ev = selectors.EVENT_READ | (selectors.EVENT_WRITE if want else 0)
+        try:
+            self.sel.modify(conn.sock, ev, conn)
+        except (KeyError, ValueError, OSError):
+            pass
+
+    def _close(self, conn: _Conn) -> None:
+        if self.conns.pop(conn.fd, None) is None:
+            return
+        conn.gen += 1                 # outstanding replies become stale
+        conn.state = _CLOSING
+        try:
+            self.sel.unregister(conn.sock)
+        except (KeyError, ValueError, OSError):
+            pass
+        try:
+            conn.sock.close()
+        except OSError:
+            pass
+
+    # -- sweeps --------------------------------------------------------------
+
+    def _sweep(self, now: float) -> None:
+        fe = self.frontend
+        idle = fe.idle_timeout
+        rt = fe.request_timeout
+        doomed: List[_Conn] = []
+        timed_out: List[_Conn] = []
+        for conn in self.conns.values():
+            if conn.state == _AWAIT:
+                if rt and rt > 0 and now - conn.t_await > rt:
+                    timed_out.append(conn)
+                continue
+            if idle and idle > 0 and conn.state in (_HEAD, _BODY):
+                if conn.buf or conn.state == _BODY:
+                    # mid-request stall: the slow-loris shape — bytes
+                    # dribbling in keep t_last fresh, so the reap clock
+                    # is the REQUEST's age, not the socket's idleness
+                    if now - conn.t_req_start > idle:
+                        doomed.append(conn)
+                elif now - conn.t_last > idle:
+                    doomed.append(conn)
+        for conn in doomed:
+            fe.n_idle_reaped += 1
+            self._close(conn)
+        for conn in timed_out:
+            # same contract as the threaded frontend's Event.wait
+            # expiry: 504 now, drop the late real reply by generation
+            gen = conn.gen
+            body = fe.request_timeout_body
+            self._deliver(conn, gen,
+                          build_head(504, len(body),
+                                     close=not conn.keep_alive),
+                          body, not conn.keep_alive)
+            fe.n_request_timeouts += 1
+
+    # -- shutdown ------------------------------------------------------------
+
+    def _shutdown(self) -> None:
+        self._close_listener()
+        # deliver any replies already posted (the pipeline quiesced
+        # before stop; what is queued now is all there will ever be),
+        # then give pending writes a short bounded flush
+        self._drain_replies()
+        deadline = time.monotonic() + 0.5
+        while time.monotonic() < deadline and any(
+                c.out for c in self.conns.values()):
+            events = self.sel.select(timeout=0.05)
+            for key, mask in events:
+                if isinstance(key.data, _Conn) and \
+                        mask & selectors.EVENT_WRITE:
+                    self._on_writable(key.data)
+        for conn in list(self.conns.values()):
+            self._close(conn)
+        try:
+            self.sel.close()
+        except OSError:
+            pass
+        for s in (self._wake_r, self._wake_w):
+            try:
+                s.close()
+            except OSError:
+                pass
+
+
+def _json_str(s: str) -> str:
+    import json
+    return json.dumps(s)
+
+
+# ---------------------------------------------------------------------------
+# The frontend
+# ---------------------------------------------------------------------------
+
+class EventLoopFrontend:
+    """N accept/event loops sharing one port, speaking the
+    ``handle_request`` protocol to an application (see module doc)."""
+
+    def __init__(self, app, host: str = "127.0.0.1", port: int = 0, *,
+                 acceptors: int = 1, reuse_port: bool = False,
+                 idle_timeout: float = 0.0,
+                 request_timeout: Optional[float] = None,
+                 request_timeout_body: bytes =
+                 b'{"error": "inference timed out"}',
+                 max_header_bytes: int = 16384,
+                 max_body_bytes: int = 64 << 20,
+                 backlog: int = 1024,
+                 registry=None, name: str = "serving"):
+        self.app = app
+        self.name = name
+        self.idle_timeout = float(idle_timeout or 0.0)
+        self.request_timeout = request_timeout
+        self.request_timeout_body = request_timeout_body
+        self.max_header_bytes = int(max_header_bytes)
+        self.max_body_bytes = int(max_body_bytes)
+        self.acceptors = max(int(acceptors), 1)
+        self.backlog = max(int(backlog), 1)
+        self.reuse_port = bool(reuse_port)
+        if self.acceptors > 1 and not self.reuse_port:
+            # N loops cannot share ONE listening socket without the
+            # thundering-herd accept races SO_REUSEPORT exists to fix
+            raise ValueError("acceptors > 1 requires reuse_port=True")
+        # frontend counters: plain ints bumped from loop threads (int
+        # += is tear-free under the GIL; exactness beyond that is not
+        # worth a lock on the accept path), exposed via set_function
+        # views exactly like the server's own counters
+        self.n_connections = 0
+        self.n_requests = 0
+        self.n_keepalive_reuses = 0
+        self.n_idle_reaped = 0
+        self.n_parse_errors = 0
+        self.n_request_timeouts = 0
+        self._listeners: List[socket.socket] = []
+        first = self._bind(host, port)
+        self.host, self.port = first.getsockname()[:2]
+        self._listeners.append(first)
+        for _ in range(self.acceptors - 1):
+            self._listeners.append(self._bind(self.host, self.port))
+        self._loops = [_Loop(self, i, lst)
+                       for i, lst in enumerate(self._listeners)]
+        if registry is not None:
+            self._register_metrics(registry)
+
+    def _bind(self, host: str, port: int) -> socket.socket:
+        s = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        try:
+            s.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+            if self.reuse_port:
+                if not hasattr(socket, "SO_REUSEPORT"):
+                    raise OSError(
+                        errno.ENOPROTOOPT,
+                        "SO_REUSEPORT unavailable on this platform")
+                s.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEPORT, 1)
+            s.bind((host, port))
+            s.listen(self.backlog)
+            s.setblocking(False)
+        except BaseException:
+            s.close()
+            raise
+        return s
+
+    def _register_metrics(self, registry) -> None:
+        registry.gauge(
+            "serving_open_connections",
+            "Sockets currently registered with the frontend loops "
+            "(keep-alive connections park here between requests)."
+        ).set_function(lambda: sum(len(lp.conns) for lp in self._loops))
+        registry.gauge(
+            "serving_accept_loop_busy_ratio",
+            "Fraction of wall time the busiest accept loop spent "
+            "processing events (1.0 = the socket edge is saturated; "
+            "add SO_REUSEPORT acceptors)."
+        ).set_function(
+            lambda: max((lp.busy_ratio for lp in self._loops),
+                        default=0.0))
+        for mname, help_, attr in (
+            ("serving_connections_total",
+             "Connections accepted by the event-loop frontend.",
+             "n_connections"),
+            ("serving_frontend_requests_total",
+             "Requests framed by the event-loop frontend (all routes).",
+             "n_requests"),
+            ("serving_keepalive_reuses_total",
+             "Requests served on an already-used connection (reuse "
+             "rate = reuses / frontend requests).", "n_keepalive_reuses"),
+            ("serving_idle_reaped_total",
+             "Connections closed by the idle/slow-loris sweep.",
+             "n_idle_reaped"),
+            ("serving_parse_errors_total",
+             "Requests rejected at the framing layer (400/411/413/"
+             "431/501).", "n_parse_errors"),
+            ("serving_request_timeouts_total",
+             "In-flight requests 504ed by the request-timeout sweep.",
+             "n_request_timeouts"),
+        ):
+            registry.counter(mname, help_).set_function(
+                lambda a=attr: getattr(self, a))
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def start(self) -> "EventLoopFrontend":
+        # idempotent: ServingServer.start() may run twice (helper +
+        # context-manager __enter__ is a common test shape) and the
+        # loops are long-lived Thread objects, not per-call ones
+        for lp in self._loops:
+            if not lp._started.is_set():
+                lp.start()
+        return self
+
+    def pause_accept(self) -> None:
+        """Stop accepting new connections; established connections keep
+        being served. Part of graceful drain: readiness flips first,
+        then the listeners go away, then in-flight work finishes."""
+        for lp in self._loops:
+            lp.pause_accept()
+
+    def stop(self) -> None:
+        """Stop the loops. Call only after the application has quiesced
+        (every ``reply`` that will ever fire has fired): each loop
+        delivers already-posted replies, briefly flushes pending
+        writes, then closes everything."""
+        for lp in self._loops:
+            lp.request_stop()
+        for lp in self._loops:
+            if lp.is_alive():
+                lp.join(timeout=5)
+        for lst in self._listeners:
+            try:
+                lst.close()
+            except OSError:
+                pass
+
+    # -- observability -------------------------------------------------------
+
+    def stats(self) -> Dict[str, Any]:
+        reqs = self.n_requests
+        return {
+            "kind": "eventloop",
+            "acceptors": self.acceptors,
+            "reuse_port": self.reuse_port,
+            "open_connections": sum(len(lp.conns) for lp in self._loops),
+            "connections_total": self.n_connections,
+            "requests_total": reqs,
+            "keepalive_reuses_total": self.n_keepalive_reuses,
+            "keepalive_reuse_rate": round(
+                self.n_keepalive_reuses / reqs, 4) if reqs else 0.0,
+            "idle_reaped_total": self.n_idle_reaped,
+            "parse_errors_total": self.n_parse_errors,
+            "request_timeouts_total": self.n_request_timeouts,
+            "busy_ratio": round(max(
+                (lp.busy_ratio for lp in self._loops), default=0.0), 4),
+        }

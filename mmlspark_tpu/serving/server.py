@@ -81,10 +81,11 @@ from mmlspark_tpu.core.telemetry import (
     render_registries, render_samples, trace_context,
 )
 from mmlspark_tpu.core.tracing import (
-    PARENT_SPAN_HEADER, TRACER, AdaptiveThreshold, ambient_tracer,
-    extract_span_context, format_span_id, merge_traces, span_tree,
-    to_perfetto,
+    CAPTURE_HEADER, PARENT_SPAN_HEADER, TRACER, AdaptiveThreshold,
+    ambient_tracer, capture_hint, extract_span_context, format_span_id,
+    merge_traces, span_tree, to_perfetto,
 )
+from mmlspark_tpu.serving.frontend import EventLoopFrontend
 
 logger = get_logger("serving")
 
@@ -113,7 +114,7 @@ _MAX_SHAPES_TRACKED = 1024
 
 class _PendingRequest:
     __slots__ = ("rid", "payload", "event", "reply", "status", "deadline",
-                 "trace", "span", "t_enqueue")
+                 "trace", "span", "t_enqueue", "callbacks")
 
     def __init__(self, payload: Any, rid: Optional[str] = None,
                  deadline: Optional[Deadline] = None,
@@ -121,6 +122,13 @@ class _PendingRequest:
         self.rid = rid or f"{_RID_PREFIX}-{next(_RID_COUNTER):x}"
         self.payload = payload
         self.event = threading.Event()
+        # completion fan-out: the threaded frontend's handler threads
+        # block on ``event``; the event-loop frontend registers a
+        # callback here instead (fired at commit, from whichever stage
+        # thread resolves the request) — both may be active at once
+        # when a threaded retry joins a request an event-loop client
+        # enqueued, or vice versa
+        self.callbacks: List[Any] = []
         self.reply: Optional[bytes] = None
         self.status = 200
         self.deadline = deadline
@@ -166,6 +174,9 @@ class ServingServer:
                  adaptive_ceiling_ms: float = 5000.0,
                  adaptive_min_count: int = 50,
                  tracer=None,
+                 frontend: str = "eventloop",
+                 acceptors: int = 1,
+                 reuse_port: bool = False,
                  clock: Clock = SYSTEM_CLOCK):
         self.model = model
         self.api_path = api_path
@@ -272,8 +283,36 @@ class ServingServer:
         self._active_batches = 0
         self._queue: "Queue[_PendingRequest]" = Queue()
         self._stop = threading.Event()
-        self._server = _Server((host, port), self._handler_class())
-        self.host, self.port = self._server.server_address[:2]
+        # -- the socket edge: ``frontend="eventloop"`` (the default)
+        # serves ingress from selectors-based non-blocking accept/read/
+        # write loops — HTTP/1.1 keep-alive steady state, zero-copy
+        # framing, vectored single-syscall replies, and optional
+        # SO_REUSEPORT multi-acceptor loops (``acceptors``/
+        # ``reuse_port``) — see serving/frontend.py and docs/serving.md
+        # "The socket edge". ``frontend="threaded"`` keeps the
+        # thread-per-connection http.server plane as the A/B baseline.
+        # Both speak to the SAME staged data plane; only the edge
+        # differs.
+        self.frontend = str(frontend)
+        if self.frontend == "eventloop":
+            self._server = None
+            self._frontend: Optional[EventLoopFrontend] = \
+                EventLoopFrontend(
+                    self, host, port,
+                    acceptors=acceptors, reuse_port=reuse_port,
+                    idle_timeout=self.idle_timeout,
+                    request_timeout=self.request_timeout,
+                    registry=self.registry, name="serving")
+            self.host, self.port = (self._frontend.host,
+                                    self._frontend.port)
+        elif self.frontend == "threaded":
+            self._frontend = None
+            self._server = _Server((host, port), self._handler_class())
+            self.host, self.port = self._server.server_address[:2]
+        else:
+            raise ValueError(
+                f"unknown frontend {frontend!r} "
+                "(expected 'eventloop' or 'threaded')")
         self._threads: List[threading.Thread] = []
         self.n_requests = 0
         self.n_batches = 0
@@ -429,7 +468,7 @@ class ServingServer:
 
             def _reply(self, status: int, body: bytes, replayed=False,
                        window_missed=False, retry_after=None,
-                       trace=None, ctype="application/json"):
+                       trace=None, ctype="application/json", extra=()):
                 self.send_response(status)
                 self.send_header("Content-Type", ctype)
                 if trace:
@@ -442,6 +481,8 @@ class ServingServer:
                     self.send_header("X-Replay-Window-Missed", "1")
                 if retry_after is not None:
                     self.send_header("Retry-After", str(retry_after))
+                for k, v in extra:
+                    self.send_header(k, v)
                 self.send_header("Content-Length", str(len(body)))
                 # one write for status+headers+body: Nagle is disabled,
                 # so the stdlib's separate end_headers()/body writes
@@ -458,152 +499,16 @@ class ServingServer:
                     self.wfile.write(body)
 
             def do_GET(self):
-                if self.path == "/healthz":
-                    # liveness: the process answers HTTP at all
-                    self._reply(200, b'{"ok": true}')
-                    return
-                if self.path == "/readyz":
-                    # readiness: flips 503 the moment drain starts, so
-                    # an orchestrator stops routing BEFORE the listener
-                    # goes away (the k8s readiness-probe contract)
-                    if serving._draining.is_set() or \
-                            serving._stop.is_set():
-                        self._reply(503, b'{"ready": false, '
-                                         b'"reason": "draining"}')
-                        return
-                    body = {"ready": True,
-                            "queue_depth": serving.backlog(),
-                            "max_queue": serving.max_queue}
-                    self._reply(200, json.dumps(body).encode())
-                    return
-                if self.path.split("?", 1)[0] == "/metrics":
-                    # Prometheus text exposition: the per-server
-                    # registry (stage/dispatch histograms + counter
-                    # views) plus the process-wide one (trainer, HTTP
-                    # egress, breakers, Timer stages).
-                    # ``?scope=server`` limits to the per-server
-                    # registry — the fleet merge scrapes that, so
-                    # co-hosted workers sharing one process REGISTRY
-                    # never double-count its families in the sum.
-                    # Exemplars ride ONLY the OpenMetrics exposition
-                    # (Accept-negotiated, or forced via ?exemplars=1):
-                    # the classic 0.0.4 grammar has no exemplar
-                    # production and a strict scraper would fail the
-                    # whole scrape on the trailer
-                    server_only = "scope=server" in self.path
-                    regs = (serving.registry,) if server_only \
-                        else (serving.registry, REGISTRY)
-                    accept = self.headers.get("Accept", "")
-                    openmetrics = ("application/openmetrics-text"
-                                   in accept
-                                   or "exemplars=1" in self.path)
-                    body = render_registries(
-                        *regs, exemplars=openmetrics)
-                    if openmetrics:
-                        body += "# EOF\n"
-                    self._reply(200, body.encode(),
-                                ctype=_OPENMETRICS_CONTENT_TYPE
-                                if openmetrics
-                                else _METRICS_CONTENT_TYPE)
-                    return
-                if self.path == "/stats":
-                    # data-plane observability: per-stage timings, the
-                    # bucket set actually dispatched, and the recompile
-                    # counter (a dispatch shape seen for the first time
-                    # forces a trace/compile in any jitted model) — the
-                    # evidence that the bucketed pipeline holds a fixed
-                    # compiled-shape set after warm-up
-                    with serving._stats_lock:
-                        stats = {
-                            "pipeline": serving.pipeline,
-                            "bucket_batches": serving.bucket_batches,
-                            "encoder_threads": serving.encoder_threads,
-                            "n_batches": serving.n_batches,
-                            "n_requests": serving.n_requests,
-                            "n_recompiles": serving.n_recompiles,
-                            "dispatch_sizes": sorted(
-                                {k[0] for k in serving._shapes_seen}),
-                            "inflight_batches": serving._active_batches,
-                            "queue_depth": serving._n_backlog,
-                            "stage_timings":
-                                serving.timings.snapshot(),
-                            # the LIVE tail-capture threshold (adaptive
-                            # refreshes move it; fixed config pins it)
-                            "slow_trace_ms":
-                                serving.tracer.threshold(
-                                    serving.api_path),
-                            "adaptive_slow_trace":
-                                serving.adaptive is not None,
-                            # process vitals: chaos drills diff these
-                            # across kill/restart cycles — uptime
-                            # proves the restart, RSS spots the leak
-                            "uptime_s": round(process_uptime_s(), 3),
-                            "rss_bytes": process_rss_bytes(),
-                        }
-                    self._reply(200, json.dumps(stats).encode())
-                    return
-                if self.path.split("?", 1)[0] == "/traces":
-                    # the tail-capture store: every retained trace was
-                    # slow or ended non-ok; ?slow=1 keeps only the
-                    # threshold-retained ones. Slowest first (root
-                    # duration descending), so the capture an operator
-                    # wants tops the list without fetching every tree
-                    items = serving.tracer.traces(
-                        slow_only="slow=1" in self.path)
-                    items.sort(key=lambda t: -t["duration_ms"])
-                    self._reply(200, json.dumps(items).encode())
-                    return
-                if self.path.startswith("/trace/"):
-                    tid, _, query = \
-                        self.path[len("/trace/"):].partition("?")
-                    tr = serving.tracer.get_trace(tid)
-                    if tr is None:
-                        self._reply(404, json.dumps(
-                            {"error": "trace not retained (fast + ok "
-                                      "traces are tail-dropped)",
-                             "trace_id": tid}).encode())
-                        return
-                    if "format=raw" in query:
-                        # the stored capture verbatim (flat span list +
-                        # origin_unix anchor): what the coordinator's
-                        # distributed merge consumes
-                        body = json.dumps(tr).encode()
-                    elif "format=perfetto" in query:
-                        # Chrome trace_event JSON: load the body in
-                        # chrome://tracing or ui.perfetto.dev (see
-                        # tools/trace_dump.py)
-                        body = json.dumps(to_perfetto(tr)).encode()
-                    else:
-                        out = {k: tr[k] for k in
-                               ("trace_id", "root", "route",
-                                "duration_ms", "status", "reason",
-                                "captured_at", "n_spans")}
-                        out["tree"] = span_tree(tr)
-                        body = json.dumps(out).encode()
-                    self._reply(200, body)
-                    return
-                if self.path != "/status":
+                # one route table for both frontends: the threaded
+                # handler and the event-loop frontend's handle_request
+                # serve the SAME _get_route result — observability
+                # endpoints cannot drift between the A/B planes
+                route = serving._get_route(self.path, self.headers)
+                if route is None:
                     self.send_error(404)
                     return
-                with serving._commit_lock:
-                    status = {
-                        "n_requests": serving.n_requests,
-                        "n_batches": serving.n_batches,
-                        "n_replayed": serving.n_replayed,
-                        "n_journal_evicted": serving.n_journal_evicted,
-                        "n_window_missed": serving.n_window_missed,
-                        "n_shed": serving.n_shed,
-                        "n_deadline_expired": serving.n_deadline_expired,
-                        "queue_depth": serving.backlog(),
-                        "max_queue": serving.max_queue,
-                        "draining": serving._draining.is_set(),
-                        "journal_entries": len(serving._journal),
-                        "journal_size": serving.journal_size,
-                        "journal_ttl": serving.journal_ttl,
-                        "journal_path": serving.journal_path,
-                        "journal_recovered": serving.n_journal_recovered,
-                    }
-                self._reply(200, json.dumps(status).encode())
+                status, body, ctype, extra = route
+                self._reply(status, body, ctype=ctype, extra=extra)
 
             def do_POST(self):
                 if self.path != serving.api_path:
@@ -627,6 +532,10 @@ class ServingServer:
                         "request", trace_id=tid,
                         remote_parent=parent_sid,
                         route=serving.api_path)
+                    if capture_hint(self.headers):
+                        # the X-Capture wire hint: retain this trace
+                        # end to end, thresholds notwithstanding
+                        root.force = True
                     status = "error"
                     try:
                         status = self._do_predict(tid, root)
@@ -659,97 +568,25 @@ class ServingServer:
                 deadline = Deadline.from_headers(self.headers,
                                                  clock=serving.clock)
                 rid = self.headers.get("X-Request-Id")
-                window_missed = False
-                shed = False
+                kind, pending, committed, window_missed = \
+                    serving._admit(payload, rid, deadline, tid)
                 if rid:
-                    with serving._commit_lock:
-                        serving._reap_expired_locked()
-                        committed = serving._journal.get(rid)
-                        pending = (serving._inflight.get(rid)
-                                   if committed is None else None)
-                        if committed is None and pending is None:
-                            if serving._overloaded():
-                                # shedding applies to NEW work only:
-                                # replays and in-flight joins above cost
-                                # no inference and always succeed
-                                serving.n_shed += 1
-                                shed = True
-                                enqueue = False
-                            else:
-                                # request ids are unique per logical
-                                # request, so a rid in the evicted ring
-                                # can only be a retry that outlived the
-                                # replay window — detected, warned, and
-                                # re-executed (the documented
-                                # past-window semantics)
-                                window_missed = rid in serving._evicted
-                                if window_missed:
-                                    serving.n_window_missed += 1
-                                pending = _PendingRequest(payload, rid,
-                                                          deadline,
-                                                          trace=tid)
-                                serving._inflight[rid] = pending
-                                enqueue = True
-                        else:
-                            enqueue = False
-                        if committed is not None:
-                            serving.n_replayed += 1
                     root.set_attr("rid", rid)
-                    if committed is not None:
-                        root.set_attr("replayed", True)
-                        self._reply(committed[0], committed[1],
-                                    replayed=True, trace=tid)
-                        return "ok"
-                    if shed:
-                        self._reply(429, b'{"error": "overloaded"}',
-                                    retry_after=serving.shed_retry_after,
-                                    trace=tid)
-                        return "shed"
-                    if window_missed:
-                        logger.warning(
-                            "request id %s retried after its journal "
-                            "entry was evicted (journal_size=%d, "
-                            "journal_ttl=%s); re-executing", rid,
-                            serving.journal_size, serving.journal_ttl)
-                else:
-                    if serving._overloaded():
-                        with serving._commit_lock:
-                            serving.n_shed += 1
-                        self._reply(429, b'{"error": "overloaded"}',
-                                    retry_after=serving.shed_retry_after,
-                                    trace=tid)
-                        return "shed"
-                    pending = _PendingRequest(payload, deadline=deadline,
-                                              trace=tid)
-                    enqueue = True
-
-                if enqueue and deadline is not None and deadline.expired:
-                    # dead on arrival: the client's budget is already
-                    # spent — never enqueue work nobody will read. The
-                    # pending is resolved (status + event) BEFORE it
-                    # leaves _inflight, so a duplicate that joined it
-                    # in the window between the two locked sections is
-                    # released immediately instead of blocking until
-                    # request_timeout
-                    pending.status = 504
-                    pending.reply = b'{"error": "deadline exceeded"}'
-                    with serving._stats_lock:
-                        serving.n_deadline_expired += 1
-                    with serving._commit_lock:
-                        serving._inflight.pop(pending.rid, None)
-                    pending.event.set()
+                if kind == "replay":
+                    root.set_attr("replayed", True)
+                    self._reply(committed[0], committed[1],
+                                replayed=True, trace=tid)
+                    return "ok"
+                if kind == "shed":
+                    self._reply(429, b'{"error": "overloaded"}',
+                                retry_after=serving.shed_retry_after,
+                                trace=tid)
+                    return "shed"
+                if kind == "doa":
                     self._reply(504, pending.reply, trace=tid)
                     return "deadline"
-
-                if enqueue:
-                    # the root span rides the work item across the
-                    # stage threads (exactly as the trace id does);
-                    # t_enqueue anchors the queue_wait child span
-                    pending.span = root
-                    pending.t_enqueue = serving.tracer.clock.now()
-                    with serving._stats_lock:
-                        serving._n_backlog += 1
-                    serving._queue.put(pending)
+                if kind == "enqueue":
+                    serving._enqueue(pending, root)
                 if not pending.event.wait(serving.request_timeout):
                     # the stuck-batch timeout is the reply operators
                     # most need to trace: echo the id here too
@@ -760,7 +597,8 @@ class ServingServer:
                 # actually committed — errors are never journaled, so
                 # they must not carry the committed-replay marker
                 self._reply(pending.status, pending.reply or b"{}",
-                            replayed=not enqueue and pending.status == 200,
+                            replayed=(kind == "join"
+                                      and pending.status == 200),
                             window_missed=window_missed, trace=tid)
                 return ("ok" if pending.status == 200 else
                         "deadline" if pending.status == 504 else "error")
@@ -769,6 +607,372 @@ class ServingServer:
                 pass
 
         return Handler
+
+    # -- shared ingress (both frontends) -------------------------------------
+
+    def _get_route(self, path: str, headers
+                   ) -> Optional[Tuple[int, bytes, str, tuple]]:
+        """The GET route table: ``(status, body, content_type, extra
+        headers)`` or None for 404. The threaded handler and the
+        event-loop frontend both serve exactly this, so the
+        observability surface cannot drift between the A/B planes."""
+        if path == "/healthz":
+            # liveness: the process answers HTTP at all
+            return 200, b'{"ok": true}', "application/json", ()
+        if path == "/readyz":
+            # readiness: flips 503 the moment drain starts, so an
+            # orchestrator stops routing BEFORE the listener goes away
+            # (the k8s readiness-probe contract)
+            if self._draining.is_set() or self._stop.is_set():
+                return (503, b'{"ready": false, "reason": "draining"}',
+                        "application/json", ())
+            body = {"ready": True,
+                    "queue_depth": self.backlog(),
+                    "max_queue": self.max_queue}
+            return 200, json.dumps(body).encode(), "application/json", ()
+        base = path.split("?", 1)[0]
+        if base == "/metrics":
+            # Prometheus text exposition: the per-server registry
+            # (stage/dispatch histograms + counter views) plus the
+            # process-wide one (trainer, HTTP egress, breakers, Timer
+            # stages). ``?scope=server`` limits to the per-server
+            # registry — the fleet merge scrapes that, so co-hosted
+            # workers sharing one process REGISTRY never double-count
+            # its families in the sum. Exemplars ride ONLY the
+            # OpenMetrics exposition (Accept-negotiated, or forced via
+            # ?exemplars=1): the classic 0.0.4 grammar has no exemplar
+            # production and a strict scraper would fail the whole
+            # scrape on the trailer
+            server_only = "scope=server" in path
+            regs = (self.registry,) if server_only \
+                else (self.registry, REGISTRY)
+            accept = headers.get("Accept", "") if headers is not None \
+                else ""
+            openmetrics = ("application/openmetrics-text"
+                           in (accept or "")
+                           or "exemplars=1" in path)
+            body = render_registries(*regs, exemplars=openmetrics)
+            if openmetrics:
+                body += "# EOF\n"
+            return (200, body.encode(),
+                    _OPENMETRICS_CONTENT_TYPE if openmetrics
+                    else _METRICS_CONTENT_TYPE, ())
+        if path == "/stats":
+            # data-plane observability: per-stage timings, the bucket
+            # set actually dispatched, and the recompile counter (a
+            # dispatch shape seen for the first time forces a
+            # trace/compile in any jitted model) — the evidence that
+            # the bucketed pipeline holds a fixed compiled-shape set
+            # after warm-up
+            with self._stats_lock:
+                stats = {
+                    "pipeline": self.pipeline,
+                    "bucket_batches": self.bucket_batches,
+                    "encoder_threads": self.encoder_threads,
+                    "n_batches": self.n_batches,
+                    "n_requests": self.n_requests,
+                    "n_recompiles": self.n_recompiles,
+                    "dispatch_sizes": sorted(
+                        {k[0] for k in self._shapes_seen}),
+                    "inflight_batches": self._active_batches,
+                    "queue_depth": self._n_backlog,
+                    "stage_timings": self.timings.snapshot(),
+                    # the LIVE tail-capture threshold (adaptive
+                    # refreshes move it; fixed config pins it)
+                    "slow_trace_ms":
+                        self.tracer.threshold(self.api_path),
+                    "adaptive_slow_trace": self.adaptive is not None,
+                    # the socket edge: keep-alive reuse rate, open
+                    # connections, accept-loop saturation (eventloop);
+                    # the threaded plane reports only its kind
+                    "frontend": (self._frontend.stats()
+                                 if self._frontend is not None
+                                 else {"kind": "threaded"}),
+                    # process vitals: chaos drills diff these across
+                    # kill/restart cycles — uptime proves the restart,
+                    # RSS spots the leak
+                    "uptime_s": round(process_uptime_s(), 3),
+                    "rss_bytes": process_rss_bytes(),
+                }
+            return 200, json.dumps(stats).encode(), "application/json", ()
+        if base == "/traces":
+            # the tail-capture store: every retained trace was slow or
+            # ended non-ok; ?slow=1 keeps only the threshold-retained
+            # ones. Slowest first (root duration descending), so the
+            # capture an operator wants tops the list without fetching
+            # every tree
+            items = self.tracer.traces(slow_only="slow=1" in path)
+            items.sort(key=lambda t: -t["duration_ms"])
+            return 200, json.dumps(items).encode(), "application/json", ()
+        if path.startswith("/trace/"):
+            tid, _, query = path[len("/trace/"):].partition("?")
+            tr = self.tracer.get_trace(tid)
+            if tr is None:
+                return (404, json.dumps(
+                    {"error": "trace not retained (fast + ok traces "
+                              "are tail-dropped)",
+                     "trace_id": tid}).encode(), "application/json", ())
+            if "format=raw" in query:
+                # the stored capture verbatim (flat span list +
+                # origin_unix anchor): what the coordinator's
+                # distributed merge consumes
+                body = json.dumps(tr).encode()
+            elif "format=perfetto" in query:
+                # Chrome trace_event JSON: load the body in
+                # chrome://tracing or ui.perfetto.dev (see
+                # tools/trace_dump.py)
+                body = json.dumps(to_perfetto(tr)).encode()
+            else:
+                out = {k: tr[k] for k in
+                       ("trace_id", "root", "route", "duration_ms",
+                        "status", "reason", "captured_at", "n_spans")}
+                out["tree"] = span_tree(tr)
+                body = json.dumps(out).encode()
+            return 200, body, "application/json", ()
+        if path != "/status":
+            return None
+        with self._commit_lock:
+            status = {
+                "n_requests": self.n_requests,
+                "n_batches": self.n_batches,
+                "n_replayed": self.n_replayed,
+                "n_journal_evicted": self.n_journal_evicted,
+                "n_window_missed": self.n_window_missed,
+                "n_shed": self.n_shed,
+                "n_deadline_expired": self.n_deadline_expired,
+                "queue_depth": self.backlog(),
+                "max_queue": self.max_queue,
+                "draining": self._draining.is_set(),
+                "journal_entries": len(self._journal),
+                "journal_size": self.journal_size,
+                "journal_ttl": self.journal_ttl,
+                "journal_path": self.journal_path,
+                "journal_recovered": self.n_journal_recovered,
+            }
+        return 200, json.dumps(status).encode(), "application/json", ()
+
+    def _admit(self, payload: Any, rid: Optional[str],
+               deadline: Optional[Deadline], tid: str
+               ) -> Tuple[str, Optional[_PendingRequest],
+                          Optional[tuple], bool]:
+        """Ingress admission, shared by both frontends: journal replay,
+        in-flight join, overload shedding, and the dead-on-arrival
+        deadline check. Returns ``(kind, pending, committed_entry,
+        window_missed)`` with kind one of:
+
+        * ``"replay"`` — the rid's reply is already committed
+          (``committed_entry`` is the journal tuple);
+        * ``"join"``   — the rid is in flight: wait on / watch
+          ``pending`` without enqueuing a second compute;
+        * ``"shed"``   — overloaded, refuse with 429;
+        * ``"doa"``    — the deadline was spent before admission:
+          ``pending`` is already resolved with its 504;
+        * ``"enqueue"`` — ``pending`` is fresh; the caller enqueues it
+          (:meth:`_enqueue`) and awaits resolution.
+        """
+        window_missed = False
+        if rid:
+            with self._commit_lock:
+                self._reap_expired_locked()
+                committed = self._journal.get(rid)
+                pending = (self._inflight.get(rid)
+                           if committed is None else None)
+                if committed is not None:
+                    self.n_replayed += 1
+                    return "replay", None, committed, False
+                if pending is not None:
+                    return "join", pending, None, False
+                if self._overloaded():
+                    # shedding applies to NEW work only: replays and
+                    # in-flight joins above cost no inference and
+                    # always succeed
+                    self.n_shed += 1
+                    return "shed", None, None, False
+                # request ids are unique per logical request, so a rid
+                # in the evicted ring can only be a retry that outlived
+                # the replay window — detected, warned, and re-executed
+                # (the documented past-window semantics)
+                window_missed = rid in self._evicted
+                if window_missed:
+                    self.n_window_missed += 1
+                pending = _PendingRequest(payload, rid, deadline,
+                                          trace=tid)
+                self._inflight[rid] = pending
+            if window_missed:
+                logger.warning(
+                    "request id %s retried after its journal entry was "
+                    "evicted (journal_size=%d, journal_ttl=%s); "
+                    "re-executing", rid, self.journal_size,
+                    self.journal_ttl)
+        else:
+            if self._overloaded():
+                with self._commit_lock:
+                    self.n_shed += 1
+                return "shed", None, None, False
+            pending = _PendingRequest(payload, deadline=deadline,
+                                      trace=tid)
+        if deadline is not None and deadline.expired:
+            # dead on arrival: the client's budget is already spent —
+            # never enqueue work nobody will read. The pending is
+            # resolved (status + event) BEFORE it leaves _inflight, so
+            # a duplicate that joined it in the window between the two
+            # locked sections is released immediately instead of
+            # blocking until request_timeout
+            pending.status = 504
+            pending.reply = b'{"error": "deadline exceeded"}'
+            with self._stats_lock:
+                self.n_deadline_expired += 1
+            with self._commit_lock:
+                self._inflight.pop(pending.rid, None)
+            self._release(pending)
+            return "doa", pending, None, window_missed
+        return "enqueue", pending, None, window_missed
+
+    def _enqueue(self, pending: _PendingRequest, root) -> None:
+        """Hand an admitted request to the data plane. The root span
+        rides the work item across the stage threads (exactly as the
+        trace id does); ``t_enqueue`` anchors the queue_wait child
+        span."""
+        pending.span = root
+        pending.t_enqueue = self.tracer.clock.now()
+        with self._stats_lock:
+            self._n_backlog += 1
+        self._queue.put(pending)
+
+    def _release(self, p: _PendingRequest) -> None:
+        """Resolve a pending request: wake any threaded-frontend
+        handler blocked on the event AND fire any event-loop completion
+        callbacks. A callback registered concurrently with release may
+        fire twice (see :meth:`_add_waiter`); the event-loop frontend
+        drops the duplicate reply by connection generation."""
+        p.event.set()
+        for cb in p.callbacks:
+            try:
+                cb(p)
+            except Exception:  # noqa: BLE001 — one bad reply callback
+                logger.warning("reply callback failed",  # must never
+                               exc_info=True)            # strand others
+
+    def _add_waiter(self, p: _PendingRequest, cb) -> None:
+        """Watch a pending request from the event-loop frontend. Append
+        -then-check: if release already ran (or runs concurrently and
+        misses the append), the is_set check fires the callback here —
+        at worst both sides fire it, which the frontend's generation
+        guard absorbs."""
+        p.callbacks.append(cb)
+        if p.event.is_set():
+            try:
+                cb(p)
+            except Exception:  # noqa: BLE001
+                logger.warning("reply callback failed", exc_info=True)
+
+    # -- event-loop frontend protocol ----------------------------------------
+
+    def handle_request(self, method: str, path: str, headers,
+                       body: bytes, reply) -> bool:
+        """The :class:`EventLoopFrontend` application protocol (see
+        serving/frontend.py): route one framed request. GET routes
+        answer synchronously on the loop thread (they are in-memory
+        reads); POST predict replies later, from whichever stage thread
+        commits the request — ``reply`` is thread-safe and
+        duplicate-proof by design."""
+        if method == "GET":
+            route = self._get_route(path, headers)
+            if route is None:
+                return False
+            status, rbody, ctype, extra = route
+            reply(status, rbody, ctype=ctype, extra=extra)
+            return True
+        if method != "POST" or path != self.api_path:
+            return False
+        tid, parent_sid = extract_span_context(headers)
+        with trace_context(tid):
+            root = self.tracer.start("request", trace_id=tid,
+                                     remote_parent=parent_sid,
+                                     route=self.api_path)
+            if capture_hint(headers):
+                root.force = True
+            status = "error"
+            try:
+                status = self._predict_eventloop(headers, body, tid,
+                                                 root, reply)
+            finally:
+                if status is not None:
+                    # sync reject paths; async completions finish the
+                    # root in their on_done callback instead
+                    self.tracer.finish(root, status=status)
+        return True
+
+    def _predict_eventloop(self, headers, body: bytes, tid: str,
+                           root, reply) -> Optional[str]:
+        """Admission for the event-loop frontend: same decisions as the
+        threaded ``_do_predict`` (one ``_admit`` serves both), but the
+        enqueue/join paths return None and deliver via callback — no
+        thread ever blocks on a pending request."""
+        if self._draining.is_set():
+            # graceful drain: accepted work finishes, new work is
+            # refused so the orchestrator's retry lands on a live worker
+            reply(503, b'{"error": "draining"}',
+                  extra=((TRACE_HEADER, tid),
+                         ("Retry-After", str(self.shed_retry_after))))
+            return "shed"
+        try:
+            payload = json.loads(body or b"{}")
+        except ValueError:
+            # even a rejected request must echo its trace id, or the
+            # client cannot correlate the failure with worker logs
+            reply(400, b'{"error": "invalid JSON"}',
+                  extra=((TRACE_HEADER, tid),))
+            return "error"
+        deadline = Deadline.from_headers(headers, clock=self.clock)
+        rid = headers.get("X-Request-Id")
+        kind, pending, committed, window_missed = \
+            self._admit(payload, rid, deadline, tid)
+        if rid:
+            root.set_attr("rid", rid)
+        if kind == "replay":
+            root.set_attr("replayed", True)
+            reply(committed[0], committed[1],
+                  extra=((TRACE_HEADER, tid), ("X-Replayed", "1")))
+            return "ok"
+        if kind == "shed":
+            reply(429, b'{"error": "overloaded"}',
+                  extra=((TRACE_HEADER, tid),
+                         ("Retry-After", str(self.shed_retry_after))))
+            return "shed"
+        if kind == "doa":
+            reply(504, pending.reply, extra=((TRACE_HEADER, tid),))
+            return "deadline"
+
+        tracer = self.tracer
+        joined = kind == "join"
+
+        def on_done(p: _PendingRequest) -> None:
+            extra = [(TRACE_HEADER, tid)]
+            # a joined duplicate is only "replayed" if the reply was
+            # actually committed — errors are never journaled, so they
+            # must not carry the committed-replay marker
+            if joined and p.status == 200:
+                extra.append(("X-Replayed", "1"))
+            if window_missed:
+                extra.append(("X-Replay-Window-Missed", "1"))
+            reply(p.status, p.reply or b"{}", extra=tuple(extra))
+            # the root finishes HERE, with the commit-time status: if
+            # the frontend's request-timeout sweep already 504ed the
+            # connection, this reply is dropped by generation but the
+            # trace still records what actually happened. (A request
+            # whose reply never comes at all leaves its root
+            # unfinished — the threaded frontend remains the plane
+            # that tail-captures true stuck-batch timeouts.)
+            tracer.finish(root, status="ok" if p.status == 200 else
+                          "deadline" if p.status == 504 else "error")
+
+        if joined:
+            self._add_waiter(pending, on_done)
+        else:
+            self._enqueue(pending, root)
+            self._add_waiter(pending, on_done)
+        return None
 
     # -- batching loop -------------------------------------------------------
 
@@ -1251,11 +1455,12 @@ class ServingServer:
         with self._commit_lock:
             self._commit_locked(p)
             self._reap_expired_locked()
-        # the commit child span must hit the recorder BEFORE the event
-        # releases the handler thread — the handler finishes the ROOT
-        # on wake, and capture only gathers spans already recorded
+        # the commit child span must hit the recorder BEFORE the
+        # release — waiters finish the ROOT on wake (threaded handler
+        # thread or event-loop callback), and capture only gathers
+        # spans already recorded
         self._add_spans([p], "commit", t0, self.tracer.clock.now())
-        p.event.set()
+        self._release(p)
 
     def _commit_many(self, ps: List[_PendingRequest]) -> None:
         """Batch commit: one lock acquisition and one TTL reap for the
@@ -1269,10 +1474,10 @@ class ServingServer:
             for p in ps:
                 self._commit_locked(p)
             self._reap_expired_locked()
-        # record commit children before ANY event fires (see _commit)
+        # record commit children before ANY release fires (see _commit)
         self._add_spans(ps, "commit", t0, self.tracer.clock.now())
         for p in ps:
-            p.event.set()
+            self._release(p)
 
     # -- pipeline loops ------------------------------------------------------
 
@@ -1400,12 +1605,17 @@ class ServingServer:
     # -- lifecycle -----------------------------------------------------------
 
     def start(self) -> "ServingServer":
-        t_http = threading.Thread(target=self._server.serve_forever,
-                                  daemon=True)
+        self._threads = []
+        if self._frontend is not None:
+            self._frontend.start()
+        else:
+            t_http = threading.Thread(target=self._server.serve_forever,
+                                      daemon=True)
+            t_http.start()
+            self._threads.append(t_http)
         t_batch = threading.Thread(target=self._batch_loop, daemon=True)
-        t_http.start()
         t_batch.start()
-        self._threads = [t_http, t_batch]
+        self._threads.append(t_batch)
         self._stage_threads = [t_batch]
         if self.pipeline:
             t_exec = threading.Thread(target=self._executor_loop,
@@ -1445,8 +1655,15 @@ class ServingServer:
                     (self.backlog() > 0 or self._active_batches > 0):
                 time.sleep(0.005)
         self._stop.set()
-        self._server.shutdown()
-        self._server.server_close()
+        if self._frontend is None:
+            self._server.shutdown()
+            self._server.server_close()
+        else:
+            # stop taking NEW connections now (established keep-alive
+            # connections keep being served so in-flight replies land);
+            # the loops themselves stop below, after the pipeline flush
+            # has posted every reply that will ever exist
+            self._frontend.pause_accept()
         for t in self._threads:
             t.join(timeout=5)
         if any(t.is_alive() for t in getattr(self, "_stage_threads", [])):
@@ -1461,6 +1678,10 @@ class ServingServer:
                 "at request_timeout)")
         else:
             self._flush_pipeline()
+        if self._frontend is not None:
+            # everything that will ever call reply() has run: the loops
+            # deliver what's queued, flush pending writes, close fds
+            self._frontend.stop()
         if self._journal_fh is not None:
             jt = getattr(self, "_journal_thread", None)
             if jt is not None and jt.is_alive():
@@ -1501,7 +1722,8 @@ class ServingCoordinator:
 
     def __init__(self, host: str = "127.0.0.1", port: int = 0,
                  stale_after: Optional[float] = None,
-                 tracer=None):
+                 tracer=None, frontend: str = "eventloop",
+                 acceptors: int = 1, reuse_port: bool = False):
         # stale_after: drop workers not re-registered within this many
         # seconds — workers heartbeat (`python -m mmlspark_tpu.serving
         # worker` re-registers every REGISTER_INTERVAL), so dead pods
@@ -1525,98 +1747,23 @@ class ServingCoordinator:
 
         class Handler(BaseHTTPRequestHandler):
             def do_POST(self):
-                if self.path not in ("/register", "/deregister"):
+                length = int(self.headers.get("Content-Length", 0))
+                routed = coordinator._post_route(
+                    self.path, self.rfile.read(length))
+                if routed is None:
                     self.send_error(404)
                     return
-                length = int(self.headers.get("Content-Length", 0))
-                try:
-                    info = json.loads(self.rfile.read(length))
-                except ValueError:
-                    self.send_error(400, "invalid JSON")
-                    return
-                key = (info.get("host"), info.get("port"))
-                with coordinator._lock:
-                    if self.path == "/register":
-                        # idempotent: a re-registering worker (periodic
-                        # heartbeat, or after a coordinator restart)
-                        # replaces its old entry instead of duplicating
-                        coordinator._services = [
-                            s for s in coordinator._services
-                            if (s.get("host"), s.get("port")) != key]
-                        coordinator._services.append(info)
-                        coordinator._seen[key] = time.monotonic()
-                    else:
-                        coordinator._services = [
-                            s for s in coordinator._services
-                            if (s.get("host"), s.get("port")) != key]
-                        coordinator._seen.pop(key, None)
-                self.send_response(200)
-                self.send_header("Content-Length", "2")
-                self.end_headers()
-                self.wfile.write(b"{}")
+                self._send(*routed)
 
             def do_GET(self):
-                if self.path == "/fleet":
-                    # one-stop fleet observability: polls every live
-                    # worker's /stats + /metrics and serves the merged
-                    # view (slowest stage, widest bucket, totals)
-                    body = json.dumps(coordinator.fleet_stats()).encode()
-                    ctype = "application/json"
-                elif self.path == "/fleet/metrics":
-                    body = coordinator.fleet_metrics().encode()
-                    ctype = _METRICS_CONTENT_TYPE
-                elif self.path == "/fleet/traces":
-                    # every worker's retained slow/error captures in
-                    # one listing (concurrent polls; a dead worker
-                    # degrades to an error entry, never a 5xx here)
-                    body = json.dumps(
-                        coordinator.fleet_traces()).encode()
-                    ctype = "application/json"
-                elif self.path.startswith("/fleet/trace/"):
-                    raw, _, query = \
-                        self.path[len("/fleet/trace/"):].partition("?")
-                    # same charset as trace ids: the id is spliced into
-                    # per-worker URLs and must not smuggle a path/query
-                    tid = "".join(ch for ch in raw[:128]
-                                  if ch.isalnum() or ch in "._-")
-                    merged, errors = coordinator.fleet_trace(tid)
-                    if merged is None:
-                        body = json.dumps(
-                            {"error": "trace not retained by any "
-                                      "worker (fast + ok traces are "
-                                      "tail-dropped)",
-                             "trace_id": tid,
-                             "workers_failed": errors}).encode()
-                        self.send_response(404)
-                        self.send_header("Content-Type",
-                                         "application/json")
-                        self.send_header("Content-Length",
-                                         str(len(body)))
-                        self.end_headers()
-                        self.wfile.write(body)
-                        return
-                    if "format=perfetto" in query:
-                        # per-worker lanes: each process renders as its
-                        # own pid with named process_name metadata
-                        body = json.dumps(to_perfetto(merged)).encode()
-                    else:
-                        out = {k: merged[k] for k in
-                               ("trace_id", "root", "route",
-                                "duration_ms", "status", "reason",
-                                "captured_at", "n_spans", "workers")}
-                        out["tree"] = span_tree(merged)
-                        out["workers_failed"] = errors
-                        body = json.dumps(out).encode()
-                    ctype = "application/json"
-                elif self.path == "/services":
-                    with coordinator._lock:
-                        coordinator._prune_stale_locked()
-                        body = json.dumps(coordinator._services).encode()
-                    ctype = "application/json"
-                else:
+                routed = coordinator._route(self.path)
+                if routed is None:
                     self.send_error(404)
                     return
-                self.send_response(200)
+                self._send(*routed)
+
+            def _send(self, status, body, ctype):
+                self.send_response(status)
                 self.send_header("Content-Type", ctype)
                 self.send_header("Content-Length", str(len(body)))
                 self.end_headers()
@@ -1625,17 +1772,147 @@ class ServingCoordinator:
             def log_message(self, *args):
                 pass
 
-        self._server = _Server((host, port), Handler)
-        self.host, self.port = self._server.server_address[:2]
+        # the coordinator rides the same socket edge as the workers:
+        # fleet dashboards poll /fleet every few seconds, and with the
+        # event-loop frontend (the default) those pollers hold ONE
+        # keep-alive connection instead of a fresh handshake per scrape.
+        # ``frontend="threaded"`` keeps the http.server plane selectable,
+        # mirroring ServingServer's A/B switch.
+        self.frontend = str(frontend)
         self._thread: Optional[threading.Thread] = None
+        if self.frontend == "eventloop":
+            self._server = None
+            self._frontend: Optional[EventLoopFrontend] = \
+                EventLoopFrontend(self, host, port,
+                                  acceptors=acceptors,
+                                  reuse_port=reuse_port,
+                                  name="coordinator")
+            self.host, self.port = (self._frontend.host,
+                                    self._frontend.port)
+        elif self.frontend == "threaded":
+            self._frontend = None
+            self._server = _Server((host, port), Handler)
+            self.host, self.port = self._server.server_address[:2]
+        else:
+            raise ValueError(
+                f"unknown frontend {frontend!r} "
+                "(expected 'eventloop' or 'threaded')")
+
+    # -- route table (both frontends serve exactly this) ---------------------
+
+    def _post_route(self, path: str, body: bytes
+                    ) -> Optional[Tuple[int, bytes, str]]:
+        if path not in ("/register", "/deregister"):
+            return None
+        try:
+            info = json.loads(body)
+        except ValueError:
+            return 400, b'{"error": "invalid JSON"}', "application/json"
+        key = (info.get("host"), info.get("port"))
+        with self._lock:
+            if path == "/register":
+                # idempotent: a re-registering worker (periodic
+                # heartbeat, or after a coordinator restart) replaces
+                # its old entry instead of duplicating
+                self._services = [
+                    s for s in self._services
+                    if (s.get("host"), s.get("port")) != key]
+                self._services.append(info)
+                self._seen[key] = time.monotonic()
+            else:
+                self._services = [
+                    s for s in self._services
+                    if (s.get("host"), s.get("port")) != key]
+                self._seen.pop(key, None)
+        return 200, b"{}", "application/json"
+
+    def _route(self, path: str) -> Optional[Tuple[int, bytes, str]]:
+        if path == "/fleet":
+            # one-stop fleet observability: polls every live worker's
+            # /stats + /metrics and serves the merged view (slowest
+            # stage, widest bucket, totals)
+            return (200, json.dumps(self.fleet_stats()).encode(),
+                    "application/json")
+        if path == "/fleet/metrics":
+            return (200, self.fleet_metrics().encode(),
+                    _METRICS_CONTENT_TYPE)
+        if path == "/fleet/traces":
+            # every worker's retained slow/error captures in one
+            # listing (concurrent polls; a dead worker degrades to an
+            # error entry, never a 5xx here)
+            return (200, json.dumps(self.fleet_traces()).encode(),
+                    "application/json")
+        if path.startswith("/fleet/trace/"):
+            raw, _, query = path[len("/fleet/trace/"):].partition("?")
+            # same charset as trace ids: the id is spliced into
+            # per-worker URLs and must not smuggle a path/query
+            tid = "".join(ch for ch in raw[:128]
+                          if ch.isalnum() or ch in "._-")
+            merged, errors = self.fleet_trace(tid)
+            if merged is None:
+                body = json.dumps(
+                    {"error": "trace not retained by any worker "
+                              "(fast + ok traces are tail-dropped)",
+                     "trace_id": tid,
+                     "workers_failed": errors}).encode()
+                return 404, body, "application/json"
+            if "format=perfetto" in query:
+                # per-worker lanes: each process renders as its own
+                # pid with named process_name metadata
+                body = json.dumps(to_perfetto(merged)).encode()
+            else:
+                out = {k: merged[k] for k in
+                       ("trace_id", "root", "route", "duration_ms",
+                        "status", "reason", "captured_at", "n_spans",
+                        "workers")}
+                out["tree"] = span_tree(merged)
+                out["workers_failed"] = errors
+                body = json.dumps(out).encode()
+            return 200, body, "application/json"
+        if path == "/services":
+            with self._lock:
+                self._prune_stale_locked()
+                body = json.dumps(self._services).encode()
+            return 200, body, "application/json"
+        return None
+
+    # -- event-loop frontend protocol ----------------------------------------
+
+    def handle_request(self, method: str, path: str, headers,
+                       body: bytes, reply) -> bool:
+        """The :class:`EventLoopFrontend` application protocol. Every
+        coordinator route answers synchronously — registry mutations
+        are in-memory, and the fleet polls run on the loop thread (the
+        coordinator is a control-plane process; a multi-second fleet
+        poll stalling its own accept loop is the same behavior the
+        single-threaded pollers already observe)."""
+        if method == "POST":
+            routed = self._post_route(path, body)
+        elif method == "GET":
+            routed = self._route(path)
+        else:
+            return False
+        if routed is None:
+            return False
+        status, rbody, ctype = routed
+        reply(status, rbody, ctype=ctype)
+        return True
+
+    # -- lifecycle -----------------------------------------------------------
 
     def start(self) -> "ServingCoordinator":
+        if self._frontend is not None:
+            self._frontend.start()
+            return self
         self._thread = threading.Thread(target=self._server.serve_forever,
                                         daemon=True)
         self._thread.start()
         return self
 
     def stop(self):
+        if self._frontend is not None:
+            self._frontend.stop()
+            return
         self._server.shutdown()
         self._server.server_close()
         if self._thread:
@@ -1932,6 +2209,12 @@ class ServingClient:
         self._workers: List[str] = []
         self._dead: set = set()
         self._rr = 0
+        # one pooled session: every attempt rides a kept-alive
+        # connection to its worker (urllib3's pool is thread-safe, so
+        # concurrent predict() calls share it) — against an event-loop
+        # worker each burst costs one handshake, not one per request
+        import requests as _requests
+        self._http = _requests.Session()
         self.refresh()
 
     def refresh(self) -> List[str]:
@@ -2023,9 +2306,9 @@ class ServingClient:
                 headers[PARENT_SPAN_HEADER] = \
                     format_span_id(att.span_id)
                 try:
-                    r = requests.post(url, json=payload,
-                                      timeout=self.timeout,
-                                      headers=headers)
+                    r = self._http.post(url, json=payload,
+                                        timeout=self.timeout,
+                                        headers=headers)
                 except requests.ConnectionError as e:
                     tracer.finish(att, status="error")
                     last_err = e

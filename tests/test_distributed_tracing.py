@@ -419,7 +419,7 @@ class TestFleetTraceE2E:
                 raise requests.exceptions.ChunkedEncodingError(
                     "connection broken mid-body")
 
-            monkeypatch.setattr(requests, "post", explode)
+            monkeypatch.setattr(client._http, "post", explode)
             with pytest.raises(
                     requests.exceptions.ChunkedEncodingError):
                 client.predict({"x": 1.0})
@@ -455,7 +455,7 @@ class TestFleetTraceE2E:
                 def raise_for_status(self):
                     raise requests.HTTPError("404 from fake")
 
-            monkeypatch.setattr(requests, "post",
+            monkeypatch.setattr(client._http, "post",
                                 lambda *a, **kw: NotFound())
             with pytest.raises(requests.HTTPError):
                 client.predict({"x": 1.0})

@@ -1,0 +1,701 @@
+"""The event-loop socket edge: HTTP/1.1 framing, keep-alive lifecycle,
+and serving-semantics parity (ISSUE 6).
+
+Four pillars:
+
+* **framing edges** — the state machine must survive exactly the
+  byte-stream shapes ``http.server`` never showed it: headers split at
+  arbitrary boundaries, oversized header blocks (431), bodies with
+  missing/invalid/oversized Content-Length (411/400/413), chunked
+  uploads (501), ``Connection: close``, and stray pipelined bytes;
+* **connection lifecycle** — keep-alive reuse is the steady state,
+  idle and slow-loris connections are reaped on the sweep clock, and a
+  graceful drain finishes in-flight keep-alive requests;
+* **serving parity** — journal/replay, 429 shedding, deadline
+  rejection, and trace-context adoption behave identically behind the
+  new edge (the broad suites already run on ``frontend="eventloop"``
+  by default; the tests here pin the wire-visible details);
+* **satellites** — the ``X-Capture`` force-capture wire hint and
+  ``MetricsPusher`` rotating auth headers.
+
+Raw-socket tests talk bytes on purpose: the stdlib client would paper
+over the exact framing shapes under test.
+"""
+
+import json
+import socket
+import threading
+import time
+
+import numpy as np
+import pytest
+import requests
+
+from mmlspark_tpu.core.stage import Transformer
+from mmlspark_tpu.core.tracing import (
+    Tracer, capture_hint, inject_span_context,
+)
+from mmlspark_tpu.serving import ServingServer
+from mmlspark_tpu.serving.frontend import (
+    EventLoopFrontend, build_head, parse_head,
+)
+
+
+class Doubler(Transformer):
+    def transform(self, df):
+        return df.with_column(
+            "y", np.asarray(df["x"], dtype=np.float64) * 2)
+
+
+class SlowDoubler(Doubler):
+    def __init__(self, delay=0.2, **kw):
+        super().__init__(**kw)
+        self.delay = delay
+
+    def transform(self, df):
+        time.sleep(self.delay)
+        return super().transform(df)
+
+
+def _server(model=None, **kw):
+    kw.setdefault("max_batch_size", 8)
+    kw.setdefault("max_latency_ms", 2)
+    return ServingServer(model or Doubler(), **kw).start()
+
+
+def _connect(srv, timeout=10.0):
+    s = socket.create_connection((srv.host, srv.port), timeout=timeout)
+    s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+    return s
+
+
+def _request_bytes(path="/predict", body=b'{"x": 1.0}', headers=()):
+    head = [f"POST {path} HTTP/1.1", "Host: t",
+            "Content-Type: application/json",
+            f"Content-Length: {len(body)}"]
+    head += [f"{k}: {v}" for k, v in headers]
+    return ("\r\n".join(head) + "\r\n\r\n").encode() + body
+
+
+def _read_response(sock):
+    """One full response off the socket: (status, headers dict, body)."""
+    buf = bytearray()
+    while b"\r\n\r\n" not in buf:
+        chunk = sock.recv(65536)
+        if not chunk:
+            raise ConnectionError(f"EOF mid-head: {bytes(buf)!r}")
+        buf += chunk
+    he = buf.index(b"\r\n\r\n")
+    head = bytes(buf[:he]).decode("latin-1").split("\r\n")
+    status = int(head[0].split()[1])
+    hdrs = {}
+    for line in head[1:]:
+        k, _, v = line.partition(":")
+        hdrs[k.strip().lower()] = v.strip()
+    clen = int(hdrs.get("content-length", 0))
+    body = buf[he + 4:]
+    while len(body) < clen:
+        chunk = sock.recv(65536)
+        if not chunk:
+            raise ConnectionError("EOF mid-body")
+        body += chunk
+    rest = bytes(body[clen:])
+    return status, hdrs, bytes(body[:clen]), rest
+
+
+# ---------------------------------------------------------------------------
+# Framing units
+# ---------------------------------------------------------------------------
+
+class TestParseHead:
+
+    def test_basic(self):
+        raw = bytearray(b"POST /p HTTP/1.1\r\nHost: h\r\n"
+                        b"X-Trace-Id: abc\r\n")
+        method, path, version, h = parse_head(raw, len(raw))
+        assert (method, path, version) == (b"POST", "/p", b"HTTP/1.1")
+        assert h.get("x-trace-id") == "abc"          # case-insensitive
+        assert h.get("X-Trace-Id") == "abc"
+        assert h.get("missing") is None
+        assert h.get("missing", "d") == "d"
+        assert "HOST" in h
+
+    def test_value_whitespace_and_empty(self):
+        raw = bytearray(b"GET / HTTP/1.1\r\nA:   padded\r\nB:\r\n")
+        _, _, _, h = parse_head(raw, len(raw))
+        assert h.get("a") == "padded"
+        assert h.get("b") == ""
+
+    def test_malformed_request_line_raises(self):
+        raw = bytearray(b"NONSENSE\r\nHost: h\r\n")
+        with pytest.raises(ValueError):
+            parse_head(raw, len(raw))
+
+    def test_build_head_cached_blocks(self):
+        h = build_head(200, 10)
+        assert h.startswith(b"HTTP/1.1 200 OK\r\n")
+        assert b"Content-Length: 10\r\n" in h
+        assert b"Date: " in h
+        assert h.endswith(b"\r\n\r\n")
+        # >1024 bodies leave the interned Content-Length cache
+        assert b"Content-Length: 5000\r\n" in build_head(200, 5000)
+        assert b"Connection: close\r\n" in build_head(200, 1, close=True)
+        assert b"Retry-After: 1\r\n" in build_head(
+            429, 1, extra=(("Retry-After", "1"),))
+
+
+# ---------------------------------------------------------------------------
+# Framing edges on the wire
+# ---------------------------------------------------------------------------
+
+class TestFramingEdges:
+
+    def test_split_at_every_boundary(self):
+        """The whole request dribbled in two fragments, split at EVERY
+        byte boundary (headers mid-name, mid-CRLF, body mid-JSON):
+        framing must be agnostic to how TCP fragments the stream."""
+        with _server() as srv:
+            raw = _request_bytes(body=b'{"x": 3.0}')
+            sock = _connect(srv)
+            try:
+                for cut in range(1, len(raw), 7):
+                    sock.sendall(raw[:cut])
+                    time.sleep(0.001)
+                    sock.sendall(raw[cut:])
+                    status, _, body, rest = _read_response(sock)
+                    assert status == 200
+                    assert json.loads(body) == {"y": 6.0}
+                    assert rest == b""
+            finally:
+                sock.close()
+
+    def test_pipelined_requests_served_in_order(self):
+        """Two complete requests in ONE send: both answered, in order,
+        on the same connection (no read event for the second)."""
+        with _server() as srv:
+            two = (_request_bytes(body=b'{"x": 1.0}')
+                   + _request_bytes(body=b'{"x": 2.0}'))
+            sock = _connect(srv)
+            try:
+                sock.sendall(two)
+                status1, _, body1, _ = _read_response(sock)
+                status2, _, body2, _ = _read_response(sock)
+                assert (status1, status2) == (200, 200)
+                assert json.loads(body1) == {"y": 2.0}
+                assert json.loads(body2) == {"y": 4.0}
+            finally:
+                sock.close()
+
+    def test_oversized_headers_rejected_431(self):
+        with _server() as srv:
+            fe = srv._frontend
+            sock = _connect(srv)
+            try:
+                filler = b"X-Pad: " + b"a" * fe.max_header_bytes
+                sock.sendall(b"POST /predict HTTP/1.1\r\n" + filler)
+                status, hdrs, _, _ = _read_response(sock)
+                assert status == 431
+                assert hdrs.get("connection") == "close"
+                assert sock.recv(65536) == b""    # server closed
+            finally:
+                sock.close()
+            assert fe.n_parse_errors >= 1
+
+    def test_oversized_headers_in_one_send_rejected_431(self):
+        """The whole oversized block — terminator included — landing in
+        a single recv must still 431: finding CRLFCRLF does not make an
+        over-limit header block admissible."""
+        with _server() as srv:
+            fe = srv._frontend
+            fe.max_header_bytes = 1024
+            sock = _connect(srv)
+            try:
+                sock.sendall(_request_bytes(
+                    headers=(("X-Pad", "a" * 4096),)))
+                status, hdrs, _, _ = _read_response(sock)
+                assert status == 431
+                assert hdrs.get("connection") == "close"
+                assert sock.recv(65536) == b""    # server closed
+            finally:
+                sock.close()
+            assert fe.n_parse_errors >= 1
+
+    def test_missing_content_length_411(self):
+        with _server() as srv:
+            sock = _connect(srv)
+            try:
+                sock.sendall(b"POST /predict HTTP/1.1\r\nHost: t\r\n\r\n")
+                status, _, _, _ = _read_response(sock)
+                assert status == 411
+            finally:
+                sock.close()
+
+    def test_invalid_content_length_400(self):
+        with _server() as srv:
+            sock = _connect(srv)
+            try:
+                sock.sendall(_request_bytes(
+                    headers=()).replace(b"Content-Length: 10",
+                                        b"Content-Length: ten"))
+                status, _, _, _ = _read_response(sock)
+                assert status == 400
+            finally:
+                sock.close()
+
+    def test_oversized_body_rejected_413(self):
+        with _server() as srv:
+            srv._frontend.max_body_bytes = 1024
+            sock = _connect(srv)
+            try:
+                head = (b"POST /predict HTTP/1.1\r\nHost: t\r\n"
+                        b"Content-Length: 4096\r\n\r\n")
+                sock.sendall(head)
+                status, _, _, _ = _read_response(sock)
+                assert status == 413
+            finally:
+                sock.close()
+
+    def test_chunked_transfer_encoding_501(self):
+        with _server() as srv:
+            sock = _connect(srv)
+            try:
+                sock.sendall(b"POST /predict HTTP/1.1\r\nHost: t\r\n"
+                             b"Transfer-Encoding: chunked\r\n\r\n"
+                             b"0\r\n\r\n")
+                status, _, _, _ = _read_response(sock)
+                assert status == 501
+            finally:
+                sock.close()
+
+    def test_malformed_request_line_400(self):
+        with _server() as srv:
+            sock = _connect(srv)
+            try:
+                sock.sendall(b"garbage\r\n\r\n")
+                status, _, _, _ = _read_response(sock)
+                assert status == 400
+            finally:
+                sock.close()
+
+    def test_connection_close_honored(self):
+        with _server() as srv:
+            sock = _connect(srv)
+            try:
+                sock.sendall(_request_bytes(
+                    headers=(("Connection", "close"),)))
+                status, hdrs, body, _ = _read_response(sock)
+                assert status == 200
+                assert json.loads(body) == {"y": 2.0}
+                assert hdrs.get("connection") == "close"
+                assert sock.recv(65536) == b""
+            finally:
+                sock.close()
+
+    def test_http10_defaults_to_close(self):
+        with _server() as srv:
+            sock = _connect(srv)
+            try:
+                body = b'{"x": 1.0}'
+                sock.sendall(b"POST /predict HTTP/1.0\r\nHost: t\r\n"
+                             b"Content-Length: %d\r\n\r\n%b"
+                             % (len(body), body))
+                status, _, rbody, _ = _read_response(sock)
+                assert status == 200
+                assert json.loads(rbody) == {"y": 2.0}
+                assert sock.recv(65536) == b""
+            finally:
+                sock.close()
+
+    def test_unknown_route_404_keeps_connection(self):
+        with _server() as srv:
+            sock = _connect(srv)
+            try:
+                sock.sendall(_request_bytes(path="/nope"))
+                status, _, _, _ = _read_response(sock)
+                assert status == 404
+                # framing intact: the connection survives a 404 and
+                # serves the next request
+                sock.sendall(_request_bytes())
+                status, _, body, _ = _read_response(sock)
+                assert status == 200
+                assert json.loads(body) == {"y": 2.0}
+            finally:
+                sock.close()
+
+
+# ---------------------------------------------------------------------------
+# Connection lifecycle
+# ---------------------------------------------------------------------------
+
+def wait_until(cond, timeout=8.0, what="condition"):
+    t_end = time.monotonic() + timeout
+    while time.monotonic() < t_end:
+        if cond():
+            return
+        time.sleep(0.01)
+    raise AssertionError(f"timed out waiting for {what}")
+
+
+class TestConnectionLifecycle:
+
+    def test_keepalive_reuse_counters(self):
+        with _server() as srv:
+            fe = srv._frontend
+            sock = _connect(srv)
+            try:
+                for i in range(20):
+                    sock.sendall(_request_bytes(
+                        body=json.dumps({"x": float(i)}).encode()))
+                    status, _, body, _ = _read_response(sock)
+                    assert status == 200
+                    assert json.loads(body) == {"y": 2.0 * i}
+            finally:
+                sock.close()
+            assert fe.n_keepalive_reuses >= 19
+            stats = fe.stats()
+            assert stats["keepalive_reuse_rate"] > 0.9
+            assert stats["kind"] == "eventloop"
+
+    def test_idle_connection_reaped(self):
+        with _server(idle_timeout=0.3) as srv:
+            fe = srv._frontend
+            sock = _connect(srv)
+            try:
+                sock.sendall(_request_bytes())
+                status, _, _, _ = _read_response(sock)
+                assert status == 200
+                # park idle: the sweep must close it from the server
+                # side within the idle budget (plus sweep cadence)
+                sock.settimeout(5)
+                assert sock.recv(65536) == b""
+            finally:
+                sock.close()
+            wait_until(lambda: fe.n_idle_reaped >= 1,
+                       what="idle reap counter")
+
+    def test_slow_loris_reaped_mid_request(self):
+        """Bytes dribbling in keep the socket non-idle; the reap clock
+        for a mid-request stall is the REQUEST's age."""
+        with _server(idle_timeout=0.4) as srv:
+            fe = srv._frontend
+            raw = _request_bytes()
+            sock = _connect(srv)
+            closed = False
+            try:
+                sock.settimeout(10)
+                t_end = time.monotonic() + 6.0
+                try:
+                    for i in range(len(raw)):
+                        if time.monotonic() > t_end:
+                            break
+                        sock.sendall(raw[i:i + 1])
+                        time.sleep(0.05)
+                    # the server must have hung up mid-dribble
+                    closed = sock.recv(65536) == b""
+                except OSError:
+                    closed = True
+            finally:
+                sock.close()
+            assert closed
+            assert fe.n_idle_reaped >= 1
+
+    def test_followup_during_inflight_ages_from_reply(self):
+        """Bytes of request B arriving while A is still awaiting its
+        reply must age from A's reply, not from A's first byte — a
+        well-behaved keep-alive client is not a slow loris just because
+        the previous dispatch was slow."""
+        with _server(model=SlowDoubler(delay=0.5),
+                     idle_timeout=0.4) as srv:
+            raw_b = _request_bytes(body=b'{"x": 3.0}')
+            split = len(raw_b) // 2
+            sock = _connect(srv)
+            try:
+                sock.settimeout(10)
+                sock.sendall(_request_bytes(body=b'{"x": 2.0}'))
+                time.sleep(0.1)               # A is mid-dispatch
+                sock.sendall(raw_b[:split])   # B starts while A awaits
+                status, _, body, _ = _read_response(sock)
+                assert status == 200
+                assert json.loads(body) == {"y": 4.0}
+                # sit across a few sweep ticks (but inside B's own idle
+                # budget): a stale reap clock would close the socket here
+                time.sleep(0.15)
+                sock.sendall(raw_b[split:])
+                status, _, body, _ = _read_response(sock)
+                assert status == 200
+                assert json.loads(body) == {"y": 6.0}
+            finally:
+                sock.close()
+
+    def test_graceful_drain_finishes_inflight_keepalive(self):
+        """stop(drain=True) while a keep-alive request is in flight:
+        the reply lands on the open connection before the loops die."""
+        with ServingServer(SlowDoubler(delay=0.3), max_batch_size=8,
+                           max_latency_ms=1) as srv:
+            sock = _connect(srv)
+            try:
+                sock.sendall(_request_bytes(body=b'{"x": 5.0}'))
+                time.sleep(0.1)          # request is mid-dispatch
+                t = threading.Thread(target=srv.stop,
+                                     kwargs={"drain_timeout": 10.0})
+                t.start()
+                status, _, body, _ = _read_response(sock)
+                assert status == 200
+                assert json.loads(body) == {"y": 10.0}
+                t.join(timeout=10)
+                assert not t.is_alive()
+            finally:
+                sock.close()
+
+    def test_drain_refuses_new_work_503(self):
+        with _server() as srv:
+            srv._draining.set()
+            r = requests.post(srv.address, json={"x": 1.0}, timeout=10)
+            assert r.status_code == 503
+            assert "Retry-After" in r.headers
+            srv._draining.clear()
+
+    @pytest.mark.skipif(not hasattr(socket, "SO_REUSEPORT"),
+                        reason="no SO_REUSEPORT on this platform")
+    def test_reuseport_acceptors_share_port(self):
+        with ServingServer(Doubler(), max_batch_size=8,
+                           max_latency_ms=1, acceptors=2,
+                           reuse_port=True) as srv:
+            assert len(srv._frontend._loops) == 2
+            out = set()
+            for i in range(16):
+                r = requests.post(srv.address, json={"x": float(i)},
+                                  timeout=10)
+                assert r.status_code == 200
+                out.add(r.json()["y"])
+            assert out == {2.0 * i for i in range(16)}
+            assert srv._frontend.stats()["acceptors"] == 2
+
+    def test_acceptors_without_reuseport_rejected(self):
+        with pytest.raises(ValueError, match="reuse_port"):
+            EventLoopFrontend(None, acceptors=2, reuse_port=False)
+
+
+# ---------------------------------------------------------------------------
+# Serving-semantics parity behind the new edge
+# ---------------------------------------------------------------------------
+
+class TestServingParity:
+
+    def test_journal_replay_on_keepalive_connection(self):
+        calls = []
+
+        class Counting(Doubler):
+            def transform(self, df):
+                calls.append(df.num_rows)
+                return super().transform(df)
+
+        with _server(Counting()) as srv:
+            sock = _connect(srv)
+            try:
+                for _ in range(3):   # original + 2 replays, one conn
+                    sock.sendall(_request_bytes(
+                        body=b'{"x": 4.0}',
+                        headers=(("X-Request-Id", "rid-ka-1"),)))
+                    status, hdrs, body, _ = _read_response(sock)
+                    assert status == 200
+                    assert json.loads(body) == {"y": 8.0}
+                replayed = hdrs.get("x-replayed")
+            finally:
+                sock.close()
+            assert replayed == "1"
+            assert sum(calls) == 1          # one compute, two replays
+            assert srv.n_replayed == 2
+
+    def test_shed_429_with_retry_after(self):
+        with ServingServer(SlowDoubler(delay=0.5), max_batch_size=1,
+                           max_latency_ms=1, max_queue=1,
+                           shed_retry_after=0.7) as srv:
+            statuses = []
+
+            def hit():
+                r = requests.post(srv.address, json={"x": 1.0},
+                                  timeout=10)
+                statuses.append((r.status_code, r.headers))
+
+            threads = [threading.Thread(target=hit) for _ in range(8)]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+            shed = [(s, h) for s, h in statuses if s == 429]
+            assert shed, f"expected 429s, got {[s for s, _ in statuses]}"
+            assert all(h.get("Retry-After") == "0.7" for _, h in shed)
+            assert srv.n_shed == len(shed)
+
+    def test_deadline_rejection(self):
+        r_ok = None
+        with _server() as srv:
+            r = requests.post(srv.address, json={"x": 1.0},
+                              headers={"X-Deadline-Ms": "0"}, timeout=10)
+            assert r.status_code == 504
+            assert srv.n_deadline_expired == 1
+            r_ok = requests.post(srv.address, json={"x": 1.0},
+                                 headers={"X-Deadline-Ms": "30000"},
+                                 timeout=10)
+        assert r_ok.status_code == 200
+
+    def test_trace_context_adopted_and_echoed(self):
+        with _server(tracer=Tracer(), slow_trace_ms=0.0) as srv:
+            r = requests.post(srv.address, json={"x": 1.0},
+                              headers={"X-Trace-Id": "edge-trace-1"},
+                              timeout=10)
+            assert r.status_code == 200
+            assert r.headers.get("X-Trace-Id") == "edge-trace-1"
+            tr = requests.get(
+                f"http://{srv.host}:{srv.port}/trace/edge-trace-1",
+                timeout=10).json()
+            assert tr["trace_id"] == "edge-trace-1"
+            names = {s["name"] for s in _flatten(tr["tree"])}
+            assert "request" in names and "commit" in names
+
+    def test_invalid_json_400_echoes_trace(self):
+        with _server() as srv:
+            sock = _connect(srv)
+            try:
+                sock.sendall(_request_bytes(
+                    body=b"not json",
+                    headers=(("X-Trace-Id", "bad-json-1"),)))
+                status, hdrs, _, _ = _read_response(sock)
+                assert status == 400
+                assert hdrs.get("x-trace-id") == "bad-json-1"
+            finally:
+                sock.close()
+
+    def test_get_routes_served_by_frontend(self):
+        with _server() as srv:
+            base = f"http://{srv.host}:{srv.port}"
+            assert requests.get(f"{base}/healthz", timeout=10).json() \
+                == {"ok": True}
+            assert requests.get(f"{base}/readyz", timeout=10).json()[
+                "ready"] is True
+            stats = requests.get(f"{base}/stats", timeout=10).json()
+            assert stats["frontend"]["kind"] == "eventloop"
+            metrics = requests.get(f"{base}/metrics", timeout=10).text
+            assert "serving_open_connections" in metrics
+            assert "serving_keepalive_reuses_total" in metrics
+
+
+def _flatten(tree):
+    out = [tree]
+    for c in tree.get("children", ()):
+        out.extend(_flatten(c))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Satellites: X-Capture wire hint, MetricsPusher rotating auth
+# ---------------------------------------------------------------------------
+
+class TestCaptureHint:
+
+    def test_capture_header_forces_retention(self):
+        """slow_trace_ms=None retains errors only — yet an X-Capture: 1
+        request's trace is kept end to end."""
+        with _server(tracer=Tracer(), slow_trace_ms=None,
+                     adaptive_slow_trace=False) as srv:
+            r = requests.post(srv.address, json={"x": 1.0},
+                              headers={"X-Trace-Id": "forced-1",
+                                       "X-Capture": "1"}, timeout=10)
+            assert r.status_code == 200
+            tr = requests.get(
+                f"http://{srv.host}:{srv.port}/trace/forced-1",
+                timeout=10).json()
+            assert tr["reason"] == "forced"
+            # the unforced twin is tail-dropped as usual
+            requests.post(srv.address, json={"x": 1.0},
+                          headers={"X-Trace-Id": "unforced-1"},
+                          timeout=10)
+            missing = requests.get(
+                f"http://{srv.host}:{srv.port}/trace/unforced-1",
+                timeout=10)
+            assert missing.status_code == 404
+
+    def test_capture_hint_parsing(self):
+        assert capture_hint({"X-Capture": "1"})
+        assert not capture_hint({"X-Capture": "0"})
+        assert not capture_hint({"X-Capture": "yes"})  # boolean, not knob
+        assert not capture_hint({})
+        assert not capture_hint(None)
+
+    def test_forced_span_propagates_hint_on_egress(self):
+        tracer = Tracer()
+        root = tracer.start("request", trace_id="t-forced")
+        root.force = True
+        child = tracer.start("http_egress", parent=root)
+        assert child.force                      # inherits parent's flag
+        out = inject_span_context({"A": "b"}, child)
+        assert out["X-Capture"] == "1"
+        # an unforced span adds nothing
+        plain = tracer.start("http_egress",
+                             trace_id="t-plain")
+        assert "X-Capture" not in inject_span_context({}, plain)
+        # a caller-supplied hint wins (never duplicated)
+        pre = inject_span_context({"x-capture": "0"}, child)
+        assert pre["x-capture"] == "0"
+        assert "X-Capture" not in pre
+
+
+class TestMetricsPusherAuth:
+
+    def _gateway(self):
+        """In-process gateway capturing each push's headers."""
+        seen = []
+
+        class App:
+            def handle_request(self, method, path, headers, body,
+                               reply):
+                seen.append({k.lower(): v for k, v in headers.items()})
+                reply(200, b"{}")
+                return True
+
+        fe = EventLoopFrontend(App()).start()
+        return fe, seen
+
+    def test_header_provider_reinvoked_per_push(self):
+        from mmlspark_tpu.core.telemetry import (
+            MetricsPusher, MetricsRegistry)
+        fe, seen = self._gateway()
+        try:
+            tokens = iter(["tok-1", "tok-2", "tok-3"])
+            pusher = MetricsPusher(
+                f"http://{fe.host}:{fe.port}/push",
+                registries=(MetricsRegistry(),),
+                interval_s=3600,
+                headers={"X-Static": "s"},
+                header_provider=lambda: {
+                    "Authorization": f"Bearer {next(tokens)}"})
+            for _ in range(3):
+                pusher.push_now()
+            assert [h["authorization"] for h in seen] == \
+                ["Bearer tok-1", "Bearer tok-2", "Bearer tok-3"]
+            assert all(h["x-static"] == "s" for h in seen)
+        finally:
+            fe.stop()
+
+    def test_broken_provider_degrades_to_static(self):
+        from mmlspark_tpu.core.telemetry import (
+            MetricsPusher, MetricsRegistry)
+        fe, seen = self._gateway()
+        try:
+            def boom():
+                raise RuntimeError("token refresh down")
+
+            pusher = MetricsPusher(
+                f"http://{fe.host}:{fe.port}/push",
+                registries=(MetricsRegistry(),),
+                interval_s=3600,
+                headers={"X-Static": "s"},
+                header_provider=boom)
+            pusher.push_now()
+            assert len(seen) == 1               # push still happened
+            assert seen[0]["x-static"] == "s"
+            assert "authorization" not in seen[0]
+            assert pusher.n_errors >= 1
+        finally:
+            fe.stop()

@@ -37,6 +37,16 @@ request's Perfetto ``trace_event`` JSON to ``PATH.<mode>.trace.json``
 — open it in ``chrome://tracing``/ui.perfetto.dev to see exactly
 where that mode's worst request spent its time (queue wait vs pad vs
 dispatch vs encode).
+
+``--connections N`` switches the harness to the SOCKET-EDGE A/B
+instead: the same pipelined data plane behind each of the two
+frontends (``eventloop`` vs ``threaded`` — docs/serving.md "The
+socket edge"), driven by N concurrent keep-alive connections running
+strictly serial (pipelining-free) request/response cycles
+(``mmlspark_tpu.testing.load``). Reports req/s, p50/p99, the
+connection-reuse rate, and connection-level errors per frontend:
+
+    python tools/bench_serving_pipeline.py --connections 1000
 """
 
 from __future__ import annotations
@@ -44,10 +54,17 @@ from __future__ import annotations
 import argparse
 import http.client
 import json
+import os
+import sys
 import threading
 import time
 
 import numpy as np
+
+# runnable as `python tools/bench_serving_pipeline.py` from anywhere,
+# same as chaos_serving.py
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
 
 
 def _identity_model():
@@ -192,6 +209,31 @@ def run_mode(mode: str, model_kind: str, n_clients: int,
     }
 
 
+def run_connections(frontend: str, model_kind: str, n_connections: int,
+                    cycles: int, max_batch_size: int) -> dict:
+    """One many-connection keep-alive window against a fresh worker on
+    the given socket edge (same pipelined data plane either way)."""
+    from mmlspark_tpu.serving import ServingServer
+    from mmlspark_tpu.testing.load import drive_keepalive
+
+    model = _nn_model() if model_kind == "nn" else _identity_model()
+    with ServingServer(model, max_latency_ms=2,
+                       max_batch_size=max_batch_size,
+                       max_queue=max(4 * n_connections, 1024),
+                       frontend=frontend) as srv:
+        srv.warmup(json.loads(_payload(model_kind, 0)))
+        recompiles_warm = _stats(srv)["n_recompiles"]
+        out = drive_keepalive(
+            srv.host, srv.port, srv.api_path, _payload(model_kind, 0),
+            n_connections=n_connections, requests_per_conn=cycles)
+        stats = _stats(srv)
+        out["frontend"] = frontend
+        out["recompiles_after_warmup"] = \
+            stats["n_recompiles"] - recompiles_warm
+        out["frontend_stats"] = stats["frontend"]
+    return out
+
+
 def main() -> None:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("--smoke", action="store_true",
@@ -210,10 +252,41 @@ def main() -> None:
                     help="capture every request (slow_trace_ms=0) and "
                          "write the slowest one's Perfetto trace_event "
                          "JSON to PATH.<mode>.trace.json")
+    ap.add_argument("--connections", type=int, default=0, metavar="N",
+                    help="socket-edge A/B instead: drive N concurrent "
+                         "keep-alive connections against each frontend "
+                         "(eventloop vs threaded) on the pipelined "
+                         "plane and report req/s, p50/p99, and "
+                         "connection-reuse rate per frontend")
+    ap.add_argument("--cycles", type=int, default=25,
+                    help="serial request/response cycles per "
+                         "connection in --connections mode (reuse "
+                         "rate = 1 - 1/cycles when keep-alive holds)")
     args = ap.parse_args()
     if args.smoke:
         args.clients, args.seconds = min(args.clients, 4), 1.0
         args.max_batch_size = min(args.max_batch_size, 32)
+    if args.connections > 0:
+        results = {}
+        for fe in ("eventloop", "threaded"):
+            r = run_connections(fe, args.model, args.connections,
+                                args.cycles, args.max_batch_size)
+            results[fe] = r
+            print(json.dumps(r), flush=True)
+        ev, th = results["eventloop"], results["threaded"]
+        if ev["conn_errors"] or ev["http_errors"]:
+            raise SystemExit(
+                f"FAIL: event-loop frontend dropped requests at "
+                f"{args.connections} connections "
+                f"({ev['conn_errors']} connection errors, "
+                f"{ev['http_errors']} HTTP errors)")
+        print(json.dumps({
+            "metric": "serving_frontend_ab",
+            "connections": args.connections,
+            "speedup": round(ev["rps"] / max(th["rps"], 1e-9), 3),
+            "eventloop_reuse_rate": ev["reuse_rate"],
+            "threaded_reuse_rate": th["reuse_rate"]}), flush=True)
+        return
     results = {}
     for mode in ("serial", "pipelined"):
         r = run_mode(mode, args.model, args.clients, args.seconds,

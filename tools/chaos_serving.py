@@ -13,6 +13,16 @@ execution (journals verified via each worker's ``GET /status``).
     python tools/chaos_serving.py --requests 300 --kill-at 40 \
         --restart-after 30 --seed 7
 
+After the kill/restart drill, a second phase drives a concurrent
+KEEP-ALIVE burst (N client threads sharing one ``ServingClient``, whose
+pooled session holds a persistent connection per worker) and SIGKILLs a
+worker mid-burst: the drill asserts the failover path retries every
+affected request onto the survivors with ZERO dropped requests — the
+in-flight requests already accepted by the surviving worker all
+complete — and that the survivor's frontend counters prove the burst
+actually rode kept-alive connections. ``--burst-threads 0`` skips the
+phase.
+
 Runs on CPU; no model artifact needed (workers serve an inline doubler).
 """
 
@@ -69,6 +79,79 @@ def worker_status(port: int) -> dict:
         return {}
 
 
+def keepalive_burst_drill(coord_url: str, workers: list,
+                          kill_index: int, n_threads: int,
+                          per_thread: int, seed: int) -> dict:
+    """Phase 2: concurrent keep-alive burst, one worker killed mid-way.
+
+    ``n_threads`` client threads share ONE ServingClient (pooled
+    session = persistent connection per worker); after each thread has
+    finished ~1/3 of its requests, worker ``kill_index`` is SIGKILLed.
+    Every logical request must still return the right answer — the
+    attempts in flight on the dead worker fail over, and the requests
+    the SURVIVORS had already accepted all complete (zero drops)."""
+    import threading
+
+    import requests
+
+    from mmlspark_tpu.serving.server import ServingClient
+
+    client = ServingClient(coord_url, timeout=10)
+    survivor_port = workers[1 - kill_index].port
+    reuses_before = requests.get(
+        f"http://127.0.0.1:{survivor_port}/stats", timeout=5
+    ).json()["frontend"].get("keepalive_reuses_total", 0)
+    results: dict = {}
+    errors: list = []
+    kill_gate = threading.Barrier(n_threads + 1)
+
+    def burst(ti: int) -> None:
+        for j in range(per_thread):
+            if j == per_thread // 3:
+                kill_gate.wait()      # every thread is mid-burst here
+            rid = f"burst-{seed}-{ti}-{j}"
+            x = float(ti * per_thread + j)
+            try:
+                results[rid] = client.predict({"x": x},
+                                              request_id=rid)
+            except Exception as e:  # noqa: BLE001 — a dropped request
+                errors.append({"rid": rid, "error": str(e)})
+
+    threads = [threading.Thread(target=burst, args=(ti,))
+               for ti in range(n_threads)]
+    for t in threads:
+        t.start()
+    kill_gate.wait()                  # all threads in flight
+    os.kill(workers[kill_index].pid, signal.SIGKILL)
+    workers[kill_index].wait()
+    for t in threads:
+        t.join()
+
+    def expected(rid: str) -> dict:
+        _, _, ti, j = rid.rsplit("-", 3)
+        return {"y": 2.0 * (int(ti) * per_thread + int(j))}
+
+    n_wrong = sum(1 for rid, out in results.items()
+                  if out != expected(rid))
+    survivor = requests.get(
+        f"http://127.0.0.1:{survivor_port}/stats", timeout=5).json()
+    reuses_during = survivor["frontend"].get(
+        "keepalive_reuses_total", 0) - reuses_before
+    total = n_threads * per_thread
+    return {
+        "what": "keep-alive burst with a mid-burst worker kill",
+        "n_threads": n_threads, "per_thread": per_thread,
+        "total_requests": total,
+        "n_ok": len(results) - n_wrong, "n_wrong": n_wrong,
+        "n_dropped": len(errors), "dropped": errors[:5],
+        "n_failovers": client.n_failovers,
+        "survivor_keepalive_reuses": reuses_during,
+        "ok": (len(results) == total and n_wrong == 0
+               and not errors and client.n_failovers > 0
+               and reuses_during > 0),
+    }
+
+
 def main() -> int:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("--requests", type=int, default=120)
@@ -78,6 +161,11 @@ def main() -> int:
                     help="restart it this many requests later")
     ap.add_argument("--seed", type=int, default=0,
                     help="FaultPlan seed (request-id stream)")
+    ap.add_argument("--burst-threads", type=int, default=8,
+                    help="phase-2 keep-alive burst client threads "
+                         "(0 skips the phase)")
+    ap.add_argument("--burst-requests", type=int, default=15,
+                    help="requests per burst thread")
     args = ap.parse_args()
 
     from mmlspark_tpu.serving.server import (
@@ -141,6 +229,18 @@ def main() -> int:
                     stats["first_ok_after_kill"] = i
             else:
                 stats["n_wrong"] += 1
+        burst = None
+        if args.burst_threads > 0:
+            # phase 2: kill worker 1 (worker 0 was already killed and
+            # restarted above) in the middle of a concurrent keep-alive
+            # burst, then bring a replacement up so the fleet ends the
+            # drill whole
+            burst = keepalive_burst_drill(
+                coord_url, workers, kill_index=1,
+                n_threads=args.burst_threads,
+                per_thread=args.burst_requests, seed=args.seed)
+            workers[1] = spawn_worker(
+                coord_url, os.path.join(tmp, "w1.jsonl"))
         wall = time.perf_counter() - t0
 
         per_worker = [worker_status(w.port) for w in workers]
@@ -157,6 +257,7 @@ def main() -> int:
             "workers": [{k: s.get(k) for k in
                          ("n_requests", "n_replayed", "n_shed",
                           "journal_recovered")} for s in per_worker],
+            **({"burst": burst} if burst is not None else {}),
             "wall_s": round(wall, 3),
         }
         print(json.dumps(report, indent=2))
@@ -169,7 +270,8 @@ def main() -> int:
               and stats["n_wrong"] == 0
               and not stats["failed_rids"]
               and recovered
-              and stats.get("fleet_traces_ok", True))
+              and stats.get("fleet_traces_ok", True)
+              and (burst is None or burst["ok"]))
         print("RESULT:", "PASS" if ok else "FAIL")
         return 0 if ok else 1
     finally:
