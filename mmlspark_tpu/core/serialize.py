@@ -5,18 +5,32 @@ persistence extended by `ComplexParamsWritable`/`ConstructorWritable`,
 `core/serialize/src/main/scala/`): every stage saves to a directory with
 ``metadata.json`` (class name, version, JSON params) and, when needed,
 ``arrays.npz`` plus stage-specific extra files written by ``_save_extra``.
+
+Integrity: every save finishes by writing a SHA-256 manifest
+(``checkpoint.sha256.json``, :mod:`mmlspark_tpu.io.checkpoint`) over the
+whole checkpoint tree; every load verifies it. A corrupted/truncated
+checkpoint raises :class:`~mmlspark_tpu.io.checkpoint.
+CheckpointIntegrityError` instead of loading garbage weights; a
+digest-less legacy checkpoint loads with a warning (backward compat).
+The serving rollout path additionally requires a *present and valid*
+manifest before a model version becomes flip-eligible.
 """
 
 from __future__ import annotations
 
 import json
 import os
+import threading
 from typing import Any, Dict, Optional
 
 import numpy as np
 
 from mmlspark_tpu.core import registry
 from mmlspark_tpu.version import __version__
+
+#: nesting depth of in-flight load_stage calls (per thread): the
+#: top-level manifest covers the whole tree, so only depth 0 verifies
+_LOAD_DEPTH = threading.local()
 
 METADATA_FILE = "metadata.json"
 ARRAYS_FILE = "arrays.npz"
@@ -36,9 +50,35 @@ def save_stage(stage, path: str) -> None:
         np.savez_compressed(os.path.join(path, ARRAYS_FILE), **arrays)
     with open(os.path.join(path, METADATA_FILE), "w") as f:
         json.dump(meta, f, indent=2, default=_json_default)
+    # the digest manifest goes LAST: an interrupted save leaves a
+    # missing/stale manifest, never a valid-looking one over torn files
+    from mmlspark_tpu.io import checkpoint as _ckpt
+    _ckpt.write_digest(path)
 
 
-def load_stage(path: str):
+def load_stage(path: str, verify: bool = True):
+    # A manifest pins the WHOLE tree under its directory (substage
+    # subdirectories included), so the top-level verification already
+    # covered every nested checkpoint: nested loads (Pipeline stages,
+    # wrapper substages — they re-enter here via PipelineStage.load)
+    # skip re-hashing, or a depth-k pipeline would hash its leaves
+    # k+1 times. Thread-local so concurrent loads can't cross-talk.
+    depth = getattr(_LOAD_DEPTH, "n", 0)
+    if verify and depth == 0:
+        from mmlspark_tpu.io import checkpoint as _ckpt
+        ok, detail = _ckpt.verify_digest(path, strict=False)
+        if not ok:
+            raise _ckpt.CheckpointIntegrityError(
+                f"checkpoint {path} failed integrity verification: "
+                f"{detail}")
+    _LOAD_DEPTH.n = depth + 1
+    try:
+        return _load_stage_inner(path)
+    finally:
+        _LOAD_DEPTH.n = depth
+
+
+def _load_stage_inner(path: str):
     with open(os.path.join(path, METADATA_FILE)) as f:
         meta = json.load(f)
     cls = registry.resolve(meta["class"])

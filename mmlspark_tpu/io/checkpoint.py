@@ -1,4 +1,4 @@
-"""Shared orbax checkpoint-manager construction.
+"""Shared orbax checkpoint-manager construction + checkpoint integrity.
 
 One place for the path rule both training stacks use (NNLearner step
 checkpoints, the SPMD transformer's save/restore): remote URLs
@@ -6,11 +6,37 @@ checkpoints, the SPMD transformer's save/restore): remote URLs
 handles them natively on TPU VMs — and only local paths are
 absolutized (parity: the reference checkpoints streaming state to
 HDFS, `HadoopUtils.scala`).
+
+Integrity manifests: every directory checkpoint written through stage
+persistence (:func:`mmlspark_tpu.core.serialize.save_stage`) gets a
+``checkpoint.sha256.json`` manifest — a per-file SHA-256 listing plus
+one combined tree digest — written LAST, so a save that died mid-way
+can never present a complete-looking manifest. :func:`verify_digest`
+re-hashes the tree against the manifest; the serving rollout path
+(:mod:`mmlspark_tpu.serving.rollout`) runs it in **strict** mode before
+a model version is flip-eligible, so a truncated or bit-rotted
+checkpoint can never go live behind traffic. Restores of digest-less
+legacy checkpoints degrade to a warning (``strict=False``), never a
+failure — pre-manifest checkpoints keep loading.
 """
 
 from __future__ import annotations
 
+import hashlib
+import json
 import os
+from typing import Dict, Optional, Tuple
+
+from mmlspark_tpu.core.logs import get_logger
+
+logger = get_logger("io.checkpoint")
+
+#: the integrity manifest written beside every stage checkpoint
+MANIFEST_FILE = "checkpoint.sha256.json"
+
+
+class CheckpointIntegrityError(RuntimeError):
+    """A checkpoint's content does not match its digest manifest."""
 
 
 def manager(path: str, max_to_keep: int = 3, create: bool = True):
@@ -20,3 +46,99 @@ def manager(path: str, max_to_keep: int = 3, create: bool = True):
     return ocp.CheckpointManager(
         path, options=ocp.CheckpointManagerOptions(
             max_to_keep=max_to_keep, create=create))
+
+
+def _iter_files(path: str):
+    """Relative paths of every regular file under ``path``, sorted, the
+    top-level manifest excluded (it cannot hash itself; NESTED manifests
+    — substage checkpoints are checkpoints too — are content like any
+    other file)."""
+    out = []
+    for root, dirs, files in os.walk(path):
+        dirs.sort()
+        for name in sorted(files):
+            rel = os.path.relpath(os.path.join(root, name), path)
+            if rel == MANIFEST_FILE:
+                continue
+            out.append(rel)
+    return out
+
+
+def _sha256_file(path: str) -> str:
+    h = hashlib.sha256()
+    with open(path, "rb") as f:
+        for chunk in iter(lambda: f.read(1 << 20), b""):
+            h.update(chunk)
+    return h.hexdigest()
+
+
+def compute_digest(path: str) -> Dict[str, object]:
+    """Hash every file under ``path`` into a manifest dict:
+    ``{"files": {relpath: sha256}, "digest": <combined tree digest>}``.
+    The combined digest hashes the sorted ``relpath:sha256`` lines, so
+    it pins both contents AND the file set (a deleted file changes it
+    as surely as a flipped bit)."""
+    files = {rel: _sha256_file(os.path.join(path, rel))
+             for rel in _iter_files(path)}
+    tree = hashlib.sha256()
+    for rel in sorted(files):
+        tree.update(f"{rel}:{files[rel]}\n".encode())
+    return {"files": files, "digest": tree.hexdigest()}
+
+
+def write_digest(path: str) -> Dict[str, object]:
+    """Write (atomically: temp file + rename) the integrity manifest
+    for the checkpoint directory at ``path`` and return it. Call LAST
+    in any save path — an interrupted save must leave a missing or
+    stale manifest, never a valid-looking one."""
+    manifest = compute_digest(path)
+    manifest["algorithm"] = "sha256"
+    target = os.path.join(path, MANIFEST_FILE)
+    tmp = target + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(manifest, f, indent=2, sort_keys=True)
+    os.replace(tmp, target)
+    return manifest
+
+
+def verify_digest(path: str, strict: bool = False
+                  ) -> Tuple[bool, Optional[str]]:
+    """Verify the checkpoint at ``path`` against its manifest.
+
+    Returns ``(ok, detail)``. A **missing** manifest is the legacy
+    (pre-digest) case: with ``strict=False`` it logs a warning and
+    passes (``detail`` says why), with ``strict=True`` it fails — the
+    rollout flip-eligibility contract, where "cannot prove integrity"
+    must read as "not safe to serve". A **mismatch** (changed bytes,
+    missing files, extra files) always fails; callers that load the
+    checkpoint raise :class:`CheckpointIntegrityError` on it.
+    """
+    manifest_path = os.path.join(path, MANIFEST_FILE)
+    if not os.path.exists(manifest_path):
+        detail = ("no integrity manifest (legacy checkpoint saved "
+                  "before digests)")
+        if strict:
+            return False, detail
+        logger.warning("checkpoint %s has %s; loading unverified",
+                       path, detail)
+        return True, detail
+    try:
+        with open(manifest_path) as f:
+            manifest = json.load(f)
+        want = dict(manifest["files"])
+    except (ValueError, KeyError, TypeError) as e:
+        return False, f"unreadable manifest: {e}"
+    have = set(_iter_files(path))
+    missing = sorted(set(want) - have)
+    if missing:
+        return False, f"files missing from checkpoint: {missing[:5]}"
+    extra = sorted(have - set(want))
+    if extra:
+        return False, f"files not in manifest: {extra[:5]}"
+    for rel, digest in sorted(want.items()):
+        actual = _sha256_file(os.path.join(path, rel))
+        if actual != digest:
+            return False, (f"digest mismatch for {rel!r}: "
+                           f"manifest {digest[:12]}..., "
+                           f"file {actual[:12]}...")
+    return True, None

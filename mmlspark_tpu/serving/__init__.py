@@ -22,6 +22,10 @@ from mmlspark_tpu.serving.server import (
 )
 from mmlspark_tpu.serving.consolidator import PartitionConsolidator
 from mmlspark_tpu.serving.frontend import EventLoopFrontend
+from mmlspark_tpu.serving.rollout import (
+    ModelVersionManager, RolloutError, RolloutOrchestrator,
+)
 
 __all__ = ["ServingServer", "ServingCoordinator", "ServingClient",
-           "PartitionConsolidator", "EventLoopFrontend"]
+           "PartitionConsolidator", "EventLoopFrontend",
+           "ModelVersionManager", "RolloutError", "RolloutOrchestrator"]

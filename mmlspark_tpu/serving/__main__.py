@@ -71,6 +71,26 @@ Environment:
   IDLE_TIMEOUT     (worker, optional) seconds a keep-alive connection
                    may sit idle between requests (default 60; also the
                    slow-loris mid-request reap clock; 0 disables)
+  MODEL_VERSION    (worker, optional) the version label of the model
+                   served at boot (default "v1") — the zero-downtime
+                   rollout machinery stages/flips later versions via
+                   POST /rollout/{stage,flip,rollback,abort} and
+                   GET /version; see docs/serving.md "Zero-downtime
+                   rollout"
+  VERIFY_CHECKPOINTS
+                   (worker, optional) 0 disables the strict digest
+                   verification a staged checkpoint must pass before
+                   it is flip-eligible (leave on: a truncated or
+                   corrupt checkpoint must never go live)
+  MAX_CONNS_PER_IP (worker, optional) per-peer-address concurrent
+                   connection cap at the socket edge: accepts beyond
+                   it get an immediate 429 + close (0 = off; a
+                   shedding layer in front of MAX_QUEUE)
+  MAX_PIPELINED_PER_ITER
+                   (worker, optional) HTTP/1.1 pipelining fairness
+                   cap: buffered pipelined requests served per
+                   connection per event-loop pass (default 16; one
+                   flooding connection cannot monopolize a loop)
   PUSH_GATEWAY_URL / PUSH_INTERVAL_S
                    (worker, optional) remote-write: POST the worker's
                    metrics exposition (per-server + process registry)
@@ -135,7 +155,12 @@ def run_worker() -> None:
         # listener); default it on so the one knob is enough
         reuse_port=_env_float("REUSE_PORT",
                               1 if acceptors > 1 else 0) != 0,
-        idle_timeout=_env_float("IDLE_TIMEOUT", 60.0))
+        idle_timeout=_env_float("IDLE_TIMEOUT", 60.0),
+        max_conns_per_ip=int(_env_float("MAX_CONNS_PER_IP", 0)),
+        max_pipelined_per_iter=int(
+            _env_float("MAX_PIPELINED_PER_ITER", 16)),
+        model_version=os.environ.get("MODEL_VERSION", "v1"),
+        verify_checkpoints=_env_float("VERIFY_CHECKPOINTS", 1) != 0)
     warm = os.environ.get("WARMUP_PAYLOAD")
     if warm:
         # warm BEFORE start(): the socket is already bound (early

@@ -49,6 +49,19 @@ Timeouts (all enforced by a per-loop sweep, not per-socket timers):
   stuck-batch contract the threaded frontend implements with
   ``Event.wait``); the eventual real reply is dropped by generation.
 
+Fairness and shedding at the socket edge:
+
+* ``max_pipelined_per_iter`` — at most this many buffered pipelined
+  requests are served per connection per loop pass; the remainder is
+  deferred to the next iteration (``serving_pipelining_deferred_total``
+  counts deferrals), so a single connection flooding pipelined requests
+  cannot monopolize a loop while other connections wait.
+* ``max_conns_per_ip`` — a per-peer-address concurrent-connection cap
+  enforced at accept, IN FRONT of the application's ``max_queue``
+  shedding: over-cap accepts get an immediate 429 + close
+  (``serving_per_ip_rejected_total``), and the observed per-IP
+  high-water mark is exported as a gauge.
+
 Protocol guardrails (each satisfies one of the framing edge cases the
 frontend must not inherit from ``http.server``): header blocks beyond
 ``max_header_bytes`` are rejected 431; POST bodies need a valid
@@ -241,10 +254,11 @@ class _Conn:
     __slots__ = ("sock", "fd", "buf", "scanned", "state", "gen", "out",
                  "t_last", "t_req_start", "t_await", "n_requests",
                  "keep_alive", "method", "path", "headers", "body_start",
-                 "body_len", "want_write", "advancing")
+                 "body_len", "want_write", "advancing", "peer_ip")
 
-    def __init__(self, sock: socket.socket):
+    def __init__(self, sock: socket.socket, peer_ip: str = ""):
         self.sock = sock
+        self.peer_ip = peer_ip
         self.fd = sock.fileno()
         self.buf = bytearray()
         self.scanned = 0            # CRLFCRLF search resume offset
@@ -282,6 +296,11 @@ class _Loop(threading.Thread):
         self.listener = listener
         self.sel = selectors.DefaultSelector()
         self.conns: Dict[int, _Conn] = {}
+        # pipelining-fairness continuations: connections whose buffered
+        # requests were deferred mid-_advance (cap reached) resume here
+        # on the NEXT loop iteration, after every other connection's
+        # events were handled
+        self._deferred: Dict[int, _Conn] = {}
         self._replies: deque = deque()
         self._wake_r, self._wake_w = socket.socketpair()
         self._wake_r.setblocking(False)
@@ -340,7 +359,10 @@ class _Loop(threading.Thread):
         try:
             while True:
                 t_sel = time.monotonic()
-                events = self.sel.select(timeout=tick)
+                # never park in select while deferred pipelined work is
+                # waiting — it was deferred for fairness, not for later
+                events = self.sel.select(
+                    timeout=0 if self._deferred else tick)
                 t0 = time.monotonic()
                 if self._stopping:
                     break
@@ -359,6 +381,16 @@ class _Loop(threading.Thread):
                                 conn.fd in self.conns:
                             self._on_readable(conn)
                 self._drain_replies()
+                if self._deferred:
+                    # resume capped pipelined connections: one fresh
+                    # _advance budget each, AFTER this iteration's
+                    # events — a flooding connection progresses, but
+                    # never monopolizes the loop
+                    resumed = list(self._deferred.values())
+                    self._deferred.clear()
+                    for conn in resumed:
+                        if conn.fd in self.conns:
+                            self._advance(conn)
                 if not self._accepting and self.listener is not None:
                     self._close_listener()
                 now = time.monotonic()
@@ -400,7 +432,27 @@ class _Loop(threading.Thread):
                 sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
             except OSError:
                 pass                  # AF_UNIX etc.
-            conn = _Conn(sock)
+            peer_ip = _addr[0] if isinstance(_addr, tuple) and _addr \
+                else ""
+            if not fe._ip_acquire(peer_ip):
+                # per-IP shedding layer: one peer flooding connections
+                # is refused at accept — an immediate 429 + close —
+                # BEFORE it can occupy queue slots other clients need.
+                # Best-effort single send: the socket was just
+                # accepted, so the tiny reply fits the send buffer.
+                fe.n_per_ip_rejected += 1
+                body = (b'{"error": "too many connections from this '
+                        b'address"}')
+                try:
+                    sock.send(build_head(
+                        429, len(body),
+                        extra=(("Retry-After", "1"),),
+                        close=True) + body)
+                except OSError:
+                    pass
+                sock.close()
+                continue
+            conn = _Conn(sock, peer_ip)
             conn.t_last = conn.t_req_start = time.monotonic()
             self.conns[conn.fd] = conn
             fe.n_connections += 1
@@ -473,7 +525,18 @@ class _Loop(threading.Thread):
 
     def _advance_inner(self, conn: _Conn) -> None:
         fe = self.frontend
+        served = 0
+        cap = fe.max_pipelined_per_iter
         while conn.state in (_HEAD, _BODY) and not conn.out:
+            if cap > 0 and served >= cap and conn.buf:
+                # HTTP/1.1 pipelining fairness: one connection
+                # flooding pipelined requests in a single buffer must
+                # not monopolize this loop iteration — park the rest
+                # of its buffer and resume next iteration, after every
+                # OTHER connection's events were handled
+                fe.n_pipelining_deferred += 1
+                self._deferred[conn.fd] = conn
+                return
             buf = conn.buf
             if conn.state == _HEAD:
                 # tolerate stray CRLFs between requests (RFC 7230 3.5)
@@ -561,6 +624,7 @@ class _Loop(threading.Thread):
             body = bytes(memoryview(conn.buf)[conn.body_start:total])
             del conn.buf[:total]
             conn.scanned = 0
+            served += 1
             self._dispatch(conn, body)
 
     def _dispatch(self, conn: _Conn, body: bytes) -> None:
@@ -696,6 +760,8 @@ class _Loop(threading.Thread):
     def _close(self, conn: _Conn) -> None:
         if self.conns.pop(conn.fd, None) is None:
             return
+        self._deferred.pop(conn.fd, None)
+        self.frontend._ip_release(conn.peer_ip)
         conn.gen += 1                 # outstanding replies become stale
         conn.state = _CLOSING
         try:
@@ -794,6 +860,8 @@ class EventLoopFrontend:
                  max_header_bytes: int = 16384,
                  max_body_bytes: int = 64 << 20,
                  backlog: int = 1024,
+                 max_conns_per_ip: int = 0,
+                 max_pipelined_per_iter: int = 16,
                  registry=None, name: str = "serving"):
         self.app = app
         self.name = name
@@ -805,6 +873,22 @@ class EventLoopFrontend:
         self.acceptors = max(int(acceptors), 1)
         self.backlog = max(int(backlog), 1)
         self.reuse_port = bool(reuse_port)
+        # -- per-IP connection cap: a shedding layer IN FRONT of the
+        # application's max_queue — beyond this many concurrent
+        # connections from one peer address, further accepts get an
+        # immediate 429 + close. 0 disables. Tracked frontend-wide
+        # (one peer's connections spread across every acceptor loop).
+        self.max_conns_per_ip = int(max_conns_per_ip)
+        self._ip_lock = threading.Lock()
+        self._conns_per_ip: Dict[str, int] = {}
+        self.per_ip_high_water = 0
+        self.n_per_ip_rejected = 0
+        # -- HTTP/1.1 pipelining fairness: at most this many buffered
+        # requests served per connection per _advance pass; the rest
+        # are deferred to the next loop iteration so one flooding
+        # pipelined connection cannot monopolize a loop. <= 0 disables.
+        self.max_pipelined_per_iter = int(max_pipelined_per_iter)
+        self.n_pipelining_deferred = 0
         if self.acceptors > 1 and not self.reuse_port:
             # N loops cannot share ONE listening socket without the
             # thundering-herd accept races SO_REUSEPORT exists to fix
@@ -829,6 +913,32 @@ class EventLoopFrontend:
                        for i, lst in enumerate(self._listeners)]
         if registry is not None:
             self._register_metrics(registry)
+
+    # -- per-IP accounting (accept path; lock-guarded, accepts are
+    # orders of magnitude rarer than requests) ------------------------------
+
+    def _ip_acquire(self, ip: str) -> bool:
+        """Admit a new connection from ``ip``; False = over the cap."""
+        if self.max_conns_per_ip <= 0 or not ip:
+            return True
+        with self._ip_lock:
+            n = self._conns_per_ip.get(ip, 0)
+            if n >= self.max_conns_per_ip:
+                return False
+            self._conns_per_ip[ip] = n + 1
+            if n + 1 > self.per_ip_high_water:
+                self.per_ip_high_water = n + 1
+            return True
+
+    def _ip_release(self, ip: str) -> None:
+        if self.max_conns_per_ip <= 0 or not ip:
+            return
+        with self._ip_lock:
+            n = self._conns_per_ip.get(ip, 0) - 1
+            if n <= 0:
+                self._conns_per_ip.pop(ip, None)
+            else:
+                self._conns_per_ip[ip] = n
 
     def _bind(self, host: str, port: int) -> socket.socket:
         s = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
@@ -881,9 +991,22 @@ class EventLoopFrontend:
             ("serving_request_timeouts_total",
              "In-flight requests 504ed by the request-timeout sweep.",
              "n_request_timeouts"),
+            ("serving_pipelining_deferred_total",
+             "Times a connection's buffered pipelined requests were "
+             "deferred to the next loop iteration by the fairness cap "
+             "(max_pipelined_per_iter).", "n_pipelining_deferred"),
+            ("serving_per_ip_rejected_total",
+             "Connections refused at accept by the per-IP cap "
+             "(429 + close before any queue slot was spent).",
+             "n_per_ip_rejected"),
         ):
             registry.counter(mname, help_).set_function(
                 lambda a=attr: getattr(self, a))
+        registry.gauge(
+            "serving_per_ip_conns_high_water",
+            "Highest concurrent-connection count any single peer "
+            "address has reached (0 when the per-IP cap is off)."
+        ).set_function(lambda: self.per_ip_high_water)
 
     # -- lifecycle -----------------------------------------------------------
 
@@ -936,6 +1059,9 @@ class EventLoopFrontend:
             "idle_reaped_total": self.n_idle_reaped,
             "parse_errors_total": self.n_parse_errors,
             "request_timeouts_total": self.n_request_timeouts,
+            "pipelining_deferred_total": self.n_pipelining_deferred,
+            "per_ip_rejected_total": self.n_per_ip_rejected,
+            "per_ip_conns_high_water": self.per_ip_high_water,
             "busy_ratio": round(max(
                 (lp.busy_ratio for lp in self._loops), default=0.0), 4),
         }

@@ -86,6 +86,9 @@ from mmlspark_tpu.core.tracing import (
     merge_traces, span_tree, to_perfetto,
 )
 from mmlspark_tpu.serving.frontend import EventLoopFrontend
+from mmlspark_tpu.serving.rollout import (
+    ModelVersionManager, RolloutError, RolloutOrchestrator,
+)
 
 logger = get_logger("serving")
 
@@ -177,8 +180,12 @@ class ServingServer:
                  frontend: str = "eventloop",
                  acceptors: int = 1,
                  reuse_port: bool = False,
+                 max_conns_per_ip: int = 0,
+                 max_pipelined_per_iter: int = 16,
+                 model_version: str = "v1",
+                 verify_checkpoints: bool = True,
+                 rollout_fault_plan=None,
                  clock: Clock = SYSTEM_CLOCK):
-        self.model = model
         self.api_path = api_path
         self.max_batch_size = int(max_batch_size)
         self.max_latency_ms = float(max_latency_ms)
@@ -213,6 +220,23 @@ class ServingServer:
         self.registry = MetricsRegistry(clock=clock)
         self.timings = StageTimings(registry=self.registry,
                                     metric="serving_stage_duration_ms")
+        # -- versioned hot-swap: the manager owns the ACTIVE model
+        # version the dispatch stage reads (one snapshot per batch, so
+        # a flip lands between batches and in-flight batches finish on
+        # the version that dispatched them), plus at most one staged
+        # next version (loaded/digest-verified/bucket-warmed in the
+        # background) and the previous version kept resident for
+        # instant rollback — see serving/rollout.py and docs/serving.md
+        # "Zero-downtime rollout". ``model_version`` names the boot
+        # version; ``verify_checkpoints=False`` disables the strict
+        # flip-eligibility digest check (tests only).
+        self.versions = ModelVersionManager(
+            self, model, version=model_version,
+            verify_checkpoints=verify_checkpoints,
+            fault_plan=rollout_fault_plan)
+        # remembered by warmup(): staged versions warm with the same
+        # payload schema unless the rollout supplies its own
+        self.warmup_payload: Any = None
         # -- tracing: one root span per request, child spans per stage,
         # recorded into the process-wide flight recorder. Tail capture:
         # a completed trace is RETAINED (GET /trace/<id>) only when its
@@ -279,6 +303,9 @@ class ServingServer:
         self.clock = clock
         self.n_shed = 0
         self.n_deadline_expired = 0
+        # 5xx replies committed (model/encode failures): the per-worker
+        # error signal the rollout canary comparison reads
+        self.n_errors = 0
         self._draining = threading.Event()
         self._active_batches = 0
         self._queue: "Queue[_PendingRequest]" = Queue()
@@ -302,6 +329,8 @@ class ServingServer:
                     acceptors=acceptors, reuse_port=reuse_port,
                     idle_timeout=self.idle_timeout,
                     request_timeout=self.request_timeout,
+                    max_conns_per_ip=max_conns_per_ip,
+                    max_pipelined_per_iter=max_pipelined_per_iter,
                     registry=self.registry, name="serving")
             self.host, self.port = (self._frontend.host,
                                     self._frontend.port)
@@ -373,6 +402,16 @@ class ServingServer:
             self._recover_journal()
         self._register_metric_views()
 
+    @property
+    def model(self):
+        """The ACTIVE model version's transformer. Kept as a property
+        so the pre-rollout ``server.model`` surface still works; the
+        dispatch stage itself snapshots the whole
+        :class:`~mmlspark_tpu.serving.rollout.ModelVersion` per batch
+        (model + version label together, so a mid-batch flip can't
+        split them)."""
+        return self.versions.active.model
+
     def _register_metric_views(self) -> None:
         """Expose the server's existing counters/state as registry
         families via exposition-time callbacks: ``GET /metrics`` reads
@@ -404,6 +443,10 @@ class ServingServer:
             ("serving_window_missed_total",
              "Retries that arrived after their journal entry was "
              "evicted (re-executed).", lambda: self.n_window_missed),
+            ("serving_errors_total",
+             "Requests answered 5xx (model/encode failures) — the "
+             "per-worker error signal rollout canarying compares.",
+             lambda: self.n_errors),
         ):
             m.counter(name, help_).set_function(fn)
         m.gauge("serving_backlog",
@@ -512,7 +555,16 @@ class ServingServer:
 
             def do_POST(self):
                 if self.path != serving.api_path:
-                    self.send_error(404)
+                    # control-plane POSTs (rollout admin) share one
+                    # route table with the event-loop frontend
+                    length = int(self.headers.get("Content-Length", 0))
+                    routed = serving._post_route(
+                        self.path, self.rfile.read(length))
+                    if routed is None:
+                        self.send_error(404)
+                        return
+                    status, rbody, ctype = routed
+                    self._reply(status, rbody, ctype=ctype)
                     return
                 # trace ingress: adopt the inbound X-Trace-Id or mint
                 # one; bound for this handler thread's logs, carried on
@@ -677,6 +729,10 @@ class ServingServer:
                     "inflight_batches": self._active_batches,
                     "queue_depth": self._n_backlog,
                     "stage_timings": self.timings.snapshot(),
+                    # the active model version (full lifecycle detail
+                    # at GET /version): the fleet view aggregates this
+                    # into its coherent-version-set check
+                    "model_version": self.versions.active.version,
                     # the LIVE tail-capture threshold (adaptive
                     # refreshes move it; fixed config pins it)
                     "slow_trace_ms":
@@ -729,12 +785,19 @@ class ServingServer:
                 out["tree"] = span_tree(tr)
                 body = json.dumps(out).encode()
             return 200, body, "application/json", ()
+        if path == "/version":
+            # the rollout state machine: active/staged/previous version
+            # lifecycle, shadow-traffic stats, flip/rollback counters
+            return (200, json.dumps(self.versions.status()).encode(),
+                    "application/json", ())
         if path != "/status":
             return None
         with self._commit_lock:
             status = {
                 "n_requests": self.n_requests,
                 "n_batches": self.n_batches,
+                "n_errors": self.n_errors,
+                "model_version": self.versions.active.version,
                 "n_replayed": self.n_replayed,
                 "n_journal_evicted": self.n_journal_evicted,
                 "n_window_missed": self.n_window_missed,
@@ -750,6 +813,63 @@ class ServingServer:
                 "journal_recovered": self.n_journal_recovered,
             }
         return 200, json.dumps(status).encode(), "application/json", ()
+
+    def _post_route(self, path: str, body: bytes
+                    ) -> Optional[Tuple[int, bytes, str]]:
+        """The worker's control-plane POST routes (rollout admin),
+        shared by both frontends exactly like ``_get_route`` — only
+        ``api_path`` itself takes the data-plane admission path.
+        Returns ``(status, body, content_type)`` or None for 404."""
+        if not path.startswith("/rollout/"):
+            return None
+        try:
+            args = json.loads(body or b"{}")
+            if not isinstance(args, dict):
+                raise ValueError("body must be a JSON object")
+        except ValueError as e:
+            return (400, json.dumps({"error": f"invalid JSON: {e}"}
+                                    ).encode(), "application/json")
+        try:
+            if path == "/rollout/stage":
+                if not args.get("path"):
+                    return (400, b'{"error": "stage needs a checkpoint '
+                                 b'path"}', "application/json")
+                if args.get("sync"):
+                    # sync staging is Python-API-only: this handler
+                    # runs ON the event-loop thread, and inline
+                    # digest-hashing + every-bucket warmup of a big
+                    # checkpoint would stall every connection on the
+                    # loop — the rollout endpoint causing downtime
+                    return (400, b'{"error": "staging is asynchronous '
+                                 b'over HTTP; poll GET /version until '
+                                 b'the staged state settles"}',
+                            "application/json")
+                out = self.versions.stage(
+                    source=args["path"],
+                    version=args.get("version"),
+                    warmup_payload=args.get("warmup_payload"),
+                    shadow_fraction=args.get("shadow_fraction"))
+                # 202: staging continues in the background — poll
+                # GET /version until the staged state settles
+                return (202, json.dumps(out).encode(),
+                        "application/json")
+            if path == "/rollout/flip":
+                out = self.versions.flip(version=args.get("version"))
+                return 200, json.dumps(out).encode(), "application/json"
+            if path == "/rollout/rollback":
+                out = self.versions.rollback()
+                return 200, json.dumps(out).encode(), "application/json"
+            if path == "/rollout/abort":
+                out = self.versions.abort()
+                return 200, json.dumps(out).encode(), "application/json"
+        except RolloutError as e:
+            # an illegal transition is a conflict with current state,
+            # not a server fault: 409 + the state that refused it
+            return (409, json.dumps(
+                {"error": str(e),
+                 "rollout": self.versions.status()}).encode(),
+                "application/json")
+        return None
 
     def _admit(self, payload: Any, rid: Optional[str],
                deadline: Optional[Deadline], tid: str
@@ -883,8 +1003,15 @@ class ServingServer:
             status, rbody, ctype, extra = route
             reply(status, rbody, ctype=ctype, extra=extra)
             return True
-        if method != "POST" or path != self.api_path:
+        if method != "POST":
             return False
+        if path != self.api_path:
+            routed = self._post_route(path, body)
+            if routed is None:
+                return False
+            status, rbody, ctype = routed
+            reply(status, rbody, ctype=ctype)
+            return True
         tid, parent_sid = extract_span_context(headers)
         with trace_context(tid):
             root = self.tracer.start("request", trace_id=tid,
@@ -1097,7 +1224,7 @@ class ServingServer:
                 self.tracer.add("queue_wait", p.t_enqueue, now,
                                 parent=p.span)
         job = {"batch_n": len(batch), "live": [], "n_live": 0,
-               "df": None, "out": None, "error": None}
+               "df": None, "out": None, "error": None, "version": None}
         return self._refresh_live(job, batch)
 
     def _assemble_frame(self, live: List[_PendingRequest]) -> DataFrame:
@@ -1120,6 +1247,27 @@ class ServingServer:
                 for n in df.columns})
         return df
 
+    @staticmethod
+    def _shape_key(df: DataFrame):
+        """The dispatch-shape identity: row count + column schema —
+        exactly what forces a retrace in any jitted model."""
+        return (df.num_rows, tuple(sorted(df.schema().items())))
+
+    def _bucket_sizes(self) -> List[int]:
+        """Every reachable shape bucket: the pow2 ladder clamped at
+        max_batch_size (shared by warmup() and staged-version warmup —
+        the two must warm the same set or flips retrace)."""
+        cap = self.max_batch_size
+        return sorted({bucket_target(k, cap) for k in range(1, cap + 1)})
+
+    def _warmup_frame(self, payload: Any, n: int) -> DataFrame:
+        """One synthetic bucket-shaped frame, built exactly like live
+        traffic's (payload -> rows -> bucket padding), so a model
+        warmed on it compiles the very executables live dispatch
+        uses."""
+        return self._assemble_frame(
+            [_PendingRequest(payload) for _ in range(n)])
+
     def _stage_dispatch(self, job: dict) -> dict:
         """Stage 2 (executor): push the bucketed frame through the
         model. New dispatch shapes are counted as recompiles (any jitted
@@ -1137,9 +1285,15 @@ class ServingServer:
             self._refresh_live(job, job["live"])
         df = job["df"]
         if job["error"] is None and df is not None:
+            # ONE snapshot of the active version per batch: the rollout
+            # flip is a reference assignment, so this batch dispatches,
+            # labels, and counts wholly on the version it read here —
+            # a flip landing mid-batch affects only the NEXT batch
+            mv = self.versions.active
+            job["version"] = mv.version
             t0 = self.tracer.clock.now()
             try:
-                key = (df.num_rows, tuple(sorted(df.schema().items())))
+                key = self._shape_key(df)
                 with self._stats_lock:
                     if key not in self._shapes_seen:
                         self.n_recompiles += 1
@@ -1150,6 +1304,10 @@ class ServingServer:
                         # recompiles but are no longer remembered
                         if len(self._shapes_seen) < _MAX_SHAPES_TRACKED:
                             self._shapes_seen.add(key)
+                # per-version shape bookkeeping: a shape first reaching
+                # the live path after this version flipped is a
+                # post-flip recompile (/version, model_swap_v1 gate)
+                mv.record_shape(key)
                 # batch-representative trace AND span (the first live
                 # request's): contextvars do not follow the thread
                 # handoff, so the executor re-binds here — model-
@@ -1163,7 +1321,7 @@ class ServingServer:
                         self.tracer.bind(job["live"][0].span), \
                         self.timings.span("dispatch"), \
                         self._m_dispatch.labels(df.num_rows).time():
-                    out = self.model.transform(df)
+                    out = mv.model.transform(df)
                 # df.num_rows < n_live only for degenerate frames (e.g.
                 # empty-object payloads -> a zero-column frame): still a
                 # row-count error, never a silent short batch
@@ -1175,12 +1333,16 @@ class ServingServer:
                         f"requests); serving models must preserve row "
                         f"count")
                 job["out"] = out
+                # shadow traffic: mirror this batch to the staged
+                # version (sampled, queued, never blocking) — outputs
+                # are compared off the client path
+                self.versions.maybe_shadow(df, out)
             except Exception as e:  # noqa: BLE001 — model failure -> 500s
                 job["error"] = e
             self._add_spans(
                 job["live"], "dispatch", t0, self.tracer.clock.now(),
                 status="ok" if job["error"] is None else "error",
-                bucket=df.num_rows)
+                bucket=df.num_rows, model_version=mv.version)
         return job
 
     def _encode_replies(self, out: DataFrame, in_cols: List[str],
@@ -1232,11 +1394,15 @@ class ServingServer:
             self._add_spans(live, "encode", t0, self.tracer.clock.now(),
                             status="ok" if job["error"] is None
                             else "error")
+        version = job["version"] or self.versions.active.version
         if job["error"] is not None:
             err = json.dumps({"error": str(job["error"])}).encode()
+            with self._stats_lock:
+                self.n_errors += len(live)
             for p in live:
                 p.status = 500
                 p.reply = err
+            self.versions.count_committed(version, len(live))
             self._commit_many(live)
             return
         to_commit = []
@@ -1249,6 +1415,7 @@ class ServingServer:
                 continue
             p.reply = r
             to_commit.append(p)
+        self.versions.count_committed(version, len(to_commit))
         self._commit_many(to_commit)
 
     def _serve_batch(self, batch: List[_PendingRequest]) -> None:
@@ -1273,12 +1440,13 @@ class ServingServer:
         ``n_batches``/``n_requests`` (they really ran the model).
         Returns the dispatched batch sizes.
         """
+        # remember the payload: staged rollout versions warm every
+        # bucket with the same schema before they become flip-eligible
+        self.warmup_payload = payload
         if sizes is None:
             # one batch per reachable bucket: the pow2 ladder clamped at
             # max_batch_size (buckets never exceed the cap)
-            cap = self.max_batch_size
-            sizes = sorted({bucket_target(k, cap)
-                            for k in range(1, cap + 1)})
+            sizes = self._bucket_sizes()
         for n in sizes:
             batch = [_PendingRequest(payload) for _ in range(n)]
             # the dispatch stage debits the backlog; synthetic requests
@@ -1682,6 +1850,9 @@ class ServingServer:
             # everything that will ever call reply() has run: the loops
             # deliver what's queued, flush pending writes, close fds
             self._frontend.stop()
+        # stop mirroring shadow traffic (the staged version, if any,
+        # stays staged — a restart-less stop/start keeps it resident)
+        self.versions.close()
         if self._journal_fh is not None:
             jt = getattr(self, "_journal_thread", None)
             if jt is not None and jt.is_alive():
@@ -1739,6 +1910,10 @@ class ServingCoordinator:
         # the failover schedule, not just the worker fragments
         self.tracer = tracer if tracer is not None else TRACER
         self._lock = threading.Lock()
+        # the current (or last) fleet rollout: POST /rollout starts
+        # one RolloutOrchestrator at a time; GET /rollout reports it
+        self._rollout: Optional[RolloutOrchestrator] = None
+        self._rollout_lock = threading.Lock()
         # previous poll's merged counters: GET /fleet reports
         # rate()-style deltas alongside the lifetime totals (trend
         # needs two scrapes — the ROADMAP fleet-rate item)
@@ -1802,6 +1977,30 @@ class ServingCoordinator:
 
     def _post_route(self, path: str, body: bytes
                     ) -> Optional[Tuple[int, bytes, str]]:
+        if path == "/rollout":
+            # fleet rollout: stage everywhere -> (shadow) -> canary ->
+            # flip or auto-rollback, orchestrated in the background;
+            # poll GET /rollout for the state machine
+            try:
+                args = json.loads(body or b"{}")
+                if not isinstance(args, dict) or not args.get("version"):
+                    raise ValueError('need a JSON object with "version"')
+            except ValueError as e:
+                return (400, json.dumps({"error": str(e)}).encode(),
+                        "application/json")
+            try:
+                run = self.rollout(**args)
+            except TypeError as e:
+                return (400, json.dumps(
+                    {"error": f"bad rollout parameter: {e}"}).encode(),
+                    "application/json")
+            except RolloutError as e:
+                return (409, json.dumps(
+                    {"error": str(e),
+                     "rollout": self.rollout_status()}).encode(),
+                    "application/json")
+            return (202, json.dumps(run.status()).encode(),
+                    "application/json")
         if path not in ("/register", "/deregister"):
             return None
         try:
@@ -1869,6 +2068,9 @@ class ServingCoordinator:
                 out["workers_failed"] = errors
                 body = json.dumps(out).encode()
             return 200, body, "application/json"
+        if path == "/rollout":
+            return (200, json.dumps(self.rollout_status()).encode(),
+                    "application/json")
         if path == "/services":
             with self._lock:
                 self._prune_stale_locked()
@@ -1933,6 +2135,30 @@ class ServingCoordinator:
         with self._lock:
             self._prune_stale_locked()
             return list(self._services)
+
+    # -- fleet rollout orchestration -----------------------------------------
+
+    def rollout(self, version: str, **kwargs) -> RolloutOrchestrator:
+        """Start one fleet rollout (see
+        :class:`~mmlspark_tpu.serving.rollout.RolloutOrchestrator` for
+        the phases and knobs). One at a time: a second call while one
+        is running raises :class:`RolloutError` (HTTP callers get a
+        409)."""
+        with self._rollout_lock:
+            if self._rollout is not None and self._rollout.running:
+                raise RolloutError(
+                    f"a rollout to {self._rollout.version!r} is "
+                    f"already {self._rollout.state}")
+            run = RolloutOrchestrator(self, version, **kwargs)
+            self._rollout = run
+            run.start()
+            return run
+
+    def rollout_status(self) -> Dict[str, Any]:
+        with self._rollout_lock:
+            if self._rollout is None:
+                return {"state": "idle"}
+            return self._rollout.status()
 
     # -- fleet-level stats aggregation ---------------------------------------
 
@@ -2037,10 +2263,19 @@ class ServingCoordinator:
             rates = {k: round(max(totals[k] - prev[1].get(k, 0), 0)
                               / (now - prev[0]), 3)
                      for k in ("n_requests", "n_batches", "n_recompiles")}
+        # the fleet's model-version set (RESPONDING workers only): a
+        # completed rollout reads as one coherent version fleet-wide —
+        # the kill-mid-rollout drill's acceptance signal
+        versions = sorted({str(s["model_version"])
+                           for s in per_worker.values()
+                           if isinstance(s, dict)
+                           and s.get("model_version")})
         return {"n_workers": len(per_worker), "n_responding": n_live,
                 "totals": totals, "rates_per_s": rates,
                 "rate_interval_s": interval, "stage_timings": merged,
                 "slowest_stage": slowest, "widest_bucket": widest,
+                "model_versions": versions,
+                "version_coherent": len(versions) <= 1,
                 "workers": per_worker}
 
     def fleet_metrics(self, timeout: float = 5.0) -> str:

@@ -2,7 +2,8 @@
 
 from mmlspark_tpu.stages.basic import (
     DropColumns, SelectColumns, RenameColumn, Repartition, Cacher,
-    CheckpointData, Explode, Lambda, UDFTransformer, TextPreprocessor,
+    CheckpointData, Explode, Lambda, ScaleColumn, UDFTransformer,
+    TextPreprocessor,
     UnicodeNormalize, ClassBalancer, ClassBalancerModel, PartitionSample,
     MultiColumnAdapter, EnsembleByKey, SummarizeData, Timer, TimerModel,
 )
@@ -21,7 +22,7 @@ from mmlspark_tpu.stages.image import (
 
 __all__ = [
     "DropColumns", "SelectColumns", "RenameColumn", "Repartition", "Cacher",
-    "CheckpointData", "Explode", "Lambda", "UDFTransformer",
+    "CheckpointData", "Explode", "Lambda", "ScaleColumn", "UDFTransformer",
     "TextPreprocessor", "UnicodeNormalize", "ClassBalancer",
     "ClassBalancerModel", "PartitionSample", "MultiColumnAdapter",
     "EnsembleByKey", "SummarizeData", "Timer", "TimerModel",

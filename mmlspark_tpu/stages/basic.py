@@ -63,6 +63,26 @@ class RenameColumn(Transformer, HasInputCol, HasOutputCol):
         return df.rename({self.input_col: self.output_col})
 
 
+class ScaleColumn(Transformer, HasInputCol, HasOutputCol):
+    """``output_col = input_col * scale + offset`` — a fully persistable
+    arithmetic stage (all-JSON params, no complex state).
+
+    Exists for pipelines that need a cheap numeric map, and as the
+    canonical *versionable* serving model: two saved ``ScaleColumn``
+    checkpoints with different ``scale`` are distinguishable model
+    versions, which the rollout tests and ``tools/chaos_serving.py``'s
+    kill-mid-rollout drill stage and flip through real checkpoint
+    directories (see docs/serving.md "Zero-downtime rollout")."""
+
+    scale = Param(1.0, "multiplier", ptype=float)
+    offset = Param(0.0, "additive constant", ptype=float)
+
+    def transform(self, df: DataFrame) -> DataFrame:
+        x = np.asarray(df[self.input_col], dtype=np.float64)
+        return df.with_column(self.output_col,
+                              x * float(self.scale) + float(self.offset))
+
+
 class Repartition(Transformer):
     """Reorder rows so ``n`` contiguous shards are statistically similar.
 

@@ -23,6 +23,14 @@ Wrappers put the plan in front of each layer's failure surface:
 Process-kill schedules are for multi-process harnesses
 (``tools/chaos_serving.py``): the plan only *decides* when to kill; the
 harness owns the actual signal.
+
+Rollout fault points: a
+:class:`~mmlspark_tpu.serving.rollout.ModelVersionManager` constructed
+with ``fault_plan=`` consults the sites ``rollout_load``,
+``rollout_verify``, ``rollout_warmup``, and ``rollout_flip`` (via
+:meth:`FaultPlan.raise_at`), so chaos tests can fail a hot-swap at any
+stage of the load -> verify -> warmup -> flip machine and prove the
+active version keeps serving.
 """
 
 from __future__ import annotations

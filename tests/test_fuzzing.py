@@ -229,6 +229,9 @@ case(B + "SelectColumns", make=_mk("mmlspark_tpu.stages.basic",
      "SelectColumns", cols=["words"]), df=_basic_df)
 case(B + "RenameColumn", make=_mk("mmlspark_tpu.stages.basic", "RenameColumn",
      input_col="words", output_col="w"), df=_basic_df)
+case(B + "ScaleColumn", make=_mk("mmlspark_tpu.stages.basic", "ScaleColumn",
+     input_col="doubles", output_col="scaled", scale=2.0, offset=1.0),
+     df=_basic_df)
 case(B + "Repartition", make=_mk("mmlspark_tpu.stages.basic", "Repartition",
      n=2), df=_basic_df)
 case(B + "Cacher", make=_mk("mmlspark_tpu.stages.basic", "Cacher"),

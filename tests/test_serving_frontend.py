@@ -699,3 +699,181 @@ class TestMetricsPusherAuth:
             assert pusher.n_errors >= 1
         finally:
             fe.stop()
+
+
+# ---------------------------------------------------------------------------
+# Socket-edge fairness + per-IP shedding (ISSUE 7 satellites)
+# ---------------------------------------------------------------------------
+
+def _read_n_responses(sock, n):
+    """Parse ``n`` responses off one socket with a persistent buffer
+    (pipelined replies may coalesce into one recv)."""
+    buf = bytearray()
+    out = []
+    while len(out) < n:
+        he = buf.find(b"\r\n\r\n")
+        if he < 0:
+            chunk = sock.recv(65536)
+            if not chunk:
+                raise ConnectionError(f"EOF after {len(out)} responses")
+            buf += chunk
+            continue
+        head = bytes(buf[:he]).decode("latin-1").split("\r\n")
+        status = int(head[0].split()[1])
+        hdrs = {}
+        for line in head[1:]:
+            k, _, v = line.partition(":")
+            hdrs[k.strip().lower()] = v.strip()
+        clen = int(hdrs.get("content-length", 0))
+        total = he + 4 + clen
+        while len(buf) < total:
+            chunk = sock.recv(65536)
+            if not chunk:
+                raise ConnectionError("EOF mid-body")
+            buf += chunk
+        out.append((status, hdrs, bytes(buf[he + 4:total])))
+        del buf[:total]
+    return out
+
+
+class TestPerIpConnectionCap:
+
+    def test_over_cap_accept_shed_429_then_slot_freed(self):
+        """The third concurrent connection from one peer is refused at
+        accept — immediate 429 + close, before any queue slot is spent
+        — and closing an admitted connection frees the slot."""
+        with _server(max_conns_per_ip=2) as srv:
+            fe = srv._frontend
+            s1, s2 = _connect(srv), _connect(srv)
+            try:
+                for s in (s1, s2):        # both admitted conns serve
+                    s.sendall(_request_bytes())
+                    status, _, _, _ = _read_response(s)
+                    assert status == 200
+                s3 = _connect(srv)
+                try:
+                    status, hdrs, body, _ = _read_response(s3)
+                    assert status == 429
+                    assert hdrs.get("retry-after") == "1"
+                    assert b"too many connections" in body
+                    assert s3.recv(65536) == b""      # closed
+                finally:
+                    s3.close()
+                assert fe.n_per_ip_rejected == 1
+                assert fe.per_ip_high_water == 2
+            finally:
+                s1.close()
+                s2.close()
+            # Prove the released slots readmit: poll until a fresh
+            # connect serves 200 (the loop processes the closes
+            # asynchronously; rejected polls bump the counter too).
+            deadline = time.monotonic() + 5
+            admitted = False
+            while time.monotonic() < deadline and not admitted:
+                s4 = _connect(srv)
+                try:
+                    s4.sendall(_request_bytes())
+                    status, _, _, _ = _read_response(s4)
+                    admitted = status == 200
+                except ConnectionError:
+                    pass
+                finally:
+                    s4.close()
+                if not admitted:
+                    time.sleep(0.05)
+            assert admitted, "closed connections never freed the cap"
+            # with slots free again, the counters are visible over HTTP
+            base = f"http://{srv.host}:{srv.port}"
+            st = requests.get(base + "/stats", timeout=10).json()
+            assert st["frontend"]["per_ip_rejected_total"] >= 1
+            assert st["frontend"]["per_ip_conns_high_water"] == 2
+            text = requests.get(base + "/metrics?scope=server",
+                                timeout=10).text
+            assert "serving_per_ip_rejected_total" in text
+            assert "serving_per_ip_conns_high_water 2" in text
+
+    def test_cap_off_by_default(self):
+        with _server() as srv:
+            fe = srv._frontend
+            assert fe.max_conns_per_ip == 0
+            socks = [_connect(srv) for _ in range(8)]
+            try:
+                for s in socks:
+                    s.sendall(_request_bytes())
+                    assert _read_response(s)[0] == 200
+            finally:
+                for s in socks:
+                    s.close()
+            assert fe.n_per_ip_rejected == 0
+
+
+class TestPipeliningFairnessCap:
+
+    def test_flooding_pipelined_conn_deferred_but_fully_served(self):
+        """A connection flooding N pipelined requests in ONE buffer is
+        served completely and in order, but the loop defers its excess
+        beyond max_pipelined_per_iter to later iterations (counted by
+        serving_pipelining_deferred_total) instead of serving the
+        whole buffer in one pass."""
+        n = 32
+        with _server(max_pipelined_per_iter=2) as srv:
+            fe = srv._frontend
+            # synchronous control-plane GETs reply inline, so one
+            # buffer of them exercises the per-iteration budget
+            burst = (b"GET /healthz HTTP/1.1\r\nHost: t\r\n\r\n") * n
+            sock = _connect(srv)
+            try:
+                sock.sendall(burst)
+                rsps = _read_n_responses(sock, n)
+            finally:
+                sock.close()
+            assert [status for status, _, _ in rsps] == [200] * n
+            assert fe.n_pipelining_deferred >= 1
+            st = requests.get(f"http://{srv.host}:{srv.port}/stats",
+                              timeout=10).json()
+            assert st["frontend"]["pipelining_deferred_total"] >= 1
+
+    def test_cap_zero_disables_deferral(self):
+        n = 16
+        with _server(max_pipelined_per_iter=0) as srv:
+            fe = srv._frontend
+            burst = (b"GET /healthz HTTP/1.1\r\nHost: t\r\n\r\n") * n
+            sock = _connect(srv)
+            try:
+                sock.sendall(burst)
+                rsps = _read_n_responses(sock, n)
+            finally:
+                sock.close()
+            assert [status for status, _, _ in rsps] == [200] * n
+            assert fe.n_pipelining_deferred == 0
+
+    def test_interleaved_conns_all_served_under_cap(self):
+        """Two connections pipelining concurrently under a tight cap:
+        both finish, both in order (fairness must not starve or
+        misdeliver either)."""
+        n = 12
+        with _server(max_pipelined_per_iter=1) as srv:
+            results = {}
+
+            def drive(tag):
+                burst = b"".join(
+                    _request_bytes(body=json.dumps(
+                        {"x": float(i)}).encode())
+                    for i in range(n))
+                s = _connect(srv)
+                try:
+                    s.sendall(burst)
+                    results[tag] = _read_n_responses(s, n)
+                finally:
+                    s.close()
+
+            ts = [threading.Thread(target=drive, args=(t,))
+                  for t in ("a", "b")]
+            for t in ts:
+                t.start()
+            for t in ts:
+                t.join()
+            for tag in ("a", "b"):
+                assert [s for s, _, _ in results[tag]] == [200] * n
+                assert [json.loads(b) for _, _, b in results[tag]] == \
+                    [{"y": 2.0 * i} for i in range(n)]

@@ -23,7 +23,18 @@ complete — and that the survivor's frontend counters prove the burst
 actually rode kept-alive connections. ``--burst-threads 0`` skips the
 phase.
 
-Runs on CPU; no model artifact needed (workers serve an inline doubler).
+A third phase drills the ZERO-DOWNTIME ROLLOUT machinery
+(docs/serving.md "Zero-downtime rollout"): a fresh fleet of workers
+serving a persisted v1 checkpoint, idempotent client traffic, then a
+coordinator-orchestrated ``POST /rollout`` to a v2 checkpoint with
+canary enabled — and one worker SIGKILLed in the middle of it. The
+drill asserts the rollout still ends ``completed`` (survivors finish
+the flip), ``GET /fleet`` reports ONE coherent version set across the
+responding workers, and no logical client request was dropped or
+answered wrongly at any point. ``--rollout-workers 0`` skips the phase.
+
+Runs on CPU; phases 1-2 need no model artifact (workers serve an
+inline doubler); phase 3 persists real ``ScaleColumn`` checkpoints.
 """
 
 import argparse
@@ -58,10 +69,29 @@ while True:
 """
 
 
-def spawn_worker(coord_url: str, journal: str) -> "subprocess.Popen":
+ROLLOUT_WORKER_SCRIPT = """
+import sys, time
+from mmlspark_tpu.serving.server import ServingServer, ServingCoordinator
+from mmlspark_tpu.core.stage import PipelineStage
+
+model = PipelineStage.load(sys.argv[2])
+srv = ServingServer(model, max_latency_ms=1, max_batch_size=8,
+                    journal_path=sys.argv[3], model_version="v1",
+                    slow_trace_ms=None)
+srv.warmup({"x": 0.0})
+srv.start()
+ServingCoordinator.register_worker(sys.argv[1], srv.host, srv.port)
+print(srv.port, flush=True)
+while True:
+    time.sleep(1)
+"""
+
+
+def spawn_worker(coord_url: str, journal: str,
+                 script: str = WORKER_SCRIPT, *extra) -> "subprocess.Popen":
     env = dict(os.environ, PYTHONPATH=REPO)
     p = subprocess.Popen(
-        [sys.executable, "-c", WORKER_SCRIPT, coord_url, journal],
+        [sys.executable, "-c", script, coord_url, *extra, journal],
         stdout=subprocess.PIPE, env=env, text=True)
     port = p.stdout.readline().strip()
     if not port:
@@ -152,6 +182,130 @@ def keepalive_burst_drill(coord_url: str, workers: list,
     }
 
 
+def rollout_drill(tmp: str, seed: int, n_workers: int = 3) -> dict:
+    """Phase 3: kill a worker in the middle of a canary rollout.
+
+    A fresh fleet serves a persisted v1 ``ScaleColumn`` checkpoint;
+    idempotent client traffic runs throughout; the coordinator
+    orchestrates ``POST /rollout`` to a v2 checkpoint (canary on); one
+    NON-canary worker is SIGKILLed once the rollout is under way. Pass
+    iff the rollout ends ``completed``, ``GET /fleet`` shows one
+    coherent version set (``["v2"]``) across responding workers, and
+    every logical client request was answered correctly (v1 or v2
+    output — the flip is mid-traffic — but never an error or a drop).
+    """
+    import threading
+
+    import requests
+
+    from mmlspark_tpu.serving.server import (
+        ServingClient, ServingCoordinator)
+    from mmlspark_tpu.stages import ScaleColumn
+
+    v1_dir = os.path.join(tmp, "model_v1")
+    v2_dir = os.path.join(tmp, "model_v2")
+    ScaleColumn(input_col="x", output_col="y", scale=2.0).save(v1_dir)
+    ScaleColumn(input_col="x", output_col="y", scale=3.0).save(v2_dir)
+
+    coord = ServingCoordinator().start()
+    coord_url = f"http://{coord.host}:{coord.port}"
+    workers = [
+        spawn_worker(coord_url,
+                     os.path.join(tmp, f"r{i}.jsonl"),
+                     ROLLOUT_WORKER_SCRIPT, v1_dir)
+        for i in range(n_workers)]
+    stats = {"n_ok": 0, "n_wrong": 0, "dropped": [],
+             "killed_during": None}
+    stop = threading.Event()
+    client = ServingClient(coord_url, timeout=10)
+
+    def traffic() -> None:
+        i = 0
+        while not stop.is_set():
+            i += 1
+            rid = f"rollout-{seed}-{i}"
+            x = float(i)
+            try:
+                out = client.predict({"x": x}, request_id=rid)
+            except Exception as e:  # noqa: BLE001 — a dropped request
+                stats["dropped"].append({"rid": rid, "error": str(e)})
+                continue
+            # the flip is mid-traffic: v1 (2x) and v2 (3x) replies are
+            # both correct; anything else is a wrong answer
+            if out.get("y") in (2.0 * x, 3.0 * x):
+                stats["n_ok"] += 1
+            else:
+                stats["n_wrong"] += 1
+
+    t = threading.Thread(target=traffic)
+    t.start()
+    try:
+        # canary_min_requests is sized so the canary phase lasts long
+        # enough (roughly a second under this traffic) for the kill to
+        # land genuinely mid-rollout, not after it
+        r = requests.post(coord_url + "/rollout", json={
+            "path": v2_dir, "version": "v2", "canary": True,
+            "warmup_payload": {"x": 0.0},
+            "canary_window_s": 8.0, "canary_min_requests": 150,
+            "poll_interval_s": 0.05}, timeout=10)
+        assert r.status_code == 202, r.text
+        # kill a NON-canary worker (the orchestrator canaries the
+        # first registered) once the rollout is past staging
+        deadline = time.perf_counter() + 30
+        state = "pending"
+        while time.perf_counter() < deadline:
+            state = requests.get(coord_url + "/rollout",
+                                 timeout=10).json()["state"]
+            if state in ("canary", "flipping", "completed",
+                         "rolled_back", "failed"):
+                break
+            time.sleep(0.05)
+        stats["killed_during"] = state
+        os.kill(workers[-1].pid, signal.SIGKILL)
+        workers[-1].wait()
+        # wait for the rollout to reach a terminal state
+        deadline = time.perf_counter() + 60
+        final = None
+        while time.perf_counter() < deadline:
+            final = requests.get(coord_url + "/rollout",
+                                 timeout=10).json()
+            if final["state"] in ("completed", "rolled_back", "failed"):
+                break
+            time.sleep(0.1)
+        fleet = requests.get(coord_url + "/fleet", timeout=10).json()
+    finally:
+        stop.set()
+        t.join()
+        for w in workers:
+            if w.poll() is None:
+                w.kill()
+                w.wait()
+        coord.stop()
+    ok = (final is not None and final["state"] == "completed"
+          and stats["killed_during"] in ("canary", "flipping")
+          and fleet["model_versions"] == ["v2"]
+          and fleet["version_coherent"]
+          and fleet["n_responding"] == n_workers - 1
+          and stats["n_wrong"] == 0 and not stats["dropped"]
+          and stats["n_ok"] > 0)
+    return {
+        "what": "kill one worker mid-canary-rollout; survivors must "
+                "finish the flip",
+        "n_workers": n_workers,
+        "rollout": {"state": final["state"] if final else None,
+                    "decision": final.get("decision") if final else None,
+                    "workers": final.get("workers") if final else None},
+        "killed_during": stats["killed_during"],
+        "fleet_versions": fleet["model_versions"],
+        "version_coherent": fleet["version_coherent"],
+        "n_responding": fleet["n_responding"],
+        "traffic": {"n_ok": stats["n_ok"], "n_wrong": stats["n_wrong"],
+                    "n_dropped": len(stats["dropped"]),
+                    "dropped": stats["dropped"][:5]},
+        "ok": ok,
+    }
+
+
 def main() -> int:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("--requests", type=int, default=120)
@@ -166,6 +320,10 @@ def main() -> int:
                          "(0 skips the phase)")
     ap.add_argument("--burst-requests", type=int, default=15,
                     help="requests per burst thread")
+    ap.add_argument("--rollout-workers", type=int, default=3,
+                    help="phase-3 kill-mid-rollout drill fleet size "
+                         "(0 skips the phase; needs >= 3 so a "
+                         "non-canary worker can die)")
     args = ap.parse_args()
 
     from mmlspark_tpu.serving.server import (
@@ -241,6 +399,11 @@ def main() -> int:
                 per_thread=args.burst_requests, seed=args.seed)
             workers[1] = spawn_worker(
                 coord_url, os.path.join(tmp, "w1.jsonl"))
+        rollout = None
+        if args.rollout_workers > 0:
+            rollout = rollout_drill(tmp, args.seed,
+                                    n_workers=max(args.rollout_workers,
+                                                  3))
         wall = time.perf_counter() - t0
 
         per_worker = [worker_status(w.port) for w in workers]
@@ -258,6 +421,7 @@ def main() -> int:
                          ("n_requests", "n_replayed", "n_shed",
                           "journal_recovered")} for s in per_worker],
             **({"burst": burst} if burst is not None else {}),
+            **({"rollout": rollout} if rollout is not None else {}),
             "wall_s": round(wall, 3),
         }
         print(json.dumps(report, indent=2))
@@ -271,7 +435,8 @@ def main() -> int:
               and not stats["failed_rids"]
               and recovered
               and stats.get("fleet_traces_ok", True)
-              and (burst is None or burst["ok"]))
+              and (burst is None or burst["ok"])
+              and (rollout is None or rollout["ok"]))
         print("RESULT:", "PASS" if ok else "FAIL")
         return 0 if ok else 1
     finally:
