@@ -1085,3 +1085,163 @@ def make_batch(rng: np.random.Generator, cfg: TransformerConfig,
     labels = jnp.asarray(toks[:, 1:].astype(np.int32))
     mask = jnp.ones((batch, seq), jnp.float32)
     return tokens, labels, mask
+
+
+# ---------------------------------------------------------------------------
+# autoregressive decode: slot-indexed KV-cache pool
+#
+# The serving-side decode path. Shapes are FIXED at build time
+# ([n_slots, ...] for the single-token step, a bucketed prompt ladder
+# for prefill), the cache is one preallocated pool donated through
+# every call (cache-in buffers are reused for cache-out — zero
+# steady-state HBM allocations), and requests address it by SLOT: a
+# request claims a free slot, prefill fills rows [0, len) of that
+# slot's lane in every layer, each decode step appends one row at its
+# position, and freeing the slot is just returning the index — the
+# next occupant's prefill overwrites the lane. Single-device by
+# design (decode serving is replicated per worker; the SPMD mesh
+# stays a training concern); dense-MLP configs only.
+
+
+def _decode_block_params(params, cfg: TransformerConfig
+                         ) -> List[Dict[str, Any]]:
+    """Per-layer param dicts in reference order (stage-major), with
+    the leading ``n_stages`` dim indexed away."""
+    out = []
+    for s in range(cfg.n_stages):
+        for bp_all in params["blocks"]:
+            out.append({k: v[s] for k, v in bp_all.items()})
+    return out
+
+
+def _rope_at(x, pos):
+    """Rotary embedding for one token per slot: ``x`` [N, H, Dh] at
+    per-slot positions ``pos`` [N] (each slot is mid-sequence at its
+    own depth — the batched analogue of :func:`_rope` at S=1)."""
+    dh = x.shape[-1]
+    freqs = 1.0 / (10000.0 ** (jnp.arange(0, dh, 2) / dh))
+    ang = pos[:, None].astype(jnp.float32) * freqs[None, :]  # [N, Dh/2]
+    cos = jnp.cos(ang)[:, None, :]
+    sin = jnp.sin(ang)[:, None, :]
+    x1, x2 = x[..., 0::2], x[..., 1::2]
+    r1 = x1 * cos - x2 * sin
+    r2 = x1 * sin + x2 * cos
+    return jnp.stack([r1, r2], axis=-1).reshape(x.shape)
+
+
+def _check_decode_config(cfg: TransformerConfig) -> None:
+    if cfg.n_experts:
+        raise NotImplementedError(
+            "the slot-indexed decode path supports dense-MLP configs "
+            "only (MoE decode needs per-token capacity routing at "
+            "batch 1 — a different dispatch problem)")
+
+
+def init_kv_cache(cfg: TransformerConfig, n_slots: int, max_len: int
+                  ) -> Dict[str, jax.Array]:
+    """The preallocated slot-indexed KV pool: ``{"k", "v"}`` arrays of
+    shape ``[n_layers, n_slots, max_len, n_heads, d_head]`` (f32 — the
+    decode path mirrors the reference forward's numerics so greedy
+    decode matches the full-context argmax token-for-token). Allocated
+    ONCE; every prefill/decode call donates it back in."""
+    _check_decode_config(cfg)
+    shape = (cfg.n_layers, int(n_slots), int(max_len),
+             cfg.n_heads, cfg.d_head)
+    return {"k": jnp.zeros(shape, jnp.float32),
+            "v": jnp.zeros(shape, jnp.float32)}
+
+
+def build_prefill(cfg: TransformerConfig, donate: bool = True):
+    """Jitted ``prefill(params, cache, tokens, slot, length) ->
+    (cache, next_token, last_logits)``.
+
+    ``tokens`` is ONE bucket-padded prompt ``[S_pad]`` (one compile per
+    bucket — the prompt ladder is the serving shape set), ``slot`` the
+    claimed cache lane, ``length`` the true prompt length. Every
+    layer's K/V rows land in ``cache[...][layer, slot, :S_pad]``; rows
+    past ``length`` hold padding-token garbage, but the decode step's
+    position mask never reads an index it has not yet overwritten, so
+    they are dead by construction. The cache is donated: prefill
+    writes in place, no second pool exists.
+
+    ``next_token`` is the greedy argmax at position ``length - 1`` —
+    the first generated token."""
+    _check_decode_config(cfg)
+
+    def prefill(params, cache, tokens, slot, length):
+        x = params["embed"][tokens][None]              # [1, S, D]
+        pos = jnp.arange(tokens.shape[0])
+        ck, cv = cache["k"], cache["v"]
+        for l, bp in enumerate(_decode_block_params(params, cfg)):
+            h = _rmsnorm(x, bp["ln1"])
+            q = _rope(jnp.einsum("bsd,dhk->bshk", h, bp["wq"]), pos)
+            k = _rope(jnp.einsum("bsd,dhk->bshk", h, bp["wk"]), pos)
+            v = jnp.einsum("bsd,dhk->bshk", h, bp["wv"])
+            # [S, H, Dh] -> this layer's slot lane, rows [0, S)
+            ck = jax.lax.dynamic_update_slice(
+                ck, k[0][None, None], (l, slot, 0, 0, 0))
+            cv = jax.lax.dynamic_update_slice(
+                cv, v[0][None, None], (l, slot, 0, 0, 0))
+            a = dense_attention(q, k, v, causal=True)
+            x = x + jnp.einsum("bshk,hkd->bsd", a, bp["wo"])
+            h2 = _rmsnorm(x, bp["ln2"])
+            z = jax.nn.relu(jnp.einsum("bsd,df->bsf", h2, bp["w1"])
+                            + bp["b1"])
+            x = x + jnp.einsum("bsf,fd->bsd", z, bp["w2"]) + bp["b2"]
+        h = _rmsnorm(x[0], params["final_norm"])       # [S, D]
+        last = jax.lax.dynamic_index_in_dim(h, length - 1, axis=0,
+                                            keepdims=False)
+        logits = last @ params["head"]
+        return ({"k": ck, "v": cv},
+                jnp.argmax(logits, -1).astype(jnp.int32), logits)
+
+    return jax.jit(prefill, donate_argnums=(1,) if donate else ())
+
+
+def build_decode_step(cfg: TransformerConfig, n_slots: int,
+                      max_len: int, donate: bool = True):
+    """Jitted ``step(params, cache, tokens, pos) -> (cache,
+    next_tokens, logits)`` — ONE token for every slot at once.
+
+    All shapes are fixed at build time (``tokens``/``pos`` are
+    ``[n_slots]`` int32), so the step compiles exactly once however
+    requests join and leave; the cache is donated, so a warm loop
+    allocates nothing on device. Each slot writes its new K/V row at
+    ``pos[slot]`` then attends over its own lane masked to
+    ``index <= pos`` — slots are fully independent, which is what lets
+    the scheduler splice a freshly prefilled request into a running
+    batch between steps. Free slots ride along with ``token 0 @ pos
+    0`` (their lane row 0 is rewritten by the next prefill); their
+    outputs are garbage the host never reads."""
+    _check_decode_config(cfg)
+    n_slots, max_len = int(n_slots), int(max_len)
+    scale = cfg.d_head ** -0.5
+    rows = jnp.arange(n_slots)
+    idx = jnp.arange(max_len)
+
+    def step(params, cache, tokens, pos):
+        x = params["embed"][tokens]                    # [N, D]
+        ck, cv = cache["k"], cache["v"]
+        mask = idx[None, None, :] <= pos[:, None, None]  # [N, 1, S]
+        for l, bp in enumerate(_decode_block_params(params, cfg)):
+            h = _rmsnorm(x, bp["ln1"])
+            q = _rope_at(jnp.einsum("nd,dhk->nhk", h, bp["wq"]), pos)
+            k = _rope_at(jnp.einsum("nd,dhk->nhk", h, bp["wk"]), pos)
+            v = jnp.einsum("nd,dhk->nhk", h, bp["wv"])
+            ck = ck.at[l, rows, pos].set(k)
+            cv = cv.at[l, rows, pos].set(v)
+            s = jnp.einsum("nhk,nshk->nhs", q, ck[l]) * scale
+            s = jnp.where(mask, s, -1e30)
+            p = jax.nn.softmax(s, axis=-1)
+            a = jnp.einsum("nhs,nshk->nhk", p, cv[l])
+            x = x + jnp.einsum("nhk,hkd->nd", a, bp["wo"])
+            h2 = _rmsnorm(x, bp["ln2"])
+            z = jax.nn.relu(jnp.einsum("nd,df->nf", h2, bp["w1"])
+                            + bp["b1"])
+            x = x + jnp.einsum("nf,fd->nd", z, bp["w2"]) + bp["b2"]
+        h = _rmsnorm(x, params["final_norm"])
+        logits = h @ params["head"]
+        return ({"k": ck, "v": cv},
+                jnp.argmax(logits, -1).astype(jnp.int32), logits)
+
+    return jax.jit(step, donate_argnums=(1,) if donate else ())

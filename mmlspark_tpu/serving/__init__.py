@@ -21,11 +21,17 @@ from mmlspark_tpu.serving.server import (
     ServingClient, ServingCoordinator, ServingServer,
 )
 from mmlspark_tpu.serving.consolidator import PartitionConsolidator
+from mmlspark_tpu.serving.decode import (
+    DecodeOverloaded, DecodeScheduler, SlotPool, TransformerDecoder,
+)
 from mmlspark_tpu.serving.frontend import EventLoopFrontend
+from mmlspark_tpu.serving.policy import AdaptiveBatchPolicy
 from mmlspark_tpu.serving.rollout import (
     ModelVersionManager, RolloutError, RolloutOrchestrator,
 )
 
 __all__ = ["ServingServer", "ServingCoordinator", "ServingClient",
            "PartitionConsolidator", "EventLoopFrontend",
-           "ModelVersionManager", "RolloutError", "RolloutOrchestrator"]
+           "ModelVersionManager", "RolloutError", "RolloutOrchestrator",
+           "DecodeScheduler", "DecodeOverloaded", "SlotPool",
+           "TransformerDecoder", "AdaptiveBatchPolicy"]

@@ -35,6 +35,12 @@ Environment:
                    live batch sizes, at the cost of per-size jit
                    retraces), ENCODER_THREADS sizes the reply-encoder
                    pool — see docs/serving.md "The data plane"
+  BATCH_POLICY     (worker, optional) "adaptive" decides the batch-
+                   mate wait per batch from the live arrival rate +
+                   per-bucket dispatch latencies (MAX_LATENCY_MS
+                   becomes the hard ceiling); default "fixed" keeps
+                   the constant knob — docs/serving.md "Adaptive
+                   batching"
   WARMUP_PAYLOAD   (worker, optional) a JSON example payload; when set,
                    the worker dispatches one synthetic batch per shape
                    bucket (ServingServer.warmup) BEFORE registering
@@ -160,7 +166,8 @@ def run_worker() -> None:
         max_pipelined_per_iter=int(
             _env_float("MAX_PIPELINED_PER_ITER", 16)),
         model_version=os.environ.get("MODEL_VERSION", "v1"),
-        verify_checkpoints=_env_float("VERIFY_CHECKPOINTS", 1) != 0)
+        verify_checkpoints=_env_float("VERIFY_CHECKPOINTS", 1) != 0,
+        batch_policy=os.environ.get("BATCH_POLICY", "fixed"))
     warm = os.environ.get("WARMUP_PAYLOAD")
     if warm:
         # warm BEFORE start(): the socket is already bound (early

@@ -1,0 +1,652 @@
+"""Continuous batching for autoregressive decode.
+
+The frame-serving plane (server.py) dispatches whole shape-bucketed
+batches: right for stateless models, wrong for autoregressive decode,
+where requests have private growing state (a KV cache) and finish at
+different times — batching whole requests would hold every member
+until the slowest one's last token. This module batches at the *slot*
+level instead:
+
+* a :class:`TransformerDecoder` owns ONE preallocated slot-indexed
+  KV-cache pool (``models/transformer.init_kv_cache``) plus the jitted
+  prefill/step functions built over it — fixed shapes, donated cache,
+  so a warm decode loop performs **zero device allocations and zero
+  retraces** however requests churn;
+* a :class:`DecodeScheduler` runs the step loop: between any two
+  decode steps, waiting requests claim free slots (one bucketed
+  prefill each), finished requests (EOS / token budget / cache-lane
+  end / deadline / cancel) release theirs, and the single-token step
+  always runs over the full fixed ``[n_slots]`` batch. The loop never
+  stops or retraces while traffic flows — joiners splice in between
+  steps, leavers just return an index.
+
+Requests ride the server's existing admission machinery
+(:class:`~mmlspark_tpu.serving.server.ServingServer` routes its
+``decode_path`` here): replay/join/shed/deadline semantics, the reply
+journal, root spans, and the trace id all behave exactly as on the
+frame plane. Tokens are emitted incrementally into the request's
+in-flight state (visible via ``GET /decode/stats``); the reply carries
+the full sequence once the request leaves its slot.
+
+Observability: slot occupancy, decode steps, per-token counters,
+prefill/step latency histograms, and queue-wait all land in the
+server's registry (``docs/observability.md`` "Decode metrics"); every
+request's trace shows ``queue_wait``/``prefill``/``decode`` children
+under its root. Chaos: a ``fault_plan`` drives the ``decode_prefill``
+and ``decode_step`` sites — an injected step fault 500s the affected
+requests but **never strands a slot** (tests/test_serving_decode.py).
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from collections import deque
+from typing import Any, Callable, Dict, List, Optional
+
+import numpy as np
+
+from mmlspark_tpu.core.logs import get_logger
+from mmlspark_tpu.core.resilience import SYSTEM_CLOCK, Clock
+from mmlspark_tpu.parallel.sharding import bucket_target
+
+logger = get_logger("serving.decode")
+
+
+class DecodeOverloaded(RuntimeError):
+    """The waiting queue is full: new decode work must shed (429)."""
+
+
+class TransformerDecoder:
+    """The model side of continuous batching: one KV pool + the jitted
+    prefill/step pair over it, with host-side bookkeeping.
+
+    Not thread-safe by design — exactly one :class:`DecodeScheduler`
+    loop thread drives it (the cache is DONATED through every call;
+    two concurrent calls would race one buffer). ``eos_id`` is the
+    stop token (None = never stops early; requests end on their token
+    budget). ``warmup()`` compiles the step and every prompt bucket;
+    after it, :meth:`n_compiles` staying flat is the zero-retrace
+    evidence the bench gates on."""
+
+    def __init__(self, params, cfg, n_slots: int = 8,
+                 max_len: int = 256, eos_id: Optional[int] = None,
+                 donate: bool = True):
+        from mmlspark_tpu.models import transformer as T
+        self.params = params
+        self.cfg = cfg
+        self.n_slots = int(n_slots)
+        self.max_len = int(max_len)
+        self.eos_id = eos_id
+        self.cache = T.init_kv_cache(cfg, self.n_slots, self.max_len)
+        self._prefill = T.build_prefill(cfg, donate=donate)
+        self._step = T.build_decode_step(cfg, self.n_slots,
+                                         self.max_len, donate=donate)
+
+    # -- shapes --------------------------------------------------------------
+
+    def prompt_buckets(self) -> List[int]:
+        """The prefill shape ladder: pow2 buckets clamped at
+        ``max_len`` (same policy as the frame plane's batch buckets —
+        one ladder idiom framework-wide)."""
+        return sorted({bucket_target(n, self.max_len)
+                       for n in range(1, self.max_len + 1)})
+
+    def pad_prompt(self, prompt: np.ndarray) -> np.ndarray:
+        bucket = bucket_target(len(prompt), self.max_len)
+        out = np.zeros(bucket, np.int32)
+        out[:len(prompt)] = prompt
+        return out
+
+    # -- compute -------------------------------------------------------------
+
+    def prefill(self, slot: int, prompt: np.ndarray) -> int:
+        """Fill ``slot``'s cache lane from ``prompt``; returns the
+        first generated (greedy) token."""
+        import jax.numpy as jnp
+        padded = self.pad_prompt(prompt)
+        self.cache, nxt, _ = self._prefill(
+            self.params, self.cache, jnp.asarray(padded),
+            np.int32(slot), np.int32(len(prompt)))
+        return int(nxt)
+
+    def step(self, tokens: np.ndarray, pos: np.ndarray) -> np.ndarray:
+        """One token for every slot: ``tokens``/``pos`` are the full
+        fixed ``[n_slots]`` arrays (free slots ride along at token 0 /
+        pos 0)."""
+        import jax.numpy as jnp
+        self.cache, nxt, _ = self._step(
+            self.params, self.cache, jnp.asarray(tokens),
+            jnp.asarray(pos))
+        return np.asarray(nxt)
+
+    def n_compiles(self) -> int:
+        """Compiled-executable count across prefill buckets + the step
+        (jit cache sizes): flat after warmup = zero retraces."""
+        return int(self._prefill._cache_size()
+                   + self._step._cache_size())
+
+    def warmup(self) -> int:
+        """Compile the decode step and every prefill bucket before
+        traffic (the cache content it writes is garbage on a FREE
+        slot's lane, which the next real prefill overwrites). Returns
+        the compile count — the post-warmup baseline."""
+        zeros_t = np.zeros(self.n_slots, np.int32)
+        self.step(zeros_t, zeros_t.copy())
+        for bucket in self.prompt_buckets():
+            self.prefill(0, np.zeros(min(bucket, self.max_len - 1),
+                                     np.int32))
+        return self.n_compiles()
+
+
+class SlotPool:
+    """Free-slot index pool. Claim/release are O(1) under one lock;
+    the scheduler loop is the only claimer, but cancel paths and tests
+    read ``n_free`` concurrently."""
+
+    def __init__(self, n_slots: int):
+        self.n_slots = int(n_slots)
+        self._free = list(range(self.n_slots - 1, -1, -1))
+        self._lock = threading.Lock()
+
+    def claim(self) -> Optional[int]:
+        with self._lock:
+            return self._free.pop() if self._free else None
+
+    def release(self, slot: int) -> None:
+        with self._lock:
+            if slot in self._free:
+                raise RuntimeError(f"slot {slot} double-released")
+            self._free.append(slot)
+
+    @property
+    def n_free(self) -> int:
+        with self._lock:
+            return len(self._free)
+
+
+class _DecodeRequest:
+    """Per-request decode state, riding alongside the server's
+    ``_PendingRequest`` (``pending`` — reply/status/event/callbacks/
+    deadline/trace/span all live there)."""
+
+    __slots__ = ("pending", "prompt", "max_new", "produced", "slot",
+                 "cancelled", "t_submit", "t_prefill", "t_decode")
+
+    def __init__(self, pending, prompt: np.ndarray, max_new: int):
+        self.pending = pending
+        self.prompt = prompt
+        self.max_new = int(max_new)
+        self.produced: List[int] = []       # incremental emission
+        self.slot: Optional[int] = None
+        self.cancelled = False
+        self.t_submit: float = 0.0
+        self.t_prefill: float = 0.0
+        self.t_decode: float = 0.0
+
+
+class DecodeScheduler:
+    """The continuous-batching step loop.
+
+    ``submit()`` (any thread) parses and enqueues; the loop thread
+    admits waiting requests into free slots between steps, runs the
+    fixed-shape decode step while any slot is live, and resolves
+    requests through the server's commit path (journal + spans +
+    waiter release) — or a standalone default when unbound (direct
+    scheduler tests).
+
+    Slot lifecycle (docs/serving.md "Continuous batching"):
+
+    ``waiting -> prefill(slot claimed) -> stepping -> released`` on
+    the first of: EOS, ``max_new_tokens`` produced, cache lane full
+    (``max_len``), deadline expired, cancel, or an injected/real step
+    fault. Every exit path releases the slot — the slot-leak chaos
+    test churns all of them and asserts ``n_free == n_slots`` after.
+    """
+
+    def __init__(self, decoder: TransformerDecoder,
+                 max_waiting: int = 256,
+                 max_new_tokens_default: int = 64,
+                 clock: Clock = SYSTEM_CLOCK,
+                 fault_plan=None,
+                 registry=None, tracer=None,
+                 idle_wait_s: float = 0.02):
+        self.decoder = decoder
+        self.max_waiting = int(max_waiting)
+        self.max_new_tokens_default = int(max_new_tokens_default)
+        self.clock = clock
+        self.fault_plan = fault_plan
+        self.tracer = tracer
+        self.idle_wait_s = float(idle_wait_s)
+        self.pool = SlotPool(decoder.n_slots)
+        self._waiting: deque = deque()
+        self._by_rid: Dict[str, _DecodeRequest] = {}
+        self._active: Dict[int, _DecodeRequest] = {}
+        self._tokens = np.zeros(decoder.n_slots, np.int32)
+        self._pos = np.zeros(decoder.n_slots, np.int32)
+        self._lock = threading.Lock()
+        self._work = threading.Event()
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        # resolved by bind(); standalone default releases the pending
+        # directly (event + callbacks), no journal
+        self._commit: Callable[[Any], None] = self._standalone_commit
+        self.n_requests = 0
+        self.n_steps = 0
+        self.n_tokens = 0
+        self.n_prefills = 0
+        self.n_step_faults = 0
+        self.releases: Dict[str, int] = {}   # finish_reason -> count
+        self._m_prefill = None
+        self._m_step = None
+        self._m_queue_wait = None
+        if registry is not None:
+            self._register_metrics(registry)
+
+    # -- wiring --------------------------------------------------------------
+
+    def bind(self, server) -> None:
+        """Attach to a :class:`ServingServer`: its registry, tracer,
+        clock, and commit path (journaled exactly-once replies) become
+        this scheduler's."""
+        self.clock = server.clock
+        self.tracer = server.tracer
+        self._commit = server._commit
+        self._register_metrics(server.registry)
+
+    def _register_metrics(self, m) -> None:
+        m.gauge("serving_decode_slots_in_use",
+                "KV-cache slots currently decoding."
+                ).set_function(lambda: len(self._active))
+        m.gauge("serving_decode_slots_free",
+                "Free KV-cache slots.").set_function(
+            lambda: self.pool.n_free)
+        m.gauge("serving_decode_waiting",
+                "Decode requests admitted but not yet in a slot."
+                ).set_function(lambda: len(self._waiting))
+        for name, help_, fn in (
+            ("serving_decode_requests_total",
+             "Decode requests that entered the scheduler.",
+             lambda: self.n_requests),
+            ("serving_decode_steps_total",
+             "Single-token decode steps executed (each covers every "
+             "live slot).", lambda: self.n_steps),
+            ("serving_decode_tokens_total",
+             "Tokens emitted to live requests.",
+             lambda: self.n_tokens),
+            ("serving_decode_prefills_total",
+             "Prompt prefills (slot claims).",
+             lambda: self.n_prefills),
+            ("serving_decode_step_faults_total",
+             "Decode steps that raised (injected or real); affected "
+             "requests 500, slots are released.",
+             lambda: self.n_step_faults),
+        ):
+            m.counter(name, help_).set_function(fn)
+        self._m_prefill = m.histogram(
+            "serving_prefill_latency_ms",
+            "Prompt prefill wall-clock per prompt bucket.",
+            labels=("bucket",))
+        self._m_step = m.histogram(
+            "serving_decode_step_latency_ms",
+            "Single-token decode step wall-clock (all slots at once).")
+        self._m_queue_wait = m.histogram(
+            "serving_decode_queue_wait_ms",
+            "Submit -> slot-claim wait per decode request.")
+
+    # -- admission (any thread) ----------------------------------------------
+
+    def overloaded(self) -> bool:
+        return len(self._waiting) >= self.max_waiting
+
+    def parse(self, payload: Any) -> "tuple[np.ndarray, int]":
+        """Payload -> (prompt tokens, max_new). Raises ValueError on
+        anything the decode plane cannot serve (the caller 400s)."""
+        if not isinstance(payload, dict):
+            raise ValueError("decode payload must be a JSON object")
+        prompt = payload.get("prompt")
+        if not isinstance(prompt, list) or not prompt or \
+                not all(isinstance(t, int) and not isinstance(t, bool)
+                        and 0 <= t for t in prompt):
+            # bool is an int subclass: [true, false] must 400, not
+            # silently decode as tokens [1, 0]
+            raise ValueError(
+                'decode payload needs "prompt": [token ids] '
+                '(non-empty list of non-negative ints)')
+        if any(t >= self.decoder.cfg.vocab for t in prompt):
+            raise ValueError(
+                f"prompt token out of range (vocab "
+                f"{self.decoder.cfg.vocab})")
+        if len(prompt) >= self.decoder.max_len:
+            raise ValueError(
+                f"prompt length {len(prompt)} >= max_len "
+                f"{self.decoder.max_len} (no room to generate)")
+        max_new = payload.get("max_new_tokens",
+                              self.max_new_tokens_default)
+        if not isinstance(max_new, int) or isinstance(max_new, bool) \
+                or max_new < 1:
+            raise ValueError('"max_new_tokens" must be a positive int')
+        # the cache lane bounds the sequence: clamp the budget to it
+        max_new = min(max_new, self.decoder.max_len - len(prompt))
+        return np.asarray(prompt, np.int32), max_new
+
+    def submit(self, pending) -> None:
+        """Enqueue one admitted request (already past the server's
+        replay/join/shed/doa checks). Raises ValueError on a bad
+        payload (caller replies 400), DecodeOverloaded when the
+        waiting queue is full (caller replies 429)."""
+        prompt, max_new = self.parse(pending.payload)
+        req = _DecodeRequest(pending, prompt, max_new)
+        req.t_submit = self.clock.now()
+        with self._lock:
+            if len(self._waiting) >= self.max_waiting:
+                raise DecodeOverloaded("decode waiting queue full")
+            self._waiting.append(req)
+            self._by_rid[pending.rid] = req
+            self.n_requests += 1
+        self._work.set()
+
+    def cancel(self, rid: str) -> bool:
+        """Flag a waiting or in-slot request cancelled; it resolves
+        (partial tokens, ``finish_reason: "cancelled"``) and frees its
+        slot at the next loop pass. Returns False for unknown rids."""
+        with self._lock:
+            req = self._by_rid.get(rid)
+            if req is None:
+                return False
+            req.cancelled = True
+        self._work.set()
+        return True
+
+    # -- resolution ----------------------------------------------------------
+
+    @staticmethod
+    def _standalone_commit(p) -> None:
+        p.event.set()
+        for cb in p.callbacks:
+            try:
+                cb(p)
+            except Exception:  # noqa: BLE001 — mirror server._release
+                logger.warning("reply callback failed", exc_info=True)
+
+    def _now(self) -> float:
+        return (self.tracer.clock.now() if self.tracer is not None
+                else self.clock.now())
+
+    def _add_span(self, req: _DecodeRequest, name: str, t0: float,
+                  t1: float, status: str = "ok", **attrs) -> None:
+        if self.tracer is not None and req.pending.span is not None:
+            self.tracer.add(name, t0, t1, parent=req.pending.span,
+                            status=status, **attrs)
+
+    def _finish(self, req: _DecodeRequest, reason: str,
+                status: int = 200,
+                error: Optional[str] = None) -> None:
+        """Resolve a request and (if it held one) free its slot —
+        EVERY exit path funnels here, so a slot can never leak."""
+        if req.slot is not None:
+            with self._lock:
+                # under the lock so stats() can snapshot _active
+                # against the loop thread's churn
+                self._active.pop(req.slot, None)
+            self._tokens[req.slot] = 0
+            self._pos[req.slot] = 0
+            self.pool.release(req.slot)
+            t1 = self._now()
+            self._add_span(req, "decode", req.t_decode, t1,
+                           status="ok" if status == 200 else "error",
+                           slot=req.slot, n_tokens=len(req.produced),
+                           finish_reason=reason)
+            req.slot = None
+        with self._lock:
+            self._by_rid.pop(req.pending.rid, None)
+            self.releases[reason] = self.releases.get(reason, 0) + 1
+        p = req.pending
+        if status == 200:
+            p.status = 200
+            p.reply = json.dumps(
+                {"tokens": req.produced,
+                 "n_tokens": len(req.produced),
+                 "prompt_len": int(len(req.prompt)),
+                 "finish_reason": reason}).encode()
+        else:
+            p.status = status
+            p.reply = json.dumps(
+                {"error": error or reason,
+                 "tokens": req.produced,
+                 "n_tokens": len(req.produced),
+                 "finish_reason": reason}).encode()
+        self._commit(p)
+
+    # -- the loop ------------------------------------------------------------
+
+    def start(self) -> "DecodeScheduler":
+        if self._thread is None or not self._thread.is_alive():
+            self._stop.clear()
+            self._thread = threading.Thread(target=self._loop,
+                                            daemon=True,
+                                            name="decode-scheduler")
+            self._thread.start()
+        return self
+
+    def stop(self, timeout: float = 5.0) -> None:
+        self._stop.set()
+        self._work.set()
+        if self._thread is not None:
+            self._thread.join(timeout=timeout)
+            if self._thread.is_alive():
+                # the loop is stuck inside a prefill/step (hung device,
+                # first-compile of a big model): finishing its in-slot
+                # requests from HERE would race its own retirement path
+                # — double slot releases, double commits. Leave them to
+                # the daemon thread; stranded clients 504 at
+                # request_timeout (the server stop() idiom).
+                logger.warning(
+                    "decode loop did not stop in %.1fs; leaving "
+                    "in-flight slots to it", timeout)
+                return
+        # the loop is dead: resolve stragglers so no client hangs
+        with self._lock:
+            waiting = list(self._waiting)
+            self._waiting.clear()
+        for req in waiting:
+            self._finish(req, "error", status=503,
+                         error="decode scheduler stopping")
+        for req in list(self._active.values()):
+            self._finish(req, "error", status=503,
+                         error="decode scheduler stopping")
+
+    def _loop(self) -> None:
+        while not self._stop.is_set():
+            # dead waiters resolve EVERY pass, slots full or not: with
+            # every slot pinned by long decodes, a cancelled/expired
+            # waiter must still get its prompt reply (and stop counting
+            # toward overloaded()) instead of rotting until the
+            # frontend's request_timeout
+            self._reap_waiting()
+            self._admit_waiting()
+            if not self._active:
+                # fully idle (nothing waiting either) -> block until
+                # submit()/cancel()/stop() wakes us, no 50 Hz poll;
+                # with waiters held back by deadline-less slots the
+                # short timeout keeps their deadlines honest
+                self._work.wait(self.idle_wait_s
+                                if self._waiting else None)
+                self._work.clear()
+                continue
+            self._run_step()
+
+    def _reap_waiting(self) -> None:
+        with self._lock:
+            if not self._waiting:
+                return
+            keep, dead = deque(), []
+            for req in self._waiting:
+                p = req.pending
+                if req.cancelled or (p.deadline is not None
+                                     and p.deadline.expired):
+                    dead.append(req)
+                else:
+                    keep.append(req)
+            self._waiting = keep
+        for req in dead:
+            if req.cancelled:
+                self._finish(req, "cancelled")
+            else:
+                self._finish(req, "deadline", status=504,
+                             error="deadline exceeded before decode")
+
+    def _pop_waiting(self) -> Optional[_DecodeRequest]:
+        with self._lock:
+            return self._waiting.popleft() if self._waiting else None
+
+    def _admit_waiting(self) -> None:
+        """Between steps: claim free slots for waiting requests (one
+        prefill each). Cancelled/expired waiters resolve WITHOUT ever
+        claiming a slot."""
+        while self.pool.n_free > 0:
+            req = self._pop_waiting()
+            if req is None:
+                return
+            p = req.pending
+            if req.cancelled:
+                self._finish(req, "cancelled")
+                continue
+            if p.deadline is not None and p.deadline.expired:
+                self._finish(req, "deadline", status=504,
+                             error="deadline exceeded before decode")
+                continue
+            slot = self.pool.claim()
+            if slot is None:      # raced a concurrent release? retry
+                with self._lock:
+                    self._waiting.appendleft(req)
+                return
+            t0 = self._now()
+            self._add_span(req, "queue_wait", req.t_submit, t0)
+            if self._m_queue_wait is not None:
+                self._m_queue_wait.labels().observe(
+                    (t0 - req.t_submit) * 1000.0)
+            try:
+                if self.fault_plan is not None:
+                    self.fault_plan.raise_at("decode_prefill",
+                                             clock=self.clock)
+                first = self.decoder.prefill(slot, req.prompt)
+            except Exception as e:  # noqa: BLE001 — injected or real
+                self.pool.release(slot)
+                self._add_span(req, "prefill", t0, self._now(),
+                               status="error")
+                self._finish(req, "error", status=500,
+                             error=f"prefill failed: {e}")
+                continue
+            t1 = self._now()
+            req.t_prefill = t1
+            req.t_decode = t1
+            self.n_prefills += 1
+            if self._m_prefill is not None:
+                self._m_prefill.labels(
+                    bucket_target(len(req.prompt),
+                                  self.decoder.max_len)).observe(
+                    (t1 - t0) * 1000.0)
+            self._add_span(req, "prefill", t0, t1, slot=slot,
+                           prompt_len=len(req.prompt))
+            req.slot = slot
+            req.produced.append(first)
+            self.n_tokens += 1
+            self._tokens[slot] = first
+            self._pos[slot] = len(req.prompt)
+            with self._lock:
+                self._active[slot] = req
+            self._retire_if_done(req, first)
+
+    def _retire_if_done(self, req: _DecodeRequest, tok: int) -> bool:
+        """Post-token finish checks, cheapest terminal first."""
+        eos = self.decoder.eos_id
+        if eos is not None and tok == eos:
+            self._finish(req, "eos")
+            return True
+        if len(req.produced) >= req.max_new:
+            self._finish(req, "length")
+            return True
+        if req.slot is not None and \
+                int(self._pos[req.slot]) >= self.decoder.max_len - 1:
+            self._finish(req, "length")   # cache lane exhausted
+            return True
+        if req.cancelled:
+            self._finish(req, "cancelled")
+            return True
+        p = req.pending
+        if p.deadline is not None and p.deadline.expired:
+            self._finish(req, "deadline", status=504,
+                         error="deadline exceeded mid-decode")
+            return True
+        return False
+
+    def _run_step(self) -> None:
+        # pre-step reap: expired/cancelled slots free BEFORE paying a
+        # step for them (and their lanes stop being written)
+        for req in list(self._active.values()):
+            p = req.pending
+            if req.cancelled:
+                self._finish(req, "cancelled")
+            elif p.deadline is not None and p.deadline.expired:
+                self._finish(req, "deadline", status=504,
+                             error="deadline exceeded mid-decode")
+        if not self._active:
+            return
+        t0 = self._now()
+        try:
+            if self.fault_plan is not None:
+                self.fault_plan.raise_at("decode_step",
+                                         clock=self.clock)
+            out = self.decoder.step(self._tokens, self._pos)
+        except Exception as e:  # noqa: BLE001 — injected or real
+            # a failed step loses the affected requests (500, never
+            # journaled — clients may retry) but NEVER a slot
+            self.n_step_faults += 1
+            logger.warning("decode step failed; failing %d in-slot "
+                           "requests", len(self._active), exc_info=True)
+            for req in list(self._active.values()):
+                self._finish(req, "error", status=500,
+                             error=f"decode step failed: {e}")
+            return
+        t1 = self._now()
+        self.n_steps += 1
+        if self._m_step is not None:
+            self._m_step.labels().observe((t1 - t0) * 1000.0)
+        for slot, req in list(self._active.items()):
+            tok = int(out[slot])
+            req.produced.append(tok)
+            self.n_tokens += 1
+            self._pos[slot] += 1
+            self._tokens[slot] = tok
+            self._retire_if_done(req, tok)
+
+    # -- observability -------------------------------------------------------
+
+    def stats(self) -> Dict[str, Any]:
+        with self._lock:
+            # snapshot under the lock: the loop thread churns _active
+            # and the release ledger while scrapes read them
+            waiting = len(self._waiting)
+            active = sorted(self._active.items())
+            releases = dict(self.releases)
+        slots = [{"slot": s,
+                  "rid": r.pending.rid,
+                  "prompt_len": int(len(r.prompt)),
+                  "n_tokens": len(r.produced),   # incremental progress
+                  "max_new_tokens": r.max_new}
+                 for s, r in active]
+        return {"n_slots": self.decoder.n_slots,
+                "slots_in_use": len(slots),
+                "slots_free": self.pool.n_free,
+                "max_len": self.decoder.max_len,
+                "waiting": waiting,
+                "max_waiting": self.max_waiting,
+                "n_requests": self.n_requests,
+                "n_steps": self.n_steps,
+                "n_tokens": self.n_tokens,
+                "n_prefills": self.n_prefills,
+                "n_step_faults": self.n_step_faults,
+                "n_compiles": self.decoder.n_compiles(),
+                "releases": releases,
+                "active": slots}

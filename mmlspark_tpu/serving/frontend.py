@@ -85,11 +85,51 @@ import time
 from collections import deque
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
+from contextlib import contextmanager
+
 from mmlspark_tpu.core.logs import get_logger
 
 logger = get_logger("serving.frontend")
 
-__all__ = ["EventLoopFrontend", "Headers"]
+__all__ = ["EventLoopFrontend", "Headers", "batched_replies"]
+
+
+# ---------------------------------------------------------------------------
+# Batched reply flushing
+# ---------------------------------------------------------------------------
+
+#: per-thread reply batch: while a :func:`batched_replies` scope is
+#: active on a committing thread, ``_Loop.post_reply`` parks replies
+#: here (keyed by loop) instead of queue-append + wake per reply; the
+#: scope exit flushes each loop's batch with ONE deque extend and ONE
+#: wake. Thread-local, so concurrent encoder threads batch
+#: independently with no shared state.
+_REPLY_BATCH = threading.local()
+
+
+@contextmanager
+def batched_replies():
+    """Coalesce cross-thread reply posts made inside the scope.
+
+    The serving pipeline commits replies per micro-batch
+    (``_commit_many``): without batching, N replies destined for N
+    distinct connections on the same loop cost N wake checks and up to
+    N wake syscalls; inside this scope they land in one deque extend
+    and one wake per *loop*, and the loop delivers them all in one
+    pass. Safe to nest (the outermost scope flushes) and to use on any
+    thread; in-loop synchronous replies never hit the batch (they
+    deliver inline, as before)."""
+    if getattr(_REPLY_BATCH, "active", None) is not None:
+        yield                      # nested: the outer scope flushes
+        return
+    batch: Dict[Any, list] = {}
+    _REPLY_BATCH.active = batch
+    try:
+        yield
+    finally:
+        _REPLY_BATCH.active = None
+        for loop, items in batch.items():
+            loop.flush_replies(items)
 
 
 # ---------------------------------------------------------------------------
@@ -319,11 +359,30 @@ class _Loop(threading.Thread):
     def post_reply(self, conn: _Conn, gen: int, head: bytes,
                    body: bytes, close_after: bool) -> None:
         """Queue a reply for delivery by the loop thread; safe from any
-        thread. In-loop callers deliver inline (no queue round-trip)."""
+        thread. In-loop callers deliver inline (no queue round-trip);
+        inside a :func:`batched_replies` scope, cross-thread replies
+        park in the thread-local batch and flush together."""
         if threading.get_ident() == self.ident:
             self._deliver(conn, gen, head, body, close_after)
             return
+        batch = getattr(_REPLY_BATCH, "active", None)
+        if batch is not None:
+            batch.setdefault(self, []).append(
+                (conn, gen, head, body, close_after))
+            return
         self._replies.append((conn, gen, head, body, close_after))
+        self.wake()
+
+    def flush_replies(self, items: list) -> None:
+        """Batched-commit flush: every reply in ``items`` joins the
+        delivery deque in one extend, then ONE wake — the loop serves
+        them all in a single pass (vs one wake check per reply)."""
+        if not items:
+            return
+        self._replies.extend(items)
+        fe = self.frontend
+        fe.n_reply_flushes += 1
+        fe.n_batched_replies += len(items)
         self.wake()
 
     def wake(self) -> None:
@@ -903,6 +962,11 @@ class EventLoopFrontend:
         self.n_idle_reaped = 0
         self.n_parse_errors = 0
         self.n_request_timeouts = 0
+        # batched reply flushing (the commit path's batched_replies
+        # scope): flushes = one-wake loop passes, batched = replies
+        # they carried (batched/flushes = coalescing factor)
+        self.n_reply_flushes = 0
+        self.n_batched_replies = 0
         self._listeners: List[socket.socket] = []
         first = self._bind(host, port)
         self.host, self.port = first.getsockname()[:2]
@@ -999,6 +1063,13 @@ class EventLoopFrontend:
              "Connections refused at accept by the per-IP cap "
              "(429 + close before any queue slot was spent).",
              "n_per_ip_rejected"),
+            ("serving_reply_flush_batches_total",
+             "Batched reply flushes (one deque extend + one wake per "
+             "loop per commit batch).", "n_reply_flushes"),
+            ("serving_batched_replies_total",
+             "Replies delivered through batched flushes (ratio to "
+             "flush batches = coalescing factor).",
+             "n_batched_replies"),
         ):
             registry.counter(mname, help_).set_function(
                 lambda a=attr: getattr(self, a))
@@ -1062,6 +1133,8 @@ class EventLoopFrontend:
             "pipelining_deferred_total": self.n_pipelining_deferred,
             "per_ip_rejected_total": self.n_per_ip_rejected,
             "per_ip_conns_high_water": self.per_ip_high_water,
+            "reply_flush_batches_total": self.n_reply_flushes,
+            "batched_replies_total": self.n_batched_replies,
             "busy_ratio": round(max(
                 (lp.busy_ratio for lp in self._loops), default=0.0), 4),
         }

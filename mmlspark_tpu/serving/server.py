@@ -85,7 +85,9 @@ from mmlspark_tpu.core.tracing import (
     ambient_tracer, capture_hint, extract_span_context, format_span_id,
     merge_traces, span_tree, to_perfetto,
 )
-from mmlspark_tpu.serving.frontend import EventLoopFrontend
+from mmlspark_tpu.serving.decode import DecodeOverloaded, DecodeScheduler
+from mmlspark_tpu.serving.frontend import EventLoopFrontend, batched_replies
+from mmlspark_tpu.serving.policy import AdaptiveBatchPolicy
 from mmlspark_tpu.serving.rollout import (
     ModelVersionManager, RolloutError, RolloutOrchestrator,
 )
@@ -185,6 +187,9 @@ class ServingServer:
                  model_version: str = "v1",
                  verify_checkpoints: bool = True,
                  rollout_fault_plan=None,
+                 decoder: Optional[DecodeScheduler] = None,
+                 decode_path: str = "/generate",
+                 batch_policy: str = "fixed",
                  clock: Clock = SYSTEM_CLOCK):
         self.api_path = api_path
         self.max_batch_size = int(max_batch_size)
@@ -275,6 +280,44 @@ class ServingServer:
                 floor_ms=adaptive_floor_ms,
                 ceiling_ms=adaptive_ceiling_ms,
                 min_count=adaptive_min_count)
+        # -- adaptive micro-batching (A/B vs the fixed knob): with
+        # ``batch_policy="adaptive"`` the collector's batch-mate wait
+        # is decided per batch from the measured arrival rate and the
+        # per-bucket dispatch-latency histograms, with the configured
+        # ``max_latency_ms`` demoted to a hard ceiling — see
+        # serving/policy.py and docs/serving.md "Adaptive batching".
+        # ``"fixed"`` (the default) keeps the constant knob.
+        self.batch_policy = str(batch_policy)
+        if self.batch_policy not in ("fixed", "adaptive"):
+            raise ValueError(
+                f"unknown batch_policy {batch_policy!r} "
+                "(expected 'fixed' or 'adaptive')")
+        self.adaptive_batcher: Optional[AdaptiveBatchPolicy] = None
+        if self.batch_policy == "adaptive":
+            fam = self._m_dispatch
+
+            def _bucket_stats():
+                out = []
+                for key, child in fam.children():
+                    try:
+                        rows = int(key[0])
+                    except (IndexError, ValueError):
+                        continue
+                    out.append((rows, fam.buckets,
+                                child.stats()["buckets"]))
+                return out
+
+            self.adaptive_batcher = AdaptiveBatchPolicy(
+                _bucket_stats, self._bucket_sizes(),
+                ceiling_ms=self.max_latency_ms, clock=clock)
+        # -- continuous-batching decode plane (optional): POSTs to
+        # ``decode_path`` route to a DecodeScheduler (slot-indexed
+        # KV-cache continuous batching — serving/decode.py) through
+        # the SAME admission path as the frame plane, so replay/join/
+        # shed/deadline/journal semantics are identical. GET
+        # /decode/stats exposes slot occupancy + in-flight progress.
+        self.decode_path = decode_path
+        self.decoder = decoder
         self.n_recompiles = 0
         self._shapes_seen: set = set()
         self._stats_lock = threading.Lock()
@@ -400,6 +443,10 @@ class ServingServer:
         self._journal_queue: "Queue[bytes]" = Queue()
         if journal_path:
             self._recover_journal()
+        if self.decoder is not None:
+            # bound last: bind reads the server's clock/tracer/registry
+            # and commit path, all of which must exist first
+            self.decoder.bind(self)
         self._register_metric_views()
 
     @property
@@ -554,7 +601,9 @@ class ServingServer:
                 self._reply(status, body, ctype=ctype, extra=extra)
 
             def do_POST(self):
-                if self.path != serving.api_path:
+                is_decode = (serving.decoder is not None
+                             and self.path == serving.decode_path)
+                if self.path != serving.api_path and not is_decode:
                     # control-plane POSTs (rollout admin) share one
                     # route table with the event-loop frontend
                     length = int(self.headers.get("Content-Length", 0))
@@ -583,18 +632,20 @@ class ServingServer:
                     root = serving.tracer.start(
                         "request", trace_id=tid,
                         remote_parent=parent_sid,
-                        route=serving.api_path)
+                        route=(serving.decode_path if is_decode
+                               else serving.api_path))
                     if capture_hint(self.headers):
                         # the X-Capture wire hint: retain this trace
                         # end to end, thresholds notwithstanding
                         root.force = True
                     status = "error"
                     try:
-                        status = self._do_predict(tid, root)
+                        status = self._do_predict(tid, root,
+                                                  decode=is_decode)
                     finally:
                         serving.tracer.finish(root, status=status)
 
-            def _do_predict(self, tid, root):
+            def _do_predict(self, tid, root, decode=False):
                 """Serve one POST; returns the root span's terminal
                 status (``ok``/``shed``/``deadline``/``timeout``/
                 ``error`` — everything but ``ok`` is tail-captured)."""
@@ -621,7 +672,8 @@ class ServingServer:
                                                  clock=serving.clock)
                 rid = self.headers.get("X-Request-Id")
                 kind, pending, committed, window_missed = \
-                    serving._admit(payload, rid, deadline, tid)
+                    serving._admit(payload, rid, deadline, tid,
+                                   decode=decode)
                 if rid:
                     root.set_attr("rid", rid)
                 if kind == "replay":
@@ -638,7 +690,19 @@ class ServingServer:
                     self._reply(504, pending.reply, trace=tid)
                     return "deadline"
                 if kind == "enqueue":
-                    serving._enqueue(pending, root)
+                    if decode:
+                        err = serving._enqueue_decode(pending, root)
+                        if err is not None:
+                            e_status, e_body = err
+                            self._reply(
+                                e_status, e_body, trace=tid,
+                                retry_after=(serving.shed_retry_after
+                                             if e_status == 429
+                                             else None))
+                            return ("shed" if e_status == 429
+                                    else "error")
+                    else:
+                        serving._enqueue(pending, root)
                 if not pending.event.wait(serving.request_timeout):
                     # the stuck-batch timeout is the reply operators
                     # most need to trace: echo the id here too
@@ -738,6 +802,14 @@ class ServingServer:
                     "slow_trace_ms":
                         self.tracer.threshold(self.api_path),
                     "adaptive_slow_trace": self.adaptive is not None,
+                    # the dispatch-wait policy: "fixed" = the constant
+                    # max_latency_ms knob; "adaptive" learns the wait
+                    # per batch (rate + per-bucket latency — A/B
+                    # selectable, docs/serving.md "Adaptive batching")
+                    "batch_policy": self.batch_policy,
+                    "adaptive_batch": (self.adaptive_batcher.status()
+                                       if self.adaptive_batcher
+                                       is not None else None),
                     # the socket edge: keep-alive reuse rate, open
                     # connections, accept-loop saturation (eventloop);
                     # the threaded plane reports only its kind
@@ -789,6 +861,16 @@ class ServingServer:
             # the rollout state machine: active/staged/previous version
             # lifecycle, shadow-traffic stats, flip/rollback counters
             return (200, json.dumps(self.versions.status()).encode(),
+                    "application/json", ())
+        if path == "/decode/stats":
+            # the continuous-batching plane: slot occupancy, waiting
+            # depth, step/token counters, compile count (flat after
+            # warmup = zero retraces), and per-slot in-flight progress
+            # (the incremental token emission, observable mid-decode)
+            if self.decoder is None:
+                return (404, b'{"error": "no decode plane configured"}',
+                        "application/json", ())
+            return (200, json.dumps(self.decoder.stats()).encode(),
                     "application/json", ())
         if path != "/status":
             return None
@@ -872,13 +954,15 @@ class ServingServer:
         return None
 
     def _admit(self, payload: Any, rid: Optional[str],
-               deadline: Optional[Deadline], tid: str
+               deadline: Optional[Deadline], tid: str,
+               decode: bool = False
                ) -> Tuple[str, Optional[_PendingRequest],
                           Optional[tuple], bool]:
-        """Ingress admission, shared by both frontends: journal replay,
-        in-flight join, overload shedding, and the dead-on-arrival
-        deadline check. Returns ``(kind, pending, committed_entry,
-        window_missed)`` with kind one of:
+        """Ingress admission, shared by both frontends AND both data
+        planes (``decode=True`` sheds on the decode scheduler's
+        waiting-queue depth instead of the frame backlog; everything
+        else — replay, join, doa — is identical). Returns ``(kind,
+        pending, committed_entry, window_missed)`` with kind one of:
 
         * ``"replay"`` — the rid's reply is already committed
           (``committed_entry`` is the journal tuple);
@@ -891,6 +975,8 @@ class ServingServer:
           (:meth:`_enqueue`) and awaits resolution.
         """
         window_missed = False
+        overloaded = (self.decoder.overloaded if decode
+                      else self._overloaded)
         if rid:
             with self._commit_lock:
                 self._reap_expired_locked()
@@ -902,7 +988,7 @@ class ServingServer:
                     return "replay", None, committed, False
                 if pending is not None:
                     return "join", pending, None, False
-                if self._overloaded():
+                if overloaded():
                     # shedding applies to NEW work only: replays and
                     # in-flight joins above cost no inference and
                     # always succeed
@@ -925,7 +1011,7 @@ class ServingServer:
                     "re-executing", rid, self.journal_size,
                     self.journal_ttl)
         else:
-            if self._overloaded():
+            if overloaded():
                 with self._commit_lock:
                     self.n_shed += 1
                 return "shed", None, None, False
@@ -955,9 +1041,35 @@ class ServingServer:
         span."""
         pending.span = root
         pending.t_enqueue = self.tracer.clock.now()
+        if self.adaptive_batcher is not None:
+            # one clock read + two float ops: the arrival-rate EWMA
+            # the adaptive batch policy decides wait windows from
+            self.adaptive_batcher.note_arrival()
         with self._stats_lock:
             self._n_backlog += 1
         self._queue.put(pending)
+
+    def _enqueue_decode(self, pending: _PendingRequest, root
+                        ) -> Optional[Tuple[int, bytes]]:
+        """Hand an admitted request to the decode scheduler. Returns
+        ``None`` on success or ``(status, body)`` for a synchronous
+        reject (bad payload -> 400, waiting queue full -> 429) — the
+        reject path removes the in-flight entry so a retried rid
+        re-admits instead of joining a dead pending."""
+        pending.span = root
+        pending.t_enqueue = self.tracer.clock.now()
+        try:
+            self.decoder.submit(pending)
+            return None
+        except DecodeOverloaded:
+            with self._commit_lock:
+                self._inflight.pop(pending.rid, None)
+                self.n_shed += 1
+            return 429, b'{"error": "overloaded"}'
+        except ValueError as e:
+            with self._commit_lock:
+                self._inflight.pop(pending.rid, None)
+            return 400, json.dumps({"error": str(e)}).encode()
 
     def _release(self, p: _PendingRequest) -> None:
         """Resolve a pending request: wake any threaded-frontend
@@ -1005,7 +1117,9 @@ class ServingServer:
             return True
         if method != "POST":
             return False
-        if path != self.api_path:
+        is_decode = (self.decoder is not None
+                     and path == self.decode_path)
+        if path != self.api_path and not is_decode:
             routed = self._post_route(path, body)
             if routed is None:
                 return False
@@ -1016,13 +1130,15 @@ class ServingServer:
         with trace_context(tid):
             root = self.tracer.start("request", trace_id=tid,
                                      remote_parent=parent_sid,
-                                     route=self.api_path)
+                                     route=(self.decode_path if is_decode
+                                            else self.api_path))
             if capture_hint(headers):
                 root.force = True
             status = "error"
             try:
                 status = self._predict_eventloop(headers, body, tid,
-                                                 root, reply)
+                                                 root, reply,
+                                                 decode=is_decode)
             finally:
                 if status is not None:
                     # sync reject paths; async completions finish the
@@ -1031,7 +1147,8 @@ class ServingServer:
         return True
 
     def _predict_eventloop(self, headers, body: bytes, tid: str,
-                           root, reply) -> Optional[str]:
+                           root, reply, decode: bool = False
+                           ) -> Optional[str]:
         """Admission for the event-loop frontend: same decisions as the
         threaded ``_do_predict`` (one ``_admit`` serves both), but the
         enqueue/join paths return None and deliver via callback — no
@@ -1054,7 +1171,7 @@ class ServingServer:
         deadline = Deadline.from_headers(headers, clock=self.clock)
         rid = headers.get("X-Request-Id")
         kind, pending, committed, window_missed = \
-            self._admit(payload, rid, deadline, tid)
+            self._admit(payload, rid, deadline, tid, decode=decode)
         if rid:
             root.set_attr("rid", rid)
         if kind == "replay":
@@ -1096,6 +1213,17 @@ class ServingServer:
 
         if joined:
             self._add_waiter(pending, on_done)
+        elif decode:
+            err = self._enqueue_decode(pending, root)
+            if err is not None:
+                e_status, e_body = err
+                extra = [(TRACE_HEADER, tid)]
+                if e_status == 429:
+                    extra.append(("Retry-After",
+                                  str(self.shed_retry_after)))
+                reply(e_status, e_body, extra=tuple(extra))
+                return "shed" if e_status == 429 else "error"
+            self._add_waiter(pending, on_done)
         else:
             self._enqueue(pending, root)
             self._add_waiter(pending, on_done)
@@ -1125,7 +1253,17 @@ class ServingServer:
     def _collect_rest(self, first: _PendingRequest
                       ) -> List[_PendingRequest]:
         batch = [first]
-        if self.max_latency_ms <= 0:
+        window_ms = self.max_latency_ms
+        if self.adaptive_batcher is not None:
+            # the adaptive policy picks THIS batch's wait from the
+            # live arrival rate + per-bucket dispatch latencies (None
+            # while warming up -> the fixed knob keeps ruling; the
+            # fixed knob is also the policy's hard ceiling)
+            decided = self.adaptive_batcher.decide_wait_ms(
+                1 + self._queue.qsize())
+            if decided is not None:
+                window_ms = decided
+        if window_ms <= 0:
             # latency-first mode: take whatever is already queued and
             # serve immediately — no added wait for batch-mates
             while len(batch) < self.max_batch_size:
@@ -1134,7 +1272,7 @@ class ServingServer:
                 except Empty:
                     break
             return batch
-        deadline = time.monotonic() + self.max_latency_ms / 1000.0
+        deadline = time.monotonic() + window_ms / 1000.0
         while len(batch) < self.max_batch_size:
             remaining = deadline - time.monotonic()
             if remaining <= 0:
@@ -1375,9 +1513,12 @@ class ServingServer:
             self.n_requests += job["batch_n"]
         # adaptive-threshold upkeep rides the encoder stage — off the
         # request path; one int bump per batch, a histogram walk every
-        # refresh_every-th batch
+        # refresh_every-th batch (same cadence for the batch policy's
+        # service-time table)
         if self.adaptive is not None:
             self.adaptive.tick()
+        if self.adaptive_batcher is not None:
+            self.adaptive_batcher.tick()
         if not live:
             return
         replies = None
@@ -1644,8 +1785,14 @@ class ServingServer:
             self._reap_expired_locked()
         # record commit children before ANY release fires (see _commit)
         self._add_spans(ps, "commit", t0, self.tracer.clock.now())
-        for p in ps:
-            self._release(p)
+        # batched reply flushing: event-loop completion callbacks fired
+        # by these releases post their replies into one per-loop batch,
+        # flushed with ONE deque extend + ONE wake per loop when the
+        # scope exits — a 64-row commit wakes each loop once, not up to
+        # 64 times (threaded-frontend waiters are Event.set, unaffected)
+        with batched_replies():
+            for p in ps:
+                self._release(p)
 
     # -- pipeline loops ------------------------------------------------------
 
@@ -1803,6 +1950,8 @@ class ServingServer:
                 target=self._journal_loop, daemon=True)
             self._journal_thread.start()
             self._threads.append(self._journal_thread)
+        if self.decoder is not None:
+            self.decoder.start()
         return self
 
     def stop(self, drain: bool = True, drain_timeout: float = 5.0):
@@ -1822,6 +1971,13 @@ class ServingServer:
             while time.monotonic() < t_end and \
                     (self.backlog() > 0 or self._active_batches > 0):
                 time.sleep(0.005)
+        if self.decoder is not None:
+            # the decode plane drains itself: in-slot requests would
+            # take seconds to finish naturally, so the scheduler stops
+            # its loop and resolves stragglers with 503s (a retry
+            # lands on a live worker) — accepted-and-journaled replies
+            # are already committed and replayable
+            self.decoder.stop()
         self._stop.set()
         if self._frontend is None:
             self._server.shutdown()
@@ -1894,7 +2050,8 @@ class ServingCoordinator:
     def __init__(self, host: str = "127.0.0.1", port: int = 0,
                  stale_after: Optional[float] = None,
                  tracer=None, frontend: str = "eventloop",
-                 acceptors: int = 1, reuse_port: bool = False):
+                 acceptors: int = 1, reuse_port: bool = False,
+                 rollout_history: int = 32):
         # stale_after: drop workers not re-registered within this many
         # seconds — workers heartbeat (`python -m mmlspark_tpu.serving
         # worker` re-registers every REGISTER_INTERVAL), so dead pods
@@ -1914,6 +2071,13 @@ class ServingCoordinator:
         # one RolloutOrchestrator at a time; GET /rollout reports it
         self._rollout: Optional[RolloutOrchestrator] = None
         self._rollout_lock = threading.Lock()
+        # bounded ring of rollout runs (current included): GET
+        # /rollouts lists every remembered run's state machine + phase
+        # decisions, newest first — the audit trail an operator reads
+        # after an auto-rollback they did not witness
+        from collections import deque as _deque
+        self._rollout_runs: "_deque[RolloutOrchestrator]" = _deque(
+            maxlen=max(int(rollout_history), 1))
         # previous poll's merged counters: GET /fleet reports
         # rate()-style deltas alongside the lifetime totals (trend
         # needs two scrapes — the ROADMAP fleet-rate item)
@@ -2071,6 +2235,12 @@ class ServingCoordinator:
         if path == "/rollout":
             return (200, json.dumps(self.rollout_status()).encode(),
                     "application/json")
+        if path == "/rollouts":
+            # the bounded history ring: past runs + the current one,
+            # newest first, each with its phase decisions (canary
+            # verdict, failure detail, per-worker staging states)
+            return (200, json.dumps(self.rollout_history()).encode(),
+                    "application/json")
         if path == "/services":
             with self._lock:
                 self._prune_stale_locked()
@@ -2151,6 +2321,9 @@ class ServingCoordinator:
                     f"already {self._rollout.state}")
             run = RolloutOrchestrator(self, version, **kwargs)
             self._rollout = run
+            # remembered from the start: a run that dies mid-phase is
+            # exactly the one the history must still show
+            self._rollout_runs.append(run)
             run.start()
             return run
 
@@ -2159,6 +2332,16 @@ class ServingCoordinator:
             if self._rollout is None:
                 return {"state": "idle"}
             return self._rollout.status()
+
+    def rollout_history(self) -> Dict[str, Any]:
+        """Every remembered rollout run (bounded ring, newest first):
+        final state, phase decision, failure detail, per-worker
+        staging/flip bookkeeping — ``RolloutOrchestrator.status()``
+        verbatim per run. Live runs report their current phase."""
+        with self._rollout_lock:
+            runs = [r.status() for r in reversed(self._rollout_runs)]
+        return {"capacity": self._rollout_runs.maxlen,
+                "n_runs": len(runs), "rollouts": runs}
 
     # -- fleet-level stats aggregation ---------------------------------------
 

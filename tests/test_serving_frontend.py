@@ -877,3 +877,103 @@ class TestPipeliningFairnessCap:
                 assert [s for s, _, _ in results[tag]] == [200] * n
                 assert [json.loads(b) for _, _, b in results[tag]] == \
                     [{"y": 2.0 * i} for i in range(n)]
+
+
+class TestBatchedReplyFlushing:
+    """One encoder commit batch -> one deque extend + one wake per
+    loop, replies fanned out to distinct connections in one loop pass
+    (the ROADMAP item 5 follow-up)."""
+
+    def test_batched_replies_unit(self):
+        """The thread-local scope: posts inside it park per loop and
+        flush together; nesting flushes once, at the outermost exit."""
+        from mmlspark_tpu.serving.frontend import batched_replies
+
+        class FakeFrontend:
+            n_reply_flushes = 0
+            n_batched_replies = 0
+
+        class FakeLoop:
+            ident = -1            # never the current thread
+            frontend = FakeFrontend()
+
+            def __init__(self):
+                self._replies = []
+                self.wakes = 0
+
+            def wake(self):
+                self.wakes += 1
+
+            def flush_replies(self, items):
+                self._replies.extend(items)
+                self.frontend.n_reply_flushes += 1
+                self.frontend.n_batched_replies += len(items)
+                self.wake()
+
+        from mmlspark_tpu.serving import frontend as fe_mod
+        a, b = FakeLoop(), FakeLoop()
+        with batched_replies():
+            with batched_replies():         # nested: outer flushes
+                fe_mod._Loop.post_reply(a, None, 0, b"h", b"b", False)
+            fe_mod._Loop.post_reply(a, None, 1, b"h", b"b", False)
+            fe_mod._Loop.post_reply(b, None, 2, b"h", b"b", False)
+            assert a.wakes == b.wakes == 0  # parked, not posted
+        assert len(a._replies) == 2 and a.wakes == 1
+        assert len(b._replies) == 1 and b.wakes == 1
+        assert FakeLoop.frontend.n_reply_flushes == 2
+        assert FakeLoop.frontend.n_batched_replies == 3
+        # outside any scope: straight to the deque + wake (unbatched)
+        fe_mod._Loop.post_reply(a, None, 3, b"h", b"b", False)
+        assert len(a._replies) == 3 and a.wakes == 2
+
+    def test_commit_batch_flushes_once_across_connections(self):
+        """N keep-alive connections whose requests commit in one
+        micro-batch: every reply lands correctly, and the flush
+        counters show cross-connection coalescing (fewer flushes than
+        batched replies)."""
+        srv = _server(max_batch_size=8, max_latency_ms=60)
+        try:
+            srv.warmup({"x": 0.0})
+            n = 6
+            socks = [_connect(srv) for _ in range(n)]
+            # stagger-free burst: all requests queued inside one
+            # collection window -> one batch -> one _commit_many
+            for i, s in enumerate(socks):
+                s.sendall(_request_bytes(
+                    body=json.dumps({"x": float(i)}).encode()))
+            for i, s in enumerate(socks):
+                status, _headers, body, _rest = _read_response(s)
+                assert status == 200
+                assert json.loads(body)["y"] == 2.0 * i
+            fe = srv._frontend
+            assert fe.n_batched_replies >= n
+            assert 0 < fe.n_reply_flushes < fe.n_batched_replies
+            stats = fe.stats()
+            assert stats["batched_replies_total"] == \
+                fe.n_batched_replies
+            assert stats["reply_flush_batches_total"] == \
+                fe.n_reply_flushes
+            body = requests.get(
+                f"http://{srv.host}:{srv.port}/metrics?scope=server",
+                timeout=10).text
+            assert "serving_reply_flush_batches_total" in body
+            assert "serving_batched_replies_total" in body
+            for s in socks:
+                s.close()
+        finally:
+            srv.stop()
+
+    def test_threaded_frontend_unaffected(self):
+        """The threaded plane has no loops to flush: commits release
+        Event waiters exactly as before."""
+        srv = _server(frontend="threaded", max_latency_ms=20)
+        try:
+            srv.warmup({"x": 0.0})
+            rs = []
+            for i in range(4):
+                rs.append(requests.post(
+                    srv.address, json={"x": float(i)}, timeout=10))
+            assert [r.json()["y"] for r in rs] == \
+                [0.0, 2.0, 4.0, 6.0]
+        finally:
+            srv.stop()

@@ -646,3 +646,72 @@ class TestRolloutOrchestration:
             assert r.status_code == 400
         finally:
             fleet.close()
+
+
+class TestRolloutHistoryRing:
+    """GET /rollouts: the bounded ring of past rollout runs + phase
+    decisions (the PR 7 follow-up from ROADMAP item 3)."""
+
+    def test_history_lists_runs_newest_first(self, tmp_path):
+        v2 = str(tmp_path / "v2")
+        v3 = str(tmp_path / "v3")
+        _scale(3).save(v2)
+        _scale(4).save(v3)
+        fleet = _Fleet(ok_factors=(2.0, 3.0, 4.0))
+        try:
+            for version, path in (("v2", v2), ("v3", v3)):
+                r = requests.post(fleet.url + "/rollout", json={
+                    "path": path, "version": version, "canary": False,
+                    "poll_interval_s": 0.05}, timeout=10)
+                assert r.status_code == 202, r.text
+                fleet.coord._rollout.join(60)
+                assert fleet.coord._rollout.state == "completed"
+            hist = requests.get(fleet.url + "/rollouts",
+                                timeout=10).json()
+            assert hist["n_runs"] == 2
+            assert hist["capacity"] == 32
+            versions = [r["version"] for r in hist["rollouts"]]
+            assert versions == ["v3", "v2"]        # newest first
+            # each entry is the run's full status: state machine +
+            # phase decisions + per-worker bookkeeping
+            for run in hist["rollouts"]:
+                assert run["state"] == "completed"
+                assert run["finished_unix"] is not None
+                assert run["workers"]
+            # the single-run view still reports the latest
+            assert requests.get(fleet.url + "/rollout",
+                                timeout=10).json()["version"] == "v3"
+        finally:
+            fleet.close()
+        assert fleet.stats["bad"] == 0
+        assert fleet.stats["errors"] == []
+
+    def test_ring_is_bounded_and_keeps_failures(self, tmp_path):
+        """Capacity evicts oldest-first, and a FAILED run stays in the
+        ring — the audit trail an operator reads after an incident."""
+        coord = ServingCoordinator(rollout_history=2).start()
+        url = f"http://{coord.host}:{coord.port}"
+        srv = _server()
+        try:
+            ServingCoordinator.register_worker(url, srv.host, srv.port)
+            versions = ["va", "vb", "vc"]
+            for v in versions:
+                # flip-only rollouts against a worker that never
+                # staged them: each run fails fast (nothing staged)
+                run = coord.rollout(v, path=None, canary=False,
+                                    poll_interval_s=0.02)
+                run.join(30)
+                assert run.state == "failed"
+            hist = coord.rollout_history()
+            assert hist["capacity"] == 2
+            assert [r["version"] for r in hist["rollouts"]] == \
+                ["vc", "vb"]                      # va evicted
+            assert all(r["state"] == "failed"
+                       for r in hist["rollouts"])
+            assert all(r["detail"] for r in hist["rollouts"])
+            over_http = requests.get(url + "/rollouts",
+                                     timeout=10).json()
+            assert over_http == hist
+        finally:
+            srv.stop()
+            coord.stop()
