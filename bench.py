@@ -54,6 +54,16 @@ Plus (no era analogue, utilization/latency evidence):
                                    arrivals: tokens/s ratio + zero
                                    post-warmup recompiles + in-place
                                    KV-pool donation evidence
+ 17. multihost_scaling_v1        — the load-bearing mesh: pjit
+                                   data x tensor-parallel train-step
+                                   parity vs single-device on fixed
+                                   seeds, devices-vs-throughput curve
+                                   (1/2/4/8 simulated devices), zero
+                                   post-warmup recompiles in tensor-
+                                   parallel serving dispatch, and the
+                                   sharded-checkpoint topology drill
+                                   (2x2 save -> 4x1/1x1 restore,
+                                   digests verified)
 
 Every line carries chip metadata (platform/device kind/count) so the
 numbers are interpretable across hosts.
@@ -62,6 +72,7 @@ numbers are interpretable across hosts.
 from __future__ import annotations
 
 import json
+import os
 import time
 from typing import Optional
 
@@ -1158,6 +1169,69 @@ def bench_decode_continuous():
             "passed": ok, "chip": _chip()}
 
 
+def bench_multihost_scaling():
+    """Multi-device scaling + parity gate (ISSUE 10 acceptance).
+
+    Spawns ``tools/bench_multihost.py --json`` in a subprocess (the
+    virtual-device count and per-device threading are XLA_FLAGS that
+    must be set before the backend initializes — this process's jax is
+    already live) and gates on its evidence:
+
+    * sharded train step is **loss/score-parity** with the
+      single-device baseline on fixed seeds (pjit data x model
+      NNLearner fit + tensor-parallel greedy decode token equality);
+    * **zero post-warmup recompiles** in tensor-parallel serving
+      dispatch (live server, ``tensor_parallel=2``, placement visible
+      in /stats) and TP decode;
+    * the **devices-vs-throughput curve** is emitted (1/2/4/8
+      simulated devices), with >= 1.5x step throughput at 4 devices
+      over 1 for the model-parallel-friendly config — or an explicit
+      ``speedup_justification`` when the CPU sandbox can't express it;
+    * sharded checkpoints **round-trip across a topology change**
+      (2x2 save -> 4x1 and 1x1 restore, digests strict-verified).
+    """
+    import subprocess
+    import sys as _sys
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)   # the tool sets its own device count
+    rc = 1
+    try:
+        proc = subprocess.run(
+            [_sys.executable,
+             os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                          "tools", "bench_multihost.py"),
+             "--json", "--devices", "8"],
+            capture_output=True, text=True, env=env, timeout=1200)
+        rc = proc.returncode
+        line = (proc.stdout.strip().splitlines() or ["{}"])[-1]
+        try:
+            ev = json.loads(line)
+        except ValueError:
+            ev = {"passed": False, "error": proc.stdout[-2000:]
+                  or proc.stderr[-2000:]}
+    except subprocess.TimeoutExpired as e:
+        # a hung harness (e.g. an XLA:CPU collective rendezvous stall)
+        # must fail THIS gate's line, not crash the whole bench run
+        ev = {"passed": False,
+              "error": f"bench_multihost timed out after {e.timeout}s"}
+    by_n = {c["devices"]: c["steps_per_s"]
+            for c in ev.get("curve", ())}
+    return {"metric": "multihost_scaling_v1",
+            "value": by_n.get(4) or by_n.get(max(by_n) if by_n else 0, 0),
+            "unit": "steps/sec@4dev",
+            "curve": ev.get("curve"),
+            "speedup_4x_vs_1": ev.get("speedup_4x_vs_1"),
+            "speedup_justification": ev.get("speedup_justification"),
+            "parity": ev.get("parity"),
+            "tp_serving": ev.get("serving"),
+            "checkpoint_topology": ev.get("checkpoint"),
+            "baseline": by_n.get(1),
+            "vs_baseline": ev.get("speedup_4x_vs_1"),
+            "error": ev.get("error"),
+            "passed": bool(ev.get("passed")) and rc == 0,
+            "chip": _chip()}
+
+
 BENCHES = [bench_gbdt_quantile, bench_adult_census, bench_cifar10_scoring,
            bench_cifar10_scoring_uint8, bench_imagenet_scoring,
            bench_transfer_learning, bench_distributed_sgd,
@@ -1166,7 +1240,8 @@ BENCHES = [bench_gbdt_quantile, bench_adult_census, bench_cifar10_scoring,
            bench_transformer_train,
            bench_transformer_train_long, bench_moe_train,
            bench_telemetry_overhead, bench_tracing_overhead,
-           bench_trace_propagation, bench_decode_continuous]
+           bench_trace_propagation, bench_decode_continuous,
+           bench_multihost_scaling]
 
 
 def main() -> None:

@@ -683,6 +683,25 @@ def merge_traces(parts: List[Tuple[str, Dict[str, Any]]]
             spans.append(s)
     if not spans:
         return None
+    # -- cross-host clock-skew estimation: origin_unix alignment is
+    # only as good as the hosts' wall clocks. Every cross-process
+    # parent link gives a physical constraint — the callee's remote
+    # root must nest inside the caller's egress span (the request was
+    # on the wire outside that window). A subtree that nests is left
+    # untouched (zero estimated skew: asymmetric network latency must
+    # not be "corrected" away); one that escapes its egress window is
+    # shifted by the NTP-style midpoint offset
+    # ((e0 - s0) + (e1 - s1)) / 2, which splits the RTT evenly.
+    # Corrections propagate caller-first (a worker two hops out is
+    # corrected against its already-corrected parent), and the
+    # per-worker estimate is reported so merged fleet traces stay
+    # honest — and say so — on badly-synced hosts.
+    skew_ms = _estimate_clock_skew(spans, owner_of)
+    if skew_ms:
+        for s in spans:
+            shift = skew_ms.get(owner_of[s["span_id"]])
+            if shift:
+                s["start_ms"] = round(s["start_ms"] + shift, 3)
     spans.sort(key=lambda s: s["start_ms"])
     base = spans[0]["start_ms"]
     if base:
@@ -707,8 +726,63 @@ def merge_traces(parts: List[Tuple[str, Dict[str, Any]]]
         "captured_at": max(t.get("captured_at", 0.0) for _, t in parts),
         "n_spans": len(spans),
         "workers": workers,
+        # estimated wall-clock skew per worker part (ms, the shift
+        # applied to that part's spans): 0.0 = link-consistent clocks,
+        # absent = no cross-process link to estimate from
+        "clock_skew_ms": {parts[pi][0]: round(off, 3)
+                          for pi, off in skew_ms.items()},
         "spans": spans,
     }
+
+
+def _estimate_clock_skew(spans: List[Dict[str, Any]],
+                         owner_of: Dict[int, int]) -> Dict[int, float]:
+    """Per-part clock corrections from egress/ingress span overlap.
+
+    For every remote-parented span (a worker subtree root) whose
+    parent egress span lives in another part: if the subtree escapes
+    the egress window, its part is skewed by the midpoint offset;
+    inside the window the estimate is 0. Estimates average over a
+    part's links and accumulate along the caller chain (BFS from
+    parts that are nobody's callee)."""
+    by_id = {s["span_id"]: s for s in spans}
+    links: Dict[int, list] = {}          # child part -> [(parent, off)]
+    for s in spans:
+        if not s.get("remote"):
+            continue
+        e = by_id.get(s["parent_id"])
+        if e is None:
+            continue
+        ci, pi = owner_of[s["span_id"]], owner_of[e["span_id"]]
+        if ci == pi:
+            continue
+        e0, e1 = e["start_ms"], e["start_ms"] + e["duration_ms"]
+        s0, s1 = s["start_ms"], s["start_ms"] + s["duration_ms"]
+        off = 0.0 if (s0 >= e0 and s1 <= e1) \
+            else ((e0 - s0) + (e1 - s1)) / 2.0
+        links.setdefault(ci, []).append((pi, off))
+    if not links:
+        return {}
+    resolved: Dict[int, float] = {}
+    # caller-first: resolve parts whose parents are all resolved (or
+    # are not callees themselves); bounded passes guard cycles
+    for _ in range(len(links) + 1):
+        progressed = False
+        for ci, ls in links.items():
+            if ci in resolved:
+                continue
+            if any(pi in links and pi not in resolved for pi, _ in ls):
+                continue
+            resolved[ci] = sum(resolved.get(pi, 0.0) + off
+                               for pi, off in ls) / len(ls)
+            progressed = True
+        if not progressed:
+            break
+    # cycle leftovers: estimate against raw offsets (no propagation)
+    for ci, ls in links.items():
+        if ci not in resolved:
+            resolved[ci] = sum(off for _, off in ls) / len(ls)
+    return resolved
 
 
 # ---------------------------------------------------------------------------

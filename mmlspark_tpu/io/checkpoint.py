@@ -1,11 +1,19 @@
-"""Shared orbax checkpoint-manager construction + checkpoint integrity.
+"""Sharded step checkpoints + checkpoint integrity.
 
-One place for the path rule both training stacks use (NNLearner step
-checkpoints, the SPMD transformer's save/restore): remote URLs
-(``gs://...``) pass through untouched — orbax's tensorstore backend
-handles them natively on TPU VMs — and only local paths are
-absolutized (parity: the reference checkpoints streaming state to
-HDFS, `HadoopUtils.scala`).
+The native checkpoint engine both training stacks use (NNLearner step
+checkpoints, the SPMD transformer's save/restore). The on-disk format
+is **sharded and topology-independent**: every pytree leaf is written
+as the set of device shards that actually hold it (one ``.npy`` per
+unique shard — a replicated leaf writes once, a tensor-parallel kernel
+writes one file per model-axis slice, and no host ever gathers the
+global array), plus an ``index.json`` recording each leaf's global
+shape/dtype and every shard's slice. Restore assembles any *requested*
+slice from the overlapping saved shards, so a state saved on an
+8-device mesh restores onto 4, 1, or a differently-factored mesh —
+the topology change is a placement decision, not a data migration
+(:func:`restore_sharded` builds device arrays shard-by-shard via
+``jax.make_array_from_callback``; :class:`ShardedCheckpointManager`
+adds the step directory/retention policy on top).
 
 Integrity manifests: every directory checkpoint written through stage
 persistence (:func:`mmlspark_tpu.core.serialize.save_stage`) gets a
@@ -25,7 +33,9 @@ from __future__ import annotations
 import hashlib
 import json
 import os
-from typing import Dict, Optional, Tuple
+from typing import Any, Dict, Optional, Tuple
+
+import numpy as np
 
 from mmlspark_tpu.core.logs import get_logger
 
@@ -39,13 +49,453 @@ class CheckpointIntegrityError(RuntimeError):
     """A checkpoint's content does not match its digest manifest."""
 
 
-def manager(path: str, max_to_keep: int = 3, create: bool = True):
-    import orbax.checkpoint as ocp
+def manager(path: str, max_to_keep: int = 3, create: bool = True
+            ) -> "ShardedCheckpointManager":
     from mmlspark_tpu.io import fs as _fs
-    path = path if _fs.is_remote(path) else os.path.abspath(path)
-    return ocp.CheckpointManager(
-        path, options=ocp.CheckpointManagerOptions(
-            max_to_keep=max_to_keep, create=create))
+    if _fs.is_remote(path):
+        # the native store writes with plain os/open: silently dropping
+        # a gs:// checkpoint onto the VM's ephemeral disk would look
+        # like it worked until the preemption it exists for
+        raise NotImplementedError(
+            f"the native sharded checkpoint store writes local "
+            f"filesystem paths only; got {path!r} — point "
+            f"checkpoint_dir at a local/NFS mount (remote-object "
+            f"backends are a future arc)")
+    return ShardedCheckpointManager(os.path.abspath(path),
+                                    max_to_keep=max_to_keep,
+                                    create=create)
+
+
+# ---------------------------------------------------------------------------
+# sharded leaf store
+# ---------------------------------------------------------------------------
+
+INDEX_FILE = "index.json"
+_FORMAT = "mmlspark-sharded-v1"
+
+
+def _leaf_names(tree) -> "Tuple[list, list, Any]":
+    """``(leaf_name_list, leaf_list, treedef)`` from ONE flatten:
+    stable file-safe names derived from the pytree paths (dict keys /
+    sequence indices / NamedTuple fields), so a human can map files
+    back to leaves; restore matches BY ORDER against a template's
+    flatten, so exotic path objects can never break a round trip —
+    and names/leaves coming from the same traversal can never
+    desync."""
+    import jax
+    flat, treedef = jax.tree_util.tree_flatten_with_path(tree)
+    names = []
+    for i, (path, _) in enumerate(flat):
+        label = "".join(
+            str(getattr(k, "key", getattr(k, "idx", getattr(k, "name", k))))
+            .replace("/", "_").replace("\\", "_")[:24] + "."
+            for k in path)
+        names.append(f"leaf{i:05d}.{label.strip('.')}"
+                     if label.strip(".") else f"leaf{i:05d}")
+    return names, [leaf for _, leaf in flat], treedef
+
+
+def _unique_shards(arr):
+    """``[(index, np.ndarray), ...]`` covering ``arr`` without
+    duplicates: one entry per distinct slice (replica 0 only). Host
+    numpy arrays yield a single full-array shard."""
+    import jax
+    if not isinstance(arr, jax.Array):
+        a = np.asarray(arr)
+        return [(tuple((0, s) for s in a.shape), a)]
+    out = []
+    seen = set()
+    for sh in arr.addressable_shards:
+        idx = tuple(
+            (0 if sl.start is None else int(sl.start),
+             int(arr.shape[d]) if sl.stop is None else int(sl.stop))
+        for d, sl in enumerate(sh.index))
+        if idx in seen:
+            continue
+        seen.add(idx)
+        out.append((idx, np.asarray(sh.data)))
+    return out
+
+
+def _dtype_token(dtype) -> str:
+    """Serializable dtype name. Extension dtypes (bfloat16, fp8) have
+    no stable ``.str`` descr — ``np.save`` would record a raw-void
+    ``<V2`` that restores as garbage — so they travel by NAME and
+    their shards are byte-encoded (see ``_save_shard``)."""
+    dtype = np.dtype(dtype)
+    return dtype.str if dtype.kind != "V" else dtype.name
+
+
+def _resolve_dtype(token: str) -> np.dtype:
+    try:
+        return np.dtype(token)
+    except TypeError:
+        import ml_dtypes
+        return np.dtype(getattr(ml_dtypes, token))
+
+
+class _HashingWriter:
+    """File wrapper hashing every written byte: the shard's sha256
+    falls out of the write itself, so the digest manifest never reads
+    a multi-GB checkpoint back just to hash it."""
+
+    __slots__ = ("_f", "hash")
+
+    def __init__(self, f):
+        self._f = f
+        self.hash = hashlib.sha256()
+
+    def write(self, b):
+        self.hash.update(b)
+        return self._f.write(b)
+
+
+def _save_shard(fpath: str, data: np.ndarray) -> "Tuple[bool, str]":
+    """Write one shard; returns ``(byte_encoded, sha256)`` —
+    byte-encoded means an extension dtype stored as a flat uint8 view,
+    reshaped on load from the index's shape + dtype."""
+    raw = np.dtype(data.dtype).kind == "V"
+    if raw:
+        data = np.ascontiguousarray(data).reshape(-1).view(np.uint8)
+    with open(fpath, "wb") as f:
+        hw = _HashingWriter(f)
+        np.save(hw, data, allow_pickle=False)
+    return raw, hw.hash.hexdigest()
+
+
+def save_sharded(path: str, tree, extra: Optional[Dict[str, object]] = None
+                 ) -> None:
+    """Write ``tree`` under ``path`` in the sharded leaf format.
+
+    Each leaf's unique device shards land as ``<leaf>~<k>.npy`` with
+    their global slice recorded in ``index.json``; the integrity
+    manifest (:func:`write_digest`) is written LAST, so an interrupted
+    save is detectably incomplete and a completed one is flip-eligible
+    for the rollout plane exactly like any stage checkpoint. ``extra``
+    rides in the index (step number, host metadata).
+
+    Single-process writers only: on a multi-process runtime every host
+    would race the same filenames/index into one directory, so this
+    refuses loudly rather than corrupt (per-host spoke directories are
+    a future arc)."""
+    import jax as _jax
+    if _jax.process_count() > 1:
+        raise NotImplementedError(
+            "save_sharded is single-process: on a multi-process "
+            "runtime every host would write the same shard filenames "
+            "and index into one directory (last writer wins); gather "
+            "to process 0 or save per-host copies")
+    os.makedirs(path, exist_ok=True)
+    names, flat, _ = _leaf_names(tree)
+    leaves: Dict[str, dict] = {}
+    digests: Dict[str, str] = {}
+    for name, arr_like in zip(names, flat):
+        shape = tuple(int(s) for s in np.shape(arr_like))
+        shards = []
+        for k, (idx, data) in enumerate(_unique_shards(arr_like)):
+            fname = f"{name}~{k}.npy"
+            raw, sha = _save_shard(os.path.join(path, fname), data)
+            digests[fname] = sha
+            entry = {"index": [list(p) for p in idx], "file": fname}
+            if raw:
+                entry["raw"] = True
+            shards.append(entry)
+        dtype = getattr(arr_like, "dtype", None)
+        if dtype is None:
+            dtype = np.asarray(arr_like).dtype
+        leaves[name] = {"shape": list(shape),
+                        "dtype": _dtype_token(dtype),
+                        "shards": shards}
+    index = {"format": _FORMAT, "leaves": leaves,
+             "extra": dict(extra or {})}
+    tmp = os.path.join(path, INDEX_FILE + ".tmp")
+    with open(tmp, "w") as f:
+        json.dump(index, f, indent=1, sort_keys=True)
+    os.replace(tmp, os.path.join(path, INDEX_FILE))
+    # shard digests were hashed during the writes; only index.json
+    # (small) is read back — a multi-GB save pays one disk pass
+    write_digest(path, precomputed=digests)
+
+
+def read_index(path: str) -> Dict[str, object]:
+    with open(os.path.join(path, INDEX_FILE)) as f:
+        index = json.load(f)
+    if index.get("format") != _FORMAT:
+        raise CheckpointIntegrityError(
+            f"unknown checkpoint format {index.get('format')!r} at "
+            f"{path!r}")
+    return index
+
+
+def _load_shard(path: str, sh: dict, dtype, cache: Optional[dict],
+                digests: Optional[Dict[str, str]] = None) -> np.ndarray:
+    """Load one stored shard (memoized per restore call: with N
+    addressable devices the callback runs N times, and a replicated
+    leaf would otherwise re-read the identical file N times). With
+    ``digests``, the shard's sha256 is checked against the manifest
+    AS the bytes are read — every consumed byte verified in the same
+    single disk pass."""
+    import io
+
+    fname = sh["file"]
+    if cache is not None and fname in cache:
+        return cache[fname]
+    with open(os.path.join(path, fname), "rb") as f:
+        blob = f.read()
+    if digests is not None:
+        actual = hashlib.sha256(blob).hexdigest()
+        if actual != digests.get(fname):
+            raise CheckpointIntegrityError(
+                f"digest mismatch for {fname!r}: manifest "
+                f"{str(digests.get(fname))[:12]}..., file "
+                f"{actual[:12]}...")
+    data = np.load(io.BytesIO(blob), allow_pickle=False)
+    if sh.get("raw"):
+        # byte-encoded extension dtype: flat uint8 back to typed shape
+        s_shape = tuple(b - a for a, b in
+                        (tuple(p) for p in sh["index"]))
+        data = np.frombuffer(data.tobytes(), dtype=dtype).reshape(s_shape)
+    if cache is not None:
+        cache[fname] = data
+    return data
+
+
+def _assemble_slice(path: str, meta: dict, req: "Tuple[slice, ...]",
+                    dtype, cache: Optional[dict] = None,
+                    digests: Optional[Dict[str, str]] = None
+                    ) -> np.ndarray:
+    """Assemble the requested slice of one leaf from its saved shards
+    (reading only overlapping files; a same-topology restore reads
+    exactly its own shard back)."""
+    shape = tuple(meta["shape"])
+    lo = [0 if s.start is None else int(s.start) for s in req]
+    hi = [shape[d] if s.stop is None else int(s.stop)
+          for d, s in enumerate(req)]
+    out = np.empty([h - l for l, h in zip(lo, hi)], dtype=dtype)
+    filled = 0
+    for sh in meta["shards"]:
+        s_idx = [tuple(p) for p in sh["index"]]
+        # overlap of the stored shard with the requested window
+        o_lo = [max(l, a) for l, (a, _) in zip(lo, s_idx)]
+        o_hi = [min(h, b) for h, (_, b) in zip(hi, s_idx)]
+        if any(a >= b for a, b in zip(o_lo, o_hi)):
+            continue
+        data = _load_shard(path, sh, dtype, cache, digests)
+        src = tuple(slice(a - s_lo, b - s_lo) for (a, b), (s_lo, _) in
+                    zip(zip(o_lo, o_hi), s_idx))
+        dst = tuple(slice(a - l, b - l) for (a, b), l in
+                    zip(zip(o_lo, o_hi), lo))
+        out[dst] = data[src]
+        filled += int(np.prod([b - a for a, b in zip(o_lo, o_hi)],
+                              dtype=np.int64))
+    if filled < int(np.prod(out.shape, dtype=np.int64)):
+        raise CheckpointIntegrityError(
+            f"stored shards do not cover the requested slice "
+            f"(leaf shape {shape}, requested {list(zip(lo, hi))})")
+    return out
+
+
+def restore_sharded(path: str, template, shardings=None,
+                    strict_digest: bool = False):
+    """Restore a tree saved by :func:`save_sharded`.
+
+    ``template`` fixes the pytree structure (leaf order matches the
+    save). With ``shardings`` (a matching tree of ``NamedSharding`` —
+    typically :func:`mmlspark_tpu.parallel.dist.state_shardings` over
+    the *restoring* mesh) each leaf is built directly as a sharded
+    ``jax.Array``, every device shard assembled from only the saved
+    files that overlap it — the topology-change path (save on 8
+    devices, restore on 4 or 1, or re-factor the axes). Without
+    ``shardings`` the full host arrays are returned.
+
+    Integrity: with ``strict_digest`` the WHOLE tree is hashed up
+    front (the rollout flip-eligibility contract — every file proven,
+    read or not). Otherwise the manifest's file set is checked up
+    front (missing/extra files fail fast) and each shard's digest is
+    verified AS it is read — one disk pass over exactly the bytes the
+    restore consumes; legacy digest-less directories load with a
+    warning, never a failure.
+    """
+    digests: Optional[Dict[str, str]] = None
+    if strict_digest:
+        ok, detail = verify_digest(path, strict=True)
+        if not ok:
+            raise CheckpointIntegrityError(
+                f"sharded checkpoint {path!r} failed digest "
+                f"verification: {detail}")
+    else:
+        manifest_path = os.path.join(path, MANIFEST_FILE)
+        if not os.path.exists(manifest_path):
+            logger.warning(
+                "checkpoint %s has no integrity manifest (legacy "
+                "save before digests); loading unverified", path)
+        else:
+            try:
+                with open(manifest_path) as f:
+                    digests = dict(json.load(f)["files"])
+            except (ValueError, KeyError, TypeError) as e:
+                raise CheckpointIntegrityError(
+                    f"unreadable manifest at {path!r}: {e}")
+            have = set(_iter_files(path))
+            missing = sorted(set(digests) - have)
+            if missing:
+                raise CheckpointIntegrityError(
+                    f"files missing from checkpoint: {missing[:5]}")
+            extra = sorted(have - set(digests))
+            if extra:
+                raise CheckpointIntegrityError(
+                    f"files not in manifest: {extra[:5]}")
+            # the index is the map everything else is read through:
+            # check its (tiny) digest up front
+            if INDEX_FILE in digests:
+                actual = _sha256_file(os.path.join(path, INDEX_FILE))
+                if actual != digests[INDEX_FILE]:
+                    raise CheckpointIntegrityError(
+                        f"digest mismatch for {INDEX_FILE!r}")
+    index = read_index(path)
+    leaves_meta = index["leaves"]
+    import jax
+    names, flat, treedef = _leaf_names(template)
+    if len(names) != len(leaves_meta):
+        raise CheckpointIntegrityError(
+            f"checkpoint has {len(leaves_meta)} leaves; template "
+            f"expects {len(names)}")
+    shard_flat = None
+    if shardings is not None:
+        shard_flat = jax.tree_util.tree_leaves(
+            shardings, is_leaf=lambda x: hasattr(x, "spec"))
+        if len(shard_flat) != len(names):
+            raise ValueError("shardings tree does not match template")
+    out = []
+    for i, name in enumerate(names):
+        meta = leaves_meta.get(name)
+        if meta is None:
+            raise CheckpointIntegrityError(
+                f"leaf {name!r} missing from checkpoint index")
+        shape = tuple(meta["shape"])
+        t_shape = tuple(int(s) for s in np.shape(flat[i]))
+        if shape != t_shape:
+            raise CheckpointIntegrityError(
+                f"leaf {name!r}: checkpoint shape {shape} != template "
+                f"shape {t_shape}")
+        dtype = _resolve_dtype(meta["dtype"])
+        t_dtype = getattr(flat[i], "dtype", None)
+        if t_dtype is not None and np.dtype(t_dtype) != dtype:
+            # dtype drift fails as loudly as shape drift: silently
+            # restoring the saved precision into a reconfigured model
+            # retraces the donated step and trains at the wrong dtype
+            raise CheckpointIntegrityError(
+                f"leaf {name!r}: checkpoint dtype {dtype} != template "
+                f"dtype {np.dtype(t_dtype)}")
+        if shard_flat is not None:
+            sharding = shard_flat[i]
+            cache: dict = {}   # one file read per LEAF restore
+            arr = jax.make_array_from_callback(
+                shape, sharding,
+                lambda req, _m=meta, _d=dtype, _c=cache:
+                    _assemble_slice(path, _m, req, _d, cache=_c,
+                                    digests=digests))
+        else:
+            arr = _assemble_slice(
+                path, meta, tuple(slice(0, s) for s in shape), dtype,
+                digests=digests)
+        out.append(arr)
+    return jax.tree_util.tree_unflatten(treedef, out)
+
+
+# ---------------------------------------------------------------------------
+# step manager
+# ---------------------------------------------------------------------------
+
+class ShardedCheckpointManager:
+    """Step-directory retention over :func:`save_sharded` — the
+    checkpoint-manager surface the trainer drives (``latest_step`` /
+    ``save`` / ``restore`` / ``wait_until_finished``; saves are
+    synchronous, so ``wait_until_finished`` is the durability no-op
+    the call sites keep for interface parity)."""
+
+    STEP_PREFIX = "step_"
+
+    def __init__(self, path: str, max_to_keep: int = 3,
+                 create: bool = True):
+        self.path = path
+        self.max_to_keep = int(max_to_keep)
+        if create:
+            os.makedirs(path, exist_ok=True)
+
+    def _step_dir(self, step: int) -> str:
+        return os.path.join(self.path, f"{self.STEP_PREFIX}{step:08d}")
+
+    def all_steps(self) -> "list[int]":
+        if not os.path.isdir(self.path):
+            return []
+        out = []
+        for name in os.listdir(self.path):
+            if not name.startswith(self.STEP_PREFIX):
+                continue
+            # only COMPLETE saves count: the manifest is written last,
+            # so its absence marks an interrupted save (never restored,
+            # swept by retention)
+            if not os.path.exists(os.path.join(
+                    self.path, name, MANIFEST_FILE)):
+                continue
+            try:
+                out.append(int(name[len(self.STEP_PREFIX):]))
+            except ValueError:
+                continue
+        return sorted(out)
+
+    def latest_step(self) -> Optional[int]:
+        steps = self.all_steps()
+        return steps[-1] if steps else None
+
+    def save(self, step: int, tree,
+             extra: Optional[Dict[str, object]] = None) -> str:
+        target = self._step_dir(int(step))
+        save_sharded(target, tree,
+                     extra={"step": int(step), **(extra or {})})
+        self._prune(current=int(step))
+        return target
+
+    def restore(self, step: Optional[int], template, shardings=None,
+                strict_digest: bool = False):
+        target = self.latest_step() if step is None else int(step)
+        if target is None:
+            raise FileNotFoundError(f"no checkpoint under {self.path!r}")
+        return restore_sharded(self._step_dir(target), template,
+                               shardings=shardings,
+                               strict_digest=strict_digest)
+
+    def _prune(self, current: Optional[int] = None) -> None:
+        import shutil
+        if self.max_to_keep > 0:
+            for step in self.all_steps()[:-self.max_to_keep]:
+                shutil.rmtree(self._step_dir(step), ignore_errors=True)
+        if current is None:
+            return
+        # interrupted saves: a manifest-less step dir OLDER than the
+        # one just written is a dead partial (the crash the
+        # manifest-last contract detects) — sweep it, or repeated
+        # preemptions accumulate unbounded shard data retention never
+        # sees. Never touch dirs >= current: another manager could be
+        # mid-save on a newer step
+        complete = set(self.all_steps())
+        for name in os.listdir(self.path):
+            if not name.startswith(self.STEP_PREFIX):
+                continue
+            try:
+                step = int(name[len(self.STEP_PREFIX):])
+            except ValueError:
+                continue
+            if step < current and step not in complete:
+                shutil.rmtree(os.path.join(self.path, name),
+                              ignore_errors=True)
+
+    def wait_until_finished(self) -> None:
+        return None
+
+    def close(self) -> None:
+        return None
 
 
 def _iter_files(path: str):
@@ -72,13 +522,20 @@ def _sha256_file(path: str) -> str:
     return h.hexdigest()
 
 
-def compute_digest(path: str) -> Dict[str, object]:
+def compute_digest(path: str,
+                   precomputed: Optional[Dict[str, str]] = None
+                   ) -> Dict[str, object]:
     """Hash every file under ``path`` into a manifest dict:
     ``{"files": {relpath: sha256}, "digest": <combined tree digest>}``.
     The combined digest hashes the sorted ``relpath:sha256`` lines, so
     it pins both contents AND the file set (a deleted file changes it
-    as surely as a flipped bit)."""
-    files = {rel: _sha256_file(os.path.join(path, rel))
+    as surely as a flipped bit). ``precomputed`` supplies digests a
+    writer hashed while streaming the bytes out (the sharded save
+    path), so a multi-GB checkpoint is not read back just to hash it;
+    files not covered are hashed from disk as before."""
+    precomputed = precomputed or {}
+    files = {rel: precomputed.get(rel)
+             or _sha256_file(os.path.join(path, rel))
              for rel in _iter_files(path)}
     tree = hashlib.sha256()
     for rel in sorted(files):
@@ -86,12 +543,15 @@ def compute_digest(path: str) -> Dict[str, object]:
     return {"files": files, "digest": tree.hexdigest()}
 
 
-def write_digest(path: str) -> Dict[str, object]:
+def write_digest(path: str,
+                 precomputed: Optional[Dict[str, str]] = None
+                 ) -> Dict[str, object]:
     """Write (atomically: temp file + rename) the integrity manifest
     for the checkpoint directory at ``path`` and return it. Call LAST
     in any save path — an interrupted save must leave a missing or
-    stale manifest, never a valid-looking one."""
-    manifest = compute_digest(path)
+    stale manifest, never a valid-looking one. ``precomputed`` as in
+    :func:`compute_digest`."""
+    manifest = compute_digest(path, precomputed=precomputed)
     manifest["algorithm"] = "sha256"
     target = os.path.join(path, MANIFEST_FILE)
     tmp = target + ".tmp"

@@ -134,6 +134,18 @@ class NNModel(Model, HasInputCol, HasOutputCol):
                               ptype=int)
     data_parallel = Param(True, "shard minibatches over all local devices",
                           ptype=bool)
+    tensor_parallel = Param(0, "tensor-parallel width (0/1 = off): params "
+                            "are SHARDED over a 'model' mesh axis of this "
+                            "size per parallel/dist rules — one model "
+                            "spans devices instead of being replicated "
+                            "per device — and minibatches shard over the "
+                            "remaining 'data' axis; XLA inserts the "
+                            "collectives. The serving tensor-parallel "
+                            "dispatch mode: a ServingServer dispatching "
+                            "this model runs sharded computations under "
+                            "the same bucket/pipeline machinery, with "
+                            "placement visible in /stats and dispatch "
+                            "spans", ptype=int)
     input_dtype = Param("auto", "host-side cast before transfer: auto casts "
                         "to bfloat16 for bfloat16 models (halves host->HBM "
                         "bytes; the first layer casts activations anyway) | "
@@ -196,7 +208,63 @@ class NNModel(Model, HasInputCol, HasOutputCol):
         self.__dict__.pop("_jitted", None)
         self.__dict__.pop("_setup_sharded", None)
         self.__dict__.pop("_setup_single_cache", None)
+        self.__dict__.pop("_placement_mesh", None)
+        self.__dict__.pop("_placement_label", None)
+        self.__dict__.pop("_placement_single", None)
         super()._set_param(name, value)
+
+    # -- placement visibility (the /stats + dispatch-span surface) ----------
+
+    @property
+    def placement_label(self) -> Optional[str]:
+        """Compact mesh label (``"data=4,model=2"``) once placement has
+        happened; None before the first dispatch (no device work is
+        forced just to report). Cached — the dispatch stage reads this
+        per batch (``_set_param`` invalidates with the mesh)."""
+        label = self.__dict__.get("_placement_label")
+        if label is not None:
+            return label
+        mesh = self.__dict__.get("_placement_mesh")
+        if mesh is None:
+            return None
+        from mmlspark_tpu.parallel import dist
+        label = dist.placement_label(mesh)
+        self.__dict__["_placement_label"] = label
+        return label
+
+    def placement(self) -> Dict[str, Any]:
+        """Per-device placement report: how (and whether) this model
+        ACTUALLY spans the mesh — the mode comes from the mesh a
+        dispatch really placed on, never from configuration alone
+        (``tensor_parallel=2`` with ``data_parallel=False``, a
+        1-device host, or a pinned single-device scope all serve
+        single-device, and must say so). ``"unplaced"`` before the
+        first dispatch. Cheap — shapes + sharding metadata, no device
+        sync."""
+        out: Dict[str, Any] = {"tensor_parallel":
+                               int(self.tensor_parallel or 0)}
+        mesh = self.__dict__.get("_placement_mesh")
+        if mesh is None:
+            single = self.__dict__.get("_placement_single")
+            if single is not None:
+                # dispatched through the single-device path (pinned
+                # scope, data_parallel off, 1-device host): say so —
+                # distinguishable from a model that never dispatched
+                out["mode"] = "single_device"
+                out["devices"] = [single]
+                out["n_devices"] = 1
+            else:
+                out["mode"] = "unplaced"
+            return out
+        from mmlspark_tpu.parallel import dist
+        n_model = mesh.shape.get("model", 1)
+        out["mode"] = ("tensor_parallel" if n_model > 1
+                       else "data_parallel" if mesh.devices.size > 1
+                       else "single_device")
+        placed = self.__dict__.get("_setup_sharded")
+        out.update(dist.placement_report(
+            placed[0] if placed else self.model.params, mesh))
+        return out
 
     @functools.cached_property
     def _jitted(self):
@@ -227,7 +295,25 @@ class NNModel(Model, HasInputCol, HasOutputCol):
     @functools.cached_property
     def _setup_sharded(self):
         import jax
+        tp = int(self.tensor_parallel or 0)
+        if tp > 1:
+            # tensor parallel: ONE copy of the params spans the mesh
+            # (sharded over 'model' per the dist rule) instead of one
+            # copy per device; batches shard over the leftover 'data'
+            # axis and XLA inserts the TP collectives
+            from mmlspark_tpu.parallel import MeshSpec, dist
+            n_dev = len(jax.devices())
+            if n_dev % tp:
+                raise ValueError(
+                    f"tensor_parallel={tp} does not divide the "
+                    f"{n_dev}-device host")
+            mesh = build_mesh(MeshSpec.from_dict(
+                {"data": n_dev // tp, "model": tp}))
+            self._placement_mesh = mesh
+            return (dist.shard_state(self.model.params, mesh),
+                    batch_sharding(mesh), mesh.shape["data"])
         mesh = build_mesh()
+        self._placement_mesh = mesh
         return (jax.device_put(self.model.params, replicated_sharding(mesh)),
                 batch_sharding(mesh), mesh.shape["data"])
 
@@ -257,6 +343,11 @@ class NNModel(Model, HasInputCol, HasOutputCol):
         cache = self._setup_single_cache
         if dev not in cache:
             cache[dev] = (jax.device_put(self.model.params, dev), None, 1)
+        # remember that dispatch really happened (single-device), so
+        # placement() can distinguish "served on one device" from
+        # "never dispatched" — a thread race on this plain attribute
+        # is benign (last writer wins; every value is a real device)
+        self.__dict__["_placement_single"] = str(dev)
         return cache[dev]
 
     def transform(self, df: DataFrame) -> DataFrame:

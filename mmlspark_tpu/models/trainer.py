@@ -8,10 +8,17 @@ ssh/scp -> `mpirun cntk` -> copy-model-back chain
 (`CommandBuilders.scala:149-266`) collapses to a jitted train step with
 sharding-induced ICI allreduce — zero processes, zero sockets, zero MPI.
 
-Distribution: batches are sharded over the mesh's ``data`` axis, params
-replicated (or sharded over ``model`` for TP); XLA inserts the gradient
-allreduce. Step checkpointing via orbax covers the "resume" capability
-(SURVEY.md §5 checkpoint/resume).
+Distribution: batches are sharded over the mesh's ``data`` axis
+(per-host input sharding on a multi-process runtime — each host feeds
+only its rows); params and optimizer state are replicated, or sharded
+over ``model`` for tensor parallelism when ``mesh_shape`` names a
+``model`` axis (:mod:`mmlspark_tpu.parallel.dist` owns the sharding
+rule; XLA/GSPMD inserts the gradient allreduce and the TP collectives
+from the ``NamedSharding`` annotations, and the train state is donated
+through every step so the optimizer update lands in place). Step
+checkpointing uses the native sharded store
+(:mod:`mmlspark_tpu.io.checkpoint`): each device writes its own
+shards, and a resume may use a different topology than the save.
 """
 
 from __future__ import annotations
@@ -30,9 +37,7 @@ from mmlspark_tpu.core.params import (
 from mmlspark_tpu.core.stage import Estimator
 from mmlspark_tpu.models.function import NNFunction
 from mmlspark_tpu.models.nn import NNModel
-from mmlspark_tpu.parallel import (
-    MeshSpec, build_mesh, batch_sharding, replicated_sharding, pad_to_multiple,
-)
+from mmlspark_tpu.parallel import MeshSpec, build_mesh, pad_to_multiple
 
 LOSSES = ("softmax_cross_entropy", "sigmoid_cross_entropy", "squared_error")
 OPTIMIZERS = ("sgd", "momentum", "adam", "adamw")
@@ -57,12 +62,12 @@ def _metrics():
                 "Real (unpadded) examples per second per host-loop "
                 "step.", buckets=log_buckets(1.0, 1e7)),
             # wider ladder than the request-latency default: a
-            # multi-GB orbax save/restore routinely takes 30-120 s, and
-            # a 10 s top edge would collapse every sample into +Inf
+            # multi-GB save/restore routinely takes 30-120 s, and a
+            # 10 s top edge would collapse every sample into +Inf
             "ckpt_save_ms": REGISTRY.histogram(
                 "trainer_checkpoint_save_ms",
-                "Checkpoint save call wall-clock (host serialize + "
-                "enqueue; orbax may complete the write async).",
+                "Checkpoint save call wall-clock (per-shard writes + "
+                "digest manifest).",
                 buckets=log_buckets(10.0, 1e6)),
             "ckpt_restore_ms": REGISTRY.histogram(
                 "trainer_checkpoint_restore_ms",
@@ -138,8 +143,13 @@ class NNLearner(Estimator, HasLabelCol, HasFeaturesCol):
     warmup_steps = Param(0, "linear LR warmup steps", ptype=int)
     cosine_decay = Param(True, "cosine-decay LR to 0 over training", ptype=bool)
     seed = Param(0, "init/shuffle seed", ptype=int)
-    mesh_shape = Param(None, "mesh axes dict, e.g. {'data': -1}", ptype=dict)
-    checkpoint_dir = Param(None, "orbax step-checkpoint directory", ptype=str)
+    mesh_shape = Param(None, "mesh axes dict, e.g. {'data': -1}; a "
+                       "'model' axis > 1 turns on tensor parallelism "
+                       "(params + optimizer state sharded per "
+                       "parallel/dist rules, XLA inserts the "
+                       "collectives)", ptype=dict)
+    checkpoint_dir = Param(None, "sharded step-checkpoint directory "
+                           "(io/checkpoint native store)", ptype=str)
     checkpoint_every = Param(0, "steps between checkpoints (0 = off)", ptype=int)
     push_gateway_url = Param(None, "optional metrics remote-write URL "
                              "(Prometheus Pushgateway job path or any "
@@ -156,7 +166,7 @@ class NNLearner(Estimator, HasLabelCol, HasFeaturesCol):
                             ptype=float, validator=in_range(lo=1.0))
     max_restarts = Param(2, "bounded in-process auto-restarts: when a "
                          "train step fails and checkpointing is "
-                         "configured, restore the latest orbax "
+                         "configured, restore the latest step "
                          "checkpoint and resume the SAME shuffle "
                          "stream (deterministic fast-forward); after "
                          "this many restores the error propagates — a "
@@ -387,10 +397,18 @@ class NNLearner(Estimator, HasLabelCol, HasFeaturesCol):
         step = jax.jit(self.build_train_step(module, tx, loss_fn),
                        donate_argnums=(0, 1))
 
-        repl = replicated_sharding(mesh)
-        shard = batch_sharding(mesh)
+        # state placement: replicated on a pure-data mesh (byte-for-byte
+        # the pre-TP behavior — every spec degenerates to P() when no
+        # model axis exists), model-sharded per the dist rule otherwise;
+        # optimizer moments land with their param's layout because the
+        # rule is shape-driven. The jitted step donates both trees, so
+        # the sharded update happens in place in device memory.
+        from mmlspark_tpu.parallel import dist as _dist
+        repl = _dist.state_shardings(fn.params, mesh)
         params = jax.device_put(fn.params, repl)
-        opt_state = jax.device_put(tx.init(params), repl)
+        opt_state = tx.init(params)
+        opt_repl = _dist.state_shardings(opt_state, mesh)
+        opt_state = jax.device_put(opt_state, opt_repl)
 
         start_step = 0
         mngr = self._checkpoint_manager()
@@ -405,7 +423,7 @@ class NNLearner(Estimator, HasLabelCol, HasFeaturesCol):
         if mngr is not None and mngr.latest_step() is not None:
             raw_params, raw_opt, start_step = self._restore(mngr, template)
             params = jax.device_put(raw_params, repl)
-            opt_state = jax.device_put(raw_opt, repl)
+            opt_state = jax.device_put(raw_opt, opt_repl)
 
         # -- fault-tolerant fit: a step failure (preempted chip, injected
         # chaos fault, failed checkpoint write) restores the latest
@@ -416,10 +434,12 @@ class NNLearner(Estimator, HasLabelCol, HasFeaturesCol):
         while True:
             try:
                 params, opt_state = self._host_loop(
-                    x, y, w, step, shard, params, opt_state, start_step,
+                    x, y, w, step, mesh, params, opt_state, start_step,
                     steps_per_epoch, bs, n_data, mngr)
                 break
             except Exception as e:  # noqa: BLE001 — classified below
+                if isinstance(e, NotImplementedError):
+                    raise   # a permanent capability gap, not a fault
                 if mngr is None or restarts >= self.max_restarts:
                     raise
                 restarts += 1
@@ -431,13 +451,13 @@ class NNLearner(Estimator, HasLabelCol, HasFeaturesCol):
                       f" (restart {restarts}/{self.max_restarts})")
                 if latest is None:
                     params = jax.device_put(fn.params, repl)
-                    opt_state = jax.device_put(tx.init(params), repl)
+                    opt_state = jax.device_put(tx.init(params), opt_repl)
                     start_step = 0
                 else:
                     raw_params, raw_opt, start_step = \
                         self._restore(mngr, template)
                     params = jax.device_put(raw_params, repl)
-                    opt_state = jax.device_put(raw_opt, repl)
+                    opt_state = jax.device_put(raw_opt, opt_repl)
 
         trained = NNFunction(arch=dict(fn.arch), params=jax.device_get(params))
         # keep the training-time input convention (see _fit_device_resident)
@@ -445,7 +465,7 @@ class NNLearner(Estimator, HasLabelCol, HasFeaturesCol):
         return NNModel(model=trained, input_col=self.features_col,
                        output_col="scores", **extra)
 
-    def _host_loop(self, x, y, w, step, shard, params, opt_state,
+    def _host_loop(self, x, y, w, step, mesh, params, opt_state,
                    start_step, steps_per_epoch, bs, n_data, mngr):
         """One attempt at the per-step host loop, resumable at
         ``start_step``: the shuffle stream is regenerated from the seed
@@ -453,6 +473,7 @@ class NNLearner(Estimator, HasLabelCol, HasFeaturesCol):
         identical batch sequence (restart N reaches the same params an
         uninterrupted run does)."""
         import jax
+        from mmlspark_tpu.parallel import dist as _dist
 
         from mmlspark_tpu.core.tracing import ambient_tracer
         TRACER = ambient_tracer()
@@ -506,9 +527,18 @@ class NNLearner(Estimator, HasLabelCol, HasFeaturesCol):
                     if recompile:
                         shapes_seen.add(xp.shape)
                     t_disp = TRACER.clock.now()
-                    xb = jax.device_put(xp, shard)
-                    yb = jax.device_put(yp, shard)
-                    wb = jax.device_put(wp, shard)
+                    # data-sharded global placement. Multi-process: the
+                    # shuffle stream is seed-identical on every host, so
+                    # each host contributes ONLY its row slice of the
+                    # padded global batch and parallel/dist assembles —
+                    # feeding the full batch would duplicate every row
+                    # n_proc times and silently change the gradient
+                    if jax.process_count() > 1:
+                        plo, phi = _dist.process_local_rows(len(xp), mesh)
+                        xp, yp, wp = xp[plo:phi], yp[plo:phi], wp[plo:phi]
+                    placed, _ = _dist.put_batch(
+                        {"x": xp, "y": yp, "w": wp}, mesh)
+                    xb, yb, wb = placed["x"], placed["y"], placed["w"]
                     params, opt_state, loss = step(params, opt_state,
                                                    xb, yb, wb)
                     inflight.append(loss)
@@ -538,29 +568,40 @@ class NNLearner(Estimator, HasLabelCol, HasFeaturesCol):
             mngr.wait_until_finished()
         return params, opt_state
 
-    # -- orbax step checkpointing ------------------------------------------
+    # -- sharded step checkpointing ----------------------------------------
 
     def _checkpoint_manager(self):
         if not self.checkpoint_dir:
             return None
+        import jax
+        if jax.process_count() > 1:
+            # the native store is single-process (save_sharded would
+            # raise at the FIRST checkpoint, which the restart loop
+            # would then misread as a transient step fault and re-fit
+            # from scratch max_restarts times): fail before any
+            # training work is spent
+            raise NotImplementedError(
+                "checkpoint_dir is single-process for now: the native "
+                "sharded store cannot write one directory from "
+                "multiple hosts (see io/checkpoint.save_sharded)")
         from mmlspark_tpu.io import checkpoint as _ckpt
         return _ckpt.manager(self.checkpoint_dir)
 
     def _checkpoint(self, mngr, step_num: int, params, opt_state) -> None:
-        import jax
-        import orbax.checkpoint as ocp
         from mmlspark_tpu.core.tracing import ambient_tracer
         TRACER = ambient_tracer()
         with TRACER.span("checkpoint_save", step=step_num), \
                 _metrics()["ckpt_save_ms"].time():
-            state = {"params": jax.device_get(params),
-                     "opt_state": jax.device_get(opt_state)}
-            mngr.save(step_num, args=ocp.args.StandardSave(state))
+            # the live trees are written shard-by-shard (replicated
+            # leaves once, model-sharded leaves per slice) — no host
+            # gather; the digest manifest lands last
+            mngr.save(step_num,
+                      {"params": params, "opt_state": opt_state})
         # a scrape rides every checkpoint: batch fits usually exit (or
         # are preempted) before any Prometheus scrape, so the registry
         # state lands next to the step it describes — under telemetry/
-        # (NOT the checkpoint root: orbax owns that namespace's step
-        # listing). Best-effort: telemetry must never fail a save.
+        # (NOT the checkpoint root: the manager owns that namespace's
+        # step listing). Best-effort: telemetry must never fail a save.
         try:
             from mmlspark_tpu.core.telemetry import snapshot_registries
             from mmlspark_tpu.io import fs as _fs
@@ -575,14 +616,15 @@ class NNLearner(Estimator, HasLabelCol, HasFeaturesCol):
         """Restore the latest step against a host-side (params,
         opt_state) structure template, so optax NamedTuple states
         round-trip intact. The template must predate the first step:
-        the donated live buffers are not safe to read after a fault."""
-        import orbax.checkpoint as ocp
+        the donated live buffers are not safe to read after a fault.
+        Host arrays come back; the caller re-places them with the
+        current mesh's shardings — which may differ from the saving
+        run's (topology-change resume)."""
         from mmlspark_tpu.core.tracing import ambient_tracer
         TRACER = ambient_tracer()
         latest = mngr.latest_step()
         with TRACER.span("checkpoint_restore", step=latest), \
                 _metrics()["ckpt_restore_ms"].time():
-            restored = mngr.restore(
-                latest, args=ocp.args.StandardRestore(template))
+            restored = mngr.restore(latest, template)
         print(f"[NNLearner] resumed from step {latest}")
         return restored["params"], restored["opt_state"], latest

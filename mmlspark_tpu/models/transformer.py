@@ -1033,17 +1033,16 @@ def shard_params(params, cfg: TransformerConfig, mesh):
 def save_train_state(path: str, params, velocity, step: int,
                      max_to_keep: int = 3) -> None:
     """Checkpoint the SPMD training state (params + velocity) at
-    ``step``. Sharded arrays are written as-is (orbax handles sharded
-    ``jax.Array`` natively — no host gather, multi-process meshes
-    included); the on-disk format is mesh-layout independent, so a
+    ``step``. Sharded arrays are written shard-by-shard (the native
+    sharded store in :mod:`mmlspark_tpu.io.checkpoint` — no host
+    gather); the on-disk format is mesh-layout independent, so a
     resume may use a different mesh (fewer/more chips, different axis
-    split) than the run that saved it.
+    split) than the run that saved it, and the digest manifest written
+    last keeps every step flip-eligible for the rollout plane.
     """
-    import orbax.checkpoint as ocp
     from mmlspark_tpu.io import checkpoint as _ckpt
     mngr = _ckpt.manager(path, max_to_keep)
-    mngr.save(step, args=ocp.args.StandardSave(
-        {"params": params, "velocity": velocity}))
+    mngr.save(step, {"params": params, "velocity": velocity})
     mngr.wait_until_finished()
     mngr.close()
 
@@ -1051,11 +1050,10 @@ def save_train_state(path: str, params, velocity, step: int,
 def restore_train_state(path: str, cfg: TransformerConfig, mesh,
                         step: Optional[int] = None):
     """Restore ``(params, velocity, step)`` directly onto ``mesh``'s
-    canonical shardings (:func:`param_specs`, via an abstract
-    ShapeDtypeStruct template — nothing is materialized on host) — the
-    resume half of :func:`save_train_state`, valid across mesh layouts.
+    canonical shardings (:func:`param_specs`: each device shard is
+    assembled from only the saved files that overlap it) — the resume
+    half of :func:`save_train_state`, valid across mesh layouts.
     ``step=None`` restores the latest checkpoint."""
-    import orbax.checkpoint as ocp
     from jax.sharding import NamedSharding
     from mmlspark_tpu.io import checkpoint as _ckpt
     from mmlspark_tpu.io import fs as _fs
@@ -1065,14 +1063,15 @@ def restore_train_state(path: str, cfg: TransformerConfig, mesh,
     target = step if step is not None else mngr.latest_step()
     if target is None:
         raise FileNotFoundError(f"no checkpoint under {path!r}")
-    shapes = jax.eval_shape(lambda: init_params(cfg, seed=0))
+    template = jax.eval_shape(lambda: init_params(cfg, seed=0))
     specs = param_specs(cfg, mesh)
-    abstract = jax.tree.map(
-        lambda a, s: jax.ShapeDtypeStruct(
-            a.shape, a.dtype, sharding=NamedSharding(mesh, s)),
-        shapes, specs)
-    restored = mngr.restore(target, args=ocp.args.StandardRestore(
-        {"params": abstract, "velocity": abstract}))
+    shardings = jax.tree.map(lambda s: NamedSharding(mesh, s), specs,
+                             is_leaf=lambda x: isinstance(
+                                 x, jax.sharding.PartitionSpec))
+    state_template = {"params": template, "velocity": template}
+    state_shardings = {"params": shardings, "velocity": shardings}
+    restored = mngr.restore(target, state_template,
+                            shardings=state_shardings)
     mngr.close()
     return restored["params"], restored["velocity"], target
 
@@ -1098,9 +1097,14 @@ def make_batch(rng: np.random.Generator, cfg: TransformerConfig,
 # request claims a free slot, prefill fills rows [0, len) of that
 # slot's lane in every layer, each decode step appends one row at its
 # position, and freeing the slot is just returning the index — the
-# next occupant's prefill overwrites the lane. Single-device by
-# design (decode serving is replicated per worker; the SPMD mesh
-# stays a training concern); dense-MLP configs only.
+# next occupant's prefill overwrites the lane. Dense-MLP configs only.
+# Replicated per worker by default; under tensor parallelism
+# (``decode_param_specs`` + ``decode_cache_spec``) ONE model and ONE
+# pool span the mesh — heads and the MLP hidden shard over ``model``,
+# each device's cache holds its heads' lanes, and the same jitted
+# prefill/step run as sharded computations (XLA inserts the fan-in
+# collectives; shapes, donation, and the compile-once contract are
+# unchanged).
 
 
 def _decode_block_params(params, cfg: TransformerConfig
@@ -1137,6 +1141,52 @@ def _check_decode_config(cfg: TransformerConfig) -> None:
             "batch 1 — a different dispatch problem)")
 
 
+def decode_param_specs(cfg: TransformerConfig, mesh) -> Dict[str, Any]:
+    """PartitionSpec tree for the decode path's params under tensor
+    parallelism: attention heads and the MLP hidden shard over the
+    ``model`` axis (the Megatron split — each device holds its heads'
+    K/V lanes and its hidden slice; XLA inserts the out-proj/MLP
+    fan-in collectives), embed/head/norms replicated. Requires
+    ``n_heads`` and ``d_ff`` divisible by the model-axis size."""
+    from jax.sharding import PartitionSpec as P
+
+    _check_decode_config(cfg)
+    model = AXIS_MODEL if AXIS_MODEL in mesh.axis_names else None
+    tp = mesh.shape.get(AXIS_MODEL, 1)
+    if model and cfg.n_heads % tp:
+        raise ValueError(f"n_heads={cfg.n_heads} must divide over the "
+                         f"model axis ({tp})")
+    if model and cfg.d_ff % tp:
+        raise ValueError(f"d_ff={cfg.d_ff} must divide over the "
+                         f"model axis ({tp})")
+    specs: Dict[str, Any] = {"embed": P(), "head": P(), "final_norm": P()}
+    blocks = []
+    for _ in range(cfg.layers_per_stage):
+        blocks.append({
+            "ln1": P(), "ln2": P(),
+            "wq": P(None, None, model, None),
+            "wk": P(None, None, model, None),
+            "wv": P(None, None, model, None),
+            "wo": P(None, model, None, None),
+            "w1": P(None, None, model),
+            "b1": P(None, model),
+            "w2": P(None, model, None),
+            "b2": P(),
+        })
+    specs["blocks"] = blocks
+    return specs
+
+
+def decode_cache_spec(mesh):
+    """The KV pool's sharding under tensor parallelism: the head dim
+    (axis 3 of ``[n_layers, n_slots, max_len, H, Dh]``) over the
+    ``model`` axis — each device's cache holds exactly its heads'
+    lanes, so the pool's HBM footprint splits across the mesh."""
+    from jax.sharding import PartitionSpec as P
+    model = AXIS_MODEL if AXIS_MODEL in mesh.axis_names else None
+    return P(None, None, None, model, None)
+
+
 def init_kv_cache(cfg: TransformerConfig, n_slots: int, max_len: int
                   ) -> Dict[str, jax.Array]:
     """The preallocated slot-indexed KV pool: ``{"k", "v"}`` arrays of
@@ -1151,7 +1201,22 @@ def init_kv_cache(cfg: TransformerConfig, n_slots: int, max_len: int
             "v": jnp.zeros(shape, jnp.float32)}
 
 
-def build_prefill(cfg: TransformerConfig, donate: bool = True):
+def _decode_out_shardings(cache_sharding):
+    """Pin the jitted decode pair's output layout under tensor
+    parallelism: the cache keeps its canonical head sharding through
+    every donated call (otherwise XLA may pick a different layout for
+    the prefill's output than the step expects — one silent retrace
+    per transition), tokens/logits come back replicated (they are
+    host-fetched anyway)."""
+    if cache_sharding is None:
+        return None
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    repl = NamedSharding(cache_sharding.mesh, P())
+    return ({"k": cache_sharding, "v": cache_sharding}, repl, repl)
+
+
+def build_prefill(cfg: TransformerConfig, donate: bool = True,
+                  cache_sharding=None):
     """Jitted ``prefill(params, cache, tokens, slot, length) ->
     (cache, next_token, last_logits)``.
 
@@ -1195,11 +1260,16 @@ def build_prefill(cfg: TransformerConfig, donate: bool = True):
         return ({"k": ck, "v": cv},
                 jnp.argmax(logits, -1).astype(jnp.int32), logits)
 
-    return jax.jit(prefill, donate_argnums=(1,) if donate else ())
+    kw = {}
+    out_sh = _decode_out_shardings(cache_sharding)
+    if out_sh is not None:
+        kw["out_shardings"] = out_sh
+    return jax.jit(prefill, donate_argnums=(1,) if donate else (), **kw)
 
 
 def build_decode_step(cfg: TransformerConfig, n_slots: int,
-                      max_len: int, donate: bool = True):
+                      max_len: int, donate: bool = True,
+                      cache_sharding=None):
     """Jitted ``step(params, cache, tokens, pos) -> (cache,
     next_tokens, logits)`` — ONE token for every slot at once.
 
@@ -1244,4 +1314,8 @@ def build_decode_step(cfg: TransformerConfig, n_slots: int,
         return ({"k": ck, "v": cv},
                 jnp.argmax(logits, -1).astype(jnp.int32), logits)
 
-    return jax.jit(step, donate_argnums=(1,) if donate else ())
+    kw = {}
+    out_sh = _decode_out_shardings(cache_sharding)
+    if out_sh is not None:
+        kw["out_shardings"] = out_sh
+    return jax.jit(step, donate_argnums=(1,) if donate else (), **kw)

@@ -1,3 +1,4 @@
+from mmlspark_tpu.parallel import compat as _compat  # jax.shard_map shim
 from mmlspark_tpu.parallel.topology import (
     MeshSpec,
     build_mesh,
@@ -14,6 +15,15 @@ from mmlspark_tpu.parallel.sharding import (
     padded_device_batch,
     shard_batch,
     unpad,
+)
+from mmlspark_tpu.parallel.dist import (
+    placement_label,
+    placement_report,
+    put_batch,
+    shard_state,
+    state_shardings,
+    state_specs,
+    train_mesh,
 )
 from mmlspark_tpu.parallel.ring_attention import (
     dense_attention,
@@ -48,4 +58,11 @@ __all__ = [
     "padded_device_batch",
     "shard_batch",
     "unpad",
+    "placement_label",
+    "placement_report",
+    "put_batch",
+    "shard_state",
+    "state_shardings",
+    "state_specs",
+    "train_mesh",
 ]

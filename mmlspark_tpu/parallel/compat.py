@@ -1,0 +1,106 @@
+"""jax version compatibility for the mesh-parallel layer.
+
+The SPMD programs target the VMA-era API (``jax.shard_map`` with
+``check_vma``). On a jax that predates it (<= 0.4.x) the same
+functionality lives at ``jax.experimental.shard_map.shard_map`` with
+the ``check_rep`` flag — semantically the predecessor of ``check_vma``
+(replication checking is what makes the transpose insert the
+cross-shard psums for replicated-parameter gradients; ``False``
+likewise matches the interpret-mode escape hatch both eras need).
+:func:`install` bridges the gap by publishing a ``jax.shard_map``
+wrapper, so every call site — library and tests — speaks one API and
+the whole parallel layer runs unchanged across jax versions.
+
+Imported (and installed) by :mod:`mmlspark_tpu.parallel` package init,
+i.e. before any mesh program can be built.
+"""
+
+from __future__ import annotations
+
+
+def install() -> bool:
+    """Publish ``jax.shard_map`` / ``jax.lax.axis_size`` on jaxes that
+    predate them. Returns True when any shim was installed (False:
+    native support exists)."""
+    import jax
+
+    if not hasattr(jax.lax, "axis_size"):
+        import jax.core as _core
+
+        def axis_size(axis_name):
+            """Static size of a named mesh axis (compat: the VMA-era
+            ``jax.lax.axis_size``; ``jax.core.axis_frame`` returns the
+            bound size as a plain int on this jax)."""
+            if isinstance(axis_name, (tuple, list)):
+                n = 1
+                for a in axis_name:
+                    n *= int(_core.axis_frame(a))
+                return n
+            return int(_core.axis_frame(axis_name))
+
+        jax.lax.axis_size = axis_size
+
+    if not hasattr(jax, "typeof"):
+        class _AvalView:
+            """``jax.typeof`` stand-in: delegates to the abstract value
+            and reports an empty varying-manual-axes set — the pre-VMA
+            type system tracks replication via ``check_rep`` instead,
+            so nothing is ever vma-typed."""
+            __slots__ = ("_aval",)
+            vma = frozenset()
+
+            def __init__(self, aval):
+                self._aval = aval
+
+            def __getattr__(self, name):
+                return getattr(self._aval, name)
+
+        def typeof(x):
+            return _AvalView(jax.core.get_aval(x))
+
+        jax.typeof = typeof
+
+    import inspect as _inspect
+    if "vma" not in _inspect.signature(
+            jax.ShapeDtypeStruct.__init__).parameters:
+        _SDS = jax.ShapeDtypeStruct
+
+        class ShapeDtypeStruct(_SDS):  # noqa: N801 — drop-in stand-in
+            """Accepts (and drops) the VMA-era ``vma=`` kwarg: pre-VMA
+            avals carry no varying-axes set, so the annotation is
+            meaningless here and the kernels' out_shape declarations
+            keep working unchanged."""
+
+            def __init__(self, shape, dtype, *args, vma=None, **kwargs):
+                super().__init__(shape, dtype, *args, **kwargs)
+
+        jax.ShapeDtypeStruct = ShapeDtypeStruct
+
+    if not hasattr(jax.lax, "pcast"):
+        # with check_rep replication tracking there is no varying/
+        # replicated *type* to cast between: the rewrite machinery
+        # inserts pbroadcasts itself, so pcast is the identity
+        def pcast(x, axes=None, *, to=None):
+            return x
+
+        jax.lax.pcast = pcast
+
+    if hasattr(jax, "shard_map"):
+        return False
+    from jax.experimental.shard_map import shard_map as _exp_shard_map
+
+    def shard_map(f, mesh=None, in_specs=None, out_specs=None,
+                  check_vma: bool = True, **kwargs):
+        check_rep = kwargs.pop("check_rep", check_vma)
+        return _exp_shard_map(f, mesh=mesh, in_specs=in_specs,
+                              out_specs=out_specs, check_rep=check_rep,
+                              **kwargs)
+
+    shard_map.__doc__ = (_exp_shard_map.__doc__ or "") + (
+        "\n\n(compat wrapper: check_vma maps to check_rep — "
+        "mmlspark_tpu.parallel.compat)")
+    jax.shard_map = shard_map
+    return True
+
+
+INSTALLED = install()

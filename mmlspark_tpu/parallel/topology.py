@@ -153,13 +153,38 @@ def in_single_device_scope() -> bool:
 
 
 def build_mesh(spec: Optional[MeshSpec] = None, devices=None):
-    """Build a ``jax.sharding.Mesh`` over the given (default: all) devices."""
+    """Build a ``jax.sharding.Mesh`` over the given (default: all) devices.
+
+    A fully fixed spec smaller than the host's device count takes the
+    leading subset (``{"data": 1}`` on an 8-device host is a 1-device
+    mesh, not an error) — what lets one process build the 1/2/4/8-
+    device meshes of a scaling curve, or pin a small fit while the
+    rest of the chips serve."""
     import jax
     import numpy as np
     from jax.sharding import Mesh
 
     spec = spec or MeshSpec.data_parallel()
     devices = list(devices) if devices is not None else list(jax.devices())
+    fixed = [s for _, s in spec.axes if s != -1]
+    if len(fixed) == len(spec.axes):
+        need = math.prod(fixed)
+        if 0 < need < len(devices):
+            if jax.process_count() > 1:
+                # a leading subset of the GLOBAL device list can leave
+                # a process with a mesh containing none of its local
+                # devices — collectives then fail obscurely or hang;
+                # multi-process meshes must name every device
+                raise ValueError(
+                    f"mesh {dict(spec.axes)} needs {need} devices but "
+                    f"the multi-process runtime has {len(devices)}: "
+                    f"subsetting is single-process only — size the "
+                    f"mesh to the pod (or use -1 for one axis)")
+            from mmlspark_tpu.core.logs import get_logger
+            get_logger("parallel.topology").info(
+                "mesh %s uses the leading %d of %d devices",
+                dict(spec.axes), need, len(devices))
+            devices = devices[:need]
     sizes = spec.resolve(len(devices))
     shape = tuple(sizes[a] for a in spec.axis_names)
     dev_array = np.asarray(devices).reshape(shape)

@@ -22,7 +22,8 @@ from mmlspark_tpu.serving.server import (
 )
 from mmlspark_tpu.serving.consolidator import PartitionConsolidator
 from mmlspark_tpu.serving.decode import (
-    DecodeOverloaded, DecodeScheduler, SlotPool, TransformerDecoder,
+    DecodeOverloaded, DecodeScheduler, Sampler, SlotPool,
+    TransformerDecoder,
 )
 from mmlspark_tpu.serving.frontend import EventLoopFrontend
 from mmlspark_tpu.serving.policy import AdaptiveBatchPolicy
@@ -34,4 +35,4 @@ __all__ = ["ServingServer", "ServingCoordinator", "ServingClient",
            "PartitionConsolidator", "EventLoopFrontend",
            "ModelVersionManager", "RolloutError", "RolloutOrchestrator",
            "DecodeScheduler", "DecodeOverloaded", "SlotPool",
-           "TransformerDecoder", "AdaptiveBatchPolicy"]
+           "TransformerDecoder", "AdaptiveBatchPolicy", "Sampler"]

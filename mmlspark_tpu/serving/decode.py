@@ -71,17 +71,50 @@ class TransformerDecoder:
 
     def __init__(self, params, cfg, n_slots: int = 8,
                  max_len: int = 256, eos_id: Optional[int] = None,
-                 donate: bool = True):
+                 donate: bool = True, mesh=None):
         from mmlspark_tpu.models import transformer as T
-        self.params = params
         self.cfg = cfg
         self.n_slots = int(n_slots)
         self.max_len = int(max_len)
         self.eos_id = eos_id
+        self.mesh = mesh
         self.cache = T.init_kv_cache(cfg, self.n_slots, self.max_len)
-        self._prefill = T.build_prefill(cfg, donate=donate)
+        cache_sharding = None
+        if mesh is not None:
+            # tensor-parallel decode: ONE model + ONE KV pool span the
+            # mesh — heads/MLP-hidden shard over the model axis
+            # (decode_param_specs), each device's cache holds exactly
+            # its heads' lanes (decode_cache_spec). The jitted pair
+            # below compiles the SAME program as sharded computations;
+            # shapes, donation, and compile-once are unchanged.
+            import jax
+            from jax.sharding import NamedSharding, PartitionSpec
+            is_spec = lambda x: isinstance(x, PartitionSpec)  # noqa: E731
+            p_sh = jax.tree.map(
+                lambda s: NamedSharding(mesh, s),
+                T.decode_param_specs(cfg, mesh), is_leaf=is_spec)
+            params = jax.device_put(params, p_sh)
+            cache_sharding = NamedSharding(mesh,
+                                           T.decode_cache_spec(mesh))
+            self.cache = jax.device_put(self.cache, cache_sharding)
+        self.params = params
+        self._prefill = T.build_prefill(cfg, donate=donate,
+                                        cache_sharding=cache_sharding)
         self._step = T.build_decode_step(cfg, self.n_slots,
-                                         self.max_len, donate=donate)
+                                         self.max_len, donate=donate,
+                                         cache_sharding=cache_sharding)
+
+    def placement(self) -> Dict[str, Any]:
+        """Where this decoder's params + KV pool live (the
+        ``/decode/stats`` placement surface)."""
+        if self.mesh is None:
+            return {"mode": "single_device", "n_devices": 1}
+        from mmlspark_tpu.parallel import dist
+        out = {"mode": "tensor_parallel",
+               "label": dist.placement_label(self.mesh)}
+        out.update(dist.placement_report(
+            {"params": self.params, "cache": self.cache}, self.mesh))
+        return out
 
     # -- shapes --------------------------------------------------------------
 
@@ -100,25 +133,37 @@ class TransformerDecoder:
 
     # -- compute -------------------------------------------------------------
 
-    def prefill(self, slot: int, prompt: np.ndarray) -> int:
+    def prefill_logits(self, slot: int, prompt: np.ndarray
+                       ) -> "tuple[int, Any]":
         """Fill ``slot``'s cache lane from ``prompt``; returns the
-        first generated (greedy) token."""
+        first generated greedy token AND the last-position logits (a
+        device array — only a sampling caller pays the host fetch)."""
         import jax.numpy as jnp
         padded = self.pad_prompt(prompt)
-        self.cache, nxt, _ = self._prefill(
+        self.cache, nxt, logits = self._prefill(
             self.params, self.cache, jnp.asarray(padded),
             np.int32(slot), np.int32(len(prompt)))
-        return int(nxt)
+        return int(nxt), logits
 
-    def step(self, tokens: np.ndarray, pos: np.ndarray) -> np.ndarray:
+    def prefill(self, slot: int, prompt: np.ndarray) -> int:
+        """Greedy :meth:`prefill_logits` (compat surface)."""
+        return self.prefill_logits(slot, prompt)[0]
+
+    def step_logits(self, tokens: np.ndarray, pos: np.ndarray
+                    ) -> "tuple[np.ndarray, Any]":
         """One token for every slot: ``tokens``/``pos`` are the full
         fixed ``[n_slots]`` arrays (free slots ride along at token 0 /
-        pos 0)."""
+        pos 0). Returns greedy next tokens plus the full per-slot
+        logits (device array; fetched only when a sampler needs it)."""
         import jax.numpy as jnp
-        self.cache, nxt, _ = self._step(
+        self.cache, nxt, logits = self._step(
             self.params, self.cache, jnp.asarray(tokens),
             jnp.asarray(pos))
-        return np.asarray(nxt)
+        return np.asarray(nxt), logits
+
+    def step(self, tokens: np.ndarray, pos: np.ndarray) -> np.ndarray:
+        """Greedy :meth:`step_logits` (compat surface)."""
+        return self.step_logits(tokens, pos)[0]
 
     def n_compiles(self) -> int:
         """Compiled-executable count across prefill buckets + the step
@@ -137,6 +182,50 @@ class TransformerDecoder:
             self.prefill(0, np.zeros(min(bucket, self.max_len - 1),
                                      np.int32))
         return self.n_compiles()
+
+
+class Sampler:
+    """Per-request seeded token sampling over the step's full logits.
+
+    Greedy decode stays the device-side argmax (no logits transfer);
+    a request that asks for ``temperature > 0`` gets temperature /
+    top-k / nucleus (top-p) sampling on host from its slot's logits
+    row, driven by its own ``numpy`` PRNG — so one ``seed`` makes a
+    sampled decode bit-for-bit reproducible whatever other requests
+    share the batch (slot independence extends to randomness)."""
+
+    __slots__ = ("temperature", "top_k", "top_p", "seed", "_rng")
+
+    def __init__(self, temperature: float, top_k: int = 0,
+                 top_p: float = 1.0, seed: Optional[int] = None):
+        self.temperature = float(temperature)
+        self.top_k = int(top_k)
+        self.top_p = float(top_p)
+        self.seed = seed
+        self._rng = np.random.default_rng(seed)
+
+    def sample(self, logits: np.ndarray) -> int:
+        l = logits.astype(np.float64) / max(self.temperature, 1e-6)
+        if 0 < self.top_k < l.size:
+            kth = np.partition(l, -self.top_k)[-self.top_k]
+            l = np.where(l < kth, -np.inf, l)
+        l = l - l.max()
+        p = np.exp(l)
+        p /= p.sum()
+        if self.top_p < 1.0:
+            order = np.argsort(-p, kind="stable")
+            cum = np.cumsum(p[order])
+            # smallest prefix whose mass reaches top_p (>= 1 token)
+            keep = int(np.searchsorted(cum, self.top_p)) + 1
+            mask = np.zeros(p.size, bool)
+            mask[order[:keep]] = True
+            p = np.where(mask, p, 0.0)
+            p /= p.sum()
+        return int(self._rng.choice(p.size, p=p))
+
+    def describe(self) -> Dict[str, Any]:
+        return {"temperature": self.temperature, "top_k": self.top_k,
+                "top_p": self.top_p, "seed": self.seed}
 
 
 class SlotPool:
@@ -171,12 +260,15 @@ class _DecodeRequest:
     deadline/trace/span all live there)."""
 
     __slots__ = ("pending", "prompt", "max_new", "produced", "slot",
-                 "cancelled", "t_submit", "t_prefill", "t_decode")
+                 "cancelled", "t_submit", "t_prefill", "t_decode",
+                 "sampler")
 
-    def __init__(self, pending, prompt: np.ndarray, max_new: int):
+    def __init__(self, pending, prompt: np.ndarray, max_new: int,
+                 sampler: Optional[Sampler] = None):
         self.pending = pending
         self.prompt = prompt
         self.max_new = int(max_new)
+        self.sampler = sampler
         self.produced: List[int] = []       # incremental emission
         self.slot: Optional[int] = None
         self.cancelled = False
@@ -328,15 +420,55 @@ class DecodeScheduler:
             raise ValueError('"max_new_tokens" must be a positive int')
         # the cache lane bounds the sequence: clamp the budget to it
         max_new = min(max_new, self.decoder.max_len - len(prompt))
-        return np.asarray(prompt, np.int32), max_new
+        return np.asarray(prompt, np.int32), max_new, \
+            self._parse_sampling(payload)
+
+    @staticmethod
+    def _parse_sampling(payload: dict) -> Optional[Sampler]:
+        """Request-selectable sampling: ``temperature`` (> 0 turns
+        sampling on; 0/absent = greedy, the default), ``top_k``,
+        ``top_p``, ``seed``. Bad values 400 like any other payload
+        error."""
+        temp = payload.get("temperature", 0)
+        if isinstance(temp, bool) or not isinstance(temp, (int, float)) \
+                or not np.isfinite(temp) or temp < 0:
+            raise ValueError(
+                '"temperature" must be a finite number >= 0 '
+                '(0 = greedy)')
+        top_k = payload.get("top_k", 0)
+        if isinstance(top_k, bool) or not isinstance(top_k, int) \
+                or top_k < 0:
+            raise ValueError('"top_k" must be an int >= 0 (0 = off)')
+        top_p = payload.get("top_p", 1.0)
+        if isinstance(top_p, bool) or not isinstance(top_p, (int, float)) \
+                or not 0.0 < float(top_p) <= 1.0:
+            raise ValueError('"top_p" must be in (0, 1]')
+        seed = payload.get("seed")
+        if seed is not None and (isinstance(seed, bool)
+                                 or not isinstance(seed, int)):
+            raise ValueError('"seed" must be an int')
+        if float(temp) == 0.0:
+            if "temperature" not in payload and \
+                    (int(top_k) > 0 or float(top_p) < 1.0):
+                # EFFECTIVE knobs with temperature ABSENT: serve them
+                # at temperature 1 rather than silently decoding
+                # greedy. An EXPLICIT "temperature": 0 always wins —
+                # 0 is documented as greedy, and overriding it to
+                # unseeded T=1 sampling would hand the client exactly
+                # the nondeterminism it asked to avoid. No-op values
+                # (top_k: 0, top_p: 1.0 — both documented "off") stay
+                # greedy either way.
+                return Sampler(1.0, int(top_k), float(top_p), seed)
+            return None
+        return Sampler(float(temp), int(top_k), float(top_p), seed)
 
     def submit(self, pending) -> None:
         """Enqueue one admitted request (already past the server's
         replay/join/shed/doa checks). Raises ValueError on a bad
         payload (caller replies 400), DecodeOverloaded when the
         waiting queue is full (caller replies 429)."""
-        prompt, max_new = self.parse(pending.payload)
-        req = _DecodeRequest(pending, prompt, max_new)
+        prompt, max_new, sampler = self.parse(pending.payload)
+        req = _DecodeRequest(pending, prompt, max_new, sampler)
         req.t_submit = self.clock.now()
         with self._lock:
             if len(self._waiting) >= self.max_waiting:
@@ -530,7 +662,12 @@ class DecodeScheduler:
                 if self.fault_plan is not None:
                     self.fault_plan.raise_at("decode_prefill",
                                              clock=self.clock)
-                first = self.decoder.prefill(slot, req.prompt)
+                first, last_logits = self.decoder.prefill_logits(
+                    slot, req.prompt)
+                if req.sampler is not None:
+                    # the request's own seeded PRNG picks the first
+                    # generated token from the prompt's last logits
+                    first = req.sampler.sample(np.asarray(last_logits))
             except Exception as e:  # noqa: BLE001 — injected or real
                 self.pool.release(slot)
                 self._add_span(req, "prefill", t0, self._now(),
@@ -598,7 +735,8 @@ class DecodeScheduler:
             if self.fault_plan is not None:
                 self.fault_plan.raise_at("decode_step",
                                          clock=self.clock)
-            out = self.decoder.step(self._tokens, self._pos)
+            out, step_logits = self.decoder.step_logits(
+                self._tokens, self._pos)
         except Exception as e:  # noqa: BLE001 — injected or real
             # a failed step loses the affected requests (500, never
             # journaled — clients may retry) but NEVER a slot
@@ -613,8 +751,15 @@ class DecodeScheduler:
         self.n_steps += 1
         if self._m_step is not None:
             self._m_step.labels().observe((t1 - t0) * 1000.0)
+        # one host fetch of the full [n_slots, vocab] logits per step,
+        # paid ONLY while a sampling request is in a slot — pure-greedy
+        # batches keep the token-only transfer
+        logits_np = None
+        if any(r.sampler is not None for r in self._active.values()):
+            logits_np = np.asarray(step_logits)
         for slot, req in list(self._active.items()):
-            tok = int(out[slot])
+            tok = (int(out[slot]) if req.sampler is None
+                   else req.sampler.sample(logits_np[slot]))
             req.produced.append(tok)
             self.n_tokens += 1
             self._pos[slot] += 1
@@ -634,12 +779,15 @@ class DecodeScheduler:
                   "rid": r.pending.rid,
                   "prompt_len": int(len(r.prompt)),
                   "n_tokens": len(r.produced),   # incremental progress
-                  "max_new_tokens": r.max_new}
+                  "max_new_tokens": r.max_new,
+                  "sampling": (r.sampler.describe()
+                               if r.sampler is not None else None)}
                  for s, r in active]
         return {"n_slots": self.decoder.n_slots,
                 "slots_in_use": len(slots),
                 "slots_free": self.pool.n_free,
                 "max_len": self.decoder.max_len,
+                "placement": self.decoder.placement(),
                 "waiting": waiting,
                 "max_waiting": self.max_waiting,
                 "n_requests": self.n_requests,

@@ -797,6 +797,11 @@ class ServingServer:
                     # at GET /version): the fleet view aggregates this
                     # into its coherent-version-set check
                     "model_version": self.versions.active.version,
+                    # per-device placement of the active model (tensor-
+                    # parallel dispatch mode): mesh axes, device list,
+                    # sharded/replicated leaf split, bytes per device —
+                    # None for models that don't report placement
+                    "placement": self._model_placement(),
                     # the LIVE tail-capture threshold (adaptive
                     # refreshes move it; fixed config pins it)
                     "slow_trace_ms":
@@ -895,6 +900,18 @@ class ServingServer:
                 "journal_recovered": self.n_journal_recovered,
             }
         return 200, json.dumps(status).encode(), "application/json", ()
+
+    def _model_placement(self) -> Optional[dict]:
+        """The active model's device placement, when it reports one
+        (NNModel.placement / TransformerDecoder.placement) — scrapes
+        must never fail on a model without the surface."""
+        fn = getattr(self.versions.active.model, "placement", None)
+        if fn is None:
+            return None
+        try:
+            return fn()
+        except Exception:  # noqa: BLE001 — stats never 500 on a model
+            return None
 
     def _post_route(self, path: str, body: bytes
                     ) -> Optional[Tuple[int, bytes, str]]:
@@ -1477,10 +1494,18 @@ class ServingServer:
                 self.versions.maybe_shadow(df, out)
             except Exception as e:  # noqa: BLE001 — model failure -> 500s
                 job["error"] = e
+            span_attrs = {"bucket": df.num_rows,
+                          "model_version": mv.version}
+            # tensor-parallel dispatch carries its placement on the
+            # span (a cheap precomputed label like "data=4,model=2"),
+            # so a captured slow dispatch says where it ran
+            pl = getattr(mv.model, "placement_label", None)
+            if pl:
+                span_attrs["placement"] = pl
             self._add_spans(
                 job["live"], "dispatch", t0, self.tracer.clock.now(),
                 status="ok" if job["error"] is None else "error",
-                bucket=df.num_rows, model_version=mv.version)
+                **span_attrs)
         return job
 
     def _encode_replies(self, out: DataFrame, in_cols: List[str],

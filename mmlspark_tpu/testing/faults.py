@@ -15,7 +15,7 @@ Wrappers put the plan in front of each layer's failure surface:
 * :class:`FaultyModel` — wraps a serving model's ``transform`` so batch
   inference fails or stalls on schedule
   (:class:`mmlspark_tpu.serving.ServingServer`).
-* :class:`FaultyCheckpointManager` — wraps an orbax manager so
+* :class:`FaultyCheckpointManager` — wraps a checkpoint manager so
   checkpoint writes fail on schedule.
 * :meth:`FaultPlan.step_fault` — a trainer hook that raises at chosen
   global steps, driving ``NNLearner``'s bounded-restart fit loop.
@@ -256,7 +256,7 @@ class FaultyModel:
 # ---------------------------------------------------------------------------
 
 class FaultyCheckpointManager:
-    """Wraps an orbax CheckpointManager so ``save`` fails on schedule;
+    """Wraps a checkpoint manager so ``save`` fails on schedule;
     everything else proxies through. A failed save surfaces in the
     trainer as a step fault (the restart path restores the previous
     good checkpoint)."""

@@ -808,3 +808,191 @@ class TestPropagationOverhead:
                 break
             time.sleep(0.05)
         assert best < self.HOP_BUDGET_NS
+
+
+class TestClockSkew:
+    """Cross-host clock-skew estimation in ``merge_traces``: a worker
+    subtree escaping its egress window is shifted by the NTP-style
+    midpoint offset and its skew reported per part; nested (sane)
+    subtrees are untouched — asymmetric latency is never 'corrected'
+    away."""
+
+    @staticmethod
+    def _span(sid, parent, start, dur, name="s", remote=False):
+        d = {"span_id": sid, "parent_id": parent, "start_ms": start,
+             "duration_ms": dur, "name": name, "status": "ok",
+             "thread": "t"}
+        if remote:
+            d["remote"] = True
+        return d
+
+    def _caller(self):
+        return {"trace_id": "t1", "origin_unix": 1000.0, "route": "/x",
+                "captured_at": 5.0, "spans": [
+                    self._span(1, None, 0.0, 100.0, "request"),
+                    self._span(2, 1, 10.0, 60.0, "http_egress")]}
+
+    def test_skewed_worker_is_corrected_and_reported(self):
+        # worker wall clock ~500 ms ahead: its spans land far outside
+        # the 10..70 ms egress window after origin alignment
+        worker = {"trace_id": "t1", "origin_unix": 1000.0, "spans": [
+            self._span(10, 2, 520.0, 30.0, "request", remote=True),
+            self._span(11, 10, 525.0, 10.0, "dispatch")]}
+        m = merge_traces([("client", self._caller()), ("w1", worker)])
+        assert abs(m["clock_skew_ms"]["w1"] + 495.0) < 1e-6
+        spans = {s["span_id"]: s for s in m["spans"]}
+        egress, w_root = spans[2], spans[10]
+        # corrected subtree nests inside the egress window
+        assert w_root["start_ms"] >= egress["start_ms"]
+        assert (w_root["start_ms"] + w_root["duration_ms"]
+                <= egress["start_ms"] + egress["duration_ms"])
+        # intra-part layout preserved (the whole part shifts rigidly)
+        assert spans[11]["start_ms"] - w_root["start_ms"] == 5.0
+        # the merged duration is the CALLER's timeline, not 620 ms
+        assert m["duration_ms"] == 100.0
+
+    def test_synced_worker_reports_zero_and_moves_nothing(self):
+        worker = {"trace_id": "t1", "origin_unix": 1000.0, "spans": [
+            self._span(10, 2, 20.0, 30.0, "request", remote=True)]}
+        m = merge_traces([("client", self._caller()), ("w1", worker)])
+        assert m["clock_skew_ms"]["w1"] == 0.0
+        spans = {s["span_id"]: s for s in m["spans"]}
+        assert spans[10]["start_ms"] == 20.0
+
+    def test_skew_propagates_along_caller_chain(self):
+        # client -> w1 (skewed +200) -> w2 (synced WITH w1): w2's
+        # correction must include w1's, estimated against w1's
+        # already-corrected times
+        w1 = {"trace_id": "t1", "origin_unix": 1000.0, "spans": [
+            self._span(10, 2, 220.0, 40.0, "request", remote=True),
+            self._span(12, 10, 225.0, 20.0, "http_egress")]}
+        w2 = {"trace_id": "t1", "origin_unix": 1000.0, "spans": [
+            self._span(20, 12, 230.0, 10.0, "request", remote=True)]}
+        m = merge_traces([("client", self._caller()),
+                          ("w1", w1), ("w2", w2)])
+        # w1 shifted by about -195 (midpoint of 60ms window vs 40ms span)
+        assert m["clock_skew_ms"]["w1"] < -150
+        # w2 nested inside w1's PRE-shift egress, so it inherits w1's
+        # correction rather than reporting zero
+        assert abs(m["clock_skew_ms"]["w2"]
+                   - m["clock_skew_ms"]["w1"]) < 50
+        spans = {s["span_id"]: s for s in m["spans"]}
+        w1_eg, w2_root = spans[12], spans[20]
+        assert w2_root["start_ms"] >= w1_eg["start_ms"]
+
+    def test_no_links_no_skew_map(self):
+        m = merge_traces([("client", self._caller())])
+        assert m["clock_skew_ms"] == {}
+
+
+# ---------------------------------------------------------------------------
+# Native remote-write protobuf
+# ---------------------------------------------------------------------------
+
+def _pb_parse(buf):
+    """Minimal protobuf wire parser (test-side only): returns
+    [(field, value)] where value is bytes (len-delimited), float
+    (fixed64 double), or int (varint)."""
+    import struct as _struct
+    i, out = 0, []
+
+    def varint(i):
+        n = s = 0
+        while True:
+            b = buf[i]
+            i += 1
+            n |= (b & 0x7F) << s
+            s += 7
+            if not b & 0x80:
+                return n, i
+
+    while i < len(buf):
+        key, i = varint(i)
+        field, wire = key >> 3, key & 7
+        if wire == 2:
+            ln, i = varint(i)
+            out.append((field, buf[i:i + ln]))
+            i += ln
+        elif wire == 1:
+            out.append((field, _struct.unpack("<d", buf[i:i + 8])[0]))
+            i += 8
+        else:
+            v, i = varint(i)
+            out.append((field, v))
+    return out
+
+
+class TestRemoteWriteProtobuf:
+    """The hand-rolled ``prometheus.WriteRequest`` encoding: decoded
+    back by an independent mini-parser, it must reproduce exactly the
+    samples the text exposition carries — and the pusher must speak
+    the remote-write content type with the snappy-less fallback."""
+
+    def _registry(self):
+        reg = MetricsRegistry()
+        reg.counter("jobs_total").inc(3)
+        reg.gauge("depth", labels=("queue",)).labels("hot").set(7.5)
+        h = reg.histogram("lat_ms", buckets=(1.0, 10.0))
+        for v in (0.5, 5.0, 100.0):
+            h.observe(v)
+        return reg
+
+    def test_encoding_round_trips(self):
+        from mmlspark_tpu.core.telemetry import (
+            collect_samples, encode_write_request)
+        reg = self._registry()
+        rows = collect_samples(reg)
+        payload = encode_write_request(reg, ts_ms=1234567890123)
+        decoded = []
+        for f, ts_bytes in _pb_parse(payload):
+            assert f == 1
+            labels, sample = {}, None
+            for ff, v in _pb_parse(ts_bytes):
+                if ff == 1:
+                    d = dict(_pb_parse(v))
+                    labels[d[1].decode()] = d[2].decode()
+                else:
+                    sample = dict(_pb_parse(v))
+            name = labels.pop("__name__")
+            decoded.append((name, tuple(sorted(labels.items())),
+                            sample[1], sample.get(2, 0)))
+        assert {(n, tuple(sorted(l)), v) for n, l, v in rows} == \
+            {(n, l, v) for n, l, v, _ in decoded}
+        assert all(ts == 1234567890123 for *_, ts in decoded)
+        # histograms expand to cumulative le buckets + sum/count
+        names = {n for n, *_ in decoded}
+        assert {"lat_ms_bucket", "lat_ms_sum", "lat_ms_count"} <= names
+
+    def test_pusher_remote_write_headers_and_fallback(self):
+        from mmlspark_tpu.core.telemetry import (
+            REMOTE_WRITE_CONTENT_TYPE, collect_samples,
+            snappy_available)
+        reg = self._registry()
+        gw = _GatewaySession()
+        p = MetricsPusher("http://gw/api/v1/write", registries=(reg,),
+                          format="remote_write", policy=_fast_policy(),
+                          session=gw)
+        assert p.push_now()
+        method, url, headers, body = gw.seen[0]
+        assert headers["Content-Type"] == REMOTE_WRITE_CONTENT_TYPE
+        assert headers["X-Prometheus-Remote-Write-Version"] == "0.1.0"
+        if snappy_available():
+            assert headers.get("Content-Encoding") == "snappy"
+            assert p.n_uncompressed == 0
+        else:
+            # snappy-less fallback: valid uncompressed protobuf, no
+            # Content-Encoding lie, and the degradation is counted
+            assert "Content-Encoding" not in headers
+            assert p.n_uncompressed == 1
+            frames = _pb_parse(body)
+            assert frames and all(f == 1 for f, _ in frames)
+            assert len(frames) == len(collect_samples(reg))
+        # the text path is untouched by default
+        p2 = MetricsPusher("http://gw/metrics/job/x", registries=(reg,),
+                           policy=_fast_policy(), session=gw)
+        assert p2.push_now()
+        assert gw.seen[-1][2]["Content-Type"].startswith("text/plain")
+
+    def test_unknown_format_rejected(self):
+        with pytest.raises(ValueError, match="format"):
+            MetricsPusher("http://gw", format="xml")

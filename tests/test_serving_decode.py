@@ -633,3 +633,124 @@ class TestAdaptiveBatchPolicy:
             table = srv.adaptive_batcher.service_ms
             assert table, "no buckets learned"
             assert set(table) <= {1, 2, 4}
+
+
+class TestSampling:
+    """Request-selectable temperature / top-k / top-p sampling over the
+    full logits the decode step already returns — greedy stays the
+    default (and the device-argmax fast path), seeded sampling is
+    bit-reproducible per request."""
+
+    def test_sampler_seeded_determinism(self):
+        from mmlspark_tpu.serving.decode import Sampler
+        logits = np.random.default_rng(0).normal(size=64)
+        a = Sampler(0.8, top_k=16, top_p=0.9, seed=42)
+        b = Sampler(0.8, top_k=16, top_p=0.9, seed=42)
+        seq_a = [a.sample(logits) for _ in range(20)]
+        seq_b = [b.sample(logits) for _ in range(20)]
+        assert seq_a == seq_b
+        c = Sampler(0.8, top_k=16, top_p=0.9, seed=43)
+        assert [c.sample(logits) for _ in range(20)] != seq_a
+
+    def test_top_k_and_top_p_restrict_support(self):
+        from mmlspark_tpu.serving.decode import Sampler
+        logits = np.arange(64, dtype=np.float64)     # strictly increasing
+        s = Sampler(1.0, top_k=4, seed=0)
+        picks = {s.sample(logits) for _ in range(200)}
+        assert picks <= {60, 61, 62, 63}
+        # a tiny nucleus at a peaked distribution pins the argmax
+        peaked = np.zeros(64); peaked[7] = 50.0
+        s2 = Sampler(1.0, top_p=0.5, seed=0)
+        assert {s2.sample(peaked) for _ in range(50)} == {7}
+
+    def test_parse_sampling_validation(self):
+        sched = DecodeScheduler(_decoder())
+        base = {"prompt": [1, 2, 3]}
+        assert sched.parse(base)[2] is None                 # greedy default
+        assert sched.parse({**base, "temperature": 0})[2] is None
+        s = sched.parse({**base, "temperature": 0.7, "top_k": 5,
+                         "top_p": 0.9, "seed": 1})[2]
+        assert s is not None and s.temperature == 0.7
+        # explicit EFFECTIVE top_k without temperature: sampling at
+        # T=1, not silently greedy
+        assert sched.parse({**base, "top_k": 3})[2] is not None
+        assert sched.parse({**base, "top_p": 0.9})[2] is not None
+        # explicit NO-OP knobs (both documented as "off") stay greedy:
+        # key presence alone must never flip a request to unseeded
+        # full-vocab sampling
+        assert sched.parse({**base, "top_k": 0})[2] is None
+        assert sched.parse({**base, "top_p": 1.0})[2] is None
+        # an EXPLICIT temperature: 0 always wins (0 is documented as
+        # greedy), even alongside effective knobs — overriding it to
+        # T=1 would hand back exactly the nondeterminism the client
+        # asked to avoid
+        assert sched.parse({**base, "temperature": 0,
+                            "top_p": 0.9})[2] is None
+        assert sched.parse({**base, "temperature": 0,
+                            "top_k": 5})[2] is None
+        for bad in ({"temperature": -1}, {"temperature": "hot"},
+                    {"top_k": -2}, {"top_p": 0.0}, {"top_p": 1.5},
+                    {"seed": "x"}, {"temperature": True}):
+            with pytest.raises(ValueError):
+                sched.parse({**base, **bad})
+
+    @pytest.mark.parametrize("frontend", ["eventloop", "threaded"])
+    def test_http_seeded_sampling_deterministic(self, frontend):
+        with _serve(frontend=frontend) as srv:
+            srv.decoder.decoder.warmup()
+            warm = srv.decoder.decoder.n_compiles()
+            url = f"http://{srv.host}:{srv.port}/generate"
+            rng = np.random.default_rng(3)
+            prompt = _prompt(rng, 4)
+            body = {"prompt": prompt, "max_new_tokens": 6,
+                    "temperature": 0.9, "top_k": 16, "seed": 1234}
+            r1 = requests.post(url, json=body, timeout=30)
+            r2 = requests.post(url, json=body, timeout=30)
+            assert r1.status_code == r2.status_code == 200
+            # same seed -> the same sampled sequence, across requests
+            assert r1.json()["tokens"] == r2.json()["tokens"]
+            r3 = requests.post(url, json={**body, "seed": 99},
+                               timeout=30)
+            greedy = requests.post(
+                url, json={"prompt": prompt, "max_new_tokens": 6},
+                timeout=30)
+            assert greedy.json()["tokens"] == _greedy_reference(prompt, 6)
+            # different seed virtually always diverges at T=0.9 over 6
+            # tokens; equality of all three would mean sampling is off
+            assert not (r3.json()["tokens"] == r1.json()["tokens"]
+                        == greedy.json()["tokens"])
+            # sampling never grows the compiled-shape set (host-side
+            # sampling over logits the step already returns)
+            assert srv.decoder.decoder.n_compiles() == warm
+            r400 = requests.post(
+                url, json={"prompt": prompt, "temperature": -2},
+                timeout=30)
+            assert r400.status_code == 400
+
+    def test_mixed_greedy_and_sampled_slots(self):
+        """A sampled request sharing the step batch must not perturb a
+        greedy neighbour (slot independence extends to sampling)."""
+        with _serve() as srv:
+            url = f"http://{srv.host}:{srv.port}/generate"
+            rng = np.random.default_rng(5)
+            g_prompt, s_prompt = _prompt(rng, 3), _prompt(rng, 5)
+            results = {}
+
+            def hit(name, body):
+                results[name] = requests.post(url, json=body, timeout=30)
+
+            threads = [
+                threading.Thread(target=hit, args=("greedy", {
+                    "prompt": g_prompt, "max_new_tokens": 5})),
+                threading.Thread(target=hit, args=("sampled", {
+                    "prompt": s_prompt, "max_new_tokens": 5,
+                    "temperature": 1.2, "seed": 7})),
+            ]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+            assert results["greedy"].json()["tokens"] == \
+                _greedy_reference(g_prompt, 5)
+            assert results["sampled"].status_code == 200
+            assert len(results["sampled"].json()["tokens"]) == 5

@@ -150,9 +150,8 @@ class TestNNLearner:
         # train 2 epochs, writing checkpoints
         NNLearner(epochs=2, **common).fit(blobs)
         # resume: the second learner must fast-forward past saved steps
-        import orbax.checkpoint as ocp
-        mngr_steps_before = sorted(
-            ocp.CheckpointManager(ck).all_steps())
+        from mmlspark_tpu.io.checkpoint import manager
+        mngr_steps_before = sorted(manager(ck).all_steps())
         assert mngr_steps_before
         model = NNLearner(epochs=4, **common).fit(blobs)
         assert _accuracy(model, blobs) > 0.9
