@@ -1097,7 +1097,9 @@ def make_batch(rng: np.random.Generator, cfg: TransformerConfig,
 # request claims a free slot, prefill fills rows [0, len) of that
 # slot's lane in every layer, each decode step appends one row at its
 # position, and freeing the slot is just returning the index — the
-# next occupant's prefill overwrites the lane. Dense-MLP configs only.
+# next occupant's prefill overwrites the lane. Dense-MLP and
+# token-choice MoE configs (dense dispatch — see _decode_ffn);
+# expert-choice routing is refused (it couples slots).
 # Replicated per worker by default; under tensor parallelism
 # (``decode_param_specs`` + ``decode_cache_spec``) ONE model and ONE
 # pool span the mesh — heads and the MLP hidden shard over ``model``,
@@ -1119,14 +1121,16 @@ def _decode_block_params(params, cfg: TransformerConfig
 
 
 def _rope_at(x, pos):
-    """Rotary embedding for one token per slot: ``x`` [N, H, Dh] at
-    per-slot positions ``pos`` [N] (each slot is mid-sequence at its
-    own depth — the batched analogue of :func:`_rope` at S=1)."""
+    """Rotary embedding for mid-sequence tokens: ``x`` [..., H, Dh] at
+    positions ``pos`` matching the leading dims (``[N]`` for the
+    single-token step, ``[N, W]`` for the speculative verify step —
+    each slot is mid-sequence at its own depth, the batched analogue
+    of :func:`_rope` at short S)."""
     dh = x.shape[-1]
     freqs = 1.0 / (10000.0 ** (jnp.arange(0, dh, 2) / dh))
-    ang = pos[:, None].astype(jnp.float32) * freqs[None, :]  # [N, Dh/2]
-    cos = jnp.cos(ang)[:, None, :]
-    sin = jnp.sin(ang)[:, None, :]
+    ang = pos[..., None].astype(jnp.float32) * freqs      # [..., Dh/2]
+    cos = jnp.cos(ang)[..., None, :]                      # [..., 1, Dh/2]
+    sin = jnp.sin(ang)[..., None, :]
     x1, x2 = x[..., 0::2], x[..., 1::2]
     r1 = x1 * cos - x2 * sin
     r2 = x1 * sin + x2 * cos
@@ -1134,11 +1138,42 @@ def _rope_at(x, pos):
 
 
 def _check_decode_config(cfg: TransformerConfig) -> None:
-    if cfg.n_experts:
+    if cfg.n_experts and cfg.moe_router == "expert_choice":
         raise NotImplementedError(
-            "the slot-indexed decode path supports dense-MLP configs "
-            "only (MoE decode needs per-token capacity routing at "
-            "batch 1 — a different dispatch problem)")
+            "expert-choice MoE has no decode form: each expert picks "
+            "its top tokens ACROSS the batch, so slots would couple — "
+            "the property continuous batching forbids. Token-choice "
+            "MoE decodes via dense dispatch (_decode_ffn).")
+
+
+def _decode_ffn(bp, h, cfg: TransformerConfig):
+    """The decode paths' FFN over post-``ln2`` activations ``h``
+    ([..., D] — [1, S, D] prefill, [N, D] step, [N, W, D] verify).
+
+    Dense-MLP configs run the plain two-matmul FFN. MoE configs run
+    token-choice routing with **dense dispatch**: at decode the batch
+    is one token per slot, so capacity queues degenerate (C would be
+    0 or 1 and dropping a routing truncates a LIVE sequence) — every
+    expert runs on every token and the top-k router weights combine,
+    which is exactly :func:`_reference_forward`'s MoE math (the decode
+    parity golden). Compute scales with ``n_experts``, acceptable at
+    decode's tiny token counts; ``moe_capacity_factor`` is ignored
+    here by design."""
+    shape = h.shape
+    hf = h.reshape(-1, shape[-1])
+    if cfg.n_experts:
+        logits = hf @ bp["router"]                        # [T, E]
+        probs = jax.nn.softmax(logits, axis=-1)
+        wts, experts = _route_top_k(probs, cfg.moe_top_k)
+        y = jnp.zeros_like(hf)
+        for e in range(cfg.n_experts):
+            sel = jnp.sum((experts == e).astype(jnp.float32) * wts,
+                          axis=-1)
+            z = jax.nn.relu(hf @ bp["ew1"][e])
+            y = y + (z @ bp["ew2"][e]) * sel[:, None]
+        return y.reshape(shape)
+    z = jax.nn.relu(hf @ bp["w1"] + bp["b1"])
+    return (z @ bp["w2"] + bp["b2"]).reshape(shape)
 
 
 def decode_param_specs(cfg: TransformerConfig, mesh) -> Dict[str, Any]:
@@ -1162,25 +1197,35 @@ def decode_param_specs(cfg: TransformerConfig, mesh) -> Dict[str, Any]:
     specs: Dict[str, Any] = {"embed": P(), "head": P(), "final_norm": P()}
     blocks = []
     for _ in range(cfg.layers_per_stage):
-        blocks.append({
+        b = {
             "ln1": P(), "ln2": P(),
             "wq": P(None, None, model, None),
             "wk": P(None, None, model, None),
             "wv": P(None, None, model, None),
             "wo": P(None, model, None, None),
-            "w1": P(None, None, model),
-            "b1": P(None, model),
-            "w2": P(None, model, None),
-            "b2": P(),
-        })
+        }
+        if cfg.n_experts:
+            # MoE decode (dense dispatch): router replicated, expert
+            # FFNs Megatron-split over the hidden dim — the same
+            # fan-in psum the dense MLP split relies on
+            b["router"] = P()
+            b["ew1"] = P(None, None, None, model)
+            b["ew2"] = P(None, None, model, None)
+        else:
+            b["w1"] = P(None, None, model)
+            b["b1"] = P(None, model)
+            b["w2"] = P(None, model, None)
+            b["b2"] = P()
+        blocks.append(b)
     specs["blocks"] = blocks
     return specs
 
 
 def decode_cache_spec(mesh):
     """The KV pool's sharding under tensor parallelism: the head dim
-    (axis 3 of ``[n_layers, n_slots, max_len, H, Dh]``) over the
-    ``model`` axis — each device's cache holds exactly its heads'
+    (axis 3 of BOTH layouts — dense ``[n_layers, n_slots, max_len, H,
+    Dh]`` and paged ``[n_layers, n_pages, page_size, H, Dh]``) over
+    the ``model`` axis — each device's cache holds exactly its heads'
     lanes, so the pool's HBM footprint splits across the mesh."""
     from jax.sharding import PartitionSpec as P
     model = AXIS_MODEL if AXIS_MODEL in mesh.axis_names else None
@@ -1249,10 +1294,7 @@ def build_prefill(cfg: TransformerConfig, donate: bool = True,
                 cv, v[0][None, None], (l, slot, 0, 0, 0))
             a = dense_attention(q, k, v, causal=True)
             x = x + jnp.einsum("bshk,hkd->bsd", a, bp["wo"])
-            h2 = _rmsnorm(x, bp["ln2"])
-            z = jax.nn.relu(jnp.einsum("bsd,df->bsf", h2, bp["w1"])
-                            + bp["b1"])
-            x = x + jnp.einsum("bsf,fd->bsd", z, bp["w2"]) + bp["b2"]
+            x = x + _decode_ffn(bp, _rmsnorm(x, bp["ln2"]), cfg)
         h = _rmsnorm(x[0], params["final_norm"])       # [S, D]
         last = jax.lax.dynamic_index_in_dim(h, length - 1, axis=0,
                                             keepdims=False)
@@ -1285,30 +1327,194 @@ def build_decode_step(cfg: TransformerConfig, n_slots: int,
     outputs are garbage the host never reads."""
     _check_decode_config(cfg)
     n_slots, max_len = int(n_slots), int(max_len)
-    scale = cfg.d_head ** -0.5
     rows = jnp.arange(n_slots)
     idx = jnp.arange(max_len)
 
     def step(params, cache, tokens, pos):
+        ck, cv, nxt, logits = _dense_step_body(
+            params, cfg, cache["k"], cache["v"], tokens, pos, rows, idx)
+        return {"k": ck, "v": cv}, nxt, logits
+
+    kw = {}
+    out_sh = _decode_out_shardings(cache_sharding)
+    if out_sh is not None:
+        kw["out_shardings"] = out_sh
+    return jax.jit(step, donate_argnums=(1,) if donate else (), **kw)
+
+
+def _dense_step_body(params, cfg: TransformerConfig, ck, cv, tokens,
+                     pos, rows, idx):
+    """One single-token step for every slot over the dense slot-lane
+    cache — the body :func:`build_decode_step` jits and
+    :func:`build_draft_propose` unrolls ``k`` times in one program."""
+    scale = cfg.d_head ** -0.5
+    x = params["embed"][tokens]                        # [N, D]
+    mask = idx[None, None, :] <= pos[:, None, None]    # [N, 1, S]
+    for l, bp in enumerate(_decode_block_params(params, cfg)):
+        h = _rmsnorm(x, bp["ln1"])
+        q = _rope_at(jnp.einsum("nd,dhk->nhk", h, bp["wq"]), pos)
+        k = _rope_at(jnp.einsum("nd,dhk->nhk", h, bp["wk"]), pos)
+        v = jnp.einsum("nd,dhk->nhk", h, bp["wv"])
+        ck = ck.at[l, rows, pos].set(k)
+        cv = cv.at[l, rows, pos].set(v)
+        s = jnp.einsum("nhk,nshk->nhs", q, ck[l]) * scale
+        s = jnp.where(mask, s, -1e30)
+        p = jax.nn.softmax(s, axis=-1)
+        a = jnp.einsum("nhs,nshk->nhk", p, cv[l])
+        x = x + jnp.einsum("nhk,hkd->nd", a, bp["wo"])
+        x = x + _decode_ffn(bp, _rmsnorm(x, bp["ln2"]), cfg)
+    h = _rmsnorm(x, params["final_norm"])
+    logits = h @ params["head"]
+    return ck, cv, jnp.argmax(logits, -1).astype(jnp.int32), logits
+
+
+# ---------------------------------------------------------------------------
+# paged KV cache: block-table layout
+#
+# The dense pool above reserves ``max_len`` rows per slot, so a short
+# sequence wastes most of its lane — concurrency per device is capped
+# by WORST-CASE length. The paged layout breaks the lane into fixed
+# ``page_size``-row pages drawn from one shared pool
+# ``[n_layers, n_pages, page_size, H, Dh]``; a per-slot **page table**
+# (int32 page indices, virtual row r lives at
+# ``pages[table[r // page_size], r % page_size]``) maps each slot's
+# virtual lane onto whatever pages it has claimed, so HBM is spent on
+# rows sequences actually occupy and the same pool holds
+# ``~max_len / mean_len`` times more concurrent sessions. All shapes
+# stay fixed (tables are ``[pages_per_slot]`` dense int arrays), the
+# pool is donated through every call, and the compile-once contract is
+# unchanged. Page index 0 is the SCRATCH page by convention: unclaimed
+# table entries point at it, so writes past a slot's claimed region
+# (bucket-padding tails, speculative overshoot, free slots riding the
+# step) land harmlessly there and the position mask never reads them.
+
+
+def init_paged_kv_cache(cfg: TransformerConfig, n_pages: int,
+                        page_size: int) -> Dict[str, jax.Array]:
+    """The shared page pool: ``{"k", "v"}`` arrays of shape
+    ``[n_layers, n_pages, page_size, n_heads, d_head]`` (f32, like the
+    dense pool — decode mirrors the reference numerics). Allocated
+    once and donated through every prefill/step/verify call. Page 0
+    is the scratch page (see module section comment); a pool of
+    ``n_pages`` therefore holds ``n_pages - 1`` claimable pages."""
+    _check_decode_config(cfg)
+    shape = (cfg.n_layers, int(n_pages), int(page_size),
+             cfg.n_heads, cfg.d_head)
+    return {"k": jnp.zeros(shape, jnp.float32),
+            "v": jnp.zeros(shape, jnp.float32)}
+
+
+def build_paged_prefill(cfg: TransformerConfig, page_size: int,
+                        pages_per_slot: int, donate: bool = True,
+                        cache_sharding=None):
+    """Jitted ``prefill(params, cache, tokens, page_table, length) ->
+    (cache, next_token, last_logits)`` — the paged analogue of
+    :func:`build_prefill`.
+
+    ``tokens`` is one bucket-padded prompt ``[S_pad]`` (one compile
+    per bucket), ``page_table`` the slot's ``[pages_per_slot]`` table.
+    Every layer's K/V rows land in the slot's claimed pages through
+    the table: buckets >= ``page_size`` scatter whole page-shaped
+    chunks, smaller buckets write one partial page. Chunks past the
+    claimed page count ride the scratch-page convention (table entry
+    0), so bucket padding never corrupts another slot's pages."""
+    _check_decode_config(cfg)
+    page_size, pages_per_slot = int(page_size), int(pages_per_slot)
+
+    def prefill(params, cache, tokens, page_table, length):
+        S = tokens.shape[0]
+        x = params["embed"][tokens][None]              # [1, S, D]
+        pos = jnp.arange(S)
+        ck, cv = cache["k"], cache["v"]
+        for l, bp in enumerate(_decode_block_params(params, cfg)):
+            h = _rmsnorm(x, bp["ln1"])
+            q = _rope(jnp.einsum("bsd,dhk->bshk", h, bp["wq"]), pos)
+            k = _rope(jnp.einsum("bsd,dhk->bshk", h, bp["wk"]), pos)
+            v = jnp.einsum("bsd,dhk->bshk", h, bp["wv"])
+            if S >= page_size:
+                n_chunks = S // page_size
+                kc = k[0].reshape(n_chunks, page_size,
+                                  cfg.n_heads, cfg.d_head)
+                vc = v[0].reshape(n_chunks, page_size,
+                                  cfg.n_heads, cfg.d_head)
+                ck = ck.at[l, page_table[:n_chunks]].set(kc)
+                cv = cv.at[l, page_table[:n_chunks]].set(vc)
+            else:
+                # a sub-page bucket: one partial write into the first
+                # claimed page, rows [0, S)
+                ck = jax.lax.dynamic_update_slice(
+                    ck, k[0][None, None], (l, page_table[0], 0, 0, 0))
+                cv = jax.lax.dynamic_update_slice(
+                    cv, v[0][None, None], (l, page_table[0], 0, 0, 0))
+            a = dense_attention(q, k, v, causal=True)
+            x = x + jnp.einsum("bshk,hkd->bsd", a, bp["wo"])
+            x = x + _decode_ffn(bp, _rmsnorm(x, bp["ln2"]), cfg)
+        h = _rmsnorm(x[0], params["final_norm"])       # [S, D]
+        last = jax.lax.dynamic_index_in_dim(h, length - 1, axis=0,
+                                            keepdims=False)
+        logits = last @ params["head"]
+        return ({"k": ck, "v": cv},
+                jnp.argmax(logits, -1).astype(jnp.int32), logits)
+
+    kw = {}
+    out_sh = _decode_out_shardings(cache_sharding)
+    if out_sh is not None:
+        kw["out_shardings"] = out_sh
+    return jax.jit(prefill, donate_argnums=(1,) if donate else (), **kw)
+
+
+def _gather_lane(c_l, page_tables, n_slots, virtual_len, cfg):
+    """Assemble each slot's virtual lane from its pages:
+    ``c_l [n_pages, page_size, H, Dh]`` gathered through
+    ``page_tables [N, pages_per_slot]`` -> ``[N, virtual_len, H, Dh]``
+    (virtual_len = pages_per_slot * page_size)."""
+    lane = c_l[page_tables]        # [N, P, page, H, Dh]
+    return lane.reshape(n_slots, virtual_len, cfg.n_heads, cfg.d_head)
+
+
+def build_paged_decode_step(cfg: TransformerConfig, n_slots: int,
+                            page_size: int, pages_per_slot: int,
+                            donate: bool = True, cache_sharding=None):
+    """Jitted ``step(params, cache, tokens, pos, page_tables) ->
+    (cache, next_tokens, logits)`` — one token for every slot through
+    the block-table layout (the paged :func:`build_decode_step`).
+
+    Each slot writes its new K/V row at page
+    ``page_tables[slot, pos // page_size]``, row ``pos % page_size``,
+    then attends over its gathered virtual lane masked to ``index <=
+    pos``. ``page_tables`` is ``[n_slots, pages_per_slot]`` int32 —
+    fixed shape, so occupancy churn and page churn alike reuse ONE
+    executable. Free slots ride at token 0 / pos 0 with an all-scratch
+    table."""
+    _check_decode_config(cfg)
+    n_slots, page_size = int(n_slots), int(page_size)
+    pages_per_slot = int(pages_per_slot)
+    V = page_size * pages_per_slot
+    scale = cfg.d_head ** -0.5
+    rows = jnp.arange(n_slots)
+    idx = jnp.arange(V)
+
+    def step(params, cache, tokens, pos, page_tables):
         x = params["embed"][tokens]                    # [N, D]
         ck, cv = cache["k"], cache["v"]
-        mask = idx[None, None, :] <= pos[:, None, None]  # [N, 1, S]
+        mask = idx[None, None, :] <= pos[:, None, None]  # [N, 1, V]
+        pg = page_tables[rows, pos // page_size]       # [N]
+        row = pos % page_size
         for l, bp in enumerate(_decode_block_params(params, cfg)):
             h = _rmsnorm(x, bp["ln1"])
             q = _rope_at(jnp.einsum("nd,dhk->nhk", h, bp["wq"]), pos)
             k = _rope_at(jnp.einsum("nd,dhk->nhk", h, bp["wk"]), pos)
             v = jnp.einsum("nd,dhk->nhk", h, bp["wv"])
-            ck = ck.at[l, rows, pos].set(k)
-            cv = cv.at[l, rows, pos].set(v)
-            s = jnp.einsum("nhk,nshk->nhs", q, ck[l]) * scale
+            ck = ck.at[l, pg, row].set(k)
+            cv = cv.at[l, pg, row].set(v)
+            lk = _gather_lane(ck[l], page_tables, n_slots, V, cfg)
+            lv = _gather_lane(cv[l], page_tables, n_slots, V, cfg)
+            s = jnp.einsum("nhk,nshk->nhs", q, lk) * scale
             s = jnp.where(mask, s, -1e30)
             p = jax.nn.softmax(s, axis=-1)
-            a = jnp.einsum("nhs,nshk->nhk", p, cv[l])
+            a = jnp.einsum("nhs,nshk->nhk", p, lv)
             x = x + jnp.einsum("nhk,hkd->nd", a, bp["wo"])
-            h2 = _rmsnorm(x, bp["ln2"])
-            z = jax.nn.relu(jnp.einsum("nd,df->nf", h2, bp["w1"])
-                            + bp["b1"])
-            x = x + jnp.einsum("nf,fd->nd", z, bp["w2"]) + bp["b2"]
+            x = x + _decode_ffn(bp, _rmsnorm(x, bp["ln2"]), cfg)
         h = _rmsnorm(x, params["final_norm"])
         logits = h @ params["head"]
         return ({"k": ck, "v": cv},
@@ -1319,3 +1525,142 @@ def build_decode_step(cfg: TransformerConfig, n_slots: int,
     if out_sh is not None:
         kw["out_shardings"] = out_sh
     return jax.jit(step, donate_argnums=(1,) if donate else (), **kw)
+
+
+# ---------------------------------------------------------------------------
+# speculative decoding: draft propose + target verify
+#
+# A small draft model proposes ``k`` tokens per slot (one fused device
+# program — k chained single-token steps, one host round-trip instead
+# of k), then ONE width-k verify step of the target model scores every
+# proposal; the host accepts the longest agreeing prefix (exact argmax
+# match for greedy slots, Leviathan rejection sampling for sampled
+# slots — both in serving/decode.py). Per emitted token that's
+# ~(1 draft + 1 verify) / m dispatches at acceptance m instead of one
+# full target step each, which is where the tokens/s comes from; the
+# verify's K/V writes for rejected positions are repaired for free by
+# the next round's writes (every position is (re)written by the round
+# that consumes its token — the same invariant as the single step).
+
+
+def build_paged_verify_step(cfg: TransformerConfig, n_slots: int,
+                            width: int, page_size: int,
+                            pages_per_slot: int, donate: bool = True,
+                            cache_sharding=None):
+    """Jitted ``verify(params, cache, tokens, pos, page_tables) ->
+    (cache, greedy_tokens, logits)`` — the target model's batched
+    scoring of ``width`` draft positions per slot over the paged
+    cache.
+
+    ``tokens`` is ``[n_slots, width]`` (column 0 = the slot's current
+    input token, columns 1.. = draft proposals), ``pos`` the per-slot
+    start positions: query ``j`` sits at ``pos + j``, writes its K/V
+    row through the page table there, and attends its virtual lane
+    masked causally to ``index <= pos + j``. Returns the greedy argmax
+    ``[n_slots, width]`` (token at ``pos + j + 1`` per the target) and
+    the full logits ``[n_slots, width, vocab]`` (fetched only when a
+    sampled slot needs rejection sampling)."""
+    _check_decode_config(cfg)
+    n_slots, width = int(n_slots), int(width)
+    page_size, pages_per_slot = int(page_size), int(pages_per_slot)
+    V = page_size * pages_per_slot
+    scale = cfg.d_head ** -0.5
+    rows = jnp.arange(n_slots)
+    idx = jnp.arange(V)
+    offs = jnp.arange(width)
+
+    def verify(params, cache, tokens, pos, page_tables):
+        x = params["embed"][tokens]                    # [N, W, D]
+        ck, cv = cache["k"], cache["v"]
+        qpos = pos[:, None] + offs[None, :]            # [N, W]
+        # causal over the virtual lane: query j reads index <= pos + j
+        mask = idx[None, None, None, :] <= qpos[:, :, None, None]
+        # a slot whose lane ends inside the window (pos + W > V — e.g.
+        # a non-speculative slot riding the round near its lane end)
+        # must not wrap its writes onto its own live pages: overflow
+        # positions route to the scratch page instead
+        safe = qpos < V
+        pg = jnp.where(
+            safe,
+            page_tables[rows[:, None],
+                        jnp.minimum(qpos // page_size,
+                                    pages_per_slot - 1)], 0)  # [N, W]
+        row = qpos % page_size
+        for l, bp in enumerate(_decode_block_params(params, cfg)):
+            h = _rmsnorm(x, bp["ln1"])
+            q = _rope_at(jnp.einsum("nwd,dhk->nwhk", h, bp["wq"]), qpos)
+            k = _rope_at(jnp.einsum("nwd,dhk->nwhk", h, bp["wk"]), qpos)
+            v = jnp.einsum("nwd,dhk->nwhk", h, bp["wv"])
+            ck = ck.at[l, pg, row].set(k)
+            cv = cv.at[l, pg, row].set(v)
+            lk = _gather_lane(ck[l], page_tables, n_slots, V, cfg)
+            lv = _gather_lane(cv[l], page_tables, n_slots, V, cfg)
+            s = jnp.einsum("nwhk,nshk->nwhs", q, lk) * scale
+            s = jnp.where(mask, s, -1e30)              # [N, W, 1, V] bcast
+            p = jax.nn.softmax(s, axis=-1)
+            a = jnp.einsum("nwhs,nshk->nwhk", p, lv)
+            x = x + jnp.einsum("nwhk,hkd->nwd", a, bp["wo"])
+            x = x + _decode_ffn(bp, _rmsnorm(x, bp["ln2"]), cfg)
+        h = _rmsnorm(x, params["final_norm"])          # [N, W, D]
+        logits = jnp.einsum("nwd,dv->nwv", h, params["head"])
+        return ({"k": ck, "v": cv},
+                jnp.argmax(logits, -1).astype(jnp.int32), logits)
+
+    kw = {}
+    out_sh = _decode_out_shardings(cache_sharding)
+    if out_sh is not None:
+        kw["out_shardings"] = out_sh
+    return jax.jit(verify, donate_argnums=(1,) if donate else (), **kw)
+
+
+def build_draft_propose(cfg: TransformerConfig, n_slots: int,
+                        max_len: int, width: int, donate: bool = True):
+    """Jitted ``propose(params, cache, tokens, pos) -> (cache,
+    proposals)`` — ``width`` greedy draft steps chained INSIDE one
+    device program (each step's argmax feeds the next), over the
+    draft's dense slot-lane cache.
+
+    One host round-trip proposes the whole block — the draft-side
+    half of the speculative dispatch saving. Greedy only: sampled
+    slots need per-step draft distributions on host, so the scheduler
+    falls back to ``width`` separate draft steps when one is active."""
+    _check_decode_config(cfg)
+    n_slots, max_len, width = int(n_slots), int(max_len), int(width)
+    rows = jnp.arange(n_slots)
+    idx = jnp.arange(max_len)
+
+    def propose(params, cache, tokens, pos):
+        ck, cv = cache["k"], cache["v"]
+        cur = tokens
+        props = []
+        for j in range(width):
+            ck, cv, cur, _ = _dense_step_body(
+                params, cfg, ck, cv, cur, pos + j, rows, idx)
+            props.append(cur)
+        return {"k": ck, "v": cv}, jnp.stack(props, axis=1)
+
+    return jax.jit(propose, donate_argnums=(1,) if donate else ())
+
+
+def layer_truncated_draft(params, cfg: TransformerConfig,
+                          layers: int):
+    """A self-speculative draft: the target's FIRST ``layers`` blocks
+    with the shared embed/final-norm/head (LayerSkip-style early
+    exit). The draft's step costs ``layers / n_layers`` of the
+    target's while sharing its representation space — residual blocks
+    refine, not replace, the embedding stream, so the early exit's
+    argmax agrees with the full model's often enough to pay for
+    verification. Returns ``(draft_params, draft_cfg)``; the params
+    ALIAS the target's leaves (no copy — one set of weights serves
+    both models)."""
+    if cfg.n_stages != 1:
+        raise ValueError("layer-truncated drafts need n_stages == 1 "
+                         "(decode configs are single-stage)")
+    if not 1 <= layers <= cfg.layers_per_stage:
+        raise ValueError(f"draft layers must be in "
+                         f"[1, {cfg.layers_per_stage}]")
+    dcfg = dataclasses.replace(cfg, layers_per_stage=int(layers))
+    dparams = {"embed": params["embed"], "head": params["head"],
+               "final_norm": params["final_norm"],
+               "blocks": params["blocks"][:int(layers)]}
+    return dparams, dcfg

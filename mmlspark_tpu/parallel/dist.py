@@ -45,7 +45,7 @@ from mmlspark_tpu.parallel.topology import (
 __all__ = [
     "train_mesh", "spec_for_leaf", "state_specs", "state_shardings",
     "shard_state", "batch_shardings", "put_batch", "placement_report",
-    "placement_label", "process_local_rows",
+    "placement_label", "process_local_rows", "tree_bytes",
 ]
 
 
@@ -220,6 +220,14 @@ def _spec_axes(spec) -> Tuple[str, ...]:
         else:
             out.append(entry)
     return tuple(out)
+
+
+def tree_bytes(tree) -> int:
+    """Total bytes of a state pytree (shape × itemsize, no device
+    sync). The KV-pool HBM accounting behind ``/decode/stats`` — the
+    number a paged-vs-dense comparison holds fixed."""
+    import jax
+    return sum(_leaf_nbytes(leaf) for leaf in jax.tree.leaves(tree))
 
 
 def placement_report(tree, mesh, model_axis: str = AXIS_MODEL

@@ -8,7 +8,7 @@ the collectives.
 
 from __future__ import annotations
 
-from typing import Any, Dict, Optional, Sequence, Tuple
+from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
@@ -95,6 +95,25 @@ def bucket_target(n: int, cap: int = 1024) -> int:
     while target < n:
         target *= 2
     return min(target, cap)
+
+
+def bucket_ladder(cap: int) -> List[int]:
+    """Every bucket :func:`bucket_target` can return for ``n`` in
+    ``[1, cap]``: the powers of two below ``cap`` plus ``cap`` itself.
+    Derived directly — O(log cap) — instead of scanning every ``n``
+    (the ``sorted({bucket_target(n, cap) for n in range(1, cap+1)})``
+    idiom costs O(cap) set churn per caller init, which decoder/server
+    construction paid at every ``max_len``/``max_batch_size``)."""
+    cap = int(cap)
+    if cap <= 1:
+        return [1]
+    ladder = []
+    b = 1
+    while b < cap:
+        ladder.append(b)
+        b *= 2
+    ladder.append(cap)
+    return ladder
 
 
 def padded_device_batch(chunk: np.ndarray, size: int, placement=None,

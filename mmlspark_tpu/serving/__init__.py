@@ -22,11 +22,13 @@ from mmlspark_tpu.serving.server import (
 )
 from mmlspark_tpu.serving.consolidator import PartitionConsolidator
 from mmlspark_tpu.serving.decode import (
-    DecodeOverloaded, DecodeScheduler, Sampler, SlotPool,
+    DecodeOverloaded, DecodeScheduler, PagePool, Sampler, SlotPool,
     TransformerDecoder,
 )
 from mmlspark_tpu.serving.frontend import EventLoopFrontend
-from mmlspark_tpu.serving.policy import AdaptiveBatchPolicy
+from mmlspark_tpu.serving.policy import (
+    AdaptiveBatchPolicy, SpeculationPolicy,
+)
 from mmlspark_tpu.serving.rollout import (
     ModelVersionManager, RolloutError, RolloutOrchestrator,
 )
@@ -34,5 +36,6 @@ from mmlspark_tpu.serving.rollout import (
 __all__ = ["ServingServer", "ServingCoordinator", "ServingClient",
            "PartitionConsolidator", "EventLoopFrontend",
            "ModelVersionManager", "RolloutError", "RolloutOrchestrator",
-           "DecodeScheduler", "DecodeOverloaded", "SlotPool",
-           "TransformerDecoder", "AdaptiveBatchPolicy", "Sampler"]
+           "DecodeScheduler", "DecodeOverloaded", "SlotPool", "PagePool",
+           "TransformerDecoder", "AdaptiveBatchPolicy",
+           "SpeculationPolicy", "Sampler"]

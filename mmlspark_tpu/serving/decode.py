@@ -28,13 +28,31 @@ frame plane. Tokens are emitted incrementally into the request's
 in-flight state (visible via ``GET /decode/stats``); the reply carries
 the full sequence once the request leaves its slot.
 
+The decode plane's memory is **paged** by default (docs/serving.md
+"Paged KV cache"): the KV pool is a shared set of fixed-size pages
+plus per-slot page tables, so cache HBM is spent on rows sequences
+actually occupy — a :class:`PagePool` claims/frees pages between
+steps with the same no-leak ledger as slots, admission sheds 429 on
+page exhaustion, and a pool that runs dry mid-decode preempts (partial
+tokens, ``pages_exhausted``) instead of OOMing. With a draft model
+configured, the scheduler runs **speculative rounds** (fused k-token
+draft propose + one width-k target verify; exact greedy prefix
+acceptance, rejection sampling for sampled opt-ins, acceptance-gated
+by :class:`~mmlspark_tpu.serving.policy.SpeculationPolicy`). Requests
+that ask for ``stream=1`` get their tokens **incrementally** as
+chunked SSE events through either frontend's stream handle
+(``pending.stream``); disconnects flip the handle's ``closed`` flag
+and resolve through the same ``_finish`` as every other exit.
+
 Observability: slot occupancy, decode steps, per-token counters,
-prefill/step latency histograms, and queue-wait all land in the
-server's registry (``docs/observability.md`` "Decode metrics"); every
-request's trace shows ``queue_wait``/``prefill``/``decode`` children
-under its root. Chaos: a ``fault_plan`` drives the ``decode_prefill``
-and ``decode_step`` sites — an injected step fault 500s the affected
-requests but **never strands a slot** (tests/test_serving_decode.py).
+prefill/step latency histograms, page-pool occupancy, speculative
+acceptance, and queue-wait all land in the server's registry
+(``docs/observability.md`` "Decode metrics"); every request's trace
+shows ``queue_wait``/``prefill``/``decode`` children under its root.
+Chaos: a ``fault_plan`` drives the ``decode_prefill`` and
+``decode_step`` sites — an injected step/verify fault 500s the
+affected requests but **never strands a slot or a page**
+(tests/test_serving_decode.py).
 """
 
 from __future__ import annotations
@@ -48,7 +66,7 @@ import numpy as np
 
 from mmlspark_tpu.core.logs import get_logger
 from mmlspark_tpu.core.resilience import SYSTEM_CLOCK, Clock
-from mmlspark_tpu.parallel.sharding import bucket_target
+from mmlspark_tpu.parallel.sharding import bucket_ladder, bucket_target
 
 logger = get_logger("serving.decode")
 
@@ -59,7 +77,7 @@ class DecodeOverloaded(RuntimeError):
 
 class TransformerDecoder:
     """The model side of continuous batching: one KV pool + the jitted
-    prefill/step pair over it, with host-side bookkeeping.
+    prefill/step machinery over it, with host-side bookkeeping.
 
     Not thread-safe by design — exactly one :class:`DecodeScheduler`
     loop thread drives it (the cache is DONATED through every call;
@@ -67,26 +85,55 @@ class TransformerDecoder:
     stop token (None = never stops early; requests end on their token
     budget). ``warmup()`` compiles the step and every prompt bucket;
     after it, :meth:`n_compiles` staying flat is the zero-retrace
-    evidence the bench gates on."""
+    evidence the bench gates on.
+
+    **Paged mode** (``paged=True``, the default): the pool is a
+    block-table layout — ``n_pages`` shared pages of ``page_size``
+    rows plus per-slot page tables — so cache HBM is spent on rows
+    sequences actually occupy instead of ``max_len`` per slot (page 0
+    is the scratch page; see ``models/transformer.py``). ``n_pages``
+    defaults to the dense equivalent (every slot can hold a full
+    lane); set it lower to serve more slots at the same HBM — the
+    scheduler's :class:`PagePool` admission keeps the pool honest.
+    ``paged=False`` keeps the dense ``[n_slots, max_len]``-lane pool
+    as the A/B baseline. Callers without a scheduler (direct API,
+    ``testing/decode_load``) may omit page tables: an identity table
+    (slot ``s`` -> pages ``[1 + s*pps, 1 + (s+1)*pps)``) stands in,
+    which needs the full-size default pool.
+
+    **Speculative decoding** (``draft_params``/``draft_cfg``): a small
+    draft model (same vocab — e.g.
+    :func:`~mmlspark_tpu.models.transformer.layer_truncated_draft`)
+    proposes ``spec_k`` greedy tokens per slot in ONE fused device
+    program, and a width-``spec_k`` verify step of the target scores
+    them all at once; the scheduler accepts the longest agreeing
+    prefix. The draft keeps a dense slot-lane cache (its layers are
+    the cheap fraction — paging the target is where the HBM lives).
+    Requires paged mode and no mesh (the draft is replicated)."""
 
     def __init__(self, params, cfg, n_slots: int = 8,
                  max_len: int = 256, eos_id: Optional[int] = None,
-                 donate: bool = True, mesh=None):
+                 donate: bool = True, mesh=None,
+                 paged: bool = True, page_size: int = 16,
+                 n_pages: Optional[int] = None,
+                 draft_params=None, draft_cfg=None, spec_k: int = 4):
         from mmlspark_tpu.models import transformer as T
         self.cfg = cfg
         self.n_slots = int(n_slots)
         self.max_len = int(max_len)
         self.eos_id = eos_id
         self.mesh = mesh
-        self.cache = T.init_kv_cache(cfg, self.n_slots, self.max_len)
+        self.paged = bool(paged)
         cache_sharding = None
         if mesh is not None:
             # tensor-parallel decode: ONE model + ONE KV pool span the
             # mesh — heads/MLP-hidden shard over the model axis
             # (decode_param_specs), each device's cache holds exactly
-            # its heads' lanes (decode_cache_spec). The jitted pair
-            # below compiles the SAME program as sharded computations;
-            # shapes, donation, and compile-once are unchanged.
+            # its heads' lanes (decode_cache_spec — the head dim is
+            # axis 3 of the dense AND the paged layout, so one spec
+            # serves both). The jitted machinery below compiles the
+            # SAME programs as sharded computations; shapes, donation,
+            # and compile-once are unchanged.
             import jax
             from jax.sharding import NamedSharding, PartitionSpec
             is_spec = lambda x: isinstance(x, PartitionSpec)  # noqa: E731
@@ -96,13 +143,100 @@ class TransformerDecoder:
             params = jax.device_put(params, p_sh)
             cache_sharding = NamedSharding(mesh,
                                            T.decode_cache_spec(mesh))
-            self.cache = jax.device_put(self.cache, cache_sharding)
         self.params = params
-        self._prefill = T.build_prefill(cfg, donate=donate,
-                                        cache_sharding=cache_sharding)
-        self._step = T.build_decode_step(cfg, self.n_slots,
-                                         self.max_len, donate=donate,
-                                         cache_sharding=cache_sharding)
+        if self.paged:
+            page_size = int(page_size)
+            if page_size < 1 or page_size & (page_size - 1):
+                # prompt buckets are powers of two: a pow2 page divides
+                # every bucket >= itself (whole-chunk scatters) and
+                # bounds the rest to the partial-page path — any other
+                # size leaves buckets the prefill cannot chunk
+                raise ValueError(
+                    f"page_size={page_size} must be a power of two")
+            if self.max_len % page_size:
+                raise ValueError(
+                    f"page_size={page_size} must divide "
+                    f"max_len={self.max_len}")
+            self.page_size = int(page_size)
+            self.pages_per_slot = self.max_len // self.page_size
+            # default pool = the dense equivalent + the scratch page:
+            # identical HBM and admission behavior until the operator
+            # shrinks it (or raises n_slots at the same pool)
+            self.n_pages = (int(n_pages) if n_pages is not None
+                            else 1 + self.n_slots * self.pages_per_slot)
+            if self.n_pages < 2:
+                raise ValueError("paged cache needs n_pages >= 2 "
+                                 "(page 0 is the scratch page)")
+            self.cache = T.init_paged_kv_cache(cfg, self.n_pages,
+                                               self.page_size)
+            self._prefill = T.build_paged_prefill(
+                cfg, self.page_size, self.pages_per_slot,
+                donate=donate, cache_sharding=cache_sharding)
+            self._step = T.build_paged_decode_step(
+                cfg, self.n_slots, self.page_size, self.pages_per_slot,
+                donate=donate, cache_sharding=cache_sharding)
+            if 1 + self.n_slots * self.pages_per_slot <= self.n_pages:
+                self._identity_tables = (
+                    1 + np.arange(self.n_slots * self.pages_per_slot,
+                                  dtype=np.int32)
+                ).reshape(self.n_slots, self.pages_per_slot)
+            else:
+                self._identity_tables = None   # pool is undersized on
+                # purpose: tables must come from the scheduler's pool
+        else:
+            self.page_size = self.pages_per_slot = 0
+            self.n_pages = 0
+            self._identity_tables = None
+            self.cache = T.init_kv_cache(cfg, self.n_slots,
+                                         self.max_len)
+            self._prefill = T.build_prefill(
+                cfg, donate=donate, cache_sharding=cache_sharding)
+            self._step = T.build_decode_step(
+                cfg, self.n_slots, self.max_len, donate=donate,
+                cache_sharding=cache_sharding)
+        if cache_sharding is not None:
+            import jax
+            self.cache = jax.device_put(self.cache, cache_sharding)
+        # -- speculative decoding (optional)
+        self.spec_k = int(spec_k)
+        self.draft_cfg = draft_cfg
+        self.draft_params = draft_params
+        self.draft_cache = None
+        self._draft_prefill = self._draft_step = None
+        self._propose = self._verify = None
+        if draft_params is not None:
+            if draft_cfg is None:
+                raise ValueError("draft_params needs draft_cfg")
+            if draft_cfg.vocab != cfg.vocab:
+                raise ValueError("draft and target must share a vocab")
+            if not self.paged:
+                raise ValueError(
+                    "speculative decoding rides the paged cache "
+                    "(paged=True)")
+            if mesh is not None:
+                raise ValueError(
+                    "speculative decoding with a mesh is not wired "
+                    "yet: the draft is replicated")
+            if not 2 <= self.spec_k < self.max_len:
+                raise ValueError(f"spec_k={spec_k} must be in "
+                                 f"[2, max_len)")
+            self.draft_cache = T.init_kv_cache(draft_cfg, self.n_slots,
+                                               self.max_len)
+            self._draft_prefill = T.build_prefill(draft_cfg,
+                                                  donate=donate)
+            self._draft_step = T.build_decode_step(
+                draft_cfg, self.n_slots, self.max_len, donate=donate)
+            self._propose = T.build_draft_propose(
+                draft_cfg, self.n_slots, self.max_len, self.spec_k,
+                donate=donate)
+            self._verify = T.build_paged_verify_step(
+                cfg, self.n_slots, self.spec_k, self.page_size,
+                self.pages_per_slot, donate=donate,
+                cache_sharding=cache_sharding)
+
+    @property
+    def has_draft(self) -> bool:
+        return self._verify is not None
 
     def placement(self) -> Dict[str, Any]:
         """Where this decoder's params + KV pool live (the
@@ -121,9 +255,9 @@ class TransformerDecoder:
     def prompt_buckets(self) -> List[int]:
         """The prefill shape ladder: pow2 buckets clamped at
         ``max_len`` (same policy as the frame plane's batch buckets —
-        one ladder idiom framework-wide)."""
-        return sorted({bucket_target(n, self.max_len)
-                       for n in range(1, self.max_len + 1)})
+        one ladder idiom framework-wide, derived in O(log max_len)
+        instead of the old O(max_len) bucket_target scan)."""
+        return bucket_ladder(self.max_len)
 
     def pad_prompt(self, prompt: np.ndarray) -> np.ndarray:
         bucket = bucket_target(len(prompt), self.max_len)
@@ -133,56 +267,149 @@ class TransformerDecoder:
 
     # -- compute -------------------------------------------------------------
 
-    def prefill_logits(self, slot: int, prompt: np.ndarray
+    def _table_for(self, slot: int, page_table) -> np.ndarray:
+        if page_table is not None:
+            return np.asarray(page_table, np.int32)
+        if self._identity_tables is None:
+            raise ValueError(
+                "this paged pool is smaller than n_slots full lanes: "
+                "page tables must come from the scheduler's PagePool")
+        return self._identity_tables[slot]
+
+    def prefill_logits(self, slot: int, prompt: np.ndarray,
+                       page_table=None, draft: bool = True
                        ) -> "tuple[int, Any]":
-        """Fill ``slot``'s cache lane from ``prompt``; returns the
-        first generated greedy token AND the last-position logits (a
-        device array — only a sampling caller pays the host fetch)."""
+        """Fill ``slot``'s cache lane (dense) or its claimed pages
+        (paged — ``page_table``; identity fallback when omitted) from
+        ``prompt``; returns the first generated greedy token AND the
+        last-position logits (a device array — only a sampling caller
+        pays the host fetch). With a draft configured, the draft's
+        slot lane is prefilled too (both models must agree on the
+        prompt before proposals mean anything) — unless
+        ``draft=False``, for requests that can never speculate (the
+        scheduler skips the wasted draft pass)."""
         import jax.numpy as jnp
         padded = self.pad_prompt(prompt)
-        self.cache, nxt, logits = self._prefill(
-            self.params, self.cache, jnp.asarray(padded),
-            np.int32(slot), np.int32(len(prompt)))
+        if self.paged:
+            self.cache, nxt, logits = self._prefill(
+                self.params, self.cache, jnp.asarray(padded),
+                jnp.asarray(self._table_for(slot, page_table)),
+                np.int32(len(prompt)))
+        else:
+            self.cache, nxt, logits = self._prefill(
+                self.params, self.cache, jnp.asarray(padded),
+                np.int32(slot), np.int32(len(prompt)))
+        if self.has_draft and draft:
+            self.draft_cache, _, _ = self._draft_prefill(
+                self.draft_params, self.draft_cache,
+                jnp.asarray(padded), np.int32(slot),
+                np.int32(len(prompt)))
         return int(nxt), logits
 
-    def prefill(self, slot: int, prompt: np.ndarray) -> int:
+    def prefill(self, slot: int, prompt: np.ndarray,
+                page_table=None) -> int:
         """Greedy :meth:`prefill_logits` (compat surface)."""
-        return self.prefill_logits(slot, prompt)[0]
+        return self.prefill_logits(slot, prompt, page_table)[0]
 
-    def step_logits(self, tokens: np.ndarray, pos: np.ndarray
-                    ) -> "tuple[np.ndarray, Any]":
+    def step_logits(self, tokens: np.ndarray, pos: np.ndarray,
+                    page_tables=None) -> "tuple[np.ndarray, Any]":
         """One token for every slot: ``tokens``/``pos`` are the full
         fixed ``[n_slots]`` arrays (free slots ride along at token 0 /
-        pos 0). Returns greedy next tokens plus the full per-slot
-        logits (device array; fetched only when a sampler needs it)."""
+        pos 0, paged free slots with an all-scratch table row).
+        Returns greedy next tokens plus the full per-slot logits
+        (device array; fetched only when a sampler needs it)."""
         import jax.numpy as jnp
-        self.cache, nxt, logits = self._step(
-            self.params, self.cache, jnp.asarray(tokens),
+        if self.paged:
+            if page_tables is None:
+                if self._identity_tables is None:
+                    raise ValueError("undersized paged pool needs "
+                                     "scheduler page tables")
+                page_tables = self._identity_tables
+            self.cache, nxt, logits = self._step(
+                self.params, self.cache, jnp.asarray(tokens),
+                jnp.asarray(pos),
+                jnp.asarray(np.asarray(page_tables, np.int32)))
+        else:
+            self.cache, nxt, logits = self._step(
+                self.params, self.cache, jnp.asarray(tokens),
+                jnp.asarray(pos))
+        return np.asarray(nxt), logits
+
+    def step(self, tokens: np.ndarray, pos: np.ndarray,
+             page_tables=None) -> np.ndarray:
+        """Greedy :meth:`step_logits` (compat surface)."""
+        return self.step_logits(tokens, pos, page_tables)[0]
+
+    # -- speculative compute -------------------------------------------------
+
+    def propose(self, tokens: np.ndarray, pos: np.ndarray
+                ) -> np.ndarray:
+        """``spec_k`` chained greedy draft steps in ONE device program
+        -> proposals ``[n_slots, spec_k]`` (the draft cache advances
+        in place)."""
+        import jax.numpy as jnp
+        self.draft_cache, props = self._propose(
+            self.draft_params, self.draft_cache, jnp.asarray(tokens),
+            jnp.asarray(pos))
+        return np.asarray(props)
+
+    def draft_step_logits(self, tokens: np.ndarray, pos: np.ndarray
+                          ) -> "tuple[np.ndarray, Any]":
+        """One draft step with logits — the slow proposal path a
+        sampled speculative slot needs (per-step draft distributions
+        on host for rejection sampling)."""
+        import jax.numpy as jnp
+        self.draft_cache, nxt, logits = self._draft_step(
+            self.draft_params, self.draft_cache, jnp.asarray(tokens),
             jnp.asarray(pos))
         return np.asarray(nxt), logits
 
-    def step(self, tokens: np.ndarray, pos: np.ndarray) -> np.ndarray:
-        """Greedy :meth:`step_logits` (compat surface)."""
-        return self.step_logits(tokens, pos)[0]
+    def verify_logits(self, tokens: np.ndarray, pos: np.ndarray,
+                      page_tables) -> "tuple[np.ndarray, Any]":
+        """The target's width-``spec_k`` scoring pass: ``tokens`` is
+        ``[n_slots, spec_k]`` (column 0 = each slot's current input
+        token, columns 1.. = draft proposals). Returns the greedy
+        argmax per position plus the full logits (device array)."""
+        import jax.numpy as jnp
+        self.cache, toks, logits = self._verify(
+            self.params, self.cache, jnp.asarray(tokens),
+            jnp.asarray(pos),
+            jnp.asarray(np.asarray(page_tables, np.int32)))
+        return np.asarray(toks), logits
 
     def n_compiles(self) -> int:
-        """Compiled-executable count across prefill buckets + the step
-        (jit cache sizes): flat after warmup = zero retraces."""
-        return int(self._prefill._cache_size()
-                   + self._step._cache_size())
+        """Compiled-executable count across every jitted entry point
+        (prefill buckets, the step, and the draft/propose/verify
+        machinery when speculation is on): flat after warmup = zero
+        retraces."""
+        n = int(self._prefill._cache_size() + self._step._cache_size())
+        for fn in (self._draft_prefill, self._draft_step,
+                   self._propose, self._verify):
+            if fn is not None:
+                n += int(fn._cache_size())
+        return n
 
     def warmup(self) -> int:
-        """Compile the decode step and every prefill bucket before
-        traffic (the cache content it writes is garbage on a FREE
-        slot's lane, which the next real prefill overwrites). Returns
+        """Compile the decode step, every prefill bucket, and (when
+        speculation is on) the draft/propose/verify machinery before
+        traffic (the cache content it writes lands on scratch pages /
+        free lanes, which the next real prefill overwrites). Returns
         the compile count — the post-warmup baseline."""
         zeros_t = np.zeros(self.n_slots, np.int32)
-        self.step(zeros_t, zeros_t.copy())
+        zero_tables = (np.zeros((self.n_slots, self.pages_per_slot),
+                                np.int32) if self.paged else None)
+        self.step(zeros_t, zeros_t.copy(), zero_tables)
         for bucket in self.prompt_buckets():
             self.prefill(0, np.zeros(min(bucket, self.max_len - 1),
-                                     np.int32))
+                                     np.int32),
+                         zero_tables[0] if self.paged else None)
+        if self.has_draft:
+            self.propose(zeros_t, zeros_t.copy())
+            self.draft_step_logits(zeros_t, zeros_t.copy())
+            self.verify_logits(
+                np.zeros((self.n_slots, self.spec_k), np.int32),
+                zeros_t.copy(), zero_tables)
         return self.n_compiles()
-
 
 class Sampler:
     """Per-request seeded token sampling over the step's full logits.
@@ -204,7 +431,10 @@ class Sampler:
         self.seed = seed
         self._rng = np.random.default_rng(seed)
 
-    def sample(self, logits: np.ndarray) -> int:
+    def probs(self, logits: np.ndarray) -> np.ndarray:
+        """The transformed distribution (temperature, then top-k, then
+        nucleus restriction, renormalized) — the ``p``/``q`` both
+        sides of speculative rejection sampling score against."""
         l = logits.astype(np.float64) / max(self.temperature, 1e-6)
         if 0 < self.top_k < l.size:
             kth = np.partition(l, -self.top_k)[-self.top_k]
@@ -221,7 +451,20 @@ class Sampler:
             mask[order[:keep]] = True
             p = np.where(mask, p, 0.0)
             p /= p.sum()
+        return p
+
+    def sample(self, logits: np.ndarray) -> int:
+        return int(self._rng.choice(logits.size,
+                                    p=self.probs(logits)))
+
+    def draw(self, p: np.ndarray) -> int:
+        """Draw from an explicit distribution with this request's own
+        PRNG (speculative residual draws stay per-request seeded)."""
         return int(self._rng.choice(p.size, p=p))
+
+    def uniform(self) -> float:
+        """One accept/reject draw from the request's PRNG."""
+        return float(self._rng.random())
 
     def describe(self) -> Dict[str, Any]:
         return {"temperature": self.temperature, "top_k": self.top_k,
@@ -254,27 +497,82 @@ class SlotPool:
             return len(self._free)
 
 
+class PagePool:
+    """Free-page index pool over the paged KV cache. Page 0 is the
+    scratch page (unclaimed table entries route writes there) and is
+    never handed out, so a pool of ``n_pages`` holds ``n_pages - 1``
+    claimable pages. ``claim`` is all-or-nothing — a request either
+    gets every page it asked for or none (no partial grabs to leak on
+    the error path). The high-water mark and the ``n_free ==
+    n_pages - 1`` idle invariant are the page-leak ledger the chaos
+    tests assert."""
+
+    def __init__(self, n_pages: int):
+        self.n_pages = int(n_pages)
+        self._free = list(range(self.n_pages - 1, 0, -1))
+        # claimed-page set: O(1) double-release detection (a list scan
+        # would cost O(pages_released * n_free) per request teardown
+        # inside the step loop)
+        self._claimed: set = set()
+        self._lock = threading.Lock()
+        self.high_water = 0
+
+    def claim(self, n: int = 1) -> Optional[List[int]]:
+        with self._lock:
+            if n > len(self._free):
+                return None
+            pages = [self._free.pop() for _ in range(n)]
+            self._claimed.update(pages)
+            if len(self._claimed) > self.high_water:
+                self.high_water = len(self._claimed)
+            return pages
+
+    def release(self, pages: List[int]) -> None:
+        with self._lock:
+            for p in pages:
+                if p not in self._claimed:
+                    raise RuntimeError(f"page {p} double-released")
+                self._claimed.discard(p)
+                self._free.append(p)
+
+    @property
+    def n_free(self) -> int:
+        with self._lock:
+            return len(self._free)
+
+
 class _DecodeRequest:
     """Per-request decode state, riding alongside the server's
     ``_PendingRequest`` (``pending`` — reply/status/event/callbacks/
-    deadline/trace/span all live there)."""
+    deadline/trace/span/stream all live there)."""
 
     __slots__ = ("pending", "prompt", "max_new", "produced", "slot",
                  "cancelled", "t_submit", "t_prefill", "t_decode",
-                 "sampler")
+                 "sampler", "spec", "pages")
 
     def __init__(self, pending, prompt: np.ndarray, max_new: int,
-                 sampler: Optional[Sampler] = None):
+                 sampler: Optional[Sampler] = None,
+                 spec: Optional[bool] = None):
         self.pending = pending
         self.prompt = prompt
         self.max_new = int(max_new)
         self.sampler = sampler
+        # speculative opt-in/out from the payload; None = default
+        # (greedy slots speculate when a draft exists, sampled slots
+        # only on explicit opt-in — rejection sampling changes PRNG
+        # consumption, so a seeded client must ask for it)
+        self.spec = spec
         self.produced: List[int] = []       # incremental emission
         self.slot: Optional[int] = None
+        self.pages: List[int] = []          # claimed KV pages (paged)
         self.cancelled = False
         self.t_submit: float = 0.0
         self.t_prefill: float = 0.0
         self.t_decode: float = 0.0
+
+    @property
+    def stream(self):
+        return getattr(self.pending, "stream", None)
 
 
 class DecodeScheduler:
@@ -302,8 +600,18 @@ class DecodeScheduler:
                  clock: Clock = SYSTEM_CLOCK,
                  fault_plan=None,
                  registry=None, tracer=None,
-                 idle_wait_s: float = 0.02):
+                 idle_wait_s: float = 0.02,
+                 spec_policy="auto"):
+        from mmlspark_tpu.serving.policy import SpeculationPolicy
         self.decoder = decoder
+        # acceptance-gated speculation (serving/policy.py): "auto"
+        # installs the default policy when a draft exists, None runs
+        # speculation unconditionally, or pass a configured
+        # SpeculationPolicy
+        if spec_policy == "auto":
+            spec_policy = (SpeculationPolicy() if decoder.has_draft
+                           else None)
+        self.spec_policy = spec_policy
         self.max_waiting = int(max_waiting)
         self.max_new_tokens_default = int(max_new_tokens_default)
         self.clock = clock
@@ -311,6 +619,15 @@ class DecodeScheduler:
         self.tracer = tracer
         self.idle_wait_s = float(idle_wait_s)
         self.pool = SlotPool(decoder.n_slots)
+        # the page plane (paged decoders): the shared page pool plus
+        # the live [n_slots, pages_per_slot] tables the jitted step/
+        # verify read — unclaimed entries stay 0 (the scratch page)
+        self.pages: Optional[PagePool] = None
+        self._tables: Optional[np.ndarray] = None
+        if decoder.paged:
+            self.pages = PagePool(decoder.n_pages)
+            self._tables = np.zeros(
+                (decoder.n_slots, decoder.pages_per_slot), np.int32)
         self._waiting: deque = deque()
         self._by_rid: Dict[str, _DecodeRequest] = {}
         self._active: Dict[int, _DecodeRequest] = {}
@@ -328,9 +645,16 @@ class DecodeScheduler:
         self.n_tokens = 0
         self.n_prefills = 0
         self.n_step_faults = 0
+        self.slots_high_water = 0
+        self.n_page_preempts = 0
+        # speculative ledger: acceptance_rate = accepted / proposed
+        self.n_spec_rounds = 0
+        self.n_spec_proposed = 0
+        self.n_spec_accepted = 0
         self.releases: Dict[str, int] = {}   # finish_reason -> count
         self._m_prefill = None
         self._m_step = None
+        self._m_spec_round = None
         self._m_queue_wait = None
         if registry is not None:
             self._register_metrics(registry)
@@ -373,8 +697,32 @@ class DecodeScheduler:
              "Decode steps that raised (injected or real); affected "
              "requests 500, slots are released.",
              lambda: self.n_step_faults),
+            ("serving_decode_page_preempts_total",
+             "Requests finished early because the page pool could not "
+             "grow their lane mid-decode (finish_reason "
+             "pages_exhausted).", lambda: self.n_page_preempts),
+            ("serving_decode_spec_rounds_total",
+             "Speculative rounds executed (one draft propose + one "
+             "target verify each).", lambda: self.n_spec_rounds),
+            ("serving_decode_spec_proposed_total",
+             "Draft tokens proposed to the verifier.",
+             lambda: self.n_spec_proposed),
+            ("serving_decode_spec_accepted_total",
+             "Draft tokens the target accepted (acceptance rate = "
+             "accepted / proposed).", lambda: self.n_spec_accepted),
         ):
             m.counter(name, help_).set_function(fn)
+        if self.pages is not None:
+            m.gauge("serving_decode_pages_free",
+                    "Free KV-cache pages in the shared pool."
+                    ).set_function(lambda: self.pages.n_free)
+            m.gauge("serving_decode_pages_in_use",
+                    "KV-cache pages currently claimed by live slots."
+                    ).set_function(
+                lambda: (self.pages.n_pages - 1) - self.pages.n_free)
+            m.gauge("serving_decode_page_high_water",
+                    "Most pages ever simultaneously claimed."
+                    ).set_function(lambda: self.pages.high_water)
         self._m_prefill = m.histogram(
             "serving_prefill_latency_ms",
             "Prompt prefill wall-clock per prompt bucket.",
@@ -382,6 +730,10 @@ class DecodeScheduler:
         self._m_step = m.histogram(
             "serving_decode_step_latency_ms",
             "Single-token decode step wall-clock (all slots at once).")
+        self._m_spec_round = m.histogram(
+            "serving_decode_spec_round_latency_ms",
+            "Speculative round wall-clock (draft propose + target "
+            "verify + host acceptance, all slots at once).")
         self._m_queue_wait = m.histogram(
             "serving_decode_queue_wait_ms",
             "Submit -> slot-claim wait per decode request.")
@@ -391,9 +743,11 @@ class DecodeScheduler:
     def overloaded(self) -> bool:
         return len(self._waiting) >= self.max_waiting
 
-    def parse(self, payload: Any) -> "tuple[np.ndarray, int]":
-        """Payload -> (prompt tokens, max_new). Raises ValueError on
-        anything the decode plane cannot serve (the caller 400s)."""
+    def parse(self, payload: Any
+              ) -> "tuple[np.ndarray, int, Optional[Sampler], Optional[bool]]":
+        """Payload -> (prompt tokens, max_new, sampler, speculative).
+        Raises ValueError on anything the decode plane cannot serve
+        (the caller 400s)."""
         if not isinstance(payload, dict):
             raise ValueError("decode payload must be a JSON object")
         prompt = payload.get("prompt")
@@ -420,8 +774,14 @@ class DecodeScheduler:
             raise ValueError('"max_new_tokens" must be a positive int')
         # the cache lane bounds the sequence: clamp the budget to it
         max_new = min(max_new, self.decoder.max_len - len(prompt))
+        spec = payload.get("speculative")
+        if spec is not None and not isinstance(spec, bool):
+            raise ValueError('"speculative" must be a boolean')
+        stream = payload.get("stream")
+        if stream is not None and not isinstance(stream, bool):
+            raise ValueError('"stream" must be a boolean')
         return np.asarray(prompt, np.int32), max_new, \
-            self._parse_sampling(payload)
+            self._parse_sampling(payload), spec
 
     @staticmethod
     def _parse_sampling(payload: dict) -> Optional[Sampler]:
@@ -462,14 +822,50 @@ class DecodeScheduler:
             return None
         return Sampler(float(temp), int(top_k), float(top_p), seed)
 
-    def submit(self, pending) -> None:
+    def _pages_for(self, rows: int) -> int:
+        """Pages covering virtual rows ``[0, rows)``."""
+        ps = self.decoder.page_size
+        return max((int(rows) + ps - 1) // ps, 1)
+
+    def _spec_capable(self, req: _DecodeRequest) -> bool:
+        """Whether this request may EVER enter a speculative cohort:
+        explicit payload opt-in/out wins; greedy defaults on, sampled
+        defaults off (rejection sampling changes seeded-PRNG
+        consumption). Fixed for the request's lifetime — it decides
+        the draft prefill at admission and the draft-cache catch-up
+        obligation on non-speculative rounds."""
+        if not self.decoder.has_draft:
+            return False
+        return (req.spec if req.spec is not None
+                else req.sampler is None)
+
+    def submit(self, pending, parsed=None) -> None:
         """Enqueue one admitted request (already past the server's
         replay/join/shed/doa checks). Raises ValueError on a bad
         payload (caller replies 400), DecodeOverloaded when the
-        waiting queue is full (caller replies 429)."""
-        prompt, max_new, sampler = self.parse(pending.payload)
-        req = _DecodeRequest(pending, prompt, max_new, sampler)
+        waiting queue is full OR the page pool cannot hold the prompt
+        (caller replies 429 + Retry-After — page exhaustion is
+        backpressure, never a mid-decode OOM). ``parsed`` lets a
+        caller that already validated the payload (the streaming
+        pre-check) pass its :meth:`parse` tuple instead of paying a
+        second pass."""
+        prompt, max_new, sampler, spec = (
+            parsed if parsed is not None else self.parse(
+                pending.payload))
+        req = _DecodeRequest(pending, prompt, max_new, sampler, spec)
         req.t_submit = self.clock.now()
+        if self.pages is not None:
+            # admission-time page check: the prompt (plus the first
+            # generated row) must fit the pool outright. Advisory —
+            # running slots may grow before this request reaches a
+            # slot, and _admit_waiting re-checks — but it turns a
+            # full pool into an honest 429 instead of a queued
+            # request that can never start.
+            need = self._pages_for(len(prompt) + 1)
+            if self.pages.n_free < need:
+                raise DecodeOverloaded(
+                    f"decode page pool exhausted ({need} pages "
+                    f"needed, {self.pages.n_free} free)")
         with self._lock:
             if len(self._waiting) >= self.max_waiting:
                 raise DecodeOverloaded("decode waiting queue full")
@@ -514,8 +910,8 @@ class DecodeScheduler:
     def _finish(self, req: _DecodeRequest, reason: str,
                 status: int = 200,
                 error: Optional[str] = None) -> None:
-        """Resolve a request and (if it held one) free its slot —
-        EVERY exit path funnels here, so a slot can never leak."""
+        """Resolve a request and free whatever it held — slot AND
+        pages; EVERY exit path funnels here, so neither can leak."""
         if req.slot is not None:
             with self._lock:
                 # under the lock so stats() can snapshot _active
@@ -523,6 +919,8 @@ class DecodeScheduler:
                 self._active.pop(req.slot, None)
             self._tokens[req.slot] = 0
             self._pos[req.slot] = 0
+            if self._tables is not None:
+                self._tables[req.slot, :] = 0
             self.pool.release(req.slot)
             t1 = self._now()
             self._add_span(req, "decode", req.t_decode, t1,
@@ -530,24 +928,35 @@ class DecodeScheduler:
                            slot=req.slot, n_tokens=len(req.produced),
                            finish_reason=reason)
             req.slot = None
+        if req.pages:
+            self.pages.release(req.pages)
+            req.pages = []
         with self._lock:
             self._by_rid.pop(req.pending.rid, None)
             self.releases[reason] = self.releases.get(reason, 0) + 1
         p = req.pending
         if status == 200:
             p.status = 200
-            p.reply = json.dumps(
-                {"tokens": req.produced,
-                 "n_tokens": len(req.produced),
-                 "prompt_len": int(len(req.prompt)),
-                 "finish_reason": reason}).encode()
+            body = {"tokens": req.produced,
+                    "n_tokens": len(req.produced),
+                    "prompt_len": int(len(req.prompt)),
+                    "finish_reason": reason}
+            p.reply = json.dumps(body).encode()
         else:
             p.status = status
-            p.reply = json.dumps(
-                {"error": error or reason,
-                 "tokens": req.produced,
-                 "n_tokens": len(req.produced),
-                 "finish_reason": reason}).encode()
+            body = {"error": error or reason,
+                    "tokens": req.produced,
+                    "n_tokens": len(req.produced),
+                    "finish_reason": reason}
+            p.reply = json.dumps(body).encode()
+        stream = req.stream
+        if stream is not None and not stream.closed:
+            # the terminal SSE event mirrors the JSON reply (plus the
+            # done marker) and ends the chunked body; the connection
+            # returns to keep-alive. The journal still gets the plain
+            # reply — a replayed rid is served non-streamed.
+            stream.finish(b"data: " + json.dumps(
+                dict(body, done=True)).encode() + b"\n\n")
         self._commit(p)
 
     # -- the loop ------------------------------------------------------------
@@ -615,8 +1024,10 @@ class DecodeScheduler:
             keep, dead = deque(), []
             for req in self._waiting:
                 p = req.pending
+                s = req.stream
                 if req.cancelled or (p.deadline is not None
-                                     and p.deadline.expired):
+                                     and p.deadline.expired) \
+                        or (s is not None and s.closed):
                     dead.append(req)
                 else:
                     keep.append(req)
@@ -624,6 +1035,12 @@ class DecodeScheduler:
         for req in dead:
             if req.cancelled:
                 self._finish(req, "cancelled")
+            elif req.stream is not None and req.stream.closed:
+                # the streaming client hung up before a slot was
+                # claimed: never journaled (status != 200) so a retry
+                # re-executes
+                self._finish(req, "disconnected", status=500,
+                             error="client disconnected")
             else:
                 self._finish(req, "deadline", status=504,
                              error="deadline exceeded before decode")
@@ -633,9 +1050,12 @@ class DecodeScheduler:
             return self._waiting.popleft() if self._waiting else None
 
     def _admit_waiting(self) -> None:
-        """Between steps: claim free slots for waiting requests (one
-        prefill each). Cancelled/expired waiters resolve WITHOUT ever
-        claiming a slot."""
+        """Between steps: claim free slots (and, paged, the prompt's
+        pages) for waiting requests — one prefill each. Cancelled/
+        expired/disconnected waiters resolve WITHOUT ever claiming
+        anything; a head-of-queue request the page pool cannot hold
+        yet WAITS (admission order preserved — pages free as running
+        requests finish)."""
         while self.pool.n_free > 0:
             req = self._pop_waiting()
             if req is None:
@@ -648,8 +1068,25 @@ class DecodeScheduler:
                 self._finish(req, "deadline", status=504,
                              error="deadline exceeded before decode")
                 continue
+            s = req.stream
+            if s is not None and s.closed:
+                self._finish(req, "disconnected", status=500,
+                             error="client disconnected")
+                continue
+            pages: List[int] = []
+            if self.pages is not None:
+                pages = self.pages.claim(
+                    self._pages_for(len(req.prompt) + 1))
+                if pages is None:
+                    # not enough pages YET: head-of-line waits for
+                    # running requests to release theirs
+                    with self._lock:
+                        self._waiting.appendleft(req)
+                    return
             slot = self.pool.claim()
             if slot is None:      # raced a concurrent release? retry
+                if pages:
+                    self.pages.release(pages)
                 with self._lock:
                     self._waiting.appendleft(req)
                 return
@@ -658,18 +1095,28 @@ class DecodeScheduler:
             if self._m_queue_wait is not None:
                 self._m_queue_wait.labels().observe(
                     (t0 - req.t_submit) * 1000.0)
+            table = None
+            if self._tables is not None:
+                self._tables[slot, :] = 0
+                self._tables[slot, :len(pages)] = pages
+                table = self._tables[slot]
             try:
                 if self.fault_plan is not None:
                     self.fault_plan.raise_at("decode_prefill",
                                              clock=self.clock)
                 first, last_logits = self.decoder.prefill_logits(
-                    slot, req.prompt)
+                    slot, req.prompt, table,
+                    draft=self._spec_capable(req))
                 if req.sampler is not None:
                     # the request's own seeded PRNG picks the first
                     # generated token from the prompt's last logits
                     first = req.sampler.sample(np.asarray(last_logits))
             except Exception as e:  # noqa: BLE001 — injected or real
                 self.pool.release(slot)
+                if pages:
+                    self.pages.release(pages)
+                if self._tables is not None:
+                    self._tables[slot, :] = 0
                 self._add_span(req, "prefill", t0, self._now(),
                                status="error")
                 self._finish(req, "error", status=500,
@@ -687,12 +1134,16 @@ class DecodeScheduler:
             self._add_span(req, "prefill", t0, t1, slot=slot,
                            prompt_len=len(req.prompt))
             req.slot = slot
+            req.pages = pages
             req.produced.append(first)
             self.n_tokens += 1
             self._tokens[slot] = first
             self._pos[slot] = len(req.prompt)
             with self._lock:
                 self._active[slot] = req
+                if len(self._active) > self.slots_high_water:
+                    self.slots_high_water = len(self._active)
+            self._emit_stream(req, [first])
             self._retire_if_done(req, first)
 
     def _retire_if_done(self, req: _DecodeRequest, tok: int) -> bool:
@@ -711,6 +1162,11 @@ class DecodeScheduler:
         if req.cancelled:
             self._finish(req, "cancelled")
             return True
+        s = req.stream
+        if s is not None and s.closed:
+            self._finish(req, "disconnected", status=500,
+                         error="client disconnected mid-stream")
+            return True
         p = req.pending
         if p.deadline is not None and p.deadline.expired:
             self._finish(req, "deadline", status=504,
@@ -718,17 +1174,85 @@ class DecodeScheduler:
             return True
         return False
 
-    def _run_step(self) -> None:
-        # pre-step reap: expired/cancelled slots free BEFORE paying a
-        # step for them (and their lanes stop being written)
+    def _emit_stream(self, req: _DecodeRequest, toks) -> None:
+        """Incremental token delivery for a streaming request: one SSE
+        event per emitted token (speculative rounds emit a small
+        burst). No-op for non-streamed requests and closed streams."""
+        s = req.stream
+        if s is None or s.closed:
+            return
+        base = len(req.produced) - len(toks)
+        for off, tok in enumerate(toks):
+            s.emit(b'data: {"token": %d, "i": %d}\n\n'
+                   % (int(tok), base + off))
+
+    def _ensure_pages(self, req: _DecodeRequest, upto_pos: int) -> bool:
+        """Grow ``req``'s page table to cover virtual row
+        ``upto_pos``; False when the pool cannot (caller decides:
+        preempt for the step's own row, degrade to non-speculative
+        for lookahead rows)."""
+        need = self._pages_for(upto_pos + 1)
+        have = len(req.pages)
+        if need <= have:
+            return True
+        got = self.pages.claim(need - have)
+        if got is None:
+            return False
+        self._tables[req.slot, have:need] = got
+        req.pages.extend(got)
+        return True
+
+    def _prepare_round(self):
+        """Pre-step upkeep: reap dead slots, grow pages for every
+        live slot's next row (preempting — finish_reason
+        ``pages_exhausted`` — when the pool is dry), and pick the
+        speculative cohort (spec-enabled slots whose lookahead window
+        fits their lane and the pool). Returns the cohort dict."""
         for req in list(self._active.values()):
             p = req.pending
+            s = req.stream
             if req.cancelled:
                 self._finish(req, "cancelled")
+            elif s is not None and s.closed:
+                self._finish(req, "disconnected", status=500,
+                             error="client disconnected mid-stream")
             elif p.deadline is not None and p.deadline.expired:
                 self._finish(req, "deadline", status=504,
                              error="deadline exceeded mid-decode")
+        if self.pages is not None:
+            for slot, req in list(self._active.items()):
+                if not self._ensure_pages(req, int(self._pos[slot])):
+                    # the pool cannot hold this slot's NEXT row: the
+                    # request ends with its partial output rather
+                    # than corrupt anyone — never a mid-decode OOM
+                    self.n_page_preempts += 1
+                    self._finish(req, "pages_exhausted")
+        spec: Dict[int, _DecodeRequest] = {}
+        if self.decoder.has_draft:
+            if self.spec_policy is not None \
+                    and not self.spec_policy.should_speculate():
+                # acceptance collapsed below break-even: single steps
+                # until a probe round says the workload turned
+                # draft-friendly again
+                return spec
+            k = self.decoder.spec_k
+            for slot, req in self._active.items():
+                if not self._spec_capable(req):
+                    continue
+                if int(self._pos[slot]) + k >= self.decoder.max_len:
+                    continue          # lane end: single steps finish it
+                if not self._ensure_pages(
+                        req, int(self._pos[slot]) + k - 1):
+                    continue          # pool tight: degrade, not block
+                spec[slot] = req
+        return spec
+
+    def _run_step(self) -> None:
+        spec = self._prepare_round()
         if not self._active:
+            return
+        if spec:
+            self._run_spec_round(spec)
             return
         t0 = self._now()
         try:
@@ -736,10 +1260,10 @@ class DecodeScheduler:
                 self.fault_plan.raise_at("decode_step",
                                          clock=self.clock)
             out, step_logits = self.decoder.step_logits(
-                self._tokens, self._pos)
+                self._tokens, self._pos, self._tables)
         except Exception as e:  # noqa: BLE001 — injected or real
             # a failed step loses the affected requests (500, never
-            # journaled — clients may retry) but NEVER a slot
+            # journaled — clients may retry) but NEVER a slot or page
             self.n_step_faults += 1
             logger.warning("decode step failed; failing %d in-slot "
                            "requests", len(self._active), exc_info=True)
@@ -751,6 +1275,21 @@ class DecodeScheduler:
         self.n_steps += 1
         if self._m_step is not None:
             self._m_step.labels().observe((t1 - t0) * 1000.0)
+        if self.decoder.has_draft and any(
+                self._spec_capable(r) for r in self._active.values()):
+            # draft-cache catch-up: a spec-capable slot stepping
+            # WITHOUT the draft (policy suppression, page-tight
+            # degradation, lane-end neighbours) would leave holes in
+            # its draft lane, and a later probe round would propose
+            # from garbage — acceptance would never recover. One cheap
+            # draft step per plain round (same inputs/positions as the
+            # target step) keeps both caches in lockstep; the draft's
+            # token outputs are discarded.
+            try:
+                self.decoder.draft_step_logits(self._tokens, self._pos)
+            except Exception:  # noqa: BLE001 — the draft is advisory:
+                logger.warning(  # a broken draft must not fail decode
+                    "draft catch-up step failed", exc_info=True)
         # one host fetch of the full [n_slots, vocab] logits per step,
         # paid ONLY while a sampling request is in a slot — pure-greedy
         # batches keep the token-only transfer
@@ -764,7 +1303,133 @@ class DecodeScheduler:
             self.n_tokens += 1
             self._pos[slot] += 1
             self._tokens[slot] = tok
+            self._emit_stream(req, [tok])
             self._retire_if_done(req, tok)
+
+    def _run_spec_round(self, spec: Dict[int, _DecodeRequest]) -> None:
+        """One speculative round: draft proposes ``spec_k`` tokens per
+        slot, the target verifies them in ONE width-k pass, and each
+        speculative slot accepts its longest agreeing prefix (exact
+        argmax match for greedy slots, Leviathan rejection sampling
+        for sampled opt-ins). Non-speculative slots ride the verify
+        and consume only its first position — exactly a single step
+        for them (their lookahead writes land on scratch/overwritten
+        rows by construction)."""
+        k = self.decoder.spec_k
+        sampled_spec = [s for s, r in spec.items()
+                        if r.sampler is not None]
+        t0 = self._now()
+        try:
+            if self.fault_plan is not None:
+                self.fault_plan.raise_at("decode_step",
+                                         clock=self.clock)
+            if not sampled_spec:
+                # the fast path: k chained greedy draft steps in ONE
+                # device program — one host round-trip per round
+                props = self.decoder.propose(self._tokens, self._pos)
+                draft_probs = None
+            else:
+                # sampled proposals need per-step draft distributions
+                # on host: k separate draft steps, each slot drawing
+                # from its own transformed draft distribution with
+                # its own PRNG
+                props = np.zeros((self.decoder.n_slots, k), np.int32)
+                draft_probs: Dict[int, list] = {s: [] for s in
+                                                sampled_spec}
+                cur = self._tokens.copy()
+                for j in range(k):
+                    nxt, dlogits = self.decoder.draft_step_logits(
+                        cur, self._pos + j)
+                    dl_np = np.asarray(dlogits)
+                    for s in range(self.decoder.n_slots):
+                        if s in draft_probs:
+                            q = spec[s].sampler.probs(dl_np[s])
+                            draft_probs[s].append(q)
+                            props[s, j] = spec[s].sampler.draw(q)
+                        else:
+                            props[s, j] = int(nxt[s])
+                    cur = props[:, j].copy()
+            ver_in = np.concatenate(
+                [self._tokens[:, None], props[:, :k - 1]],
+                axis=1).astype(np.int32)
+            out_tok, ver_logits = self.decoder.verify_logits(
+                ver_in, self._pos, self._tables)
+        except Exception as e:  # noqa: BLE001 — injected or real
+            self.n_step_faults += 1
+            logger.warning("speculative round failed; failing %d "
+                           "in-slot requests", len(self._active),
+                           exc_info=True)
+            for req in list(self._active.values()):
+                self._finish(req, "error", status=500,
+                             error=f"decode step failed: {e}")
+            return
+        t1 = self._now()
+        self.n_spec_rounds += 1
+        if self._m_spec_round is not None:
+            self._m_spec_round.labels().observe((t1 - t0) * 1000.0)
+        logits_np = None
+        if any(r.sampler is not None
+               for r in self._active.values()):
+            logits_np = np.asarray(ver_logits)
+        round_proposed = round_accepted = 0
+        for slot, req in list(self._active.items()):
+            if slot not in spec:
+                # non-speculative rider: position 0 of the verify IS
+                # its single step
+                tok = (int(out_tok[slot, 0]) if req.sampler is None
+                       else req.sampler.sample(logits_np[slot, 0]))
+                self._accept_tokens(req, slot, [tok])
+                continue
+            self.n_spec_proposed += k
+            round_proposed += k
+            emitted: List[int] = []
+            if req.sampler is None:
+                for j in range(k):
+                    tgt = int(out_tok[slot, j])
+                    emitted.append(tgt)
+                    if int(props[slot, j]) != tgt:
+                        break
+                    self.n_spec_accepted += 1
+                    round_accepted += 1
+            else:
+                smp = req.sampler
+                for j in range(k):
+                    d = int(props[slot, j])
+                    p_t = smp.probs(logits_np[slot, j])
+                    q_d = draft_probs[slot][j]
+                    accept = (q_d[d] > 0.0 and
+                              smp.uniform() <= min(
+                                  1.0, float(p_t[d] / q_d[d])))
+                    if accept:
+                        emitted.append(d)
+                        self.n_spec_accepted += 1
+                        round_accepted += 1
+                        continue
+                    resid = np.maximum(p_t - q_d, 0.0)
+                    tot = resid.sum()
+                    emitted.append(smp.draw(resid / tot) if tot > 0
+                                   else smp.draw(p_t))
+                    break
+            self._accept_tokens(req, slot, emitted)
+        if self.spec_policy is not None:
+            self.spec_policy.note(round_proposed, round_accepted)
+
+    def _accept_tokens(self, req: _DecodeRequest, slot: int,
+                       toks: List[int]) -> None:
+        """Fold a burst of emitted tokens into the slot's state,
+        stopping at the first terminal condition (EOS / budget / lane
+        end / cancel / deadline) — unconsumed acceptances beyond a
+        terminal are dropped, their cache rows repaired by later
+        writes like any rejected proposal."""
+        for tok in toks:
+            tok = int(tok)
+            req.produced.append(tok)
+            self.n_tokens += 1
+            self._pos[slot] += 1
+            self._tokens[slot] = tok
+            self._emit_stream(req, [tok])
+            if self._retire_if_done(req, tok):
+                break
 
     # -- observability -------------------------------------------------------
 
@@ -780,13 +1445,47 @@ class DecodeScheduler:
                   "prompt_len": int(len(r.prompt)),
                   "n_tokens": len(r.produced),   # incremental progress
                   "max_new_tokens": r.max_new,
+                  "n_pages": len(r.pages),
+                  "streaming": r.stream is not None,
                   "sampling": (r.sampler.describe()
                                if r.sampler is not None else None)}
                  for s, r in active]
+        pages = None
+        if self.pages is not None:
+            from mmlspark_tpu.parallel.dist import tree_bytes
+            claimable = self.pages.n_pages - 1
+            free = self.pages.n_free
+            pages = {"page_size": self.decoder.page_size,
+                     "n_pages": claimable,
+                     "free": free,
+                     "in_use": claimable - free,
+                     "high_water": self.pages.high_water,
+                     "n_preempts": self.n_page_preempts,
+                     "pool_bytes": tree_bytes(self.decoder.cache),
+                     "per_slot": {str(s): len(r.pages)
+                                  for s, r in active}}
+        spec = None
+        if self.decoder.has_draft:
+            proposed = self.n_spec_proposed
+            spec = {"k": self.decoder.spec_k,
+                    "draft_layers": self.decoder.draft_cfg.n_layers,
+                    "rounds": self.n_spec_rounds,
+                    "proposed": proposed,
+                    "accepted": self.n_spec_accepted,
+                    "acceptance_rate": (
+                        round(self.n_spec_accepted / proposed, 4)
+                        if proposed else None),
+                    "policy": (self.spec_policy.status()
+                               if self.spec_policy is not None
+                               else None)}
         return {"n_slots": self.decoder.n_slots,
                 "slots_in_use": len(slots),
                 "slots_free": self.pool.n_free,
+                "slots_high_water": self.slots_high_water,
                 "max_len": self.decoder.max_len,
+                "paged": self.decoder.paged,
+                "pages": pages,
+                "speculative": spec,
                 "placement": self.decoder.placement(),
                 "waiting": waiting,
                 "max_waiting": self.max_waiting,

@@ -283,18 +283,78 @@ def build_head(status: int, body_len: int,
     return b"".join(parts)
 
 
+_SSE_CTYPE = b"Content-Type: text/event-stream\r\n"
+_CHUNKED = b"Transfer-Encoding: chunked\r\nCache-Control: no-cache\r\n"
+
+
+def build_stream_head(status: int = 200,
+                      extra: Tuple[Tuple[str, str], ...] = (),
+                      close: bool = False) -> bytes:
+    """Response head for a chunked SSE stream: no Content-Length —
+    ``Transfer-Encoding: chunked`` frames the incremental body, so the
+    connection stays keep-alive after the terminal chunk."""
+    parts = [_status_line(status), _date_line(), _SSE_CTYPE, _CHUNKED]
+    for k, v in extra:
+        parts.append(f"{k}: {v}\r\n".encode("latin-1"))
+    if close:
+        parts.append(_CONN_CLOSE)
+    parts.append(b"\r\n")
+    return b"".join(parts)
+
+
+def _chunk(data: bytes) -> bytes:
+    return b"%x\r\n" % len(data) + data + b"\r\n"
+
+
+class _EventLoopStream:
+    """A live incremental response on one event-loop connection.
+
+    Producers (the decode scheduler's loop thread) call :meth:`emit`
+    per event and :meth:`finish` once; both post to the owning loop,
+    which frames each event as an HTTP chunk and rides the existing
+    non-blocking write state machine (partial writes continue via
+    ``conn.out`` + EVENT_WRITE). ``closed`` flips when the peer
+    disconnects mid-stream or the bounded per-connection buffer
+    overflows (slow consumer) — producers poll it and cancel their
+    work; writes after ``closed`` are dropped."""
+
+    __slots__ = ("_loop", "_conn", "_gen", "closed", "done")
+
+    def __init__(self, loop: "_Loop", conn: "_Conn", gen: int):
+        self._loop = loop
+        self._conn = conn
+        self._gen = gen
+        self.closed = False
+        self.done = False
+
+    def emit(self, data: bytes) -> None:
+        if self.closed or self.done:
+            return
+        self._loop.post_stream(self._conn, self._gen, data, False)
+
+    def finish(self, data: bytes = b"") -> None:
+        if self.closed or self.done:
+            return
+        self.done = True
+        self._loop.post_stream(self._conn, self._gen, data, True)
+
+
 # ---------------------------------------------------------------------------
 # Connection state machine
 # ---------------------------------------------------------------------------
 
-_HEAD, _BODY, _AWAIT, _CLOSING = 0, 1, 2, 3
+_HEAD, _BODY, _AWAIT, _CLOSING, _STREAM = 0, 1, 2, 3, 4
+
+#: sentinel tag marking a stream item on the shared reply deque
+_STREAM_TAG = object()
 
 
 class _Conn:
     __slots__ = ("sock", "fd", "buf", "scanned", "state", "gen", "out",
                  "t_last", "t_req_start", "t_await", "n_requests",
                  "keep_alive", "method", "path", "headers", "body_start",
-                 "body_len", "want_write", "advancing", "peer_ip")
+                 "body_len", "want_write", "advancing", "peer_ip",
+                 "stream")
 
     def __init__(self, sock: socket.socket, peer_ip: str = ""):
         self.sock = sock
@@ -320,6 +380,7 @@ class _Conn:
         self.body_len = 0
         self.want_write = False
         self.advancing = False
+        self.stream: Optional[_EventLoopStream] = None
 
 
 class _Loop(threading.Thread):
@@ -384,6 +445,72 @@ class _Loop(threading.Thread):
         fe.n_reply_flushes += 1
         fe.n_batched_replies += len(items)
         self.wake()
+
+    def post_stream(self, conn: _Conn, gen: int, data: bytes,
+                    end: bool) -> None:
+        """Queue one stream event (or the terminal event) for
+        delivery by the loop thread; safe from any thread."""
+        if threading.get_ident() == self.ident:
+            self._deliver_stream(conn, gen, data, end)
+            return
+        self._replies.append((_STREAM_TAG, conn, gen, data, end))
+        self.wake()
+
+    def open_stream(self, conn: _Conn, gen: int,
+                    extra: Tuple[Tuple[str, str], ...] = ()
+                    ) -> Optional[_EventLoopStream]:
+        """Switch the in-flight request to incremental delivery: send
+        the chunked-SSE head now, return the producer handle. LOOP
+        THREAD ONLY (called synchronously from ``handle_request``);
+        None when the request is no longer current."""
+        if conn.fd not in self.conns or conn.gen != gen \
+                or conn.state != _AWAIT:
+            return None
+        conn.state = _STREAM
+        handle = _EventLoopStream(self, conn, gen)
+        conn.stream = handle
+        self.frontend.n_streams += 1
+        self._write(conn, build_stream_head(
+            200, extra, close=not conn.keep_alive), b"", False)
+        return handle
+
+    def _deliver_stream(self, conn: _Conn, gen: int, data: bytes,
+                        end: bool) -> None:
+        """Frame one SSE event as an HTTP chunk IF the stream is still
+        current; the terminal event also writes the zero chunk and
+        returns the connection to keep-alive (or closes it)."""
+        if conn.fd not in self.conns or conn.gen != gen \
+                or conn.state != _STREAM:
+            return
+        fe = self.frontend
+        if len(conn.out) > fe.max_stream_buffer_bytes:
+            # slow-consumer backpressure: the bounded per-conn buffer
+            # is full — drop the connection rather than balloon memory
+            # (the producer sees handle.closed and cancels its work)
+            fe.n_stream_overflows += 1
+            self._close(conn)
+            return
+        payload = _chunk(data) if data else b""
+        if not end:
+            fe.n_stream_events += 1
+            # the stall clock: a stream is alive as long as events
+            # flow — the sweep reaps streams whose LAST event is older
+            # than request_timeout (the threaded frontend's
+            # q.get(timeout) analogue)
+            conn.t_await = time.monotonic()
+            self._write(conn, payload, b"", False)
+            return
+        payload += b"0\r\n\r\n"                 # terminal chunk
+        close_after = not conn.keep_alive
+        conn.stream = None
+        conn.gen += 1
+        conn.state = _CLOSING if close_after else _HEAD
+        conn.t_req_start = conn.t_last = time.monotonic()
+        fe.n_stream_events += 1
+        self._write(conn, payload, b"", close_after)
+        if conn.fd in self.conns and conn.state == _HEAD \
+                and not conn.out:
+            self._advance(conn)       # serve pipelined follow-ups
 
     def wake(self) -> None:
         # one pending byte is enough to wake the selector; the flag
@@ -705,6 +832,15 @@ class _Loop(threading.Thread):
                               close=not ka)
             loop.post_reply(conn, gen, head, rbody, not ka)
 
+        def begin_stream(extra: Tuple[Tuple[str, str], ...] = ()):
+            # upgrade this request to incremental chunked-SSE delivery
+            # (token streaming). Synchronous, loop thread only — the
+            # application calls it DURING handle_request, before any
+            # reply; the returned handle then accepts emit()/finish()
+            # from any thread. Mutually exclusive with reply().
+            return loop.open_stream(conn, gen, extra)
+
+        reply.begin_stream = begin_stream
         method = conn.method.decode("latin-1")
         try:
             handled = fe.app.handle_request(method, conn.path,
@@ -759,11 +895,14 @@ class _Loop(threading.Thread):
     def _drain_replies(self) -> None:
         while True:
             try:
-                conn, gen, head, body, close_after = \
-                    self._replies.popleft()
+                item = self._replies.popleft()
             except IndexError:
                 return
-            self._deliver(conn, gen, head, body, close_after)
+            if item[0] is _STREAM_TAG:
+                _, conn, gen, data, end = item
+                self._deliver_stream(conn, gen, data, end)
+            else:
+                self._deliver(*item)
 
     def _write(self, conn: _Conn, head: bytes, body: bytes,
                close_after: bool) -> None:
@@ -821,6 +960,12 @@ class _Loop(threading.Thread):
             return
         self._deferred.pop(conn.fd, None)
         self.frontend._ip_release(conn.peer_ip)
+        if conn.stream is not None:
+            # mid-stream disconnect: flag the producer (the decode
+            # scheduler polls this and cancels the request — no slot
+            # or page may outlive its audience)
+            conn.stream.closed = True
+            conn.stream = None
         conn.gen += 1                 # outstanding replies become stale
         conn.state = _CLOSING
         try:
@@ -840,10 +985,20 @@ class _Loop(threading.Thread):
         rt = fe.request_timeout
         doomed: List[_Conn] = []
         timed_out: List[_Conn] = []
+        stalled: List[_Conn] = []
         for conn in self.conns.values():
             if conn.state == _AWAIT:
                 if rt and rt > 0 and now - conn.t_await > rt:
                     timed_out.append(conn)
+                continue
+            if conn.state == _STREAM:
+                # a wedged producer (hung device, dead scheduler)
+                # must not park streaming clients forever: no event
+                # within the stuck-batch budget drops the connection
+                # (the 200 head is already out — there is no 504 to
+                # send; closing flags the producer via the handle)
+                if rt and rt > 0 and now - conn.t_await > rt:
+                    stalled.append(conn)
                 continue
             if idle and idle > 0 and conn.state in (_HEAD, _BODY):
                 if conn.buf or conn.state == _BODY:
@@ -856,6 +1011,9 @@ class _Loop(threading.Thread):
                     doomed.append(conn)
         for conn in doomed:
             fe.n_idle_reaped += 1
+            self._close(conn)
+        for conn in stalled:
+            fe.n_request_timeouts += 1
             self._close(conn)
         for conn in timed_out:
             # same contract as the threaded frontend's Event.wait
@@ -921,6 +1079,7 @@ class EventLoopFrontend:
                  backlog: int = 1024,
                  max_conns_per_ip: int = 0,
                  max_pipelined_per_iter: int = 16,
+                 max_stream_buffer_bytes: int = 256 << 10,
                  registry=None, name: str = "serving"):
         self.app = app
         self.name = name
@@ -948,6 +1107,13 @@ class EventLoopFrontend:
         # pipelined connection cannot monopolize a loop. <= 0 disables.
         self.max_pipelined_per_iter = int(max_pipelined_per_iter)
         self.n_pipelining_deferred = 0
+        # -- token streaming: a streamed response may only buffer this
+        # many unwritten bytes per connection (slow consumer) before
+        # the frontend drops the connection and flags the producer
+        self.max_stream_buffer_bytes = int(max_stream_buffer_bytes)
+        self.n_streams = 0
+        self.n_stream_events = 0
+        self.n_stream_overflows = 0
         if self.acceptors > 1 and not self.reuse_port:
             # N loops cannot share ONE listening socket without the
             # thundering-herd accept races SO_REUSEPORT exists to fix
@@ -1070,6 +1236,16 @@ class EventLoopFrontend:
              "Replies delivered through batched flushes (ratio to "
              "flush batches = coalescing factor).",
              "n_batched_replies"),
+            ("serving_streams_total",
+             "Requests upgraded to incremental chunked-SSE delivery "
+             "(token streaming).", "n_streams"),
+            ("serving_stream_events_total",
+             "SSE events written to streamed responses (terminal "
+             "events included).", "n_stream_events"),
+            ("serving_stream_overflows_total",
+             "Streamed connections dropped because the bounded "
+             "per-connection write buffer overflowed (slow consumer).",
+             "n_stream_overflows"),
         ):
             registry.counter(mname, help_).set_function(
                 lambda a=attr: getattr(self, a))
@@ -1135,6 +1311,9 @@ class EventLoopFrontend:
             "per_ip_conns_high_water": self.per_ip_high_water,
             "reply_flush_batches_total": self.n_reply_flushes,
             "batched_replies_total": self.n_batched_replies,
+            "streams_total": self.n_streams,
+            "stream_events_total": self.n_stream_events,
+            "stream_overflows_total": self.n_stream_overflows,
             "busy_ratio": round(max(
                 (lp.busy_ratio for lp in self._loops), default=0.0), 4),
         }

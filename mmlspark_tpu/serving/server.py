@@ -66,7 +66,9 @@ from mmlspark_tpu.core.logs import get_logger
 from mmlspark_tpu.core.profiling import (
     StageTimings, process_rss_bytes, process_uptime_s,
 )
-from mmlspark_tpu.parallel.sharding import bucket_target, padded_device_batch
+from mmlspark_tpu.parallel.sharding import (
+    bucket_ladder, bucket_target, padded_device_batch,
+)
 from mmlspark_tpu.core.resilience import (
     SYSTEM_CLOCK, BreakerBoard, Clock, Deadline, DeadlineExceeded,
     RetryPolicy,
@@ -119,7 +121,7 @@ _MAX_SHAPES_TRACKED = 1024
 
 class _PendingRequest:
     __slots__ = ("rid", "payload", "event", "reply", "status", "deadline",
-                 "trace", "span", "t_enqueue", "callbacks")
+                 "trace", "span", "t_enqueue", "callbacks", "stream")
 
     def __init__(self, payload: Any, rid: Optional[str] = None,
                  deadline: Optional[Deadline] = None,
@@ -148,6 +150,47 @@ class _PendingRequest:
         # parent. None for synthetic warmup work, which records nothing.
         self.span = None
         self.t_enqueue: Optional[float] = None
+        # token-streaming handle (decode plane, stream=1): the decode
+        # scheduler emits per-token SSE events through it and finishes
+        # the chunked body at resolution; None for everything else
+        self.stream = None
+
+
+class _ThreadedStream:
+    """Token-stream handle for the threaded frontend: the decode
+    scheduler's ``emit``/``finish`` land on a queue the blocked
+    handler thread drains into chunked writes (the threaded analogue
+    of :class:`~mmlspark_tpu.serving.frontend._EventLoopStream`).
+    ``closed`` flips on a write error (client gone) or a stalled
+    stream; producers poll it and cancel."""
+
+    __slots__ = ("q", "closed", "done")
+
+    def __init__(self):
+        self.q: "Queue[tuple]" = Queue()
+        self.closed = False
+        self.done = False
+
+    def emit(self, data: bytes) -> None:
+        if not (self.closed or self.done):
+            self.q.put((data, False))
+
+    def finish(self, data: bytes = b"") -> None:
+        if self.closed or self.done:
+            return
+        self.done = True
+        self.q.put((data, True))
+
+
+def _stream_requested(path: str, payload: Any) -> bool:
+    """Token streaming opt-in: ``?stream=1`` on the decode path or
+    ``"stream": true`` in the payload. The query is parsed per
+    parameter — ``stream=10`` or ``upstream=1`` must NOT upgrade a
+    client that expects a plain JSON reply."""
+    q = path.partition("?")[2]
+    if q and any(p == "stream=1" for p in q.split("&")):
+        return True
+    return isinstance(payload, dict) and payload.get("stream") is True
 
 
 class ServingServer:
@@ -601,8 +644,11 @@ class ServingServer:
                 self._reply(status, body, ctype=ctype, extra=extra)
 
             def do_POST(self):
+                # the decode path matches on the BASE path so the
+                # streaming opt-in query (?stream=1) still routes here
                 is_decode = (serving.decoder is not None
-                             and self.path == serving.decode_path)
+                             and self.path.partition("?")[0]
+                             == serving.decode_path)
                 if self.path != serving.api_path and not is_decode:
                     # control-plane POSTs (rollout admin) share one
                     # route table with the event-loop frontend
@@ -691,8 +737,14 @@ class ServingServer:
                     return "deadline"
                 if kind == "enqueue":
                     if decode:
+                        stream = (_ThreadedStream()
+                                  if _stream_requested(self.path,
+                                                       payload)
+                                  else None)
+                        pending.stream = stream
                         err = serving._enqueue_decode(pending, root)
                         if err is not None:
+                            pending.stream = None
                             e_status, e_body = err
                             self._reply(
                                 e_status, e_body, trace=tid,
@@ -701,6 +753,9 @@ class ServingServer:
                                              else None))
                             return ("shed" if e_status == 429
                                     else "error")
+                        if stream is not None:
+                            return self._serve_stream(tid, pending,
+                                                      stream)
                     else:
                         serving._enqueue(pending, root)
                 if not pending.event.wait(serving.request_timeout):
@@ -718,6 +773,45 @@ class ServingServer:
                             window_missed=window_missed, trace=tid)
                 return ("ok" if pending.status == 200 else
                         "deadline" if pending.status == 504 else "error")
+
+            def _serve_stream(self, tid, pending, stream) -> str:
+                """Drain the decode scheduler's token events into
+                chunked SSE writes from this handler thread — the
+                threaded analogue of the event-loop stream. The stream
+                was attached BEFORE submit, so no token can slip out
+                unstreamed; a write failure (client gone) flips
+                ``closed`` and the scheduler cancels the decode."""
+                self.send_response(200)
+                self.send_header("Content-Type", "text/event-stream")
+                self.send_header("Transfer-Encoding", "chunked")
+                self.send_header("Cache-Control", "no-cache")
+                self.send_header(TRACE_HEADER, tid)
+                self.end_headers()
+                while True:
+                    try:
+                        data, end = stream.q.get(
+                            timeout=serving.request_timeout)
+                    except Empty:
+                        # no event within the stuck-batch budget: give
+                        # up exactly like the non-streamed 504 path
+                        stream.closed = True
+                        self.close_connection = True
+                        return "timeout"
+                    try:
+                        if data:
+                            self.wfile.write(b"%x\r\n" % len(data)
+                                             + data + b"\r\n")
+                        if end:
+                            self.wfile.write(b"0\r\n\r\n")
+                            break
+                        self.wfile.flush()
+                    except OSError:
+                        stream.closed = True
+                        self.close_connection = True
+                        return "error"
+                return ("ok" if pending.status == 200 else
+                        "deadline" if pending.status == 504 else
+                        "error")
 
             def log_message(self, *args):  # quiet
                 pass
@@ -1066,17 +1160,19 @@ class ServingServer:
             self._n_backlog += 1
         self._queue.put(pending)
 
-    def _enqueue_decode(self, pending: _PendingRequest, root
-                        ) -> Optional[Tuple[int, bytes]]:
+    def _enqueue_decode(self, pending: _PendingRequest, root,
+                        parsed=None) -> Optional[Tuple[int, bytes]]:
         """Hand an admitted request to the decode scheduler. Returns
         ``None`` on success or ``(status, body)`` for a synchronous
         reject (bad payload -> 400, waiting queue full -> 429) — the
         reject path removes the in-flight entry so a retried rid
-        re-admits instead of joining a dead pending."""
+        re-admits instead of joining a dead pending. ``parsed``
+        forwards a streaming pre-check's parse result so the payload
+        is validated once."""
         pending.span = root
         pending.t_enqueue = self.tracer.clock.now()
         try:
-            self.decoder.submit(pending)
+            self.decoder.submit(pending, parsed=parsed)
             return None
         except DecodeOverloaded:
             with self._commit_lock:
@@ -1134,8 +1230,10 @@ class ServingServer:
             return True
         if method != "POST":
             return False
+        # decode matches on the BASE path (the ?stream=1 opt-in rides
+        # the query string); the frame plane stays an exact match
         is_decode = (self.decoder is not None
-                     and path == self.decode_path)
+                     and path.partition("?")[0] == self.decode_path)
         if path != self.api_path and not is_decode:
             routed = self._post_route(path, body)
             if routed is None:
@@ -1155,7 +1253,8 @@ class ServingServer:
             try:
                 status = self._predict_eventloop(headers, body, tid,
                                                  root, reply,
-                                                 decode=is_decode)
+                                                 decode=is_decode,
+                                                 path=path)
             finally:
                 if status is not None:
                     # sync reject paths; async completions finish the
@@ -1164,7 +1263,8 @@ class ServingServer:
         return True
 
     def _predict_eventloop(self, headers, body: bytes, tid: str,
-                           root, reply, decode: bool = False
+                           root, reply, decode: bool = False,
+                           path: str = ""
                            ) -> Optional[str]:
         """Admission for the event-loop frontend: same decisions as the
         threaded ``_do_predict`` (one ``_admit`` serves both), but the
@@ -1231,15 +1331,54 @@ class ServingServer:
         if joined:
             self._add_waiter(pending, on_done)
         elif decode:
-            err = self._enqueue_decode(pending, root)
+            stream = parsed = None
+            want_stream = _stream_requested(path, payload)
+            if want_stream:
+                # pre-validate so sync rejects (400/429) stay plain
+                # replies — once the chunked 200 head is on the wire
+                # there is no taking it back; the parse result is
+                # forwarded to submit so the payload is checked once
+                try:
+                    parsed = self.decoder.parse(payload)
+                except ValueError as e:
+                    with self._commit_lock:
+                        self._inflight.pop(pending.rid, None)
+                    reply(400, json.dumps({"error": str(e)}).encode(),
+                          extra=((TRACE_HEADER, tid),))
+                    return "error"
+                stream = reply.begin_stream(
+                    extra=((TRACE_HEADER, tid),))
+                # the stream is attached BEFORE submit so the very
+                # first token already flows through it; None means
+                # the connection died between framing and now
+                pending.stream = stream
+            err = self._enqueue_decode(pending, root, parsed=parsed)
             if err is not None:
+                pending.stream = None
                 e_status, e_body = err
+                if stream is not None:
+                    # headers are out: deliver the reject as the one
+                    # and only SSE event (racy overload/parse change)
+                    stream.finish(b"data: " + e_body + b"\n\n")
+                    return "shed" if e_status == 429 else "error"
                 extra = [(TRACE_HEADER, tid)]
                 if e_status == 429:
                     extra.append(("Retry-After",
                                   str(self.shed_retry_after)))
                 reply(e_status, e_body, extra=tuple(extra))
                 return "shed" if e_status == 429 else "error"
+            if stream is not None:
+                # the stream delivers the body; the waiter only
+                # finishes the root span at commit
+                tracer2 = self.tracer
+
+                def on_stream_done(p: _PendingRequest) -> None:
+                    tracer2.finish(
+                        root, status="ok" if p.status == 200 else
+                        "deadline" if p.status == 504 else "error")
+
+                self._add_waiter(pending, on_stream_done)
+                return None
             self._add_waiter(pending, on_done)
         else:
             self._enqueue(pending, root)
@@ -1412,8 +1551,7 @@ class ServingServer:
         """Every reachable shape bucket: the pow2 ladder clamped at
         max_batch_size (shared by warmup() and staged-version warmup —
         the two must warm the same set or flips retrace)."""
-        cap = self.max_batch_size
-        return sorted({bucket_target(k, cap) for k in range(1, cap + 1)})
+        return bucket_ladder(self.max_batch_size)
 
     def _warmup_frame(self, payload: Any, n: int) -> DataFrame:
         """One synthetic bucket-shaped frame, built exactly like live

@@ -75,6 +75,17 @@ class TestSharding:
             padded, _ = pad_to_bucket(np.zeros((n, 2)), cap=16)
             assert padded.shape[0] == bucket_target(n, 16)
 
+    def test_bucket_ladder_matches_target_scan(self):
+        """bucket_ladder derives in O(log cap) exactly the set the old
+        per-n bucket_target scan produced — the decoder/server init
+        cost fix is behavior-preserving by construction."""
+        from mmlspark_tpu.parallel.sharding import (
+            bucket_ladder, bucket_target,
+        )
+        for cap in (1, 2, 3, 5, 6, 8, 17, 64, 100, 256):
+            assert bucket_ladder(cap) == sorted(
+                {bucket_target(n, cap) for n in range(1, cap + 1)})
+
     def test_pad_mode_edge(self):
         # edge mode repeats the last row — valid for object columns and
         # models that reject zero rows (the serving bucket policy)

@@ -754,3 +754,542 @@ class TestSampling:
                 _greedy_reference(g_prompt, 5)
             assert results["sampled"].status_code == 200
             assert len(results["sampled"].json()["tokens"]) == 5
+
+
+# ---------------------------------------------------------------------------
+# ISSUE 11: paged KV cache, speculative decoding, streamed tokens
+# ---------------------------------------------------------------------------
+
+
+def _read_chunked_sse(sock):
+    """Read one chunked HTTP response off ``sock``; returns
+    ``(head_bytes, [parsed SSE event dicts])``."""
+    buf = b""
+    while b"\r\n\r\n" not in buf:
+        buf += sock.recv(65536)
+    head, _, rest = buf.partition(b"\r\n\r\n")
+    data = rest
+    while b"0\r\n\r\n" not in data:
+        chunk = sock.recv(65536)
+        if not chunk:
+            break
+        data += chunk
+    body = b""
+    while data:
+        line, _, data = data.partition(b"\r\n")
+        if not line:
+            continue
+        n = int(line, 16)
+        if n == 0:
+            break
+        body += data[:n]
+        data = data[n + 2:]
+    events = [json.loads(e.split(b"data: ", 1)[1])
+              for e in body.split(b"\n\n") if e.strip()]
+    return head, events
+
+
+def _post_raw(host, port, path, payload):
+    import socket as _socket
+    s = _socket.create_connection((host, port), timeout=30)
+    body = json.dumps(payload).encode()
+    s.sendall(b"POST %s HTTP/1.1\r\nHost: x\r\n"
+              b"Content-Type: application/json\r\n"
+              b"Content-Length: %d\r\n\r\n%s"
+              % (path.encode(), len(body), body))
+    return s
+
+
+class TestPagedScheduler:
+    """The paged decode plane end to end: block-table goldens through
+    the scheduler, page-leak ledger over every release reason,
+    page-exhaustion admission, mid-decode preemption."""
+
+    def test_paged_tokens_match_dense_scheduler(self):
+        """The same prompts through a paged and a dense scheduler
+        produce identical greedy sequences (4 prompt lengths)."""
+        rng = np.random.default_rng(21)
+        prompts = [_prompt(rng, n) for n in (1, 3, 6, 9)]
+        outs = {}
+        for name, kw in (("dense", dict(paged=False)),
+                         ("paged", dict(paged=True, page_size=8,
+                                        n_pages=9))):
+            sched = DecodeScheduler(
+                _decoder(n_slots=2, **kw)).start()
+            try:
+                pendings = [_Pending({"prompt": p,
+                                      "max_new_tokens": 5}, f"r{i}")
+                            for i, p in enumerate(prompts)]
+                for p in pendings:
+                    sched.submit(p)
+                for p in pendings:
+                    assert p.event.wait(30)
+                outs[name] = [json.loads(p.reply)["tokens"]
+                              for p in pendings]
+            finally:
+                sched.stop()
+            assert sched.pool.n_free == 2
+        assert outs["dense"] == outs["paged"]
+        for pr, toks in zip(prompts, outs["paged"]):
+            assert toks == _greedy_reference(pr, 5)
+
+    def test_page_reclaim_after_every_release_reason(self):
+        """EOS, token budget, deadline, cancel, disconnect-shaped
+        cancel, and an injected step fault all return their pages:
+        the ledger ends at n_free == n_pages - 1 with every reason
+        accounted."""
+        clock = ManualClock()
+        rng = np.random.default_rng(22)
+        eos_prompt = _prompt(rng, 3)
+        eos = _greedy_reference(eos_prompt, 3)[1]
+        sched = DecodeScheduler(
+            _decoder(n_slots=3, max_len=256, paged=True, page_size=8,
+                     eos_id=eos),
+            clock=clock).start()
+        try:
+            waves = [
+                [_Pending({"prompt": eos_prompt,
+                           "max_new_tokens": 8}, "w-eos"),
+                 _Pending({"prompt": _prompt(rng, 4),
+                           "max_new_tokens": 2}, "w-len")],
+                [_Pending({"prompt": _prompt(rng, 4),
+                           "max_new_tokens": 10_000}, "w-cancel"),
+                 _Pending({"prompt": _prompt(rng, 4),
+                           "max_new_tokens": 10_000}, "w-deadline",
+                          deadline=Deadline(1.0, clock=clock))],
+                [_Pending({"prompt": _prompt(rng, 4),
+                           "max_new_tokens": 10_000}, "w-fault")],
+            ]
+            for p in waves[0]:
+                sched.submit(p)
+            for p in waves[0]:
+                assert p.event.wait(30)
+            for p in waves[1]:
+                sched.submit(p)
+            t_end = time.monotonic() + 10
+            while sched.stats()["slots_in_use"] < 2 and \
+                    time.monotonic() < t_end:
+                time.sleep(0.002)
+            sched.cancel("w-cancel")
+            clock.advance(2.0)
+            for p in waves[1]:
+                assert p.event.wait(30)
+            # arm the fault only now, so the earlier waves' reasons
+            # are deterministic however many steps they consumed
+            sched.fault_plan = FaultPlan(
+                script={"decode_step": ["fail"]})
+            for p in waves[2]:
+                sched.submit(p)          # rides into the scripted fault
+            for p in waves[2]:
+                assert p.event.wait(30)
+            sched.fault_plan = None
+            reasons = {json.loads(p.reply)["finish_reason"]
+                       for wave in waves for p in wave}
+            assert {"eos", "length", "cancelled", "deadline",
+                    "error"} <= reasons
+        finally:
+            sched.stop()
+        assert sched.pool.n_free == 3
+        assert sched.pages.n_free == sched.pages.n_pages - 1
+        assert sched.pages.high_water > 0
+
+    def test_page_exhaustion_429_then_readmit(self):
+        """A pool-filling decode makes the next submit shed
+        DecodeOverloaded (the server's 429 + Retry-After); once pages
+        free, the same request admits and completes."""
+        # 4 claimable pages of 4 rows; a 13-token prompt claims all 4
+        sched = DecodeScheduler(
+            _decoder(n_slots=2, max_len=16, paged=True, page_size=4,
+                     n_pages=5)).start()
+        rng = np.random.default_rng(23)
+        try:
+            hog = _Pending({"prompt": _prompt(rng, 13),
+                            "max_new_tokens": 2}, "hog")
+            sched.submit(hog)
+            t_end = time.monotonic() + 10
+            while sched.pages.n_free > 0 and time.monotonic() < t_end:
+                time.sleep(0.001)
+            victim = _Pending({"prompt": _prompt(rng, 4),
+                               "max_new_tokens": 2}, "victim")
+            with pytest.raises(DecodeOverloaded, match="page pool"):
+                sched.submit(victim)
+            assert hog.event.wait(30)
+            retry = _Pending({"prompt": _prompt(rng, 4),
+                              "max_new_tokens": 2}, "victim")
+            sched.submit(retry)
+            assert retry.event.wait(30)
+            assert retry.status == 200
+        finally:
+            sched.stop()
+        assert sched.pages.n_free == 4
+
+    def test_mid_decode_page_preempt_never_ooms(self):
+        """When running slots outgrow the pool, the starved request
+        finishes with its partial tokens (finish_reason
+        pages_exhausted) — no OOM, no stall, pages accounted."""
+        # 3 claimable pages of 4 rows: two 5-token prompts admit at 2
+        # pages each? no — 2 pages needed each, only 3 exist, so the
+        # second waits; instead one slot grows past its claim
+        sched = DecodeScheduler(
+            _decoder(n_slots=2, max_len=16, paged=True, page_size=4,
+                     n_pages=4)).start()
+        rng = np.random.default_rng(24)
+        try:
+            a = _Pending({"prompt": _prompt(rng, 6),
+                          "max_new_tokens": 12}, "a")   # 2 pages now,
+            b = _Pending({"prompt": _prompt(rng, 2),    # grows to 4
+                          "max_new_tokens": 2}, "b")    # 1 page
+            sched.submit(a)
+            sched.submit(b)
+            assert a.event.wait(30) and b.event.wait(30)
+            out_a = json.loads(a.reply)
+            assert b.status == 200
+            # a could not reach 12 new tokens on 12 claimable rows
+            # alongside b: it preempted with partial output
+            assert out_a["finish_reason"] in ("pages_exhausted",
+                                              "length")
+            if out_a["finish_reason"] == "pages_exhausted":
+                assert sched.n_page_preempts >= 1
+                assert 0 < out_a["n_tokens"] < 12
+        finally:
+            sched.stop()
+        assert sched.pages.n_free == 3
+        assert sched.pool.n_free == 2
+
+    def test_undersized_pool_raises_without_scheduler_tables(self):
+        dec = _decoder(n_slots=2, max_len=16, paged=True, page_size=4,
+                       n_pages=4)
+        with pytest.raises(ValueError, match="PagePool"):
+            dec.prefill(0, np.asarray([1, 2], np.int32))
+
+    def test_prompt_ladder_derived_not_scanned(self):
+        from mmlspark_tpu.parallel.sharding import (
+            bucket_ladder, bucket_target,
+        )
+        dec = _decoder(max_len=32)
+        assert dec.prompt_buckets() == bucket_ladder(32) == sorted(
+            {bucket_target(n, 32) for n in range(1, 33)})
+
+
+class TestStreaming:
+    """Token streaming (ISSUE 11): chunked SSE over both frontends,
+    incremental events consistent with the terminal reply, keep-alive
+    preserved, and a mid-stream disconnect that frees slot AND
+    pages."""
+
+    @pytest.mark.parametrize("frontend", ["eventloop", "threaded"])
+    def test_streamed_generate(self, frontend):
+        with _serve(frontend=frontend) as srv:
+            rng = np.random.default_rng(31)
+            prompt = _prompt(rng, 3)
+            s = _post_raw(srv.host, srv.port, "/generate?stream=1",
+                          {"prompt": prompt, "max_new_tokens": 5})
+            head, events = _read_chunked_sse(s)
+            assert b" 200 " in head.split(b"\r\n")[0]
+            assert b"text/event-stream" in head
+            assert b"chunked" in head.lower()
+            toks = [e["token"] for e in events if "done" not in e]
+            final = [e for e in events if e.get("done")][0]
+            assert final["tokens"] == _greedy_reference(prompt, 5)
+            assert toks == final["tokens"]
+            assert [e["i"] for e in events
+                    if "done" not in e] == list(range(5))
+            assert final["finish_reason"] == "length"
+            # keep-alive: a plain decode on the SAME socket
+            body = json.dumps({"prompt": prompt,
+                               "max_new_tokens": 2}).encode()
+            s.sendall(b"POST /generate HTTP/1.1\r\nHost: x\r\n"
+                      b"Content-Length: %d\r\n\r\n%s"
+                      % (len(body), body))
+            buf = b""
+            t_end = time.monotonic() + 20
+            while b"\r\n\r\n" not in buf or b"tokens" not in buf:
+                c = s.recv(65536)
+                if not c or time.monotonic() > t_end:
+                    break
+                buf += c
+            assert b" 200 " in buf.split(b"\r\n")[0]
+            s.close()
+            assert srv.decoder.pool.n_free == \
+                srv.decoder.decoder.n_slots
+
+    def test_stream_flag_in_payload(self):
+        """`"stream": true` in the body streams too (no query)."""
+        with _serve() as srv:
+            rng = np.random.default_rng(32)
+            prompt = _prompt(rng, 4)
+            s = _post_raw(srv.host, srv.port, "/generate",
+                          {"prompt": prompt, "max_new_tokens": 3,
+                           "stream": True})
+            head, events = _read_chunked_sse(s)
+            s.close()
+            assert [e for e in events if e.get("done")]
+
+    def test_stream_bad_payload_is_plain_400(self):
+        """Sync rejects must never send the chunked 200 head."""
+        with _serve() as srv:
+            s = _post_raw(srv.host, srv.port, "/generate?stream=1",
+                          {"prompt": []})
+            buf = b""
+            while b"\r\n\r\n" not in buf:
+                buf += s.recv(65536)
+            assert b" 400 " in buf.split(b"\r\n")[0]
+            assert b"text/event-stream" not in buf
+            s.close()
+
+    @pytest.mark.parametrize("frontend", ["eventloop", "threaded"])
+    @pytest.mark.chaos
+    def test_mid_stream_disconnect_frees_slot_and_pages(
+            self, frontend):
+        with _serve(frontend=frontend) as srv:
+            sched = srv.decoder
+            rng = np.random.default_rng(33)
+            s = _post_raw(srv.host, srv.port, "/generate?stream=1",
+                          {"prompt": _prompt(rng, 3),
+                           "max_new_tokens": 100_000})
+            # see the 200 head (stream live), then slam the socket
+            assert b" 200 " in s.recv(4096)[:20]
+            s.close()
+            t_end = time.monotonic() + 15
+            while time.monotonic() < t_end and \
+                    sched.pool.n_free != sched.decoder.n_slots:
+                time.sleep(0.02)
+            assert sched.pool.n_free == sched.decoder.n_slots
+            assert sched.pages.n_free == sched.pages.n_pages - 1
+            assert sched.stats()["releases"].get(
+                "disconnected", 0) >= 1
+
+    def test_stream_stats_surface(self):
+        with _serve() as srv:
+            rng = np.random.default_rng(34)
+            s = _post_raw(srv.host, srv.port, "/generate?stream=1",
+                          {"prompt": _prompt(rng, 3),
+                           "max_new_tokens": 3})
+            _read_chunked_sse(s)
+            s.close()
+            st = requests.get(
+                f"http://{srv.host}:{srv.port}/stats",
+                timeout=10).json()
+            fr = st["frontend"]
+            assert fr["streams_total"] >= 1
+            assert fr["stream_events_total"] >= 4   # 3 tokens + done
+            body = requests.get(
+                f"http://{srv.host}:{srv.port}/metrics?scope=server",
+                timeout=10).text
+            assert "serving_streams_total" in body
+            assert "serving_decode_pages_free" in body
+
+
+def _spec_setup(n_slots=3, max_len=64, spec_k=4, **kw):
+    from mmlspark_tpu.testing.decode_load import make_spec_model_pair
+    cfg = T.TransformerConfig(vocab=64, d_model=16, n_heads=2,
+                              d_head=8, d_ff=32, n_stages=1,
+                              layers_per_stage=4)
+    params, draft_params, draft_cfg = make_spec_model_pair(
+        cfg, draft_layers=1)
+    dec = TransformerDecoder(params, cfg, n_slots=n_slots,
+                             max_len=max_len,
+                             draft_params=draft_params,
+                             draft_cfg=draft_cfg, spec_k=spec_k, **kw)
+    return params, cfg, dec
+
+
+def _spec_greedy_reference(params, cfg, prompt, n_new):
+    ctx = [int(t) for t in prompt]
+    out = []
+    for _ in range(n_new):
+        lg = T.reference_logits(
+            params, jnp.asarray(np.asarray(ctx, np.int32))[None], cfg)
+        t = int(jnp.argmax(lg[0, -1]))
+        out.append(t)
+        ctx.append(t)
+    return out
+
+
+class TestSpeculativeScheduler:
+    """Speculative decoding through the scheduler: exact greedy
+    parity, per-slot enable, seeded-sampling determinism, acceptance
+    metrics, and the acceptance-gated policy."""
+
+    def _run(self, sched, payloads, timeout=60):
+        ps = [_Pending(p, f"s{i}") for i, p in enumerate(payloads)]
+        for p in ps:
+            sched.submit(p)
+        for p in ps:
+            assert p.event.wait(timeout), "stranded"
+        return ps
+
+    def test_greedy_parity_and_acceptance(self):
+        params, cfg, dec = _spec_setup()
+        sched = DecodeScheduler(dec).start()
+        try:
+            warm = dec.warmup()
+            rng = np.random.default_rng(41)
+            prompts = [[int(t) for t in rng.integers(0, 64, size=n)]
+                       for n in (3, 5, 7)]
+            done = self._run(sched, [
+                {"prompt": pr, "max_new_tokens": 10}
+                for pr in prompts])
+            for pr, p in zip(prompts, done):
+                assert json.loads(p.reply)["tokens"] == \
+                    _spec_greedy_reference(params, cfg, pr, 10)
+            st = sched.stats()["speculative"]
+            assert st["rounds"] > 0 and st["proposed"] > 0
+            assert st["acceptance_rate"] is not None
+            assert dec.n_compiles() == warm   # spec shapes all warmed
+        finally:
+            sched.stop()
+        assert sched.pool.n_free == 3
+        assert sched.pages.n_free == sched.pages.n_pages - 1
+
+    def test_per_slot_opt_out(self):
+        params, cfg, dec = _spec_setup()
+        sched = DecodeScheduler(dec).start()
+        try:
+            rng = np.random.default_rng(42)
+            pr = [int(t) for t in rng.integers(0, 64, size=4)]
+            done = self._run(sched, [
+                {"prompt": pr, "max_new_tokens": 6,
+                 "speculative": False}])
+            assert json.loads(done[0].reply)["tokens"] == \
+                _spec_greedy_reference(params, cfg, pr, 6)
+            assert sched.stats()["speculative"]["rounds"] == 0
+        finally:
+            sched.stop()
+
+    def test_sampled_spec_seeded_determinism(self):
+        """Rejection-sampled speculation is bit-reproducible per seed
+        (the request's own PRNG drives draft draws AND accept
+        draws)."""
+        params, cfg, dec = _spec_setup()
+        sched = DecodeScheduler(dec).start()
+        try:
+            rng = np.random.default_rng(43)
+            pr = [int(t) for t in rng.integers(0, 64, size=5)]
+            body = {"prompt": pr, "max_new_tokens": 8,
+                    "temperature": 0.9, "seed": 77,
+                    "speculative": True}
+            a = self._run(sched, [dict(body)])
+            b = self._run(sched, [dict(body)])
+            ta = json.loads(a[0].reply)["tokens"]
+            tb = json.loads(b[0].reply)["tokens"]
+            assert ta == tb and len(ta) == 8
+            assert sched.stats()["speculative"]["rounds"] > 0
+        finally:
+            sched.stop()
+
+    def test_spec_requires_paged_and_matching_vocab(self):
+        from mmlspark_tpu.testing.decode_load import (
+            make_spec_model_pair,
+        )
+        cfg = T.TransformerConfig(vocab=64, d_model=16, n_heads=2,
+                                  d_head=8, d_ff=32, n_stages=1,
+                                  layers_per_stage=4)
+        params, dp, dcfg = make_spec_model_pair(cfg, draft_layers=1)
+        with pytest.raises(ValueError, match="paged"):
+            TransformerDecoder(params, cfg, n_slots=2, max_len=32,
+                               paged=False, draft_params=dp,
+                               draft_cfg=dcfg)
+
+    def test_speculation_policy_gates_rounds(self):
+        from mmlspark_tpu.serving.policy import SpeculationPolicy
+        pol = SpeculationPolicy(min_rate=0.5, warmup_rounds=2,
+                                reprobe_every=4)
+        assert pol.should_speculate()          # warmup always on
+        pol.note(8, 8)
+        pol.note(8, 8)
+        assert pol.should_speculate()          # healthy acceptance
+        for _ in range(30):
+            pol.note(8, 0)                     # acceptance collapses
+        decisions = [pol.should_speculate() for _ in range(8)]
+        assert decisions.count(True) == 2      # probes only (every 4)
+        assert pol.status()["speculating"] is False
+        pol2 = SpeculationPolicy()
+        sched = DecodeScheduler(_spec_setup()[2], spec_policy=pol2)
+        assert sched.spec_policy is pol2       # injectable
+
+
+class TestReviewHardening:
+    """Regression pins for the PR 11 review findings."""
+
+    def test_page_size_must_be_power_of_two(self):
+        """page_size=24 divides max_len=96 but cannot chunk the pow2
+        prompt buckets — the constructor must refuse, not crash at
+        prefill."""
+        with pytest.raises(ValueError, match="power of two"):
+            _decoder(max_len=96, paged=True, page_size=24)
+        _decoder(max_len=96, paged=True, page_size=32)   # fine
+
+    def test_stream_query_parsed_not_substringed(self):
+        """?stream=10 / ?upstream=1 must NOT upgrade to SSE."""
+        from mmlspark_tpu.serving.server import _stream_requested
+        assert _stream_requested("/generate?stream=1", {})
+        assert _stream_requested("/generate?a=b&stream=1", {})
+        assert not _stream_requested("/generate?stream=10", {})
+        assert not _stream_requested("/generate?upstream=1", {})
+        assert not _stream_requested("/generate", {"stream": 1})
+        assert _stream_requested("/generate", {"stream": True})
+
+    def test_wedged_stream_reaped_by_request_timeout(self):
+        """A stream whose producer never emits must not park the
+        client forever: the sweep drops it after request_timeout and
+        flags the handle closed."""
+        import socket as _socket
+        from mmlspark_tpu.serving.frontend import EventLoopFrontend
+        handles = []
+
+        class App:
+            def handle_request(self, method, path, headers, body,
+                               reply):
+                handles.append(reply.begin_stream())
+                return True          # ... and never emit
+
+        fe = EventLoopFrontend(App(), port=0,
+                               request_timeout=0.3).start()
+        try:
+            s = _socket.create_connection((fe.host, fe.port),
+                                          timeout=10)
+            s.sendall(b"POST /x HTTP/1.1\r\nHost: x\r\n"
+                      b"Content-Length: 0\r\n\r\n")
+            head = s.recv(4096)
+            assert b" 200 " in head[:20]
+            s.settimeout(5)
+            assert s.recv(4096) == b""       # dropped by the sweep
+            t_end = time.monotonic() + 5
+            while not handles[0].closed and time.monotonic() < t_end:
+                time.sleep(0.02)
+            assert handles[0].closed         # producer was flagged
+            assert fe.n_request_timeouts >= 1
+        finally:
+            fe.stop()
+
+    def test_draft_cache_stays_warm_through_suppressed_rounds(self):
+        """Policy-suppressed rounds still advance the draft cache, so
+        a probe round proposes from real rows and acceptance recovers
+        (the 'never sticky-dead' contract actually holds)."""
+        from mmlspark_tpu.serving.policy import SpeculationPolicy
+        params, cfg, dec = _spec_setup(n_slots=2, max_len=128)
+        # impossible min_rate: exactly one leading spec round, then
+        # suppression with a probe every 3rd round
+        pol = SpeculationPolicy(min_rate=2.0, warmup_rounds=0,
+                                reprobe_every=3)
+        sched = DecodeScheduler(dec, spec_policy=pol).start()
+        try:
+            rng = np.random.default_rng(51)
+            pr = [int(t) for t in rng.integers(0, 64, size=4)]
+            p = _Pending({"prompt": pr, "max_new_tokens": 40}, "long")
+            sched.submit(p)
+            assert p.event.wait(60)
+            assert json.loads(p.reply)["tokens"] == \
+                _spec_greedy_reference(params, cfg, pr, 40)
+            st = sched.stats()["speculative"]
+            # probes ran beyond the first round, and the tempered
+            # self-drafting pair kept accepting on them — stale draft
+            # rows would have cratered this to ~0
+            assert st["rounds"] >= 2
+            assert st["accepted"] / st["proposed"] > 0.8
+            assert pol.n_suppressed > 0      # suppression really on
+        finally:
+            sched.stop()
+        assert sched.pool.n_free == 2
+        assert sched.pages.n_free == sched.pages.n_pages - 1

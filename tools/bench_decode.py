@@ -14,7 +14,11 @@ zero-alloc/zero-retrace evidence:
 shrinks the model and workload, asserts the gates — zero post-warmup
 recompiles, in-place cache donation (stable buffer pointer), zero
 steady-state live-array growth, continuous >= static — and exits
-non-zero on violation.
+non-zero on violation. It also runs the ISSUE 11 acceptance pair
+(already CI-sized): ``decode_paged_v1`` (>= 2x concurrent sessions at
+fixed cache HBM, dense-parity, zero recompiles, donated page pool)
+and ``decode_speculative_v1`` (>= 1.3x tokens/s at measured
+acceptance >= 0.6 with exact greedy parity).
 
 ``--http`` additionally drives the full serving stack (HTTP ->
 admission -> DecodeScheduler) with concurrent clients and reports the
@@ -158,6 +162,22 @@ def main() -> int:
         gates["http_no_errors"] = not out["http"]["errors"]
         gates["http_slots_all_freed"] = (out["http"]["slots_free"]
                                          == out["http"]["n_slots"])
+    if args.smoke:
+        # the ISSUE 11 acceptance pair, CI-sized already: paged
+        # sessions-at-fixed-HBM + speculative tokens/s A/B, each with
+        # its own recompile/donation/parity gates baked in
+        import bench as _bench
+        paged = _bench.bench_decode_paged()
+        spec = _bench.bench_decode_speculative()
+        out["paged"] = {k: paged[k] for k in
+                        ("value", "baseline", "vs_baseline",
+                         "tokens_per_s", "token_parity", "passed")}
+        out["speculative"] = {k: spec[k] for k in
+                              ("value", "baseline", "vs_baseline",
+                               "acceptance_rate", "token_parity",
+                               "passed")}
+        gates["paged_2x_sessions_at_fixed_hbm"] = paged["passed"]
+        gates["speculative_speedup"] = spec["passed"]
     out["gates"] = gates
     out["passed"] = all(gates.values())
     print(json.dumps(out, indent=2))
