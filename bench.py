@@ -64,6 +64,14 @@ Plus (no era analogue, utilization/latency evidence):
                                    sharded-checkpoint topology drill
                                    (2x2 save -> 4x1/1x1 restore,
                                    digests verified)
+ 18. retrain_loop_v1             — the retrain->redeploy loop end to
+                                   end: live traffic -> capture ->
+                                   fit_stream (with an injected crash/
+                                   restart of the streaming query,
+                                   exactly-once pinned) -> RetrainLoop
+                                   -> canary rollout -> coherent fleet
+                                   on the retrained version, zero
+                                   dropped replies
 
 Every line carries chip metadata (platform/device kind/count) so the
 numbers are interpretable across hosts.
@@ -1391,6 +1399,238 @@ def bench_multihost_scaling():
             "chip": _chip()}
 
 
+def bench_retrain_loop():
+    """The retrain->redeploy loop end to end (ISSUE 12 acceptance).
+
+    Two live workers + a coordinator serve a v1 MLP while background
+    keep-alive-ish traffic runs; committed request/reply rows journal
+    into the traffic capture; a ``fit_stream`` query trains the model
+    from its own traffic — with an INJECTED CRASH of the streaming
+    query between the trainer-sink write and the commit-log append,
+    then a restart from the same checkpoints — and exports a
+    digest-manifested checkpoint a ``RetrainLoop`` pushes through
+    ``POST /rollout`` (canary on).
+
+    Gates (``passed``): the loop COMPLETES (rollout ``completed``),
+    the fleet ends version-coherent on the retrained checkpoint, ZERO
+    dropped/wrong replies across the whole run (every request a
+    well-formed 200 — zero downtime), and EXACTLY-ONCE sink counts
+    across the injected crash (the replayed batch id is detected and
+    skipped: no micro-batch trains twice). ``value`` is the
+    traffic-to-redeployed wall-clock of the loop's rollout leg.
+    """
+    import tempfile
+    import threading
+
+    import requests
+
+    from mmlspark_tpu.core.resilience import RetryPolicy
+    from mmlspark_tpu.core.stage import PipelineStage
+    from mmlspark_tpu.models.function import NNFunction
+    from mmlspark_tpu.models.nn import NNModel
+    from mmlspark_tpu.models.trainer import NNLearner
+    from mmlspark_tpu.serving import (
+        ServingCoordinator, ServingServer, TrafficCapture)
+    from mmlspark_tpu.streaming import RetrainLoop, TrafficLogSource
+
+    tmp = tempfile.mkdtemp(prefix="retrain_loop_")
+    v1_dir = os.path.join(tmp, "v1")
+    fn = NNFunction.init({"builder": "mlp", "hidden": [4],
+                          "num_outputs": 1}, (2,), seed=0)
+    NNModel(model=fn, input_col="x", output_col="scores").save(v1_dir)
+    capdir = os.path.join(tmp, "cap")
+    warm = {"x": [0.0, 0.0], "label": 0.0}
+
+    def make_fit():
+        learner = NNLearner(
+            arch={"builder": "mlp", "hidden": [4], "num_outputs": 1},
+            features_col="x", label_col="label", loss="squared_error",
+            optimizer="adam", learning_rate=0.02, batch_size=16,
+            checkpoint_dir=os.path.join(tmp, "train"))
+        return learner.fit_stream(
+            TrafficLogSource(capdir),
+            export_dir=os.path.join(tmp, "exp"),
+            # exports on a sane cadence (the trainer keeps running
+            # through the rollout — per-batch exports would flood
+            # hundreds of staging candidates); the exactly-once pin
+            # rides the per-batch TRAIN-STATE checkpoint, which is
+            # independent of the export cadence by design
+            export_every_batches=8,
+            checkpoint_dir=os.path.join(tmp, "wal"),
+            max_batch_rows=16,
+            retry_policy=RetryPolicy(max_attempts=1))
+
+    cap = TrafficCapture(capdir)
+    coord = ServingCoordinator().start()
+    workers = []
+    stop = threading.Event()
+    results = {"ok": 0, "bad": 0}
+    loop = None
+    try:
+        for i in range(2):
+            srv = ServingServer(PipelineStage.load(v1_dir),
+                                max_batch_size=4, max_latency_ms=1,
+                                model_version="v1",
+                                capture=cap if i == 0 else None,
+                                slow_trace_ms=None)
+            srv.warmup(warm)
+            srv.start()
+            ServingCoordinator.register_worker(
+                f"http://{coord.host}:{coord.port}", srv.host, srv.port)
+            workers.append(srv)
+
+        rng = np.random.default_rng(3)
+
+        def traffic():
+            i = 0
+            while not stop.is_set():
+                x = rng.normal(size=2)
+                try:
+                    r = requests.post(
+                        workers[i % 2].address,
+                        json={"x": x.tolist(), "label": float(x.sum())},
+                        timeout=10)
+                    if r.status_code == 200 and "scores" in r.json():
+                        results["ok"] += 1
+                    else:
+                        results["bad"] += 1
+                except Exception:  # noqa: BLE001
+                    results["bad"] += 1
+                i += 1
+                time.sleep(0.004)
+
+        t = threading.Thread(target=traffic, daemon=True)
+        t.start()
+        t0 = time.perf_counter()
+
+        # -- fit run 1, crashed between sink write and commit append
+        fit = make_fit()
+        inner = fit.query.sink
+        crash_at = {"bid": None}
+
+        class Crasher:
+            def process(self, bid, df):
+                inner.process(bid, df)
+                if inner.n_batches_trained == 2 \
+                        and crash_at["bid"] is None:
+                    crash_at["bid"] = bid
+                    raise RuntimeError("injected crash")
+
+        fit.query.sink = Crasher()
+        deadline = time.monotonic() + 60
+        crashed = False
+        while time.monotonic() < deadline and not crashed:
+            try:
+                fit.query.process_available()
+            except RuntimeError:
+                crashed = True
+            time.sleep(0.02)
+        run1 = inner.status()
+
+        # -- fit run 2: restart from the same WAL + train checkpoints;
+        # the crashed batch replays and is SKIPPED (exactly-once)
+        fit2 = make_fit()
+        deadline = time.monotonic() + 60
+        while time.monotonic() < deadline and not fit2.exports:
+            fit2.query.process_available()
+            time.sleep(0.02)
+        run2 = fit2.status()["trainer"]
+        replays = fit2.status()["query"]["n_replayed_batches"]
+
+        # -- the retrain loop drives the rollout. A canary rollback
+        # (box-noise p95 on a shared host) is the safety gate WORKING,
+        # not a loop failure: keep training so newer exports appear
+        # and the loop retries — the gate below waits for a COMPLETED
+        # rollout. p95 ratio is relaxed vs the production default
+        # because 20-request windows on a noisy sandbox are sparse.
+        t_roll = time.perf_counter()
+        loop = RetrainLoop(
+            os.path.join(tmp, "exp"),
+            f"http://{coord.host}:{coord.port}",
+            warmup_payload=warm, poll_interval_s=0.1,
+            rollout={"canary": True, "canary_min_requests": 20,
+                     "canary_window_s": 5.0, "max_p95_ratio": 10.0,
+                     "stage_timeout_s": 60.0}).start()
+        # wait for a COMPLETED rollout: rollbacks/failures along the
+        # way retry with the next export (that resilience IS the loop)
+        deadline = time.monotonic() + 120
+        while time.monotonic() < deadline and loop.n_completed == 0:
+            fit2.query.process_available()   # fresh exports keep coming
+            time.sleep(0.1)
+        loop.stop()
+        redeploy_s = time.perf_counter() - t_roll
+        total_s = time.perf_counter() - t0
+        stop.set()
+        t.join(timeout=10)
+
+        # the loop may have pushed a SECOND (newer) export before
+        # stop() landed: wait for the coordinator's in-flight rollout
+        # to reach a terminal state before judging fleet coherence —
+        # reading /version mid-flip is a harness race, not a finding
+        deadline = time.monotonic() + 60
+        while time.monotonic() < deadline:
+            st = requests.get(
+                f"http://{coord.host}:{coord.port}/rollout",
+                timeout=5).json()
+            if st.get("state") in ("idle", "completed", "rolled_back",
+                                   "failed"):
+                break
+            time.sleep(0.1)
+        versions = []
+        for srv in workers:
+            v = requests.get(f"http://{srv.host}:{srv.port}/version",
+                             timeout=5).json()
+            versions.append(v["active"]["version"])
+        completed = [h["version"] for h in loop.status()["history"]
+                     if h.get("state") == "completed"]
+        if st.get("state") == "completed":
+            completed.append(st["version"])
+        # a trailing rolled-back push leaves the fleet on the last
+        # COMPLETED version — that is the coherence target
+        new_version = completed[-1] if completed else None
+        exactly_once = (crashed and replays >= 1
+                        and run2["n_replays_skipped"] >= 1
+                        and run1["last_trained_batch"]
+                        == crash_at["bid"])
+        coherent = (len(set(versions)) == 1
+                    and versions[0] == new_version)
+        ok = (bool(completed) and coherent
+              and results["bad"] == 0 and results["ok"] > 0
+              and exactly_once)
+    finally:
+        stop.set()
+        if loop is not None:
+            # an exception mid-bench must not leave the loop's poll
+            # thread warning at a dead coordinator for later benches
+            loop.stop()
+        for srv in workers:
+            srv.stop()
+        coord.stop()
+
+    return {"metric": "retrain_loop_v1", "value": round(redeploy_s, 3),
+            "unit": "seconds export->fleet-redeployed (canary incl.)",
+            "loop_total_s": round(total_s, 3),
+            "rollout_state": "completed" if completed else (
+                (loop.status()["history"] or [{}])[-1].get("state")),
+            "canary_rollbacks_along_the_way": loop.n_rolled_back,
+            "new_version": new_version,
+            "fleet_versions": versions,
+            "version_coherent": coherent,
+            "requests_ok": results["ok"],
+            "requests_bad": results["bad"],
+            "crash_injected_at_batch": crash_at["bid"],
+            "replayed_batches": replays,
+            "replays_skipped_by_trainer": run2["n_replays_skipped"],
+            "rows_trained": run1["n_rows_trained"]
+            + run2["n_rows_trained"],
+            "batches_trained": run1["n_batches_trained"]
+            + run2["n_batches_trained"],
+            "exports": run2["n_exports"],
+            "exactly_once": exactly_once,
+            "capture": cap.status(),
+            "passed": ok, "chip": _chip()}
+
+
 BENCHES = [bench_gbdt_quantile, bench_adult_census, bench_cifar10_scoring,
            bench_cifar10_scoring_uint8, bench_imagenet_scoring,
            bench_transfer_learning, bench_distributed_sgd,
@@ -1401,7 +1641,7 @@ BENCHES = [bench_gbdt_quantile, bench_adult_census, bench_cifar10_scoring,
            bench_telemetry_overhead, bench_tracing_overhead,
            bench_trace_propagation, bench_decode_continuous,
            bench_decode_paged, bench_decode_speculative,
-           bench_multihost_scaling]
+           bench_multihost_scaling, bench_retrain_loop]
 
 
 def main() -> None:

@@ -628,3 +628,382 @@ class NNLearner(Estimator, HasLabelCol, HasFeaturesCol):
             restored = mngr.restore(latest, template)
         print(f"[NNLearner] resumed from step {latest}")
         return restored["params"], restored["opt_state"], latest
+
+    # -- incremental training from a stream ---------------------------------
+
+    def fit_stream(self, source, export_dir: Optional[str] = None,
+                   export_every_batches: int = 4,
+                   export_prefix: str = "r",
+                   steps_per_batch: int = 1,
+                   checkpoint_every_batches: int = 1,
+                   transform=None,
+                   **query_kwargs) -> "StreamingFit":
+        """Train incrementally from a micro-batch stream.
+
+        ``source`` is either an engine source (``plan``/``read``/
+        ``ack`` — e.g. a :class:`~mmlspark_tpu.streaming.traffic.
+        TrafficLogSource` over served-traffic capture segments) from
+        which a :class:`~mmlspark_tpu.streaming.engine.StreamingQuery`
+        is built (``query_kwargs`` forwarded — ``checkpoint_dir`` for
+        the WAL, watermarks, backpressure knobs), or an already-built
+        ``StreamingQuery`` whose sink slot is free — ``fit_stream``
+        installs itself as the sink either way.
+
+        Semantics: every micro-batch becomes ``steps_per_batch``
+        gradient steps on the SAME mesh-sharded, donated jitted step
+        ``fit`` uses (rows padded to the data-axis multiple on a
+        power-of-two ladder, pad rows zero-weighted — the compiled
+        shape set stays bounded). With ``checkpoint_dir`` (the Param)
+        set, the fit WARM-STARTS from the latest digest-manifested
+        train-state checkpoint and saves one every
+        ``checkpoint_every_batches`` batches (default 1: EVERY
+        trained batch), recording the high-water stream batch id
+        inside it — a post-crash replayed batch id at or below that
+        mark is SKIPPED, which is what makes this sink idempotent and
+        the end-to-end loop exactly-once. Raising
+        ``checkpoint_every_batches`` above 1 trades durability for
+        save cost: batches the engine committed AFTER the last
+        train-state checkpoint warm-start as if untrained after a
+        crash (at-most-once inside that window) — acceptable for
+        training (a lost gradient step is not a lost reply), but the
+        default keeps the strict contract. With ``export_dir`` set, a
+        servable ``NNModel`` stage checkpoint is exported every
+        ``export_every_batches`` batches on its own cadence (manifest
+        written last, so every export is flip-eligible the moment it
+        appears — what a
+        :class:`~mmlspark_tpu.streaming.loop.RetrainLoop` watches).
+
+        Streaming fits use a constant learning rate (no fixed horizon
+        to decay over); ``cosine_decay``/``warmup_steps`` are ignored.
+        Returns a :class:`StreamingFit` handle (drive the query
+        synchronously via ``handle.query.process_available()`` or
+        threaded via ``handle.query.start()``).
+        """
+        from mmlspark_tpu.streaming.engine import StreamingQuery
+        sink = _StreamTrainerSink(self, export_dir=export_dir,
+                                  export_every=export_every_batches,
+                                  export_prefix=export_prefix,
+                                  steps_per_batch=steps_per_batch,
+                                  checkpoint_every=checkpoint_every_batches)
+        if isinstance(source, StreamingQuery):
+            if source.sink is not None:
+                raise ValueError(
+                    "fit_stream needs the query's sink slot (build the "
+                    "StreamingQuery with sink=None)")
+            if query_kwargs or transform is not None:
+                raise ValueError(
+                    "pass transform/query knobs when fit_stream builds "
+                    "the query, not alongside a pre-built one")
+            query = source
+            query.sink = sink
+        else:
+            query_kwargs.setdefault("name", "fit_stream")
+            query = StreamingQuery(source, sink=sink,
+                                   transform=transform, **query_kwargs)
+        return StreamingFit(query, sink)
+
+
+def _as_label(v) -> float:
+    """A usable numeric label or NaN (filtered): captured traffic rows
+    carry JSON values, so None holes / strings / lists are expected."""
+    try:
+        if isinstance(v, (list, tuple, np.ndarray)):
+            return float("nan")
+        return float(v)
+    except (TypeError, ValueError):
+        return float("nan")
+
+
+class _StreamTrainerSink:
+    """The ``fit_stream`` sink: micro-batches -> sharded train steps.
+
+    Idempotent by batch id: the train-state checkpoint records the
+    high-water stream batch id it covers, so a replayed batch (the
+    engine re-runs planned-but-uncommitted batches after a crash) at or
+    below the restored mark is skipped — replay beats re-dispatch, and
+    a crash anywhere in the write/commit window never trains a batch
+    twice past a checkpoint. Lazily initialized on the first frame
+    (shapes come from the stream).
+    """
+
+    def __init__(self, learner: NNLearner, export_dir: Optional[str],
+                 export_every: int, export_prefix: str,
+                 steps_per_batch: int, checkpoint_every: int = 1):
+        self.learner = learner
+        self.export_dir = (os.path.abspath(export_dir)
+                           if export_dir else None)
+        self.export_every = max(int(export_every), 1)
+        self.export_prefix = str(export_prefix)
+        self.steps_per_batch = max(int(steps_per_batch), 1)
+        self.checkpoint_every = max(int(checkpoint_every), 1)
+        self._ready = False
+        self._was_int = False
+        self.last_trained_batch = 0
+        self.global_step = 0
+        self.n_batches_trained = 0
+        self.n_rows_trained = 0
+        self.n_replays_skipped = 0
+        self.n_rows_unlabeled = 0
+        self.n_batches_unusable = 0
+        self.n_exports = 0
+        self.exports: "list[str]" = []
+        self.last_loss: Optional[float] = None
+
+    # -- lazy setup ----------------------------------------------------------
+
+    def _setup(self, x: np.ndarray) -> None:
+        import jax
+        from mmlspark_tpu.parallel import dist as _dist
+
+        learner = self.learner
+        self._was_int = x.dtype == np.uint8
+        shape = ((x.shape[1:]) if x.ndim > 1 else ())
+        fn = learner.model or NNFunction.init(
+            learner.arch, shape, seed=learner.seed)
+        self._arch = dict(fn.arch)
+        module = fn.module()
+        self._mesh = build_mesh(MeshSpec.from_dict(learner.mesh_shape)
+                                if learner.mesh_shape else None)
+        self._n_data = self._mesh.shape.get("data", 1)
+        # a stream has no fixed horizon: constant learning rate (the
+        # schedule params cosine_decay/warmup_steps are batch-fit only)
+        tx = make_optimizer(learner.optimizer, learner.learning_rate,
+                            learner.momentum, learner.weight_decay,
+                            learner.clip_norm)
+        self._step = jax.jit(
+            learner.build_train_step(module, tx, make_loss(learner.loss)),
+            donate_argnums=(0, 1))
+        repl = _dist.state_shardings(fn.params, self._mesh)
+        params = jax.device_put(fn.params, repl)
+        opt_state = tx.init(params)
+        opt_repl = _dist.state_shardings(opt_state, self._mesh)
+        opt_state = jax.device_put(opt_state, opt_repl)
+        self._repl, self._opt_repl = repl, opt_repl
+        self._dist = _dist
+        self._mngr = learner._checkpoint_manager()
+        if self._mngr is not None:
+            # host-side template BEFORE any step: the donated buffers
+            # are not restore-safe afterwards (same rule as fit)
+            template = {"params": jax.device_get(params),
+                        "opt_state": jax.device_get(opt_state)}
+            self._template = template
+            latest = self._mngr.latest_step()
+            if latest is not None:
+                restored = self._mngr.restore(latest, template)
+                params = jax.device_put(restored["params"], repl)
+                opt_state = jax.device_put(restored["opt_state"],
+                                           opt_repl)
+                self.global_step = int(latest)
+                from mmlspark_tpu.io.checkpoint import read_index
+                extra = read_index(
+                    self._mngr._step_dir(latest)).get("extra", {})
+                self.last_trained_batch = int(
+                    extra.get("stream_batch_id", 0))
+                self.n_exports = int(extra.get("n_exports", 0))
+                print(f"[NNLearner] fit_stream warm-started from step "
+                      f"{latest} (stream batch "
+                      f"{self.last_trained_batch})")
+        if self.export_dir:
+            os.makedirs(self.export_dir, exist_ok=True)
+            # continue the export sequence past anything already there
+            # (a restarted loop must never reuse a pushed version name)
+            for name in os.listdir(self.export_dir):
+                if name.startswith(self.export_prefix):
+                    try:
+                        self.n_exports = max(
+                            self.n_exports,
+                            int(name[len(self.export_prefix):]))
+                    except ValueError:
+                        continue
+        self._params, self._opt = params, opt_state
+
+    # -- the sink ------------------------------------------------------------
+
+    def process(self, batch_id: int, df: DataFrame) -> None:
+        from mmlspark_tpu.models.nn import _stack_column
+        from mmlspark_tpu.parallel.sharding import (
+            pad_to_bucket, pad_to_multiple)
+
+        learner = self.learner
+        if df.num_rows == 0 or learner.features_col not in df:
+            return
+        # bad DATA must never kill the retrain loop: captured traffic
+        # routinely mixes labeled (feedback) and unlabeled (plain
+        # inference) rows, and a malformed payload is a data problem,
+        # not a query-terminal fault. Rows without a usable numeric
+        # label are dropped (counted); a batch with nothing trainable
+        # is ignored — deterministically, so a replay skips it too.
+        try:
+            if learner.label_col in df:
+                y_raw = df[learner.label_col]
+                if y_raw.dtype == object:
+                    y = np.array([_as_label(v) for v in y_raw],
+                                 dtype=np.float32)
+                else:
+                    y = np.asarray(y_raw, dtype=np.float32)
+                mask = np.isfinite(y)
+            else:
+                y = np.zeros(df.num_rows, dtype=np.float32)
+                mask = np.zeros(df.num_rows, dtype=bool)
+            n_bad = int(df.num_rows - mask.sum())
+            if n_bad:
+                self.n_rows_unlabeled += n_bad
+            if not mask.any():
+                return
+            if n_bad:
+                df = df.filter(mask)
+                y = y[mask]
+            x = _stack_column(df[learner.features_col])
+            if not self._ready:
+                self._setup(x)
+                self._ready = True
+            if self._was_int and x.dtype == np.uint8:
+                x = x.astype(np.float32) / 255.0
+            elif x.dtype != np.float32:
+                x = np.asarray(x, dtype=np.float32)
+            w = (np.asarray(df[learner.weight_col], dtype=np.float32)
+                 if learner.weight_col and learner.weight_col in df
+                 else np.ones(len(y), dtype=np.float32))
+        except (KeyError, TypeError, ValueError) as e:
+            # a data-shape problem (ragged features, non-numeric
+            # payloads): skip the batch loudly, keep the stream alive
+            self.n_batches_unusable += 1
+            from mmlspark_tpu.core.logs import get_logger
+            get_logger("trainer").warning(
+                "fit_stream batch %d unusable (%s: %s); skipped",
+                batch_id, type(e).__name__, e)
+            return
+        if batch_id <= self.last_trained_batch:
+            # the idempotent-sink contract: this batch is already
+            # inside the restored checkpoint's high-water mark
+            self.n_replays_skipped += 1
+            return
+        # two-stage pad: power-of-two bucket (bounded compile set under
+        # ragged stream batches), then the data-axis multiple; pad rows
+        # carry zero weight so they contribute nothing to the loss
+        cap = max(int(learner.batch_size), self._n_data)
+        xp, n_real = pad_to_bucket(x, cap=cap)
+        xp, _ = pad_to_multiple(xp, self._n_data)
+        target = len(xp)
+        yp = np.zeros(target, dtype=np.float32)
+        yp[:n_real] = y[:n_real]
+        wp = np.zeros(target, dtype=np.float32)
+        wp[:n_real] = w[:n_real]
+        metrics = _metrics()
+        for _ in range(self.steps_per_batch):
+            t0 = time.perf_counter()
+            placed, _ = self._dist.put_batch(
+                {"x": xp, "y": yp, "w": wp}, self._mesh)
+            self._params, self._opt, loss = self._step(
+                self._params, self._opt,
+                placed["x"], placed["y"], placed["w"])
+            self.global_step += 1
+            dt = time.perf_counter() - t0
+            metrics["step_ms"].observe(dt * 1000.0)
+            if dt > 0:
+                metrics["examples_per_sec"].observe(n_real / dt)
+        self.last_loss = float(loss)
+        self.last_trained_batch = int(batch_id)
+        self.n_batches_trained += 1
+        self.n_rows_trained += int(n_real)
+        # two independent cadences: the train-state checkpoint is the
+        # exactly-once high-water mark (default every batch — raising
+        # the cadence opens an at-most-once window after a crash, see
+        # fit_stream); the servable export is the rollout feed
+        if self.n_batches_trained % self.checkpoint_every == 0:
+            self._save_train_state()
+        if self.export_dir \
+                and self.n_batches_trained % self.export_every == 0:
+            self._export()
+
+    # -- checkpoint + servable export ----------------------------------------
+
+    def _save_train_state(self) -> None:
+        """Save the train state; the idempotence high-water mark
+        (``stream_batch_id``) rides in ``extra``."""
+        if self._mngr is None:
+            return
+        with _metrics()["ckpt_save_ms"].time():
+            self._mngr.save(
+                self.global_step,
+                {"params": self._params, "opt_state": self._opt},
+                extra={"stream_batch_id": self.last_trained_batch,
+                       "n_exports": self.n_exports})
+
+    def _export(self) -> Optional[str]:
+        """Export a servable NNModel stage checkpoint whose digest
+        manifest lands LAST — flip-eligible for the rollout plane the
+        moment the directory is complete."""
+        if not self.export_dir:
+            return None
+        self.n_exports += 1
+        name = f"{self.export_prefix}{self.n_exports:06d}"
+        path = os.path.join(self.export_dir, name)
+        self.model().save(path)
+        self.exports.append(path)
+        return path
+
+    def checkpoint_and_export(self) -> Optional[str]:
+        """Off-cadence save + export (drain/shutdown; ``export_now``)."""
+        if not self._ready:
+            return None
+        path = self._export()
+        self._save_train_state()
+        return path
+
+    def model(self) -> NNModel:
+        """A servable snapshot of the current streamed-trained model."""
+        if not self._ready:
+            raise RuntimeError("fit_stream has not seen a batch yet")
+        import jax
+        fn = NNFunction(arch=dict(self._arch),
+                        params=jax.device_get(self._params))
+        extra = {"input_dtype": "uint8"} if self._was_int else {}
+        return NNModel(model=fn, input_col=self.learner.features_col,
+                       output_col="scores", **extra)
+
+    def status(self) -> Dict[str, Any]:
+        return {"ready": self._ready,
+                "global_step": self.global_step,
+                "last_trained_batch": self.last_trained_batch,
+                "n_batches_trained": self.n_batches_trained,
+                "n_rows_trained": self.n_rows_trained,
+                "n_replays_skipped": self.n_replays_skipped,
+                "n_rows_unlabeled": self.n_rows_unlabeled,
+                "n_batches_unusable": self.n_batches_unusable,
+                "n_exports": self.n_exports,
+                "exports": list(self.exports),
+                "last_loss": self.last_loss}
+
+
+class StreamingFit:
+    """Handle over a streaming fit: the query (drive/stop it here) plus
+    the trainer sink's counters, snapshots, and exports."""
+
+    def __init__(self, query, sink: _StreamTrainerSink):
+        self.query = query
+        self._sink = sink
+
+    @property
+    def exports(self) -> "list[str]":
+        return list(self._sink.exports)
+
+    def model(self) -> NNModel:
+        return self._sink.model()
+
+    def export_now(self) -> Optional[str]:
+        """Checkpoint + export outside the cadence (drain/shutdown)."""
+        return self._sink.checkpoint_and_export()
+
+    def status(self) -> Dict[str, Any]:
+        return {"trainer": self._sink.status(),
+                "query": self.query.status()}
+
+    def stop(self) -> None:
+        self.query.stop()
+
+    def __enter__(self) -> "StreamingFit":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.stop()

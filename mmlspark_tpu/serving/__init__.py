@@ -20,6 +20,7 @@ every worker's ``/stats`` (naming the slowest stage fleet-wide) and
 from mmlspark_tpu.serving.server import (
     ServingClient, ServingCoordinator, ServingServer,
 )
+from mmlspark_tpu.serving.capture import TrafficCapture
 from mmlspark_tpu.serving.consolidator import PartitionConsolidator
 from mmlspark_tpu.serving.decode import (
     DecodeOverloaded, DecodeScheduler, PagePool, Sampler, SlotPool,
@@ -38,4 +39,4 @@ __all__ = ["ServingServer", "ServingCoordinator", "ServingClient",
            "ModelVersionManager", "RolloutError", "RolloutOrchestrator",
            "DecodeScheduler", "DecodeOverloaded", "SlotPool", "PagePool",
            "TransformerDecoder", "AdaptiveBatchPolicy",
-           "SpeculationPolicy", "Sampler"]
+           "SpeculationPolicy", "Sampler", "TrafficCapture"]

@@ -97,6 +97,18 @@ Environment:
                    cap: buffered pipelined requests served per
                    connection per event-loop pass (default 16; one
                    flooding connection cannot monopolize a loop)
+  CAPTURE_DIR      (worker, optional) opt-in traffic capture: committed
+                   request/reply rows (plus sampled shadow-diff rows
+                   during rollouts) journal into rotating JSON-line
+                   segments under this directory — the feedstock of
+                   the retrain loop (docs/streaming.md). Bounded and
+                   non-blocking: a slow disk drops sampled batches,
+                   never delays replies
+  CAPTURE_SAMPLE_EVERY / CAPTURE_MAX_SEGMENTS / CAPTURE_SEGMENT_BYTES
+                   (worker, optional) capture knobs: sample every Nth
+                   committed batch (default 1 = all), keep at most N
+                   segments (default 64) of at most N bytes each
+                   (default 4 MiB)
   PUSH_GATEWAY_URL / PUSH_INTERVAL_S
                    (worker, optional) remote-write: POST the worker's
                    metrics exposition (per-server + process registry)
@@ -142,6 +154,18 @@ def run_worker() -> None:
     port = int(os.environ.get("PORT", "8000"))
     ttl = _env_float("JOURNAL_TTL", 0.0)
     acceptors = int(_env_float("ACCEPTORS", 1))
+    capture = None
+    capture_dir = os.environ.get("CAPTURE_DIR")
+    if capture_dir:
+        from mmlspark_tpu.serving.capture import TrafficCapture
+        capture = TrafficCapture(
+            capture_dir,
+            sample_every=int(_env_float("CAPTURE_SAMPLE_EVERY", 1)),
+            max_segments=int(_env_float("CAPTURE_MAX_SEGMENTS", 64)),
+            max_segment_bytes=int(
+                _env_float("CAPTURE_SEGMENT_BYTES", 4 << 20)))
+        print(f"[serving] capturing traffic to {capture_dir}",
+              flush=True)
     srv = ServingServer(
         model, host="0.0.0.0", port=port,
         max_batch_size=int(_env_float("MAX_BATCH_SIZE", 64)),
@@ -167,7 +191,8 @@ def run_worker() -> None:
             _env_float("MAX_PIPELINED_PER_ITER", 16)),
         model_version=os.environ.get("MODEL_VERSION", "v1"),
         verify_checkpoints=_env_float("VERIFY_CHECKPOINTS", 1) != 0,
-        batch_policy=os.environ.get("BATCH_POLICY", "fixed"))
+        batch_policy=os.environ.get("BATCH_POLICY", "fixed"),
+        capture=capture)
     warm = os.environ.get("WARMUP_PAYLOAD")
     if warm:
         # warm BEFORE start(): the socket is already bound (early

@@ -483,6 +483,16 @@ class ModelVersionManager:
                 staged.record_shape(self._server._shape_key(df))
                 self._compare(df, out, shadow_out)
                 self.n_shadow_batches += 1
+                # shadow-output sampling (the PR 7 follow-up): a
+                # bounded slice of each mirrored batch — inputs, live
+                # outputs, staged outputs side by side — lands in the
+                # traffic-capture journal for offline diffing beyond
+                # the in-process mismatch counters. Non-blocking.
+                cap = getattr(self._server, "capture", None)
+                if cap is not None:
+                    cap.offer_shadow(self._active.version,
+                                     staged.version, df, out,
+                                     shadow_out)
             except Exception as e:  # noqa: BLE001 — a failing staged
                 # model is exactly what shadowing exists to observe
                 self.n_shadow_errors += 1

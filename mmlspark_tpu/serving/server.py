@@ -233,6 +233,7 @@ class ServingServer:
                  decoder: Optional[DecodeScheduler] = None,
                  decode_path: str = "/generate",
                  batch_policy: str = "fixed",
+                 capture=None,
                  clock: Clock = SYSTEM_CLOCK):
         self.api_path = api_path
         self.max_batch_size = int(max_batch_size)
@@ -486,6 +487,19 @@ class ServingServer:
         self._journal_queue: "Queue[bytes]" = Queue()
         if journal_path:
             self._recover_journal()
+        # -- traffic capture (optional): an opt-in, bounded,
+        # NON-BLOCKING journal of committed request/reply rows (plus
+        # sampled shadow-diff rows) — the feedstock of the retrain
+        # loop. The encoder stage offers each committed batch; a
+        # dedicated writer thread does all file I/O, and a full queue
+        # drops the batch (counted) rather than delay live traffic.
+        # See serving/capture.py and docs/streaming.md.
+        self.capture = capture
+        # warmup() flips this around its synthetic batches so they are
+        # never captured as traffic (warmup runs serially pre-start)
+        self._in_warmup = False
+        if capture is not None:
+            capture.bind(self.registry)
         if self.decoder is not None:
             # bound last: bind reads the server's clock/tracer/registry
             # and commit path, all of which must exist first
@@ -915,6 +929,10 @@ class ServingServer:
                     "frontend": (self._frontend.stats()
                                  if self._frontend is not None
                                  else {"kind": "threaded"}),
+                    # traffic capture (when opted in): journal rows,
+                    # drop counts, live segment inventory
+                    "capture": (self.capture.status()
+                                if self.capture is not None else None),
                     # process vitals: chaos drills diff these across
                     # kill/restart cycles — uptime proves the restart,
                     # RSS spots the leak
@@ -1721,6 +1739,15 @@ class ServingServer:
             to_commit.append(p)
         self.versions.count_committed(version, len(to_commit))
         self._commit_many(to_commit)
+        # capture AFTER commit: only committed (journal-visible)
+        # request/reply rows feed the retrain loop; offer never blocks.
+        # Synthetic warmup batches are excluded — "nothing is
+        # journaled" for them (see warmup()) covers the capture
+        # journal too, or every worker restart/rollout would feed one
+        # ladder of fabricated operator-payload rows into retraining
+        if self.capture is not None and to_commit \
+                and not self._in_warmup:
+            self.capture.offer(version, to_commit)
 
     def _serve_batch(self, batch: List[_PendingRequest]) -> None:
         """The serial plane: all three stages inline (pipeline=False;
@@ -1751,13 +1778,18 @@ class ServingServer:
             # one batch per reachable bucket: the pow2 ladder clamped at
             # max_batch_size (buckets never exceed the cap)
             sizes = self._bucket_sizes()
-        for n in sizes:
-            batch = [_PendingRequest(payload) for _ in range(n)]
-            # the dispatch stage debits the backlog; synthetic requests
-            # never passed the ingress credit, so balance it here
-            with self._stats_lock:
-                self._n_backlog += len(batch)
-            self._serve_batch(batch)
+        self._in_warmup = True
+        try:
+            for n in sizes:
+                batch = [_PendingRequest(payload) for _ in range(n)]
+                # the dispatch stage debits the backlog; synthetic
+                # requests never passed the ingress credit, so balance
+                # it here
+                with self._stats_lock:
+                    self._n_backlog += len(batch)
+                self._serve_batch(batch)
+        finally:
+            self._in_warmup = False
         return list(sizes)
 
     def _evict_locked(self, rid: str) -> None:
@@ -2172,6 +2204,9 @@ class ServingServer:
         # stop mirroring shadow traffic (the staged version, if any,
         # stays staged — a restart-less stop/start keeps it resident)
         self.versions.close()
+        if self.capture is not None:
+            # flush queued capture rows so a clean stop loses nothing
+            self.capture.stop()
         if self._journal_fh is not None:
             jt = getattr(self, "_journal_thread", None)
             if jt is not None and jt.is_alive():

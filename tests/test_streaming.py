@@ -105,3 +105,114 @@ class TestProfiling:
         with device_trace(str(tmp_path)):
             jnp.ones(8).sum().block_until_ready()
         assert any(tmp_path.rglob("*"))
+
+
+class TestForeachBatchErrors:
+    def test_consumer_exception_is_terminal_and_surfaced(self, tmp_path):
+        """A raising consumer used to kill the daemon thread silently —
+        now it's counted, logged, and terminal on the handle."""
+        src = FileStreamSource(str(tmp_path), poll_interval=0.02)
+
+        def boom(df):
+            raise ValueError("consumer bug")
+
+        handle = src.foreach_batch(boom)
+        assert handle.state == "running"
+        (tmp_path / "x.bin").write_bytes(b"payload")
+        handle.join(timeout=5)
+        assert not handle.is_alive()
+        assert handle.state == "failed"
+        assert isinstance(handle.error, ValueError)
+        assert handle.n_errors == 1
+        assert handle.n_batches == 0             # failed batch not counted
+        assert "consumer bug" in handle.status()["error"]
+        src.stop()
+
+    def test_clean_termination_reports_batches(self, tmp_path):
+        (tmp_path / "a.bin").write_bytes(b"one")
+        src = FileStreamSource(str(tmp_path), poll_interval=0.02)
+        got = []
+        handle = src.foreach_batch(got.append, max_batches=1)
+        handle.join(timeout=5)
+        assert handle.state == "terminated"
+        assert handle.error is None
+        assert handle.n_batches == 1 and len(got) == 1
+        src.stop()
+
+    def test_failed_batch_not_journaled_restart_reoffers(self, tmp_path):
+        data = tmp_path / "data"
+        data.mkdir()
+        ckpt = str(tmp_path / "progress.json")
+        (data / "a.bin").write_bytes(b"one")
+        src = FileStreamSource(str(data), poll_interval=0.02,
+                               checkpoint_location=ckpt)
+
+        def boom(df):
+            raise RuntimeError("no")
+
+        handle = src.foreach_batch(boom)
+        handle.join(timeout=5)
+        assert handle.state == "failed"
+        src.stop()
+        # the failed batch was never journaled: a restart re-offers it
+        src2 = FileStreamSource(str(data), poll_interval=0.02,
+                                checkpoint_location=ckpt)
+        batch = next(src2.batches())
+        assert list(batch["bytes"]) == [b"one"]
+        src2.stop()
+
+
+class TestCheckpointCompaction:
+    def test_dead_paths_compact_out_of_seen_and_journal(self, tmp_path):
+        """The _seen set grew one key per file FOREVER; entries whose
+        path left the disk now compact away at checkpoint time while
+        live files keep their resume semantics."""
+        import json as _json
+
+        data = tmp_path / "data"
+        data.mkdir()
+        ckpt = str(tmp_path / "progress.json")
+        for i in range(5):
+            (data / f"f{i}.bin").write_bytes(b"x")
+        src = FileStreamSource(str(data), poll_interval=0.02,
+                               checkpoint_location=ckpt)
+        list(src.batches(max_batches=1))
+        assert len(src._seen) == 5
+        # a rolling producer deletes consumed files
+        for i in range(4):
+            (data / f"f{i}.bin").unlink()
+        (data / "new.bin").write_bytes(b"y")
+        # drain the generator: the journal commits AFTER the consumer
+        # finishes a batch, and compaction rides that commit
+        [batch] = list(src.batches(max_batches=1))
+        assert os.path.basename(batch["path"][0]) == "new.bin"
+        # compacted: only the two LIVE files' keys remain (f4 + new)
+        assert len(src._seen) == 2
+        journal = set(_json.load(open(ckpt)))
+        assert len(journal) == 2
+        assert all(os.path.exists(k.rsplit(":", 2)[0]) for k in journal)
+        src.stop()
+
+    def test_compaction_applies_on_journal_load(self, tmp_path):
+        import json as _json
+
+        data = tmp_path / "data"
+        data.mkdir()
+        ckpt = tmp_path / "progress.json"
+        (data / "live.bin").write_bytes(b"x")
+        live_key = None
+        src = FileStreamSource(str(data), poll_interval=0.02,
+                               checkpoint_location=str(ckpt))
+        list(src.batches(max_batches=1))
+        live_key = next(iter(src._seen))
+        src.stop()
+        # fake a journal bloated with dead entries from older runs
+        dead = [f"{data}/gone{i}.bin:123:456" for i in range(100)]
+        ckpt.write_text(_json.dumps(dead + [live_key]))
+        src2 = FileStreamSource(str(data), poll_interval=0.02,
+                                checkpoint_location=str(ckpt))
+        assert src2._seen == {live_key}          # dead entries dropped
+        # and the live file is still NOT re-offered
+        batches = list(src2.batches(idle_timeout=0.2))
+        assert batches == []
+        src2.stop()

@@ -1,0 +1,874 @@
+"""The micro-batch streaming engine + the retrain->redeploy loop.
+
+Covers: offset/commit WAL semantics and crash/restart exactly-once
+(the acceptance pin: a kill between sink write and commit-log append
+replays the batch under the same id and an idempotent sink dedupes),
+watermark/window goldens with late data, backpressure (EWMA rate
+adaptation + RetryPolicy/terminal failure), the upgraded
+FileStreamSource engine protocol, TrafficCapture <-> TrafficLogSource
+round trips, fit_stream incremental training with flip-eligible
+exports, and the full end-to-end loop: live fleet -> capture ->
+fit_stream -> RetrainLoop -> POST /rollout -> new version serving.
+"""
+
+import json
+import os
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from mmlspark_tpu.core.dataframe import DataFrame
+from mmlspark_tpu.core.resilience import ManualClock, RetryPolicy
+from mmlspark_tpu.streaming import (
+    MemoryStreamSource, StreamingQuery, WindowSpec,
+)
+from mmlspark_tpu.streaming.traffic import TrafficLogSource
+from mmlspark_tpu.serving.capture import TrafficCapture
+
+
+class RecordingSink:
+    """Idempotent-by-batch-id sink with a crash hook: raises AFTER
+    recording (the 'sink wrote, commit never landed' crash window)."""
+
+    def __init__(self):
+        self.seen = set()
+        self.rows_by_batch = {}
+        self.calls = []
+        self.crash_on = None
+
+    def process(self, bid, df):
+        self.calls.append(bid)
+        if bid not in self.seen:
+            self.seen.add(bid)
+            self.rows_by_batch[bid] = df.num_rows
+        if self.crash_on == bid:
+            self.crash_on = None
+            raise RuntimeError("injected crash between sink and commit")
+
+
+def _rows(n, t0=0.0):
+    return [{"x": float(i), "t": t0 + float(i)} for i in range(n)]
+
+
+class TestEngineBasics:
+    def test_batches_flow_and_wal_written(self, tmp_path):
+        src = MemoryStreamSource()
+        sink = RecordingSink()
+        q = StreamingQuery(src, sink, checkpoint_dir=str(tmp_path),
+                           name="basic", max_batch_rows=4)
+        src.add_rows(_rows(10))
+        n = q.process_available()
+        assert n == 3                      # 4 + 4 + 2
+        assert sink.calls == [1, 2, 3]
+        assert sum(sink.rows_by_batch.values()) == 10
+        assert q.n_batches == 3 and q.n_rows == 10
+        # one offset + one commit file per batch, atomic JSON
+        offs = sorted(os.listdir(tmp_path / "offsets"))
+        coms = sorted(os.listdir(tmp_path / "commits"))
+        assert offs == coms == [f"{i:08d}.json" for i in (1, 2, 3)]
+        with open(tmp_path / "commits" / "00000003.json") as f:
+            assert json.load(f)["batch_id"] == 3
+
+    def test_transform_applied_before_sink(self):
+        src = MemoryStreamSource()
+        got = []
+        q = StreamingQuery(
+            src, lambda bid, df: got.append(df["y"].tolist()),
+            transform=lambda df: df.with_column(
+                "y", np.asarray(df["x"]) * 2))
+        src.add_rows(_rows(3))
+        q.process_available()
+        assert got == [[0.0, 2.0, 4.0]]
+
+    def test_empty_source_is_idle_not_a_batch(self):
+        q = StreamingQuery(MemoryStreamSource(), RecordingSink())
+        assert q.process_available() == 0
+        assert q.n_batches == 0
+
+    def test_threaded_start_stop(self, tmp_path):
+        src = MemoryStreamSource()
+        sink = RecordingSink()
+        q = StreamingQuery(src, sink, checkpoint_dir=str(tmp_path),
+                           trigger_interval_s=0.02, name="threaded")
+        q.start()
+        src.add_rows(_rows(5))
+        deadline = time.monotonic() + 5
+        while time.monotonic() < deadline and q.n_rows < 5:
+            time.sleep(0.01)
+        q.stop()
+        assert q.n_rows == 5
+        assert q.state == "terminated"
+        assert q.await_termination(1.0)
+
+
+class TestExactlyOnce:
+    """The acceptance pin: crash between sink write and commit append,
+    restart from the checkpoint dir, sink saw the batch exactly once."""
+
+    def test_crash_between_sink_and_commit_replays_batch(self, tmp_path):
+        ckpt = str(tmp_path / "wal")
+        src = MemoryStreamSource()
+        src.add_rows(_rows(8))
+        sink = RecordingSink()
+        q = StreamingQuery(src, sink, checkpoint_dir=ckpt,
+                           max_batch_rows=4, name="crash",
+                           retry_policy=RetryPolicy(max_attempts=1))
+        q.process_available(max_batches=1)       # batch 1 committed
+        sink.crash_on = 2
+        with pytest.raises(RuntimeError, match="injected crash"):
+            q.process_available()
+        assert q.state == "failed"
+        assert "injected crash" in q.status()["error"]
+        # batch 2's offset is logged, its commit is not
+        assert os.path.exists(
+            os.path.join(ckpt, "offsets", "00000002.json"))
+        assert not os.path.exists(
+            os.path.join(ckpt, "commits", "00000002.json"))
+
+        # "restart": fresh source re-populated (the durable-source
+        # analogue), fresh query on the same checkpoint dir. The
+        # sink's dedupe store survives, as a transactional sink's must.
+        src2 = MemoryStreamSource()
+        src2.add_rows(_rows(8))
+        q2 = StreamingQuery(src2, sink, checkpoint_dir=ckpt,
+                            max_batch_rows=4, name="crash",
+                            retry_policy=RetryPolicy(max_attempts=1))
+        q2.process_available()
+        # batch 2 was replayed (same id), the sink deduped it, and
+        # every row was processed exactly once
+        assert q2.n_replayed_batches == 1
+        assert sink.calls.count(2) == 2          # offered twice...
+        assert sum(sink.rows_by_batch.values()) == 8   # ...counted once
+        assert sorted(sink.seen) == [1, 2]
+
+    def test_recovery_reacks_committed_offsets(self, tmp_path):
+        """Crash between commit append and source ack: recovery re-acks
+        so the source's cursor catches up instead of re-planning
+        committed rows as a NEW batch id."""
+        ckpt = str(tmp_path / "wal")
+        src = MemoryStreamSource()
+        src.add_rows(_rows(4))
+        q = StreamingQuery(src, RecordingSink(), checkpoint_dir=ckpt,
+                           name="reack")
+        q.process_available()
+        # simulate the torn ack: a fresh source with the same rows but
+        # a zeroed cursor (what a durable source's stale journal is)
+        src2 = MemoryStreamSource()
+        src2.add_rows(_rows(4))
+        sink2 = RecordingSink()
+        q2 = StreamingQuery(src2, sink2, checkpoint_dir=ckpt,
+                            name="reack")
+        assert q2.process_available() == 0       # nothing re-planned
+        assert sink2.calls == []
+
+
+class TestWatermarksAndWindows:
+    def test_tumbling_window_golden(self, tmp_path):
+        clock = ManualClock()
+        src = MemoryStreamSource()
+        emitted = []
+
+        def sink(bid, df):
+            for i in range(df.num_rows):
+                emitted.append((float(df["window_start"][i]),
+                                float(df["window_end"][i]),
+                                int(df["n"][i]), float(df["sx"][i])))
+
+        q = StreamingQuery(
+            src, sink, name="win", checkpoint_dir=str(tmp_path),
+            event_time_col="t", watermark_delay_s=2.0,
+            window=WindowSpec(5.0, aggs={"n": ("count", None),
+                                         "sx": ("sum", "x")}),
+            clock=clock)
+        src.add_rows([{"x": 1.0, "t": 1.0}, {"x": 2.0, "t": 4.0}])
+        q.process_available()
+        assert emitted == []                     # wm = 2.0: nothing closed
+        assert q.watermark == pytest.approx(2.0)
+        src.add_rows([{"x": 3.0, "t": 6.0}, {"x": 4.0, "t": 8.5}])
+        q.process_available()
+        # wm = 6.5: window [0, 5) closes with its two rows
+        assert emitted == [(0.0, 5.0, 2, 3.0)]
+        assert q.watermark == pytest.approx(6.5)
+        # late row (t=3.0 < wm): counted, excluded from state
+        src.add_rows([{"x": 100.0, "t": 3.0}])
+        q.process_available()
+        assert q.n_late_rows == 1
+        src.add_rows([{"x": 5.0, "t": 12.5}])
+        q.process_available()
+        # wm = 10.5: window [5, 10) closes WITHOUT the late 100.0
+        assert emitted[-1] == (5.0, 10.0, 2, 7.0)
+
+    def test_sliding_windows_multi_assign(self):
+        src = MemoryStreamSource()
+        emitted = []
+
+        def sink(bid, df):
+            for i in range(df.num_rows):
+                emitted.append((float(df["window_start"][i]),
+                                int(df["n"][i])))
+
+        q = StreamingQuery(
+            src, sink, name="slide", event_time_col="t",
+            window=WindowSpec(4.0, slide_s=2.0,
+                              aggs={"n": ("count", None)}))
+        # t=3 lands in windows [0,4) and [2,6)
+        src.add_rows([{"t": 3.0}])
+        q.process_available()
+        src.add_rows([{"t": 10.0}])              # wm=10: both close
+        q.process_available()
+        assert (0.0, 1) in emitted and (2.0, 1) in emitted
+
+    def test_watermark_monotone_and_recovered(self, tmp_path):
+        ckpt = str(tmp_path / "wal")
+        src = MemoryStreamSource()
+        q = StreamingQuery(src, RecordingSink(), checkpoint_dir=ckpt,
+                           name="wm", event_time_col="t",
+                           watermark_delay_s=1.0)
+        src.add_rows([{"t": 10.0}])
+        q.process_available()
+        src.add_rows([{"t": 5.0}])               # regression: wm holds
+        q.process_available()
+        assert q.watermark == pytest.approx(9.0)
+        q2 = StreamingQuery(MemoryStreamSource(), RecordingSink(),
+                            checkpoint_dir=ckpt, name="wm",
+                            event_time_col="t", watermark_delay_s=1.0)
+        assert q2.watermark == pytest.approx(9.0)   # from the commit log
+
+    def test_window_state_survives_restart(self, tmp_path):
+        ckpt = str(tmp_path / "wal")
+        spec = WindowSpec(10.0, aggs={"n": ("count", None),
+                                      "sx": ("sum", "x")})
+        src = MemoryStreamSource()
+        q = StreamingQuery(src, RecordingSink(), checkpoint_dir=ckpt,
+                           name="state", event_time_col="t", window=spec)
+        src.add_rows([{"x": 1.0, "t": 1.0}, {"x": 2.0, "t": 3.0}])
+        q.process_available()                    # window [0,10) open
+        emitted = []
+
+        def sink(bid, df):
+            emitted.append((int(df["n"][0]), float(df["sx"][0])))
+
+        # durable-source analogue: the already-committed rows are still
+        # at positions the recovery re-ack will skip past
+        src2 = MemoryStreamSource()
+        src2.add_rows([{"x": 1.0, "t": 1.0}, {"x": 2.0, "t": 3.0}])
+        q2 = StreamingQuery(src2, sink,
+                            checkpoint_dir=ckpt, name="state",
+                            event_time_col="t", window=spec)
+        src2.add_rows([{"x": 4.0, "t": 15.0}])   # closes [0,10)
+        q2.process_available()
+        # the restarted query finalized the window with the PRE-crash
+        # partial aggregates restored from the commit log
+        assert emitted == [(2, 3.0)]
+
+
+class TestBackpressure:
+    def test_rate_adapts_down_on_slow_sink_and_back_up(self):
+        clock = ManualClock()
+        src = MemoryStreamSource()
+        slow = {"ms": 1000.0}
+
+        def sink(bid, df):
+            clock.advance(slow["ms"] / 1000.0)
+
+        q = StreamingQuery(src, sink, name="bp", max_batch_rows=64,
+                           min_batch_rows=1, target_batch_ms=100.0,
+                           clock=clock)
+        for _ in range(6):
+            src.add_rows(_rows(64))
+            q.process_available()
+        assert q.status()["rows_limit"] < 64     # pushed down
+        floor = q.status()["rows_limit"]
+        slow["ms"] = 1.0                         # sink recovers
+        for _ in range(10):
+            src.add_rows(_rows(64))
+            q.process_available()
+        assert q.status()["rows_limit"] > floor  # recovered
+
+    def test_sink_retries_then_succeeds(self):
+        src = MemoryStreamSource()
+        attempts = []
+
+        def flaky(bid, df):
+            attempts.append(bid)
+            if len(attempts) < 3:
+                raise IOError("transient")
+
+        q = StreamingQuery(
+            src, flaky, name="retry",
+            retry_policy=RetryPolicy(max_attempts=4, base=0.001,
+                                     cap=0.002))
+        src.add_rows(_rows(2))
+        q.process_available()
+        assert attempts == [1, 1, 1]             # same batch, in place
+        assert q.n_sink_retries == 2
+        assert q.n_batches == 1 and q.state != "failed"
+
+    def test_retries_exhausted_is_terminal(self):
+        src = MemoryStreamSource()
+
+        def dead(bid, df):
+            raise IOError("sink down")
+
+        q = StreamingQuery(
+            src, dead, name="dead",
+            retry_policy=RetryPolicy(max_attempts=2, base=0.001,
+                                     cap=0.002))
+        src.add_rows(_rows(1))
+        with pytest.raises(IOError):
+            q.process_available()
+        assert q.state == "failed"
+        assert q.n_sink_failures == 1
+        st = q.status()
+        assert "sink down" in st["error"]
+        # a failed query refuses further driving
+        with pytest.raises(Exception):
+            q.run_once()
+
+
+class TestFileSourceEngine:
+    def test_plan_read_ack_and_resume(self, tmp_path):
+        from mmlspark_tpu.io.streaming import FileStreamSource
+        data = tmp_path / "data"
+        data.mkdir()
+        ckpt = str(tmp_path / "progress.json")
+        (data / "a.bin").write_bytes(b"one")
+        (data / "b.bin").write_bytes(b"two")
+        src = FileStreamSource(str(data), checkpoint_location=ckpt)
+        sink = RecordingSink()
+        q = StreamingQuery(src, sink, checkpoint_dir=str(tmp_path / "wal"),
+                           name="files")
+        q.process_available()
+        assert sum(sink.rows_by_batch.values()) == 2
+        # planned-not-re-planned: an immediate second pass is idle
+        assert q.process_available() == 0
+        # resume: a fresh source instance + fresh query skip old files
+        (data / "c.bin").write_bytes(b"three")
+        src2 = FileStreamSource(str(data), checkpoint_location=ckpt)
+        sink2 = RecordingSink()
+        q2 = StreamingQuery(src2, sink2,
+                            checkpoint_dir=str(tmp_path / "wal"),
+                            name="files")
+        q2.process_available()
+        assert sum(sink2.rows_by_batch.values()) == 1
+        assert q2.batch_id == 2                  # ids continue past WAL
+
+
+class TestTrafficCapture:
+    class _P:
+        def __init__(self, i, payload=None):
+            self.rid = f"r{i}"
+            self.trace = f"trace{i}"
+            self.payload = payload or {"x": [float(i)], "label": i % 2}
+            self.reply = b'{"scores": [0.25]}'
+
+    def test_rows_round_trip_with_meta(self, tmp_path):
+        cap = TrafficCapture(str(tmp_path))
+        cap.offer("v1", [self._P(i) for i in range(5)])
+        cap.stop()
+        src = TrafficLogSource(str(tmp_path))
+        df = src.read(src.plan())
+        assert df.num_rows == 5
+        assert df["rid"][0] == "r0" and df["trace_id"][2] == "trace2"
+        assert set(df["version"]) == {"v1"}
+        assert df["x"][3] == [3.0]
+        assert df["scores"][0] == [0.25]
+
+    def test_segment_rotation_and_prune(self, tmp_path):
+        cap = TrafficCapture(str(tmp_path), max_segment_bytes=256,
+                             max_segments=3)
+        for i in range(40):
+            cap.offer("v1", [self._P(i)])
+            cap.flush()
+        cap.stop()
+        segs = [n for n in os.listdir(tmp_path) if n.endswith(".jsonl")]
+        assert 1 <= len(segs) <= 3
+        assert cap.n_segments_rotated > 0
+        assert cap.n_segments_pruned > 0
+
+    def test_offer_never_blocks_when_writer_behind(self, tmp_path,
+                                                   monkeypatch):
+        cap = TrafficCapture(str(tmp_path), queue_depth=1)
+        monkeypatch.setattr(cap, "_ensure_writer", lambda: None)
+        cap.offer("v1", [self._P(0)])
+        cap.offer("v1", [self._P(1)])            # queue full -> drop
+        assert cap.n_dropped_batches == 1
+
+    def test_batch_sampling(self, tmp_path, monkeypatch):
+        cap = TrafficCapture(str(tmp_path), sample_every=2,
+                             queue_depth=64)
+        monkeypatch.setattr(cap, "_ensure_writer", lambda: None)
+        for i in range(6):
+            cap.offer("v1", [self._P(i)])
+        assert cap._q.qsize() == 3               # every 2nd batch
+
+    def test_torn_tail_not_planned_until_complete(self, tmp_path):
+        seg = tmp_path / "segment-000001.jsonl"
+        good = json.dumps({"kind": "traffic", "t": 1.0, "rid": "a",
+                           "request": {"x": 1}}).encode()
+        seg.write_bytes(good + b"\n" + b'{"kind": "traffic", "t"')
+        src = TrafficLogSource(str(tmp_path))
+        meta = src.plan()
+        df = src.read(meta)
+        assert df.num_rows == 1                  # the torn tail waits
+        src.ack(meta)
+        # the tail completes -> it becomes plannable
+        with open(seg, "ab") as f:
+            f.write(b': 2.0, "rid": "b", "request": {"x": 2}}\n')
+        df2 = src.read(src.plan())
+        assert df2.num_rows == 1 and df2["rid"][0] == "b"
+
+    def test_cursor_resumes_across_instances(self, tmp_path):
+        cap = TrafficCapture(str(tmp_path / "w"))
+        cap.offer("v1", [self._P(i) for i in range(4)])
+        cap.stop()
+        src = TrafficLogSource(str(tmp_path / "w"))
+        meta = src.plan(2)
+        src.read(meta)
+        src.ack(meta)
+        src2 = TrafficLogSource(str(tmp_path / "w"))
+        df = src2.read(src2.plan())
+        assert df.num_rows == 2                  # only the unacked half
+
+    def test_shadow_rows_kind_filtered(self, tmp_path):
+        cap = TrafficCapture(str(tmp_path), shadow_rows_per_batch=2)
+        df = DataFrame({"x": [1.0, 2.0, 3.0]})
+        live = df.with_column("scores", [0.1, 0.2, 0.3])
+        shadow = df.with_column("scores", [0.1, 0.9, 0.3])
+        cap.offer_shadow("v1", "v2", df, live, shadow)
+        cap.stop()
+        assert cap.n_shadow_rows == 2            # bounded per batch
+        src = TrafficLogSource(str(tmp_path))    # default: traffic only
+        meta = src.plan()
+        assert meta is not None          # lines plan; kinds filter at read
+        assert src.read(meta).num_rows == 0
+        src_all = TrafficLogSource(str(tmp_path),
+                                   kinds=("traffic", "shadow"),
+                                   cursor_path=str(tmp_path / "c2.json"))
+        rows = src_all.read(src_all.plan())
+        assert rows.num_rows == 2
+        assert rows["kind"][0] == "shadow"
+        assert rows["live_scores"][1] == 0.2
+        assert rows["shadow_scores"][1] == 0.9
+
+
+class TestServerCapture:
+    def test_live_server_captures_committed_rows(self, tmp_path):
+        import requests
+        from mmlspark_tpu.serving import ServingServer, TrafficCapture
+        from mmlspark_tpu.stages import ScaleColumn
+
+        cap = TrafficCapture(str(tmp_path / "cap"))
+        with ServingServer(ScaleColumn(input_col="x", output_col="y",
+                                       scale=2.0),
+                           max_latency_ms=1, max_batch_size=4,
+                           capture=cap, slow_trace_ms=None) as srv:
+            for i in range(6):
+                r = requests.post(
+                    srv.address, json={"x": float(i)},
+                    headers={"X-Request-Id": f"rid-{i}",
+                             "X-Trace-Id": f"trace{i}"}, timeout=5)
+                assert r.status_code == 200
+            stats = requests.get(
+                f"http://{srv.host}:{srv.port}/stats", timeout=5).json()
+            assert stats["capture"]["directory"] == cap.directory
+            metrics = requests.get(
+                f"http://{srv.host}:{srv.port}/metrics",
+                timeout=5).text
+            assert "serving_capture_rows_total" in metrics
+        # server stop flushed the writer
+        src = TrafficLogSource(str(tmp_path / "cap"))
+        df = src.read(src.plan())
+        assert df.num_rows == 6
+        assert sorted(df["rid"]) == [f"rid-{i}" for i in range(6)]
+        assert all(t.startswith("trace") for t in df["trace_id"])
+        assert set(df["version"]) == {"v1"}
+        ys = {float(np.asarray(v).reshape(-1)[0]) for v in df["y"]}
+        assert ys == {0.0, 2.0, 4.0, 6.0, 8.0, 10.0}
+
+    def test_shadow_output_sampling_rides_capture(self, tmp_path):
+        import requests
+        from mmlspark_tpu.serving import ServingServer, TrafficCapture
+        from mmlspark_tpu.stages import ScaleColumn
+
+        cap = TrafficCapture(str(tmp_path / "cap"))
+        with ServingServer(ScaleColumn(input_col="x", output_col="y",
+                                       scale=2.0),
+                           max_latency_ms=1, max_batch_size=4,
+                           capture=cap, slow_trace_ms=None) as srv:
+            srv.warmup({"x": 0.0})
+            srv.versions.stage(
+                model=ScaleColumn(input_col="x", output_col="y",
+                                  scale=3.0),
+                version="v2", shadow_fraction=1.0, sync=True)
+            for i in range(8):
+                requests.post(srv.address, json={"x": 1.0}, timeout=5)
+            deadline = time.monotonic() + 5
+            while time.monotonic() < deadline and cap.n_shadow_rows == 0:
+                time.sleep(0.02)
+        assert cap.n_shadow_rows > 0
+        src = TrafficLogSource(str(tmp_path / "cap"), kinds=("shadow",),
+                               cursor_path=str(tmp_path / "c.json"))
+        df = src.read(src.plan())
+        assert df.num_rows > 0
+        i = 0
+        assert df["version"][i] == "v1"
+        assert df["staged_version"][i] == "v2"
+        # live 2x vs staged 3x on x=1.0: the diff evidence, row-aligned
+        assert float(np.asarray(df["live_y"][i]).reshape(-1)[0]) == 2.0
+        assert float(np.asarray(df["shadow_y"][i]).reshape(-1)[0]) == 3.0
+
+
+def _mlp_learner(ckpt_dir):
+    from mmlspark_tpu.models.trainer import NNLearner
+    return NNLearner(arch={"builder": "mlp", "hidden": [4],
+                           "num_outputs": 1},
+                     features_col="x", label_col="label",
+                     loss="squared_error", optimizer="adam",
+                     learning_rate=0.02, batch_size=16,
+                     checkpoint_dir=ckpt_dir)
+
+
+def _seed_traffic(capdir, n, seed=0):
+    rng = np.random.default_rng(seed)
+
+    class P:
+        def __init__(self, i):
+            x = rng.normal(size=2)
+            self.rid = f"seed-{seed}-{i}"
+            self.trace = f"t{i}"
+            self.payload = {"x": x.tolist(), "label": float(x.sum())}
+            self.reply = b'{"scores": [0.0]}'
+
+    cap = TrafficCapture(capdir)
+    cap.offer("v1", [P(i) for i in range(n)])
+    cap.stop()
+
+
+class TestFitStream:
+    def test_trains_and_exports_flip_eligible_checkpoints(self, tmp_path):
+        from mmlspark_tpu.io.checkpoint import verify_digest
+        capdir = str(tmp_path / "cap")
+        _seed_traffic(capdir, 32)
+        fit = _mlp_learner(str(tmp_path / "train")).fit_stream(
+            TrafficLogSource(capdir),
+            export_dir=str(tmp_path / "exp"), export_every_batches=1,
+            checkpoint_dir=str(tmp_path / "wal"), max_batch_rows=16)
+        fit.query.process_available()
+        st = fit.status()["trainer"]
+        assert st["n_batches_trained"] >= 1
+        assert st["n_rows_trained"] == 32
+        assert st["n_exports"] >= 1
+        for path in fit.exports:
+            ok, detail = verify_digest(path, strict=True)
+            assert ok, detail            # every export is flip-eligible
+        # the exported model scores
+        from mmlspark_tpu.core.stage import PipelineStage
+        m = PipelineStage.load(fit.exports[-1])
+        out = m.transform(DataFrame({"x": np.zeros((2, 2))}))
+        assert out["scores"].shape[0] == 2
+
+    def test_crash_mid_loop_replay_is_skipped_exactly_once(self, tmp_path):
+        """The acceptance pin inside the loop: kill the query between
+        the trainer-sink write (train + checkpoint) and the commit-log
+        append, restart from the same checkpoints, and the replayed
+        batch id is detected and skipped — no batch trains twice."""
+        capdir = str(tmp_path / "cap")
+        _seed_traffic(capdir, 48)
+        wal, train = str(tmp_path / "wal"), str(tmp_path / "train")
+
+        def make():
+            return _mlp_learner(train).fit_stream(
+                TrafficLogSource(capdir),
+                export_dir=str(tmp_path / "exp"),
+                export_every_batches=1,           # high-water each batch
+                checkpoint_dir=wal, max_batch_rows=16,
+                retry_policy=RetryPolicy(max_attempts=1))
+
+        fit = make()
+        inner = fit.query.sink
+
+        class Crasher:                    # crash AFTER sink-side effects
+            def process(self, bid, df):
+                inner.process(bid, df)
+                if bid == 2:
+                    raise RuntimeError("injected kill")
+
+        fit.query.sink = Crasher()
+        with pytest.raises(RuntimeError, match="injected kill"):
+            fit.query.process_available()
+        assert fit.query.state == "failed"
+        run1 = inner.status()
+        assert run1["last_trained_batch"] == 2    # batch 2 DID train
+
+        fit2 = make()
+        fit2.query.process_available()
+        st = fit2.status()
+        assert st["query"]["n_replayed_batches"] == 1
+        assert st["trainer"]["n_replays_skipped"] == 1   # batch 2 skipped
+        # exactly-once: every captured row trained exactly once overall
+        assert run1["n_rows_trained"] \
+            + st["trainer"]["n_rows_trained"] == 48
+
+
+class TestRetrainRedeployLoop:
+    """The headline acceptance: traffic served -> captured -> streamed
+    into fit_stream -> flip-eligible export -> RetrainLoop drives
+    POST /rollout through the canary -> the fleet serves the retrained
+    version with zero downtime and zero dropped/wrong replies."""
+
+    def test_end_to_end_loop(self, tmp_path):
+        import requests
+        from mmlspark_tpu.core.stage import PipelineStage
+        from mmlspark_tpu.models.function import NNFunction
+        from mmlspark_tpu.models.nn import NNModel
+        from mmlspark_tpu.serving import (
+            ServingCoordinator, ServingServer, TrafficCapture)
+        from mmlspark_tpu.streaming import RetrainLoop
+
+        # v1: an untrained tiny MLP, persisted + digest-manifested
+        fn = NNFunction.init({"builder": "mlp", "hidden": [4],
+                              "num_outputs": 1}, (2,), seed=0)
+        v1_dir = str(tmp_path / "v1")
+        NNModel(model=fn, input_col="x", output_col="scores").save(v1_dir)
+        capdir = str(tmp_path / "cap")
+        warm = {"x": [0.0, 0.0], "label": 0.0}
+
+        cap = TrafficCapture(capdir)
+        workers = []
+        coord = ServingCoordinator().start()
+        try:
+            for i in range(2):
+                srv = ServingServer(
+                    PipelineStage.load(v1_dir), max_batch_size=4,
+                    max_latency_ms=1, model_version="v1",
+                    capture=cap if i == 0 else None,
+                    slow_trace_ms=None)
+                srv.warmup(warm)
+                srv.start()
+                ServingCoordinator.register_worker(
+                    f"http://{coord.host}:{coord.port}",
+                    srv.host, srv.port)
+                workers.append(srv)
+
+            # -- background traffic for the WHOLE test (zero-downtime
+            # evidence): every reply must be a well-formed 200
+            rng = np.random.default_rng(7)
+            stop = threading.Event()
+            results = {"ok": 0, "bad": []}
+
+            def traffic():
+                i = 0
+                while not stop.is_set():
+                    x = rng.normal(size=2)
+                    srv = workers[i % 2]
+                    try:
+                        r = requests.post(
+                            srv.address,
+                            json={"x": x.tolist(),
+                                  "label": float(x.sum())},
+                            headers={"X-Request-Id": f"e2e-{i}"},
+                            timeout=10)
+                        body = r.json()
+                        if r.status_code == 200 and "scores" in body:
+                            results["ok"] += 1
+                        else:
+                            results["bad"].append(
+                                (i, r.status_code, body))
+                    except Exception as e:  # noqa: BLE001
+                        results["bad"].append((i, "exc", str(e)))
+                    i += 1
+                    time.sleep(0.005)
+
+            t = threading.Thread(target=traffic, daemon=True)
+            t.start()
+
+            # -- stream captured traffic into the trainer until it has
+            # exported at least one flip-eligible checkpoint
+            fit = _mlp_learner(str(tmp_path / "train")).fit_stream(
+                TrafficLogSource(capdir),
+                export_dir=str(tmp_path / "exp"),
+                export_every_batches=2,
+                checkpoint_dir=str(tmp_path / "wal"),
+                max_batch_rows=16)
+            deadline = time.monotonic() + 60
+            while time.monotonic() < deadline and not fit.exports:
+                fit.query.process_available()
+                time.sleep(0.05)
+            assert fit.exports, "fit_stream never exported a checkpoint"
+
+            # -- the retrain loop pushes it through the canary gates
+            loop = RetrainLoop(
+                str(tmp_path / "exp"),
+                f"http://{coord.host}:{coord.port}",
+                warmup_payload=warm,
+                poll_interval_s=0.1,
+                rollout={"canary": True, "canary_min_requests": 4,
+                         "canary_window_s": 3.0,
+                         "stage_timeout_s": 60.0}).start()
+            deadline = time.monotonic() + 90
+            while time.monotonic() < deadline and loop.n_completed == 0 \
+                    and loop.n_failed == 0 and loop.n_rolled_back == 0:
+                time.sleep(0.1)
+            loop.stop()
+            stop.set()
+            t.join(timeout=10)
+
+            status = loop.status()
+            assert loop.n_completed == 1, status
+            new_version = status["history"][-1]["version"]
+            assert new_version.startswith("r")
+
+            # -- the fleet is coherent on the retrained version and
+            # still answering
+            versions = set()
+            for srv in workers:
+                v = requests.get(
+                    f"http://{srv.host}:{srv.port}/version",
+                    timeout=5).json()
+                versions.add(v["active"]["version"])
+                assert v["active"]["state"] == "active"
+            assert versions == {new_version}
+            for srv in workers:
+                r = requests.post(srv.address,
+                                  json={"x": [0.0, 0.0], "label": 0.0},
+                                  timeout=10)
+                assert r.status_code == 200 and "scores" in r.json()
+
+            # -- zero downtime, zero dropped, zero wrong replies
+            assert results["bad"] == []
+            assert results["ok"] > 0
+            # the loop's audit trail shows the completed canary rollout
+            assert status["history"][-1]["state"] == "completed"
+        finally:
+            stop.set()
+            for srv in workers:
+                srv.stop()
+            coord.stop()
+
+
+class TestReviewHardening:
+    def test_unlabeled_rows_never_kill_the_retrain_query(self, tmp_path):
+        """Real traffic mixes labeled (feedback) and unlabeled (plain
+        inference) rows: label-less / None-holed / malformed labels are
+        dropped and counted — never a terminal query failure."""
+        capdir = str(tmp_path / "cap")
+        cap = TrafficCapture(capdir)
+
+        class P:
+            def __init__(self, payload):
+                self.rid = None
+                self.trace = "t"
+                self.payload = payload
+                self.reply = b'{"scores": [0.0]}'
+
+        # batch 1: NO labels at all; batch 2: mixed junk + good labels
+        cap.offer("v1", [P({"x": [0.1, 0.2]}) for _ in range(4)])
+        cap.flush()
+        mixed = [P({"x": [0.1, 0.2], "label": 1.0}),
+                 P({"x": [0.3, 0.4]}),                  # hole -> None
+                 P({"x": [0.5, 0.6], "label": "oops"}),
+                 P({"x": [0.7, 0.8], "label": 2.0})]
+        cap.offer("v1", mixed)
+        cap.stop()
+        fit = _mlp_learner(str(tmp_path / "train")).fit_stream(
+            TrafficLogSource(capdir), max_batch_rows=4,
+            checkpoint_dir=str(tmp_path / "wal"))
+        fit.query.process_available()
+        st = fit.status()
+        assert st["query"]["state"] != "failed"
+        tr = st["trainer"]
+        assert tr["n_rows_trained"] == 2         # only the good labels
+        assert tr["n_rows_unlabeled"] == 6
+        assert tr["n_batches_trained"] == 1      # all-unlabeled batch skipped
+
+    def test_transient_read_failure_reoffers_instead_of_losing(
+            self, tmp_path, monkeypatch):
+        """An engine-mode read failing transiently must NOT journal the
+        file as consumed: the key re-offers on the next plan (bounded
+        by max_read_failures before quarantine)."""
+        from mmlspark_tpu.io import streaming as iostreaming
+        data = tmp_path / "data"
+        data.mkdir()
+        (data / "a.bin").write_bytes(b"payload")
+        src = iostreaming.FileStreamSource(
+            str(data), checkpoint_location=str(tmp_path / "p.json"))
+        real_read = iostreaming.read_binary_files
+        fail = {"n": 1}
+
+        def flaky(path, **kw):
+            if fail["n"] > 0:
+                fail["n"] -= 1
+                raise OSError("transient NFS blip")
+            return real_read(path, **kw)
+
+        monkeypatch.setattr(iostreaming, "read_binary_files", flaky)
+        meta = src.plan()
+        assert src.read(meta).num_rows == 0      # blip: nothing read
+        src.ack(meta)                            # must NOT journal it
+        meta2 = src.plan()
+        assert meta2 is not None                 # re-offered
+        df = src.read(meta2)
+        assert df.num_rows == 1 and list(df["bytes"]) == [b"payload"]
+        src.ack(meta2)
+        assert src.plan() is None                # now consumed for good
+
+    def test_warmup_batches_never_captured(self, tmp_path):
+        """Synthetic warmup dispatches must not feed the retrain loop:
+        'nothing is journaled' covers the capture journal too."""
+        import requests
+        from mmlspark_tpu.serving import ServingServer, TrafficCapture
+        from mmlspark_tpu.stages import ScaleColumn
+
+        cap = TrafficCapture(str(tmp_path / "cap"))
+        with ServingServer(ScaleColumn(input_col="x", output_col="y",
+                                       scale=2.0),
+                           max_latency_ms=1, max_batch_size=4,
+                           capture=cap, slow_trace_ms=None) as srv:
+            srv.warmup({"x": 123.0})             # synthetic ladder
+            r = requests.post(srv.address, json={"x": 1.0}, timeout=5)
+            assert r.status_code == 200
+        src = TrafficLogSource(str(tmp_path / "cap"))
+        meta = src.plan()
+        df = src.read(meta) if meta else DataFrame({})
+        assert df.num_rows == 1                  # ONLY the live request
+        assert float(np.asarray(df["x"][0]).reshape(-1)[0]) == 1.0
+
+    def test_default_checkpoint_cadence_covers_every_batch(self, tmp_path):
+        """Exactly-once must not depend on the export cadence: with the
+        default checkpoint_every_batches=1, a crash after ANY committed
+        batch warm-starts past it even when exports are sparse."""
+        capdir = str(tmp_path / "cap")
+        _seed_traffic(capdir, 48)
+        wal, train = str(tmp_path / "wal"), str(tmp_path / "train")
+
+        def make():
+            return _mlp_learner(train).fit_stream(
+                TrafficLogSource(capdir),
+                export_dir=str(tmp_path / "exp"),
+                export_every_batches=100,        # exports far apart...
+                checkpoint_dir=wal, max_batch_rows=16,
+                retry_policy=RetryPolicy(max_attempts=1))
+
+        fit = make()
+        inner = fit.query.sink
+
+        class Crasher:
+            def process(self, bid, df):
+                inner.process(bid, df)
+                if bid == 2:
+                    raise RuntimeError("kill")
+
+        fit.query.sink = Crasher()
+        with pytest.raises(RuntimeError):
+            fit.query.process_available()
+        fit2 = make()
+        fit2.query.process_available()
+        st = fit2.status()["trainer"]
+        # ...but the per-batch train-state checkpoint still made the
+        # replayed batch skippable: nothing trained twice
+        assert st["n_replays_skipped"] == 1
+        assert inner.status()["n_rows_trained"] \
+            + st["n_rows_trained"] == 48
