@@ -244,7 +244,16 @@ class DataFrame:
         return splits
 
     def drop_nulls(self, subset: Optional[Sequence[str]] = None) -> "DataFrame":
-        """Drop rows with NaN (float cols) or None (object cols)."""
+        """Drop rows with NaN (float cols) or None (object cols).
+
+        When nothing drops (the common serving/featurizer case) the
+        frame is returned AS IS: filtering with an all-true mask would
+        fancy-index a full copy of every column, and a copied column
+        carries a new identity — which silently defeats every
+        downstream cache keyed on column identity (NNModel's
+        device-resident frame cache re-uploads the whole frame per
+        pass; on a tunneled chip that re-upload, not compute, was the
+        transfer-learning bench's warm-path cost)."""
         names = list(subset) if subset is not None else self.columns
         keep = np.ones(self._n_rows, dtype=bool)
         for n in names:
@@ -252,8 +261,13 @@ class DataFrame:
             if c.dtype == np.dtype("O"):
                 keep &= np.array([v is not None for v in c])
             elif np.issubdtype(c.dtype, np.floating):
+                # isnan runs natively on every float dtype: casting to
+                # float64 first allocated a 2x copy of image-sized
+                # columns just to scan them
                 flat = c.reshape(len(c), -1) if c.ndim > 1 else c[:, None]
-                keep &= ~np.isnan(flat.astype(np.float64)).any(axis=1)
+                keep &= ~np.isnan(flat).any(axis=1)
+        if keep.all():
+            return self
         return self.filter(keep)
 
     @staticmethod

@@ -149,19 +149,27 @@ class NNModel(Model, HasInputCol, HasOutputCol):
     input_dtype = Param("auto", "host-side cast before transfer: auto casts "
                         "to bfloat16 for bfloat16 models (halves host->HBM "
                         "bytes; the first layer casts activations anyway) | "
-                        "float32 | bfloat16 | uint8 (raw image bytes: 2-4x "
-                        "fewer link bytes; dequantized ON DEVICE via "
-                        "input_scale/input_offset, fused into the first "
-                        "layer — the TPU shape of 'normalize inside the "
-                        "pipeline', for uint8 image columns)",
+                        "float32 | bfloat16 | uint8 | int8 (quantized wire "
+                        "bytes: 2-4x fewer link bytes; dequantized ON "
+                        "DEVICE via input_scale/input_offset, fused into "
+                        "the first layer — the TPU shape of 'normalize "
+                        "inside the pipeline', for integer payload "
+                        "columns)",
                         validator=in_set("auto", "float32", "bfloat16",
-                                         "uint8"))
+                                         "uint8", "int8"))
     input_scale = Param(None, "on-device input scaling x*scale+offset "
                         "applied inside the jitted forward; default 1/255 "
-                        "for uint8 transfers (images -> [0,1]), 1.0 "
+                        "for uint8/int8 transfers (images -> [0,1]), 1.0 "
                         "otherwise", ptype=float)
     input_offset = Param(0.0, "on-device input offset (see input_scale)",
                          ptype=float)
+    quantization = Param(None, "a serving.quant.QuantizationConfig: one "
+                         "object carrying wire dtype + scale/zero_point "
+                         "end-to-end — setting it overrides input_dtype/"
+                         "input_scale/input_offset so the on-device "
+                         "dequant always matches the wire the serving "
+                         "plane casts to (see docs/serving.md 'The "
+                         "quantized wire')", complex=True)
     fetch_batches = Param(32, "minibatches scored per device->host fetch: "
                           "outputs are unpadded and concatenated ON DEVICE, "
                           "so a whole group costs one round-trip (each fetch "
@@ -185,12 +193,16 @@ class NNModel(Model, HasInputCol, HasOutputCol):
 
     def _transfer_dtype(self):
         mode = self.input_dtype
+        if self.quantization is not None:
+            mode = self.quantization.wire_dtype
         if mode == "auto":
             arch = getattr(self.model, "arch", None) or {}
             mode = ("bfloat16" if arch.get("dtype") == "bfloat16"
                     else "float32")
         if mode == "uint8":
             return np.dtype(np.uint8)
+        if mode == "int8":
+            return np.dtype(np.int8)
         if mode == "bfloat16":
             import ml_dtypes
             return np.dtype(ml_dtypes.bfloat16)
@@ -212,6 +224,24 @@ class NNModel(Model, HasInputCol, HasOutputCol):
         self.__dict__.pop("_placement_label", None)
         self.__dict__.pop("_placement_single", None)
         super()._set_param(name, value)
+
+    @property
+    def batch_multiple(self) -> int:
+        """The divisibility constraint this model's dispatch places on
+        batch rows — the mesh data-axis size its batches shard over.
+        Config-derived and cheap (no placement is forced): the serving
+        plane's bucket ladder rounds every bucket up to this
+        (``bucket_ladder(cap, multiple=...)``), so a bucketed frame
+        placed by ``dist.put_batch``/``batch_sharding`` is already
+        divisible and never re-pads inside the dispatch."""
+        if not self.data_parallel:
+            return 1
+        import jax
+        n_dev = len(jax.devices())
+        tp = int(self.tensor_parallel or 0)
+        if tp > 1:
+            return n_dev // tp if n_dev % tp == 0 else 1
+        return max(n_dev, 1)
 
     # -- placement visibility (the /stats + dispatch-span surface) ----------
 
@@ -273,10 +303,17 @@ class NNModel(Model, HasInputCol, HasOutputCol):
         out_layer = self._resolve_output_layer()
         module = self.model.module()
         is_int = np.issubdtype(self._transfer_dtype(), np.integer)
-        scale = self.input_scale
-        if scale is None:
-            scale = (1.0 / 255.0) if is_int else 1.0
-        offset = float(self.input_offset)
+        if self.quantization is not None:
+            # ONE object carries wire dtype + dequant constants: the
+            # jitted forward's x*scale+offset can never drift from
+            # what the serving plane cast the wire to
+            scale = self.quantization.scale
+            offset = float(self.quantization.zero_point)
+        else:
+            scale = self.input_scale
+            if scale is None:
+                scale = (1.0 / 255.0) if is_int else 1.0
+            offset = float(self.input_offset)
         arch = getattr(self.model, "arch", None) or {}
         deq_dtype = (jnp.bfloat16 if arch.get("dtype") == "bfloat16"
                      else jnp.float32)
@@ -352,11 +389,14 @@ class NNModel(Model, HasInputCol, HasOutputCol):
 
     def transform(self, df: DataFrame) -> DataFrame:
         import jax
+        from mmlspark_tpu.parallel import round_to_multiple
         col = df[self.input_col]
         tdtype = self._transfer_dtype()
         params, in_sharding, n_shards = self._device_setup
-        bs = max(self.batch_size, n_shards)
-        bs -= bs % n_shards  # static per-device shapes
+        # static per-device shapes: the same divisibility rounding the
+        # serving bucket ladder applies (one helper, two layers)
+        bs = round_to_multiple(max(self.batch_size, n_shards), n_shards,
+                               up=False)
         placement = in_sharding if in_sharding is not None else \
             (jax.config.jax_default_device or jax.local_devices()[0])
         cache_key = None
@@ -493,12 +533,26 @@ class NNModel(Model, HasInputCol, HasOutputCol):
     # -- persistence --------------------------------------------------------
 
     def _save_extra(self, path: str, arrays: Dict[str, np.ndarray]) -> None:
+        import json
         import os
         self.model.save(os.path.join(path, "nnfunction"))
+        if self.quantization is not None:
+            # complex params skip JSON persistence; the quant config is
+            # a tiny dict and MUST survive save/load (a staged rollout
+            # checkpoint carries its wire contract with it)
+            with open(os.path.join(path, "quantization.json"), "w") as f:
+                json.dump(self.quantization.to_dict(), f)
 
     def _load_extra(self, path: str, arrays: Dict[str, np.ndarray]) -> None:
+        import json
         import os
         self.model = NNFunction.load(os.path.join(path, "nnfunction"))
+        qpath = os.path.join(path, "quantization.json")
+        if os.path.exists(qpath):
+            from mmlspark_tpu.serving.quant import QuantizationConfig
+            with open(qpath) as f:
+                self.quantization = QuantizationConfig.from_value(
+                    json.load(f))
 
     # -- conveniences (parity: python CNTKModel.py loadNativeModelFromFile) --
 

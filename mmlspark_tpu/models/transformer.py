@@ -1474,25 +1474,43 @@ def _gather_lane(c_l, page_tables, n_slots, virtual_len, cfg):
 
 def build_paged_decode_step(cfg: TransformerConfig, n_slots: int,
                             page_size: int, pages_per_slot: int,
-                            donate: bool = True, cache_sharding=None):
+                            donate: bool = True, cache_sharding=None,
+                            attn_impl: str = "dense"):
     """Jitted ``step(params, cache, tokens, pos, page_tables) ->
     (cache, next_tokens, logits)`` — one token for every slot through
     the block-table layout (the paged :func:`build_decode_step`).
 
     Each slot writes its new K/V row at page
     ``page_tables[slot, pos // page_size]``, row ``pos % page_size``,
-    then attends over its gathered virtual lane masked to ``index <=
-    pos``. ``page_tables`` is ``[n_slots, pages_per_slot]`` int32 —
-    fixed shape, so occupancy churn and page churn alike reuse ONE
+    then attends over its virtual lane masked to ``index <= pos``.
+    ``page_tables`` is ``[n_slots, pages_per_slot]`` int32 — fixed
+    shape, so occupancy churn and page churn alike reuse ONE
     executable. Free slots ride at token 0 / pos 0 with an all-scratch
-    table."""
+    table.
+
+    ``attn_impl`` picks the gather engine: ``"dense"`` (the
+    CPU/fallback path — materialize each slot's lane via
+    ``c_l[page_tables]`` then one masked attention), ``"pallas"``
+    (the fused block-table kernel —
+    :func:`~mmlspark_tpu.parallel.pallas_attention.
+    paged_decode_attention`: the page table aims each page's DMA via
+    scalar prefetch, streaming softmax in VMEM, no lane intermediate
+    in HBM), or ``"pallas_interpret"`` (the kernel interpreted, for
+    CPU parity tests). Token-for-token parity between the two is
+    test-pinned."""
     _check_decode_config(cfg)
+    if attn_impl not in ("dense", "pallas", "pallas_interpret"):
+        raise ValueError(f"unknown attn_impl {attn_impl!r}")
     n_slots, page_size = int(n_slots), int(page_size)
     pages_per_slot = int(pages_per_slot)
     V = page_size * pages_per_slot
     scale = cfg.d_head ** -0.5
     rows = jnp.arange(n_slots)
     idx = jnp.arange(V)
+    use_pallas = attn_impl in ("pallas", "pallas_interpret")
+    if use_pallas:
+        from mmlspark_tpu.parallel.pallas_attention import (
+            paged_decode_attention)
 
     def step(params, cache, tokens, pos, page_tables):
         x = params["embed"][tokens]                    # [N, D]
@@ -1507,12 +1525,18 @@ def build_paged_decode_step(cfg: TransformerConfig, n_slots: int,
             v = jnp.einsum("nd,dhk->nhk", h, bp["wv"])
             ck = ck.at[l, pg, row].set(k)
             cv = cv.at[l, pg, row].set(v)
-            lk = _gather_lane(ck[l], page_tables, n_slots, V, cfg)
-            lv = _gather_lane(cv[l], page_tables, n_slots, V, cfg)
-            s = jnp.einsum("nhk,nshk->nhs", q, lk) * scale
-            s = jnp.where(mask, s, -1e30)
-            p = jax.nn.softmax(s, axis=-1)
-            a = jnp.einsum("nhs,nshk->nhk", p, lv)
+            if use_pallas:
+                a = paged_decode_attention(
+                    q, ck[l], cv[l], page_tables, pos,
+                    scale=scale, page_size=page_size,
+                    interpret=attn_impl == "pallas_interpret")
+            else:
+                lk = _gather_lane(ck[l], page_tables, n_slots, V, cfg)
+                lv = _gather_lane(cv[l], page_tables, n_slots, V, cfg)
+                s = jnp.einsum("nhk,nshk->nhs", q, lk) * scale
+                s = jnp.where(mask, s, -1e30)
+                p = jax.nn.softmax(s, axis=-1)
+                a = jnp.einsum("nhs,nshk->nhk", p, lv)
             x = x + jnp.einsum("nhk,hkd->nd", a, bp["wo"])
             x = x + _decode_ffn(bp, _rmsnorm(x, bp["ln2"]), cfg)
         h = _rmsnorm(x, params["final_norm"])
@@ -1543,14 +1567,43 @@ def build_paged_decode_step(cfg: TransformerConfig, n_slots: int,
 # that consumes its token — the same invariant as the single step).
 
 
+def verify_ce_engine(cfg: TransformerConfig, n_slots: int, width: int,
+                     sharded: bool = False) -> str:
+    """Resolve the verify/score CE engine for ``cfg.ce_impl``:
+    ``"fused"`` = the streaming Pallas CE kernel scores proposals
+    straight off the hidden states (``ops/fused_ce.py`` — no second
+    ``[N*W, vocab]`` log-prob materialization and a ``[N, W]`` fetch
+    instead of ``[N, W, vocab]``), ``"xla"`` = logsumexp-minus-gold
+    over the logits the verify computes anyway. ``"auto"`` picks fused
+    exactly when the kernel is eligible (TPU backend, lane-aligned
+    d_model, enough tokens to fill a tile) and the head is not
+    mesh-sharded (the kernel is not partition-aware — XLA partitions
+    the einsum path instead)."""
+    impl = cfg.ce_impl
+    if impl == "auto":
+        from mmlspark_tpu.ops.fused_ce import fused_ce_available
+        t = int(n_slots) * max(int(width) - 1, 1)
+        # the VMEM budget is a compute-dtype question: an f32 model's
+        # logit tiles are twice a bf16 model's (same guard the train
+        # path applies at its call site)
+        itemsize = jnp.dtype(_compute_dtype(cfg)).itemsize
+        impl = ("fused" if not sharded
+                and fused_ce_available(t, cfg.d_model, cfg.vocab,
+                                       itemsize=itemsize)
+                else "xla")
+    return impl
+
+
 def build_paged_verify_step(cfg: TransformerConfig, n_slots: int,
                             width: int, page_size: int,
                             pages_per_slot: int, donate: bool = True,
-                            cache_sharding=None):
+                            cache_sharding=None,
+                            with_scores: bool = False,
+                            ce_impl: Optional[str] = None):
     """Jitted ``verify(params, cache, tokens, pos, page_tables) ->
-    (cache, greedy_tokens, logits)`` — the target model's batched
-    scoring of ``width`` draft positions per slot over the paged
-    cache.
+    (cache, greedy_tokens, logits[, scores])`` — the target model's
+    batched scoring of ``width`` draft positions per slot over the
+    paged cache.
 
     ``tokens`` is ``[n_slots, width]`` (column 0 = the slot's current
     input token, columns 1.. = draft proposals), ``pos`` the per-slot
@@ -1559,7 +1612,17 @@ def build_paged_verify_step(cfg: TransformerConfig, n_slots: int,
     masked causally to ``index <= pos + j``. Returns the greedy argmax
     ``[n_slots, width]`` (token at ``pos + j + 1`` per the target) and
     the full logits ``[n_slots, width, vocab]`` (fetched only when a
-    sampled slot needs rejection sampling)."""
+    sampled slot needs rejection sampling).
+
+    ``with_scores`` adds a fourth output: ``[n_slots, width-1]`` f32
+    target log-probs of the PROPOSED tokens (``tokens[:, j+1]`` scored
+    by query ``j``) — the per-proposal acceptance-quality signal. The
+    engine is :func:`verify_ce_engine`'s pick (override via
+    ``ce_impl``: ``"fused"``/``"fused_interpret"``/``"xla"``): fused
+    scores come off the hidden states through the streaming CE kernel
+    (``log p = -ce``), the XLA path reuses the verify's own logits.
+    Both are f32-accumulated and parity-pinned in
+    tests/test_transformer.py."""
     _check_decode_config(cfg)
     n_slots, width = int(n_slots), int(width)
     page_size, pages_per_slot = int(page_size), int(pages_per_slot)
@@ -1568,6 +1631,11 @@ def build_paged_verify_step(cfg: TransformerConfig, n_slots: int,
     rows = jnp.arange(n_slots)
     idx = jnp.arange(V)
     offs = jnp.arange(width)
+    if ce_impl is None:
+        ce_impl = verify_ce_engine(cfg, n_slots, width,
+                                   sharded=cache_sharding is not None)
+    if ce_impl not in ("fused", "fused_interpret", "xla"):
+        raise ValueError(f"unknown verify ce_impl {ce_impl!r}")
 
     def verify(params, cache, tokens, pos, page_tables):
         x = params["embed"][tokens]                    # [N, W, D]
@@ -1603,12 +1671,33 @@ def build_paged_verify_step(cfg: TransformerConfig, n_slots: int,
             x = x + _decode_ffn(bp, _rmsnorm(x, bp["ln2"]), cfg)
         h = _rmsnorm(x, params["final_norm"])          # [N, W, D]
         logits = jnp.einsum("nwd,dv->nwv", h, params["head"])
-        return ({"k": ck, "v": cv},
-                jnp.argmax(logits, -1).astype(jnp.int32), logits)
+        out = ({"k": ck, "v": cv},
+               jnp.argmax(logits, -1).astype(jnp.int32), logits)
+        if not with_scores:
+            return out
+        labels = tokens[:, 1:].reshape(-1)             # proposals
+        if ce_impl in ("fused", "fused_interpret"):
+            # score straight off the hidden states: the streaming CE
+            # kernel computes lse - gold per token with logit tiles in
+            # VMEM — log p(proposal) = -ce, f32-accumulated
+            from mmlspark_tpu.ops.fused_ce import fused_softmax_xent
+            ce = fused_softmax_xent(
+                h[:, :-1].reshape(-1, cfg.d_model), params["head"],
+                labels, interpret=ce_impl == "fused_interpret")
+            scores = -ce.reshape(n_slots, width - 1)
+        else:
+            lg = logits[:, :-1].astype(jnp.float32)    # [N, W-1, V]
+            lse = jax.nn.logsumexp(lg, axis=-1)
+            gold = jnp.take_along_axis(
+                lg, tokens[:, 1:, None], axis=-1)[..., 0]
+            scores = gold - lse
+        return out + (scores,)
 
     kw = {}
     out_sh = _decode_out_shardings(cache_sharding)
     if out_sh is not None:
+        if with_scores:
+            out_sh = out_sh + (out_sh[-1],)   # scores: replicated too
         kw["out_shardings"] = out_sh
     return jax.jit(verify, donate_argnums=(1,) if donate else (), **kw)
 

@@ -7,12 +7,14 @@ from mmlspark_tpu.parallel.topology import (
 )
 from mmlspark_tpu.parallel.sharding import (
     batch_sharding,
+    bucket_ladder,
     bucket_target,
     replicated_sharding,
     named_sharding,
     pad_to_bucket,
     pad_to_multiple,
     padded_device_batch,
+    round_to_multiple,
     shard_batch,
     unpad,
 )
@@ -52,10 +54,12 @@ __all__ = [
     "batch_sharding",
     "replicated_sharding",
     "named_sharding",
+    "bucket_ladder",
     "bucket_target",
     "pad_to_bucket",
     "pad_to_multiple",
     "padded_device_batch",
+    "round_to_multiple",
     "shard_batch",
     "unpad",
     "placement_label",

@@ -1002,6 +1002,132 @@ def _fring_bwd_call(qf, kf, vf, dof, lse, delta, qpos, kpos_t,
     return dq, dk, dv
 
 
+# ---------------------------------------------------------------------------
+# Paged decode attention: the block-table gather kernel
+# ---------------------------------------------------------------------------
+#
+# The decode plane's paged KV cache (models/transformer.py) reads each
+# slot's K/V through a per-slot page table. The XLA path materializes
+# every slot's FULL virtual lane per layer per step
+# (``c_l[page_tables]`` — an [N, pages_per_slot, page, H, Dh] gather
+# written back to HBM) before one masked attention over it: at decode
+# the op is bandwidth-bound, and that intermediate doubles the bytes
+# every step moves. This kernel fuses gather + streaming-softmax
+# attention: the page table rides SCALAR PREFETCH (the index map reads
+# ``table[n, p]`` to aim each K/V page DMA), so pages stream
+# HBM -> VMEM exactly once, scores and the running (m, l, acc) stats
+# live in VMEM, and nothing lane-shaped ever lands in HBM. Dead pages
+# (whole page past the slot's position — including every unclaimed
+# entry aimed at the scratch page) skip their compute entirely.
+#
+# The dense gather stays the CPU/interpret fallback with token-for-
+# token parity pinned (tests/test_transformer.py TestPagedAttnKernel).
+
+
+def _paged_attn_kernel(tbl_ref, pos_ref, q_ref, k_ref, v_ref, o_ref,
+                       acc, m_scr, l_scr, *, scale: float,
+                       page_size: int):
+    """One (slot, page) step: q (1, H, Dh) against the slot's p-th
+    claimed page (1, page, H, Dh), streaming-softmax stats carried in
+    VMEM scratch across the page axis."""
+    n, p = pl.program_id(0), pl.program_id(1)
+
+    @pl.when(p == 0)
+    def _():
+        acc[:] = jnp.zeros_like(acc)
+        m_scr[:] = jnp.full_like(m_scr, _NEG_INF)
+        l_scr[:] = jnp.zeros_like(l_scr)
+
+    pos = pos_ref[n]
+    base = p * page_size
+
+    # dead-page skip: the whole page is past this slot's position
+    # (scratch-aimed unclaimed entries always are) — no DMA was free,
+    # but the compute is
+    @pl.when(base <= pos)
+    def _():
+        q = q_ref[0]                                    # (H, Dh)
+        k = k_ref[0]                                    # (page, H, Dh)
+        v = v_ref[0]
+        # per-head scores via broadcast-multiply-reduce (the op is
+        # bandwidth-bound at decode widths; no MXU tile pays off at
+        # page_size x head_dim)
+        s = jnp.sum(k.astype(jnp.float32) * q[None].astype(jnp.float32),
+                    axis=2) * scale                     # (page, H)
+        idx = base + jax.lax.broadcasted_iota(
+            jnp.int32, (page_size, 1), 0)               # (page, 1)
+        s = jnp.where(idx <= pos, s, _NEG_INF)
+        m_prev = m_scr[:]                               # (1, H)
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=0, keepdims=True))
+        pw = jnp.where(idx <= pos, jnp.exp(s - m_new), 0.0)  # (page, H)
+        alpha = jnp.exp(m_prev - m_new)                 # (1, H)
+        l_scr[:] = l_scr[:] * alpha + jnp.sum(pw, axis=0,
+                                              keepdims=True)
+        acc[:] = acc[:] * alpha.T + jnp.sum(
+            pw[:, :, None] * v.astype(jnp.float32), axis=0)  # (H, Dh)
+        m_scr[:] = m_new
+
+    @pl.when(p == pl.num_programs(1) - 1)
+    def _():
+        l_safe = jnp.maximum(l_scr[:], 1e-30)           # (1, H)
+        o_ref[0] = (acc[:] / l_safe.T).astype(o_ref.dtype)
+
+
+@functools.partial(jax.jit, static_argnames=("scale", "page_size",
+                                             "interpret"))
+def paged_decode_attention(q, k_pages, v_pages, page_tables, pos,
+                           scale: float, page_size: int,
+                           interpret: bool = False):
+    """Fused paged-attention for one decode step of one layer.
+
+    ``q`` (N, H, Dh) — each slot's single query (rope applied);
+    ``k_pages``/``v_pages`` (n_pages, page_size, H, Dh) — the layer's
+    shared page pool AFTER this step's K/V write; ``page_tables``
+    (N, pages_per_slot) int32; ``pos`` (N,) int32. Returns the
+    normalized attention output (N, H, Dh) — numerically the paged
+    dense-gather path (softmax over ``index <= pos`` of the virtual
+    lane), computed without ever materializing the lane."""
+    n, h, d = q.shape
+    pps = page_tables.shape[1]
+    kernel = functools.partial(_paged_attn_kernel, scale=float(scale),
+                               page_size=int(page_size))
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(n, pps),
+        in_specs=[
+            pl.BlockSpec((1, h, d), lambda n_, p_, tbl, ps_: (n_, 0, 0)),
+            # the paged gather itself: the page DMA is AIMED by the
+            # scalar-prefetched table — block (table[n, p], ...) of the
+            # shared pool streams in, no host- or HBM-side gather
+            pl.BlockSpec((1, page_size, h, d),
+                         lambda n_, p_, tbl, ps_: (tbl[n_, p_], 0, 0, 0)),
+            pl.BlockSpec((1, page_size, h, d),
+                         lambda n_, p_, tbl, ps_: (tbl[n_, p_], 0, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, h, d),
+                               lambda n_, p_, tbl, ps_: (n_, 0, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((h, d), jnp.float32),    # acc
+            pltpu.VMEM((1, h), jnp.float32),    # running max
+            pltpu.VMEM((1, h), jnp.float32),    # normalizer
+        ],
+    )
+    return pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((n, h, d), q.dtype),
+        interpret=interpret,
+    )(page_tables.astype(jnp.int32), pos.astype(jnp.int32),
+      q, k_pages, v_pages)
+
+
+def paged_attention_available() -> bool:
+    """Whether the fused paged-attention kernel can run compiled on
+    this backend (TPU); everywhere else the dense gather is the
+    fallback and ``interpret=True`` serves the parity tests."""
+    return jax.default_backend() == "tpu"
+
+
 def folded_block_attn(q, k, v, scale, q_pos, k_pos, causal: bool,
                       interpret: bool = False):
     """:func:`flash_block_attn` twin in the folded layout: returns

@@ -60,7 +60,8 @@ def pad_to_multiple(arr: np.ndarray, multiple: int,
 
 def pad_to_bucket(arr: np.ndarray, cap: int = 1024,
                   axis: int = 0, pad_value=0,
-                  pad_mode: str = "constant") -> Tuple[np.ndarray, int]:
+                  pad_mode: str = "constant",
+                  multiple: int = 1) -> Tuple[np.ndarray, int]:
     """Pad ``axis`` to a bounded shape bucket for jit shape-cache reuse.
 
     Small inputs round up to the next power of two, clamped at ``cap``
@@ -68,57 +69,111 @@ def pad_to_bucket(arr: np.ndarray, cap: int = 1024,
     sizes, and never a dispatch larger than the operator's ceiling);
     inputs past ``cap`` pad to a multiple of ``cap`` instead, bounding
     the waste for large offline batches at ``cap - 1`` rows.
+    ``multiple`` rounds every bucket up to a divisibility constraint
+    (the mesh's data-axis size for TP/data-sharded dispatch), so a
+    bucketed batch placed by ``dist.put_batch`` never re-pads.
     """
     n = arr.shape[axis]
     if n > cap:
-        return pad_to_multiple(arr, cap, axis=axis, pad_value=pad_value,
-                               pad_mode=pad_mode)
+        return pad_to_multiple(arr, _lcm(cap, multiple), axis=axis,
+                               pad_value=pad_value, pad_mode=pad_mode)
     if n == 0:  # empty inputs still bucket to one row (a real jit shape)
-        return _pad_axis(arr, 1, axis, pad_value, "constant"), 0
-    return pad_to_multiple(arr, bucket_target(n, cap), axis=axis,
-                           pad_value=pad_value, pad_mode=pad_mode)
+        return _pad_axis(arr, max(int(multiple), 1), axis, pad_value,
+                         "constant"), 0
+    return pad_to_multiple(arr, bucket_target(n, cap, multiple=multiple),
+                           axis=axis, pad_value=pad_value,
+                           pad_mode=pad_mode)
 
 
-def bucket_target(n: int, cap: int = 1024) -> int:
+def _lcm(a: int, b: int) -> int:
+    import math
+    a, b = max(int(a), 1), max(int(b), 1)
+    return a * b // math.gcd(a, b)
+
+
+def _effective_cap(cap: int, multiple: int) -> int:
+    """The cap a divisibility-constrained ladder really serves: the
+    operator ceiling rounded DOWN to the multiple (the ceiling is a
+    budget — overshooting it to satisfy divisibility would be a memory
+    lie), except a multiple larger than the cap IS the floor (there is
+    no smaller dispatchable shape)."""
+    cap, multiple = int(cap), max(int(multiple), 1)
+    if multiple <= 1 or cap <= multiple:
+        return max(cap, multiple) if multiple > 1 else cap
+    return (cap // multiple) * multiple
+
+
+def round_to_multiple(n: int, multiple: int, up: bool = True) -> int:
+    """Round ``n`` to a multiple (up by default; ``up=False`` rounds
+    down but never below ``multiple``). The one divisibility helper
+    behind the TP-aware bucket ladder and NNModel's minibatch sizing —
+    every layer that must honor a mesh data-axis constraint rounds the
+    same way."""
+    multiple = max(int(multiple), 1)
+    n = int(n)
+    if up:
+        return ((max(n, 1) + multiple - 1) // multiple) * multiple
+    return max((n // multiple) * multiple, multiple)
+
+
+def bucket_target(n: int, cap: int = 1024, multiple: int = 1) -> int:
     """The bucket a batch of ``n`` rows pads to: next power of two,
     clamped at ``cap`` (a batch within the cap never pads past it —
     ``cap`` is an operator ceiling, e.g. a serving memory budget); above
-    ``cap``, the next multiple of ``cap``. The single bucket policy
-    behind :func:`pad_to_bucket`, serving's shape-bucketed data plane,
-    and :class:`mmlspark_tpu.stages.batching.BucketBatcher` — one ladder,
+    ``cap``, the next multiple of ``cap``. With ``multiple`` > 1 every
+    bucket is additionally rounded up to that multiple (TP/data-sharded
+    dispatch: the mesh's data axis must divide every placed batch, so
+    rounding HERE — once, at assemble time — means ``dist.put_batch``
+    never pads again). The ``cap`` stays an operator CEILING: with a
+    multiple that does not divide it, the effective cap is ``cap``
+    rounded DOWN to the multiple (a 100-row budget over 8 shards tops
+    out at 96 — never a dispatch past the budget; when the multiple
+    itself exceeds the cap it wins, as the smallest dispatchable
+    shape). The single bucket policy behind :func:`pad_to_bucket`,
+    serving's shape-bucketed data plane, and
+    :class:`mmlspark_tpu.stages.batching.BucketBatcher` — one ladder,
     so every layer warms the same compiled shapes."""
+    multiple = max(int(multiple), 1)
+    cap = _effective_cap(cap, multiple)
     if n <= 0:
-        return 1
+        return multiple
     if n > cap:
-        return ((n + cap - 1) // cap) * cap
+        return round_to_multiple(n, _lcm(cap, multiple))
     target = 1
     while target < n:
         target *= 2
-    return min(target, cap)
+    return min(round_to_multiple(min(target, cap), multiple), cap)
 
 
-def bucket_ladder(cap: int) -> List[int]:
+def bucket_ladder(cap: int, multiple: int = 1) -> List[int]:
     """Every bucket :func:`bucket_target` can return for ``n`` in
-    ``[1, cap]``: the powers of two below ``cap`` plus ``cap`` itself.
-    Derived directly — O(log cap) — instead of scanning every ``n``
-    (the ``sorted({bucket_target(n, cap) for n in range(1, cap+1)})``
-    idiom costs O(cap) set churn per caller init, which decoder/server
-    construction paid at every ``max_len``/``max_batch_size``)."""
-    cap = int(cap)
+    ``[1, cap]``: the powers of two below ``cap`` plus ``cap`` itself,
+    each rounded up to ``multiple`` (deduplicated — small pow2 buckets
+    collapse onto the multiple). Derived directly — O(log cap) —
+    instead of scanning every ``n`` (the ``sorted({bucket_target(n,
+    cap) for n in range(1, cap+1)})`` idiom costs O(cap) set churn per
+    caller init, which decoder/server construction paid at every
+    ``max_len``/``max_batch_size``)."""
+    cap = _effective_cap(cap, multiple)
+    multiple = max(int(multiple), 1)
     if cap <= 1:
-        return [1]
+        return [bucket_target(1, cap, multiple=multiple)]
     ladder = []
     b = 1
     while b < cap:
-        ladder.append(b)
+        t = round_to_multiple(b, multiple)
+        if not ladder or ladder[-1] != t:
+            ladder.append(t)
         b *= 2
-    ladder.append(cap)
+    if not ladder or ladder[-1] != cap:
+        ladder.append(cap)
     return ladder
 
 
 def padded_device_batch(chunk: np.ndarray, size: int, placement=None,
                         put=None, bucket: bool = False, axis: int = 0,
                         pad_value=0, pad_mode: str = "constant",
+                        multiple: int = 1,
                         ) -> Tuple[Any, int]:
     """Pad a batch to its static shape and (optionally) place it on device.
 
@@ -131,7 +186,8 @@ def padded_device_batch(chunk: np.ndarray, size: int, placement=None,
     """
     if bucket:
         padded, n = pad_to_bucket(chunk, cap=size, axis=axis,
-                                  pad_value=pad_value, pad_mode=pad_mode)
+                                  pad_value=pad_value, pad_mode=pad_mode,
+                                  multiple=multiple)
     else:
         padded, n = pad_to_multiple(chunk, size, axis=axis,
                                     pad_value=pad_value, pad_mode=pad_mode)

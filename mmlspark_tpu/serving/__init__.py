@@ -30,6 +30,7 @@ from mmlspark_tpu.serving.frontend import EventLoopFrontend
 from mmlspark_tpu.serving.policy import (
     AdaptiveBatchPolicy, SpeculationPolicy,
 )
+from mmlspark_tpu.serving.quant import QuantizationConfig
 from mmlspark_tpu.serving.rollout import (
     ModelVersionManager, RolloutError, RolloutOrchestrator,
 )
@@ -39,4 +40,5 @@ __all__ = ["ServingServer", "ServingCoordinator", "ServingClient",
            "ModelVersionManager", "RolloutError", "RolloutOrchestrator",
            "DecodeScheduler", "DecodeOverloaded", "SlotPool", "PagePool",
            "TransformerDecoder", "AdaptiveBatchPolicy",
+           "QuantizationConfig",
            "SpeculationPolicy", "Sampler", "TrafficCapture"]

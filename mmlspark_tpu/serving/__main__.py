@@ -97,6 +97,19 @@ Environment:
                    cap: buffered pipelined requests served per
                    connection per event-loop pass (default 16; one
                    flooding connection cannot monopolize a loop)
+  TLS_CERT / TLS_KEY
+                   (worker, optional) PEM certificate chain + private
+                   key: the event-loop edge terminates TLS itself
+                   (non-blocking handshakes in the connection state
+                   machine — docs/serving.md "TLS at the edge"), so
+                   the worker is internet-facing without a fronting
+                   proxy. Both or neither; requires FRONTEND=eventloop
+  QUANTIZATION     (worker, optional) a JSON QuantizationConfig for
+                   the boot model version, e.g.
+                   '{"wire_dtype": "uint8", "scale": 0.0039}': request
+                   payloads are cast to the wire dtype at dispatch and
+                   dequantized on device — docs/serving.md "The
+                   quantized wire". Malformed configs fail startup
   CAPTURE_DIR      (worker, optional) opt-in traffic capture: committed
                    request/reply rows (plus sampled shadow-diff rows
                    during rollouts) journal into rotating JSON-line
@@ -129,6 +142,14 @@ import time
 def _env_float(name, default):
     v = os.environ.get(name)
     return default if v in (None, "") else float(v)
+
+
+def _json_env(name):
+    v = os.environ.get(name)
+    if v in (None, ""):
+        return None
+    import json
+    return json.loads(v)
 
 
 def run_coordinator() -> None:
@@ -192,7 +213,10 @@ def run_worker() -> None:
         model_version=os.environ.get("MODEL_VERSION", "v1"),
         verify_checkpoints=_env_float("VERIFY_CHECKPOINTS", 1) != 0,
         batch_policy=os.environ.get("BATCH_POLICY", "fixed"),
-        capture=capture)
+        capture=capture,
+        tls_cert=os.environ.get("TLS_CERT") or None,
+        tls_key=os.environ.get("TLS_KEY") or None,
+        quantization=(_json_env("QUANTIZATION")))
     warm = os.environ.get("WARMUP_PAYLOAD")
     if warm:
         # warm BEFORE start(): the socket is already bound (early

@@ -116,7 +116,9 @@ class TransformerDecoder:
                  donate: bool = True, mesh=None,
                  paged: bool = True, page_size: int = 16,
                  n_pages: Optional[int] = None,
-                 draft_params=None, draft_cfg=None, spec_k: int = 4):
+                 draft_params=None, draft_cfg=None, spec_k: int = 4,
+                 attn_impl: str = "auto",
+                 verify_ce_impl: Optional[str] = None):
         from mmlspark_tpu.models import transformer as T
         self.cfg = cfg
         self.n_slots = int(n_slots)
@@ -167,6 +169,30 @@ class TransformerDecoder:
             if self.n_pages < 2:
                 raise ValueError("paged cache needs n_pages >= 2 "
                                  "(page 0 is the scratch page)")
+            # the decode-step gather engine (ROADMAP item 5 / PR 11
+            # follow-up): "auto" runs the fused Pallas block-table
+            # kernel on TPU (the page table aims each page DMA via
+            # scalar prefetch — no per-layer lane materialization in
+            # HBM) and the dense gather everywhere else; "dense" /
+            # "pallas" / "pallas_interpret" force an engine
+            # (interpret = the CPU parity-test mode). The kernel is
+            # not sharding-aware, so a TP mesh keeps the dense gather
+            # (XLA partitions it).
+            if attn_impl not in ("auto", "dense", "pallas",
+                                 "pallas_interpret"):
+                raise ValueError(f"unknown attn_impl {attn_impl!r}")
+            if attn_impl == "auto":
+                from mmlspark_tpu.parallel.pallas_attention import (
+                    paged_attention_available)
+                attn_impl = ("pallas" if mesh is None
+                             and paged_attention_available()
+                             else "dense")
+            elif attn_impl.startswith("pallas") and mesh is not None:
+                raise ValueError(
+                    "the fused paged-attention kernel is not "
+                    "sharding-aware; use attn_impl='dense' (or "
+                    "'auto') with a mesh")
+            self.attn_impl = attn_impl
             self.cache = T.init_paged_kv_cache(cfg, self.n_pages,
                                                self.page_size)
             self._prefill = T.build_paged_prefill(
@@ -174,7 +200,8 @@ class TransformerDecoder:
                 donate=donate, cache_sharding=cache_sharding)
             self._step = T.build_paged_decode_step(
                 cfg, self.n_slots, self.page_size, self.pages_per_slot,
-                donate=donate, cache_sharding=cache_sharding)
+                donate=donate, cache_sharding=cache_sharding,
+                attn_impl=attn_impl)
             if 1 + self.n_slots * self.pages_per_slot <= self.n_pages:
                 self._identity_tables = (
                     1 + np.arange(self.n_slots * self.pages_per_slot,
@@ -184,8 +211,17 @@ class TransformerDecoder:
                 self._identity_tables = None   # pool is undersized on
                 # purpose: tables must come from the scheduler's pool
         else:
+            if attn_impl not in ("auto", "dense"):
+                # the kernel fuses the PAGED gather; the dense lane
+                # pool has none — refuse loudly rather than silently
+                # serving dense numbers under a 'pallas' flag
+                raise ValueError(
+                    f"attn_impl={attn_impl!r} needs the paged cache "
+                    "(paged=True); the dense lane pool has no gather "
+                    "to fuse")
             self.page_size = self.pages_per_slot = 0
             self.n_pages = 0
+            self.attn_impl = "dense"
             self._identity_tables = None
             self.cache = T.init_kv_cache(cfg, self.n_slots,
                                          self.max_len)
@@ -204,6 +240,7 @@ class TransformerDecoder:
         self.draft_cache = None
         self._draft_prefill = self._draft_step = None
         self._propose = self._verify = None
+        self.verify_ce_impl: Optional[str] = None
         if draft_params is not None:
             if draft_cfg is None:
                 raise ValueError("draft_params needs draft_cfg")
@@ -229,10 +266,21 @@ class TransformerDecoder:
             self._propose = T.build_draft_propose(
                 draft_cfg, self.n_slots, self.max_len, self.spec_k,
                 donate=donate)
+            # the verify/score pass also emits per-proposal target
+            # log-probs (the acceptance-quality signal): scored by the
+            # streaming fused-CE kernel when eligible (TPU,
+            # lane-aligned d_model, tile-filling token count — a
+            # [N, k-1] fetch instead of deriving from the [N, k, V]
+            # logits), the XLA logsumexp path otherwise.
+            self.verify_ce_impl = (
+                verify_ce_impl if verify_ce_impl is not None
+                else T.verify_ce_engine(cfg, self.n_slots, self.spec_k,
+                                        sharded=mesh is not None))
             self._verify = T.build_paged_verify_step(
                 cfg, self.n_slots, self.spec_k, self.page_size,
                 self.pages_per_slot, donate=donate,
-                cache_sharding=cache_sharding)
+                cache_sharding=cache_sharding,
+                with_scores=True, ce_impl=self.verify_ce_impl)
 
     @property
     def has_draft(self) -> bool:
@@ -365,17 +413,21 @@ class TransformerDecoder:
         return np.asarray(nxt), logits
 
     def verify_logits(self, tokens: np.ndarray, pos: np.ndarray,
-                      page_tables) -> "tuple[np.ndarray, Any]":
+                      page_tables
+                      ) -> "tuple[np.ndarray, Any, np.ndarray]":
         """The target's width-``spec_k`` scoring pass: ``tokens`` is
         ``[n_slots, spec_k]`` (column 0 = each slot's current input
         token, columns 1.. = draft proposals). Returns the greedy
-        argmax per position plus the full logits (device array)."""
+        argmax per position, the full logits (device array — fetched
+        only when a sampled slot needs rejection sampling), and the
+        per-proposal target log-probs ``[n_slots, spec_k - 1]``
+        (fused-CE or XLA per ``verify_ce_impl``)."""
         import jax.numpy as jnp
-        self.cache, toks, logits = self._verify(
+        self.cache, toks, logits, scores = self._verify(
             self.params, self.cache, jnp.asarray(tokens),
             jnp.asarray(pos),
             jnp.asarray(np.asarray(page_tables, np.int32)))
-        return np.asarray(toks), logits
+        return np.asarray(toks), logits, np.asarray(scores)
 
     def n_compiles(self) -> int:
         """Compiled-executable count across every jitted entry point
@@ -650,6 +702,9 @@ class DecodeScheduler:
         # speculative ledger: acceptance_rate = accepted / proposed
         self.n_spec_rounds = 0
         self.n_spec_proposed = 0
+        #: EWMA of the verify score-head's per-proposal target log-
+        #: probs (fused-CE/XLA — acceptance quality, not just rate)
+        self.spec_proposal_logp = None
         self.n_spec_accepted = 0
         self.releases: Dict[str, int] = {}   # finish_reason -> count
         self._m_prefill = None
@@ -1352,8 +1407,9 @@ class DecodeScheduler:
             ver_in = np.concatenate(
                 [self._tokens[:, None], props[:, :k - 1]],
                 axis=1).astype(np.int32)
-            out_tok, ver_logits = self.decoder.verify_logits(
-                ver_in, self._pos, self._tables)
+            out_tok, ver_logits, ver_scores = \
+                self.decoder.verify_logits(ver_in, self._pos,
+                                           self._tables)
         except Exception as e:  # noqa: BLE001 — injected or real
             self.n_step_faults += 1
             logger.warning("speculative round failed; failing %d "
@@ -1371,6 +1427,17 @@ class DecodeScheduler:
         if any(r.sampler is not None
                for r in self._active.values()):
             logits_np = np.asarray(ver_logits)
+        if spec:
+            # per-proposal target log-probs from the verify's fused-CE
+            # (or XLA) score head: the acceptance-QUALITY signal —
+            # acceptance counts say how often the draft agreed,
+            # this says how close the misses were
+            sl = sorted(spec)
+            mean_logp = float(np.mean(ver_scores[sl]))
+            prev = self.spec_proposal_logp
+            self.spec_proposal_logp = (
+                mean_logp if prev is None
+                else 0.8 * prev + 0.2 * mean_logp)
         round_proposed = round_accepted = 0
         for slot, req in list(self._active.items()):
             if slot not in spec:
@@ -1475,6 +1542,11 @@ class DecodeScheduler:
                     "acceptance_rate": (
                         round(self.n_spec_accepted / proposed, 4)
                         if proposed else None),
+                    "proposal_logp_ewma": (
+                        round(self.spec_proposal_logp, 4)
+                        if self.spec_proposal_logp is not None
+                        else None),
+                    "verify_ce_impl": self.decoder.verify_ce_impl,
                     "policy": (self.spec_policy.status()
                                if self.spec_policy is not None
                                else None)}
@@ -1484,6 +1556,10 @@ class DecodeScheduler:
                 "slots_high_water": self.slots_high_water,
                 "max_len": self.decoder.max_len,
                 "paged": self.decoder.paged,
+                # the decode-step gather engine: "pallas" = the fused
+                # block-table kernel, "dense" = the materialized-lane
+                # gather (CPU/mesh fallback)
+                "attn_impl": self.decoder.attn_impl,
                 "pages": pages,
                 "speculative": spec,
                 "placement": self.decoder.placement(),

@@ -87,6 +87,16 @@ from typing import Any, Callable, Dict, List, Optional, Tuple
 
 from contextlib import contextmanager
 
+try:
+    import ssl
+except ImportError:  # pragma: no cover — stripped-down interpreters
+    ssl = None  # type: ignore[assignment]
+
+# except-clause tuples that stay valid (matching nothing) without ssl
+_TLS_WANT_READ: tuple = (ssl.SSLWantReadError,) if ssl is not None else ()
+_TLS_WANT_WRITE: tuple = (ssl.SSLWantWriteError,) if ssl is not None \
+    else ()
+
 from mmlspark_tpu.core.logs import get_logger
 
 logger = get_logger("serving.frontend")
@@ -343,7 +353,7 @@ class _EventLoopStream:
 # Connection state machine
 # ---------------------------------------------------------------------------
 
-_HEAD, _BODY, _AWAIT, _CLOSING, _STREAM = 0, 1, 2, 3, 4
+_HEAD, _BODY, _AWAIT, _CLOSING, _STREAM, _TLS_HS = 0, 1, 2, 3, 4, 5
 
 #: sentinel tag marking a stream item on the shared reply deque
 _STREAM_TAG = object()
@@ -354,11 +364,15 @@ class _Conn:
                  "t_last", "t_req_start", "t_await", "n_requests",
                  "keep_alive", "method", "path", "headers", "body_start",
                  "body_len", "want_write", "advancing", "peer_ip",
-                 "stream")
+                 "stream", "tls")
 
     def __init__(self, sock: socket.socket, peer_ip: str = ""):
         self.sock = sock
         self.peer_ip = peer_ip
+        # TLS connection: reads/writes go through the SSL record layer
+        # (no sendmsg; SSLWantRead/WantWrite instead of EAGAIN), and
+        # the connection starts life in the _TLS_HS handshake state
+        self.tls = False
         self.fd = sock.fileno()
         self.buf = bytearray()
         self.scanned = 0            # CRLFCRLF search resume offset
@@ -626,19 +640,37 @@ class _Loop(threading.Thread):
                 # BEFORE it can occupy queue slots other clients need.
                 # Best-effort single send: the socket was just
                 # accepted, so the tiny reply fits the send buffer.
+                # (On a TLS port there is no handshake to speak the
+                # reply over — the close alone is the signal.)
                 fe.n_per_ip_rejected += 1
-                body = (b'{"error": "too many connections from this '
-                        b'address"}')
-                try:
-                    sock.send(build_head(
-                        429, len(body),
-                        extra=(("Retry-After", "1"),),
-                        close=True) + body)
-                except OSError:
-                    pass
+                if fe.ssl_context is None:
+                    body = (b'{"error": "too many connections from '
+                            b'this address"}')
+                    try:
+                        sock.send(build_head(
+                            429, len(body),
+                            extra=(("Retry-After", "1"),),
+                            close=True) + body)
+                    except OSError:
+                        pass
                 sock.close()
                 continue
+            if fe.ssl_context is not None:
+                # TLS termination: wrap now, handshake incrementally on
+                # the loop (the _TLS_HS state) — a slow (or silent, or
+                # plaintext-speaking) peer never blocks this thread
+                try:
+                    sock = fe.ssl_context.wrap_socket(
+                        sock, server_side=True,
+                        do_handshake_on_connect=False)
+                except (OSError, ValueError):
+                    fe._ip_release(peer_ip)
+                    sock.close()
+                    continue
             conn = _Conn(sock, peer_ip)
+            if fe.ssl_context is not None:
+                conn.tls = True
+                conn.state = _TLS_HS
             conn.t_last = conn.t_req_start = time.monotonic()
             self.conns[conn.fd] = conn
             fe.n_connections += 1
@@ -666,12 +698,66 @@ class _Loop(threading.Thread):
             pass
         self.listener = None
 
+    # -- TLS handshake -------------------------------------------------------
+
+    def _tls_handshake(self, conn: _Conn) -> None:
+        """Drive one step of the non-blocking TLS handshake — a
+        first-class connection state, not a blocking call: WantRead
+        leaves the read registration in place, WantWrite re-registers
+        for writability, success moves to ``_HEAD``, and anything else
+        (a plaintext byte on the TLS port, a bad record, a mid-
+        handshake disconnect) closes cleanly and counts a failure."""
+        fe = self.frontend
+        try:
+            conn.sock.do_handshake()
+        except ssl.SSLWantReadError:
+            self._want_write(conn, False)
+            return
+        except ssl.SSLWantWriteError:
+            self._want_write(conn, True)
+            return
+        except (OSError, ValueError):
+            # ssl.SSLError subclasses OSError: plaintext on a TLS
+            # port, protocol mismatch, EOF mid-handshake — all end
+            # here, closed without a stack trace or a stuck fd
+            fe.n_tls_handshake_failures += 1
+            self._close(conn)
+            return
+        fe.n_tls_handshakes += 1
+        conn.state = _HEAD
+        self._want_write(conn, False)
+        conn.t_last = conn.t_req_start = time.monotonic()
+        if conn.sock.pending():
+            # the read that finished the handshake may have pulled the
+            # first request's app-data record off the wire with it: the
+            # decrypted bytes sit in the SSL layer, the raw fd is empty,
+            # and the selector would never fire — serve them now
+            self._on_readable(conn)
+
     # -- read + parse --------------------------------------------------------
 
     def _on_readable(self, conn: _Conn) -> None:
+        if conn.state == _TLS_HS:
+            self._tls_handshake(conn)
+            return
         try:
             data = conn.sock.recv(65536)
+            if conn.tls and data:
+                # the SSL layer may hold MORE decrypted bytes than one
+                # recv returned, with nothing left on the raw socket —
+                # the selector would never fire again for them
+                while conn.sock.pending():
+                    more = conn.sock.recv(65536)
+                    if not more:
+                        break
+                    data += more
         except (BlockingIOError, InterruptedError):
+            return
+        except _TLS_WANT_READ:
+            return
+        except _TLS_WANT_WRITE:
+            # renegotiation wants the socket writable first
+            self._want_write(conn, True)
             return
         except OSError:
             self._close(conn)
@@ -911,10 +997,19 @@ class _Loop(threading.Thread):
             conn.out += body
         else:
             try:
-                # the vectored single-syscall reply: status+headers and
-                # body leave in one sendmsg, no concatenation copy
-                n = conn.sock.sendmsg((head, body) if body else (head,))
+                if conn.tls:
+                    # SSL sockets have no sendmsg (each write becomes
+                    # one TLS record anyway): one concatenated send
+                    rest = head + body if body else head
+                    n = conn.sock.send(rest)
+                else:
+                    # the vectored single-syscall reply: status+headers
+                    # and body leave in one sendmsg, no concat copy
+                    n = conn.sock.sendmsg(
+                        (head, body) if body else (head,))
             except (BlockingIOError, InterruptedError):
+                n = 0
+            except _TLS_WANT_READ + _TLS_WANT_WRITE:
                 n = 0
             except OSError:
                 self._close(conn)
@@ -929,10 +1024,15 @@ class _Loop(threading.Thread):
         self._want_write(conn, True)
 
     def _on_writable(self, conn: _Conn) -> None:
+        if conn.state == _TLS_HS:
+            self._tls_handshake(conn)
+            return
         if conn.out:
             try:
                 n = conn.sock.send(conn.out)
             except (BlockingIOError, InterruptedError):
+                return
+            except _TLS_WANT_READ + _TLS_WANT_WRITE:
                 return
             except OSError:
                 self._close(conn)
@@ -999,6 +1099,15 @@ class _Loop(threading.Thread):
                 # send; closing flags the producer via the handle)
                 if rt and rt > 0 and now - conn.t_await > rt:
                     stalled.append(conn)
+                continue
+            if conn.state == _TLS_HS:
+                # a peer parked mid-handshake (connected then silent,
+                # or trickling handshake bytes) is the TLS slow-loris:
+                # reaped on the handshake's age, like a mid-request
+                # stall
+                if idle and idle > 0 and \
+                        now - conn.t_req_start > idle:
+                    doomed.append(conn)
                 continue
             if idle and idle > 0 and conn.state in (_HEAD, _BODY):
                 if conn.buf or conn.state == _BODY:
@@ -1080,9 +1189,33 @@ class EventLoopFrontend:
                  max_conns_per_ip: int = 0,
                  max_pipelined_per_iter: int = 16,
                  max_stream_buffer_bytes: int = 256 << 10,
+                 tls_cert: Optional[str] = None,
+                 tls_key: Optional[str] = None,
+                 ssl_context=None,
                  registry=None, name: str = "serving"):
         self.app = app
         self.name = name
+        # -- TLS termination (docs/serving.md "TLS at the edge"):
+        # pass a ready ssl.SSLContext, or a cert/key pair to build the
+        # server-default one. The handshake is a first-class state of
+        # the connection machine (non-blocking, WantRead/WantWrite
+        # re-registration), so the encrypted edge keeps every event-
+        # loop property — keep-alive, pipelining, streaming, sweeps —
+        # without a fronting proxy.
+        if ssl_context is not None and (tls_cert or tls_key):
+            raise ValueError("pass ssl_context OR tls_cert/tls_key, "
+                             "not both")
+        if tls_cert or tls_key:
+            if ssl is None:
+                raise ValueError("this interpreter lacks the ssl "
+                                 "module; TLS termination unavailable")
+            if not (tls_cert and tls_key):
+                raise ValueError("TLS needs BOTH tls_cert and tls_key")
+            ssl_context = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
+            ssl_context.load_cert_chain(tls_cert, tls_key)
+        self.ssl_context = ssl_context
+        self.n_tls_handshakes = 0
+        self.n_tls_handshake_failures = 0
         self.idle_timeout = float(idle_timeout or 0.0)
         self.request_timeout = request_timeout
         self.request_timeout_body = request_timeout_body
@@ -1246,6 +1379,14 @@ class EventLoopFrontend:
              "Streamed connections dropped because the bounded "
              "per-connection write buffer overflowed (slow consumer).",
              "n_stream_overflows"),
+            ("serving_tls_handshakes_total",
+             "TLS handshakes completed by the event-loop edge "
+             "(connections that reached the HTTP state).",
+             "n_tls_handshakes"),
+            ("serving_tls_handshake_failures_total",
+             "TLS handshakes that failed (plaintext bytes on the TLS "
+             "port, protocol mismatch, mid-handshake disconnect) — "
+             "each closed cleanly.", "n_tls_handshake_failures"),
         ):
             registry.counter(mname, help_).set_function(
                 lambda a=attr: getattr(self, a))
@@ -1297,6 +1438,10 @@ class EventLoopFrontend:
             "kind": "eventloop",
             "acceptors": self.acceptors,
             "reuse_port": self.reuse_port,
+            "tls": self.ssl_context is not None,
+            "tls_handshakes_total": self.n_tls_handshakes,
+            "tls_handshake_failures_total":
+                self.n_tls_handshake_failures,
             "open_connections": sum(len(lp.conns) for lp in self._loops),
             "connections_total": self.n_connections,
             "requests_total": reqs,

@@ -1,0 +1,219 @@
+"""The quantized serving wire: per-version QuantizationConfig.
+
+``cifar10_scoring_u8_v1`` proved the shape of the win — u8 ingest beats
+f32 ~1.5x because the wire (JSON payload bytes, frame assembly, and the
+host->device upload) carries 2-4x fewer bytes per request and the
+dequantize (``x * scale + zero_point``) fuses into the model's first
+layer on device. But that was a one-off ``input_dtype`` knob on
+``NNModel``; this module makes it a first-class serving-plane feature:
+
+* a :class:`QuantizationConfig` rides each
+  :class:`~mmlspark_tpu.serving.rollout.ModelVersion` (boot config via
+  ``ServingServer(quantization=...)``; rollout configs via
+  ``POST /rollout/stage {"quantization": {...}}`` — the staged
+  version's config survives verify -> warmup -> flip untouched);
+* the dispatch stage casts the assembled columnar frame to the wire
+  dtype (saturating — out-of-range payload values clamp, the standard
+  quantization semantics, never wrap into garbage) right before the
+  model sees it, so quantized buckets compile once at warmup and the
+  jitted forward's input dtype never flips mid-flight;
+* ``serving_wire_bytes_total{dtype}`` counts the bytes each dispatch
+  actually put on the device wire, ``GET /stats`` reports the active
+  config, and dispatch spans carry ``wire_dtype`` — the evidence that
+  the quantized plane is engaged, not just configured.
+
+Config validation is strict and happens at CONSTRUCTION (so a
+malformed scale/zero-point in a rollout body is a 400 at the stage
+endpoint, never a batch of garbage dispatched at serving time): the
+scale must be a finite non-zero number, the zero_point finite, the
+wire dtype one of ``uint8``/``int8``.
+
+Parity contract: dequantized values are ``wire * scale + zero_point``
+in the model's compute dtype (bf16 for bf16 models) with f32
+accumulation inside the matmuls — ``tests/test_serving_quant.py`` pins
+row-wise agreement with the f32 plane within the quantization step's
+tolerance on both frontends.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+__all__ = ["QuantizationConfig"]
+
+_WIRE_DTYPES = {
+    "uint8": (np.uint8, 0, 255),
+    "int8": (np.int8, -128, 127),
+}
+
+
+class QuantizationConfig:
+    """How a model version's request payloads cross the wire.
+
+    ``wire_dtype`` — ``"uint8"`` or ``"int8"``: the integer dtype
+    payload values are cast to for assembly + host->device transfer
+    (4x fewer bytes than f32, 2x than bf16).
+
+    ``scale`` / ``zero_point`` — the on-device dequantization
+    ``x * scale + zero_point``, fused into the model's first layer by
+    XLA (for :class:`~mmlspark_tpu.models.nn.NNModel` via its
+    ``input_scale``/``input_offset`` params). Defaults: ``1/255`` and
+    ``0.0`` — u8 images to ``[0, 1]``.
+
+    ``columns`` — the input columns the wire dtype applies to (None =
+    every numeric input column; reply columns are never touched).
+    """
+
+    __slots__ = ("wire_dtype", "scale", "zero_point", "columns")
+
+    def __init__(self, wire_dtype: str = "uint8",
+                 scale: float = 1.0 / 255.0, zero_point: float = 0.0,
+                 columns: Optional[List[str]] = None):
+        if wire_dtype not in _WIRE_DTYPES:
+            raise ValueError(
+                f"wire_dtype must be one of {sorted(_WIRE_DTYPES)}, "
+                f"got {wire_dtype!r}")
+        try:
+            scale = float(scale)
+            zero_point = float(zero_point)
+        except (TypeError, ValueError):
+            raise ValueError(
+                "quantization scale/zero_point must be numbers, got "
+                f"scale={scale!r} zero_point={zero_point!r}") from None
+        if not math.isfinite(scale) or scale == 0.0:
+            # a zero or non-finite scale dequantizes every payload to
+            # one constant (or NaN) — refuse at config time, not after
+            # a batch of garbage replies
+            raise ValueError(
+                f"quantization scale must be finite and non-zero, "
+                f"got {scale!r}")
+        if not math.isfinite(zero_point):
+            raise ValueError(
+                f"quantization zero_point must be finite, got "
+                f"{zero_point!r}")
+        if columns is not None:
+            if not isinstance(columns, (list, tuple)) or \
+                    not all(isinstance(c, str) for c in columns):
+                raise ValueError("quantization columns must be a list "
+                                 f"of column names, got {columns!r}")
+            columns = list(columns)
+        self.wire_dtype = wire_dtype
+        self.scale = scale
+        self.zero_point = zero_point
+        self.columns = columns
+
+    # -- construction --------------------------------------------------------
+
+    @classmethod
+    def from_value(cls, value: Any) -> Optional["QuantizationConfig"]:
+        """Coerce a config from user input: an existing config passes
+        through, a dict becomes one (unknown keys refused — a typoed
+        ``zero_pont`` must not silently default), None stays None.
+        Raises ``ValueError`` on anything malformed — the rollout
+        endpoint turns that into a 400."""
+        if value is None or isinstance(value, cls):
+            return value
+        if not isinstance(value, dict):
+            raise ValueError(
+                f"quantization must be a JSON object, got "
+                f"{type(value).__name__}")
+        unknown = set(value) - {"wire_dtype", "scale", "zero_point",
+                                "columns"}
+        if unknown:
+            raise ValueError(
+                f"unknown quantization keys {sorted(unknown)}")
+        return cls(**value)
+
+    # -- the wire cast -------------------------------------------------------
+
+    @property
+    def np_dtype(self) -> np.dtype:
+        return np.dtype(_WIRE_DTYPES[self.wire_dtype][0])
+
+    def applies_to(self, column: str) -> bool:
+        return self.columns is None or column in self.columns
+
+    def quantize_column(self, arr: np.ndarray) -> np.ndarray:
+        """Cast one assembled column to the wire dtype (saturating:
+        values outside the dtype's range clamp to its edges — the
+        standard quantized-tensor semantics; integer casts that WRAP
+        would dispatch garbage for one out-of-range payload value).
+        Non-numeric (object/string) columns pass through untouched."""
+        if arr.dtype == self.np_dtype:
+            return arr
+        if arr.dtype == np.dtype("O") or arr.dtype.kind not in "fiub":
+            return arr
+        _, lo, hi = _WIRE_DTYPES[self.wire_dtype]
+        if arr.dtype.kind == "f":
+            # round-to-nearest, not truncation: a client's fp-noisy
+            # 254.9999 must land on 255, not 254 (astype truncates
+            # toward zero — a one-sided LSB of error otherwise)
+            return np.clip(np.rint(arr), lo, hi).astype(self.np_dtype)
+        if arr.dtype.kind in "iu" and arr.size:
+            # integer payloads already in range (the steady state once
+            # clients send wire-ready values) skip the clip's full-size
+            # temporary: two C-speed scans, one cast
+            mn, mx = arr.min(), arr.max()
+            if lo <= mn and mx <= hi:
+                return arr.astype(self.np_dtype)
+        return np.clip(arr, lo, hi).astype(self.np_dtype)
+
+    def quantize_frame(self, df):
+        """Cast every applicable column of a columnar frame to the
+        wire dtype; returns the frame unchanged when nothing needs the
+        cast (the steady state once clients send integer payloads)."""
+        out = {}
+        changed = False
+        for name in df.columns:
+            col = df[name]
+            if self.applies_to(name):
+                q = self.quantize_column(col)
+                changed = changed or q is not col
+                out[name] = q
+            else:
+                out[name] = col
+        if not changed:
+            return df
+        from mmlspark_tpu.core.dataframe import DataFrame
+        return DataFrame(out)
+
+    # -- model wiring --------------------------------------------------------
+
+    def configure_model(self, model) -> None:
+        """Point a model's ingest at this config: for models with the
+        ``NNModel`` quantization surface (``input_dtype`` +
+        ``input_scale``/``input_offset`` params) the wire dtype and
+        dequant constants are set so the on-device dequantize matches
+        the wire exactly. A model that carries its OWN ``quantization``
+        param (a persisted quantized checkpoint restaged under a new
+        config) has it replaced too — that param takes precedence
+        inside the model, so leaving the old one would silently
+        dequantize with the superseded constants. Models without the
+        surface are left alone — they see the integer columns and
+        handle them as data."""
+        if hasattr(model, "input_dtype") and \
+                hasattr(model, "input_scale"):
+            model.input_dtype = self.wire_dtype
+            model.input_scale = self.scale
+            model.input_offset = self.zero_point
+            if getattr(model, "quantization", None) is not None \
+                    and model.quantization != self:
+                model.quantization = self
+
+    # -- surfaces ------------------------------------------------------------
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {"wire_dtype": self.wire_dtype, "scale": self.scale,
+                "zero_point": self.zero_point, "columns": self.columns}
+
+    def __repr__(self) -> str:
+        return (f"QuantizationConfig(wire_dtype={self.wire_dtype!r}, "
+                f"scale={self.scale!r}, zero_point={self.zero_point!r},"
+                f" columns={self.columns!r})")
+
+    def __eq__(self, other) -> bool:
+        return isinstance(other, QuantizationConfig) and \
+            self.to_dict() == other.to_dict()

@@ -86,15 +86,23 @@ class ModelVersion:
 
     __slots__ = ("version", "model", "source", "state", "error",
                  "digest_verified", "warmed_buckets", "shapes_seen",
-                 "n_post_flip_recompiles", "created_unix", "flipped_unix")
+                 "n_post_flip_recompiles", "created_unix", "flipped_unix",
+                 "quantization")
 
     def __init__(self, version: str, model: Any = None,
-                 source: Optional[str] = None, state: str = "loading"):
+                 source: Optional[str] = None, state: str = "loading",
+                 quantization=None):
         self.version = version
         self.model = model
         self.source = source
         self.state = state
         self.error: Optional[str] = None
+        #: the version's quantized-wire config (serving/quant.py):
+        #: the dispatch stage casts assembled frames to its wire dtype
+        #: and the model dequantizes on device — carried on the
+        #: VERSION so stage -> verify -> warmup -> flip keeps one
+        #: coherent wire contract per model (None = the f32 plane)
+        self.quantization = quantization
         #: True = strict digest verification passed; None = not
         #: applicable (in-memory model handed in by a trusted caller)
         self.digest_verified: Optional[bool] = None
@@ -123,6 +131,8 @@ class ModelVersion:
             "version": self.version,
             "state": self.state,
             "source": self.source,
+            "quantization": (self.quantization.to_dict()
+                             if self.quantization is not None else None),
             "digest_verified": self.digest_verified,
             "warmed_buckets": list(self.warmed_buckets),
             "n_shapes": len(self.shapes_seen),
@@ -150,12 +160,14 @@ class ModelVersionManager:
     def __init__(self, server, model: Any, version: str = "v1",
                  verify_checkpoints: bool = True,
                  fault_plan=None,
-                 shadow_queue_depth: int = 4):
+                 shadow_queue_depth: int = 4,
+                 quantization=None):
         self._server = server
         self.verify_checkpoints = bool(verify_checkpoints)
         self.fault_plan = fault_plan
         self._lock = threading.RLock()
-        self._active = ModelVersion(version, model=model, state="active")
+        self._active = ModelVersion(version, model=model, state="active",
+                                    quantization=quantization)
         self._staged: Optional[ModelVersion] = None
         self._previous: Optional[ModelVersion] = None
         self.n_flips = 0
@@ -246,15 +258,23 @@ class ModelVersionManager:
               version: Optional[str] = None,
               warmup_payload: Any = None,
               shadow_fraction: Optional[float] = None,
+              quantization=None,
               sync: bool = False) -> Dict[str, Any]:
         """Begin staging the next version from a checkpoint ``source``
         (or an in-memory ``model``). Runs load -> verify -> warmup in
         the background (``sync=True`` runs it inline — tests and the
-        serial callers); live traffic is untouched either way. Returns
-        the staged version's status snapshot."""
+        serial callers); live traffic is untouched either way.
+        ``quantization`` (a config or dict — see serving/quant.py)
+        declares the staged version's wire contract: it is validated
+        HERE (malformed -> ValueError -> 400 at the endpoint), rides
+        the ModelVersion through verify/warmup/flip, and defaults to
+        whatever config the loaded model itself carries. Returns the
+        staged version's status snapshot."""
         if source is None and model is None:
             raise RolloutError("stage() needs a checkpoint source or "
                                "an in-memory model")
+        from mmlspark_tpu.serving.quant import QuantizationConfig
+        quantization = QuantizationConfig.from_value(quantization)
         with self._lock:
             if self._staged is not None and \
                     self._staged.state not in self._REPLACEABLE and \
@@ -268,7 +288,8 @@ class ModelVersionManager:
             if version == self._active.version:
                 raise RolloutError(
                     f"version {version!r} is already active")
-            mv = ModelVersion(version, model=model, source=source)
+            mv = ModelVersion(version, model=model, source=source,
+                              quantization=quantization)
             self._staged = mv
             if shadow_fraction is not None:
                 self.shadow_fraction = max(float(shadow_fraction), 0.0)
@@ -303,6 +324,16 @@ class ModelVersionManager:
                 # explicitly off) — don't hash the tree twice
                 from mmlspark_tpu.core.serialize import load_stage
                 mv.model = load_stage(mv.source, verify=False)
+            if mv.quantization is None:
+                # a persisted quantized checkpoint carries its own wire
+                # contract (NNModel saves quantization.json) — adopt it
+                from mmlspark_tpu.serving.quant import QuantizationConfig
+                mv.quantization = QuantizationConfig.from_value(
+                    getattr(mv.model, "quantization", None))
+            if mv.quantization is not None:
+                # the model's on-device dequant must match the wire the
+                # dispatch stage will cast to — one config drives both
+                mv.quantization.configure_model(mv.model)
             mv.state = "warming"
             self._fault("rollout_warmup")
             self._warm(mv, warmup_payload)
@@ -334,8 +365,13 @@ class ModelVersionManager:
                 "without pre-flip warmup risks post-flip recompiles",
                 mv.version)
             return
-        for n in srv._bucket_sizes():
-            df = srv._warmup_frame(payload, n)
+        # the STAGED version's ladder AND wire config, not the active
+        # one's: a staged version with different sharding
+        # (batch_multiple) or a different quantization contract must
+        # warm exactly the bucket shapes + dtypes live traffic will
+        # dispatch after ITS flip, or the flip retraces
+        for n in srv._bucket_sizes(model=mv.model):
+            df = srv._warmup_frame(payload, n, qc=mv.quantization)
             out = mv.model.transform(df)
             if out.num_rows != df.num_rows:
                 raise RolloutError(
@@ -476,6 +512,11 @@ class ModelVersionManager:
             except Empty:
                 continue
             try:
+                if staged.quantization is not None:
+                    # mirror what the staged version would REALLY see
+                    # post-flip: its own wire cast (a no-op when the
+                    # live frame already rode the same wire)
+                    df = staged.quantization.quantize_frame(df)
                 t0 = time.perf_counter()
                 shadow_out = staged.model.transform(df)
                 self._m_shadow_latency.observe(
@@ -585,11 +626,17 @@ class RolloutOrchestrator:
                  max_p95_ratio: float = 3.0,
                  stage_timeout_s: float = 60.0,
                  poll_interval_s: float = 0.1,
-                 http_timeout_s: float = 5.0):
+                 http_timeout_s: float = 5.0,
+                 quantization: Optional[Dict[str, Any]] = None):
         self.coordinator = coordinator
         self.version = str(version)
         self.path = path
         self.warmup_payload = warmup_payload
+        # validated up front (ValueError -> 400 at POST /rollout), then
+        # forwarded verbatim to every worker's stage body
+        from mmlspark_tpu.serving.quant import QuantizationConfig
+        qc = QuantizationConfig.from_value(quantization)
+        self.quantization = qc.to_dict() if qc is not None else None
         self.canary = bool(canary)
         self.shadow_fraction = float(shadow_fraction)
         self.shadow_window_s = float(shadow_window_s)
@@ -656,6 +703,7 @@ class RolloutOrchestrator:
             "canary": self.canary,
             "canary_worker": self.canary_worker,
             "shadow_fraction": self.shadow_fraction,
+            "quantization": self.quantization,
             # dict() copies are C-level, atomic under the GIL: the
             # orchestrator thread populates/mutates self.workers
             # concurrently with /rollout handlers calling this — a
@@ -751,6 +799,8 @@ class RolloutOrchestrator:
                     body["warmup_payload"] = self.warmup_payload
                 if self.shadow_fraction > 0:
                     body["shadow_fraction"] = self.shadow_fraction
+                if self.quantization is not None:
+                    body["quantization"] = self.quantization
                 try:
                     self._post(wk, "/rollout/stage", body)
                     self.workers[wk]["state"] = "staging"

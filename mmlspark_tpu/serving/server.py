@@ -56,7 +56,7 @@ import time
 import uuid
 from collections import OrderedDict
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
-from queue import Empty, Full, Queue
+from queue import Empty, Full, Queue, SimpleQueue
 from typing import Any, Dict, List, Optional, Tuple
 
 import numpy as np
@@ -90,6 +90,7 @@ from mmlspark_tpu.core.tracing import (
 from mmlspark_tpu.serving.decode import DecodeOverloaded, DecodeScheduler
 from mmlspark_tpu.serving.frontend import EventLoopFrontend, batched_replies
 from mmlspark_tpu.serving.policy import AdaptiveBatchPolicy
+from mmlspark_tpu.serving.quant import QuantizationConfig
 from mmlspark_tpu.serving.rollout import (
     ModelVersionManager, RolloutError, RolloutOrchestrator,
 )
@@ -234,6 +235,10 @@ class ServingServer:
                  decode_path: str = "/generate",
                  batch_policy: str = "fixed",
                  capture=None,
+                 quantization=None,
+                 tls_cert: Optional[str] = None,
+                 tls_key: Optional[str] = None,
+                 ssl_context=None,
                  clock: Clock = SYSTEM_CLOCK):
         self.api_path = api_path
         self.max_batch_size = int(max_batch_size)
@@ -279,10 +284,34 @@ class ServingServer:
         # "Zero-downtime rollout". ``model_version`` names the boot
         # version; ``verify_checkpoints=False`` disables the strict
         # flip-eligibility digest check (tests only).
+        # -- the quantized wire (optional): a per-version
+        # QuantizationConfig rides the ModelVersion — the dispatch
+        # stage casts the assembled frame to the wire dtype (u8/int8)
+        # right after its version snapshot, the model dequantizes on
+        # device (x*scale+zero_point fused into the first layer), and
+        # serving_wire_bytes_total{dtype} counts what actually crossed
+        # to the device. Validated at construction: a malformed
+        # scale/zero-point raises here (and 400s at the rollout
+        # endpoint), never dispatches garbage. When the model itself
+        # carries a config (a persisted quantized checkpoint), it is
+        # adopted — one source of truth either way.
+        quantization = QuantizationConfig.from_value(quantization)
+        if quantization is None:
+            quantization = QuantizationConfig.from_value(
+                getattr(model, "quantization", None))
+        if quantization is not None:
+            quantization.configure_model(model)
         self.versions = ModelVersionManager(
             self, model, version=model_version,
             verify_checkpoints=verify_checkpoints,
-            fault_plan=rollout_fault_plan)
+            fault_plan=rollout_fault_plan,
+            quantization=quantization)
+        self._m_wire_bytes = self.registry.counter(
+            "serving_wire_bytes_total",
+            "Bytes of assembled frame columns dispatched into the "
+            "model, labeled by column dtype — the bytes-on-wire "
+            "evidence that the quantized plane is engaged (u8 rows "
+            "are 4x smaller than f32).", labels=("dtype",))
         # remembered by warmup(): staged versions warm with the same
         # payload schema unless the rollout supplies its own
         self.warmup_payload: Any = None
@@ -395,7 +424,12 @@ class ServingServer:
         self.n_errors = 0
         self._draining = threading.Event()
         self._active_batches = 0
-        self._queue: "Queue[_PendingRequest]" = Queue()
+        # SimpleQueue, not Queue: the ingress handoff runs once PER
+        # REQUEST from the frontend threads — the C-implemented
+        # lock-free put/get is measurably cheaper than Queue's Python
+        # lock + condvar at serving rates (the stage queues below keep
+        # Queue for its maxsize backpressure)
+        self._queue: "SimpleQueue[_PendingRequest]" = SimpleQueue()
         self._stop = threading.Event()
         # -- the socket edge: ``frontend="eventloop"`` (the default)
         # serves ingress from selectors-based non-blocking accept/read/
@@ -418,10 +452,20 @@ class ServingServer:
                     request_timeout=self.request_timeout,
                     max_conns_per_ip=max_conns_per_ip,
                     max_pipelined_per_iter=max_pipelined_per_iter,
+                    tls_cert=tls_cert, tls_key=tls_key,
+                    ssl_context=ssl_context,
                     registry=self.registry, name="serving")
             self.host, self.port = (self._frontend.host,
                                     self._frontend.port)
         elif self.frontend == "threaded":
+            if tls_cert or tls_key or ssl_context is not None:
+                # TLS termination lives in the event-loop state machine
+                # (non-blocking handshakes); the threaded A/B plane
+                # stays plaintext rather than growing a second,
+                # blocking TLS implementation that could drift
+                raise ValueError(
+                    "TLS requires frontend='eventloop' (the threaded "
+                    "plane is the plaintext A/B baseline)")
             self._frontend = None
             self._server = _Server((host, port), self._handler_class())
             self.host, self.port = self._server.server_address[:2]
@@ -905,6 +949,14 @@ class ServingServer:
                     # at GET /version): the fleet view aggregates this
                     # into its coherent-version-set check
                     "model_version": self.versions.active.version,
+                    # the active version's quantized-wire config (None
+                    # = the f32 plane): wire dtype + dequant constants
+                    # — what serving_wire_bytes_total{dtype} is
+                    # evidence OF
+                    "quantization": (
+                        self.versions.active.quantization.to_dict()
+                        if self.versions.active.quantization is not None
+                        else None),
                     # per-device placement of the active model (tensor-
                     # parallel dispatch mode): mesh axes, device list,
                     # sharded/replicated leaf split, bytes per device —
@@ -1055,11 +1107,20 @@ class ServingServer:
                                  b'over HTTP; poll GET /version until '
                                  b'the staged state settles"}',
                             "application/json")
-                out = self.versions.stage(
-                    source=args["path"],
-                    version=args.get("version"),
-                    warmup_payload=args.get("warmup_payload"),
-                    shadow_fraction=args.get("shadow_fraction"))
+                try:
+                    out = self.versions.stage(
+                        source=args["path"],
+                        version=args.get("version"),
+                        warmup_payload=args.get("warmup_payload"),
+                        shadow_fraction=args.get("shadow_fraction"),
+                        quantization=args.get("quantization"))
+                except ValueError as e:
+                    # a malformed quantization config (zero scale,
+                    # non-finite zero-point, unknown wire dtype) is a
+                    # client error caught at the door — never a staged
+                    # version that dispatches garbage
+                    return (400, json.dumps(
+                        {"error": str(e)}).encode(), "application/json")
                 # 202: staging continues in the background — poll
                 # GET /version until the staged state settles
                 return (202, json.dumps(out).encode(),
@@ -1427,6 +1488,12 @@ class ServingServer:
     def _collect_rest(self, first: _PendingRequest
                       ) -> List[_PendingRequest]:
         batch = [first]
+        # the collection ceiling is the LADDER's top bucket, not the
+        # raw max_batch_size: with a batch multiple that does not
+        # divide the cap (100-row budget over 8 shards -> top bucket
+        # 96), collecting past the top would force a bucket beyond the
+        # operator's ceiling
+        limit = min(self.max_batch_size, self._bucket_sizes()[-1])
         window_ms = self.max_latency_ms
         if self.adaptive_batcher is not None:
             # the adaptive policy picks THIS batch's wait from the
@@ -1440,14 +1507,14 @@ class ServingServer:
         if window_ms <= 0:
             # latency-first mode: take whatever is already queued and
             # serve immediately — no added wait for batch-mates
-            while len(batch) < self.max_batch_size:
+            while len(batch) < limit:
                 try:
                     batch.append(self._queue.get_nowait())
                 except Empty:
                     break
             return batch
         deadline = time.monotonic() + window_ms / 1000.0
-        while len(batch) < self.max_batch_size:
+        while len(batch) < limit:
             remaining = deadline - time.monotonic()
             if remaining <= 0:
                 break
@@ -1513,8 +1580,14 @@ class ServingServer:
         if live:
             t0 = self.tracer.clock.now()
             try:
+                # remember which wire config assembled this frame: the
+                # dispatch stage compares it against ITS version
+                # snapshot and re-assembles on mismatch (a flip landing
+                # in the assemble->dispatch window)
+                job["wire_qc"] = self.versions.active.quantization
                 with self.timings.span("assemble"):
-                    job["df"] = self._assemble_frame(live)
+                    job["df"] = self._assemble_frame(
+                        live, qc=job["wire_qc"])
             except Exception as e:  # noqa: BLE001 — bad payloads -> 500s
                 job["error"] = e
             self._add_spans(live, "assemble", t0, self.tracer.clock.now(),
@@ -1536,10 +1609,15 @@ class ServingServer:
                 self.tracer.add("queue_wait", p.t_enqueue, now,
                                 parent=p.span)
         job = {"batch_n": len(batch), "live": [], "n_live": 0,
-               "df": None, "out": None, "error": None, "version": None}
+               "df": None, "out": None, "error": None, "version": None,
+               "wire_qc": None}
         return self._refresh_live(job, batch)
 
-    def _assemble_frame(self, live: List[_PendingRequest]) -> DataFrame:
+    #: sentinel: "use the active version's quantization config"
+    _ACTIVE_QC = object()
+
+    def _assemble_frame(self, live: List[_PendingRequest],
+                        qc=_ACTIVE_QC) -> DataFrame:
         """Payloads -> columnar frame, padded up to the shared bucket.
 
         ``DataFrame.from_rows`` builds one list per column straight off
@@ -1548,14 +1626,33 @@ class ServingServer:
         column is edge-padded (repeat last row: valid for object/string
         columns) to the power-of-two bucket, so any live batch size maps
         onto a bounded set of dispatch shapes.
+
+        ``qc`` is the wire config the frame is cast for (default: the
+        active version's): the quantized wire starts HERE — columns
+        drop to the wire dtype before bucket padding (edge-padding
+        1-byte rows, not the 8-byte int64 ``from_rows`` produced) and
+        before the device upload. Staged-version warmup passes its own
+        config, and the dispatch stage re-assembles from the RAW
+        payloads when a flip changed the config mid-window (casting is
+        lossy, so a cast frame cannot be re-cast for a different
+        plane).
         """
         payloads = [p.payload if isinstance(p.payload, dict)
                     else {"value": p.payload} for p in live]
         df = DataFrame.from_rows(payloads)
+        if qc is self._ACTIVE_QC:
+            qc = self.versions.active.quantization
+        if qc is not None and df.columns:
+            df = qc.quantize_frame(df)
         if self.bucket_batches and df.columns:
+            # TP-aware ladder: buckets are rounded up to the model's
+            # batch multiple HERE, once, so data/tensor-sharded
+            # dispatch (dist.put_batch / batch_sharding) never re-pads
+            mult = self._batch_multiple()
             df = DataFrame({
                 n: padded_device_batch(df[n], self.max_batch_size,
-                                       bucket=True, pad_mode="edge")[0]
+                                       bucket=True, pad_mode="edge",
+                                       multiple=mult)[0]
                 for n in df.columns})
         return df
 
@@ -1565,19 +1662,35 @@ class ServingServer:
         exactly what forces a retrace in any jitted model."""
         return (df.num_rows, tuple(sorted(df.schema().items())))
 
-    def _bucket_sizes(self) -> List[int]:
-        """Every reachable shape bucket: the pow2 ladder clamped at
-        max_batch_size (shared by warmup() and staged-version warmup —
-        the two must warm the same set or flips retrace)."""
-        return bucket_ladder(self.max_batch_size)
+    def _batch_multiple(self, model=None) -> int:
+        """A model's batch divisibility constraint (the mesh data-axis
+        size for TP/data-sharded models; 1 for everything else) — the
+        ACTIVE model's by default, read per call so a flip to a
+        differently-sharded version moves the ladder with it."""
+        if model is None:
+            model = self.versions.active.model
+        return max(int(getattr(model, "batch_multiple", 1) or 1), 1)
 
-    def _warmup_frame(self, payload: Any, n: int) -> DataFrame:
+    def _bucket_sizes(self, model=None) -> List[int]:
+        """Every reachable shape bucket: the pow2 ladder clamped at
+        max_batch_size, rounded up to the model's batch multiple
+        (the active model's by default; staged-version warmup passes
+        the STAGED model, whose sharding may differ — it must warm the
+        ladder live traffic will dispatch AFTER the flip, or the flip
+        retraces)."""
+        return bucket_ladder(self.max_batch_size,
+                             multiple=self._batch_multiple(model))
+
+    def _warmup_frame(self, payload: Any, n: int,
+                      qc=_ACTIVE_QC) -> DataFrame:
         """One synthetic bucket-shaped frame, built exactly like live
-        traffic's (payload -> rows -> bucket padding), so a model
-        warmed on it compiles the very executables live dispatch
-        uses."""
+        traffic's (payload -> rows -> wire cast -> bucket padding), so
+        a model warmed on it compiles the very executables live
+        dispatch uses. ``qc`` overrides the wire config (staged-
+        version warmup: the STAGED plane's dtypes, not the active
+        one's)."""
         return self._assemble_frame(
-            [_PendingRequest(payload) for _ in range(n)])
+            [_PendingRequest(payload) for _ in range(n)], qc=qc)
 
     def _stage_dispatch(self, job: dict) -> dict:
         """Stage 2 (executor): push the bucketed frame through the
@@ -1603,8 +1716,28 @@ class ServingServer:
             mv = self.versions.active
             job["version"] = mv.version
             t0 = self.tracer.clock.now()
+            qc = mv.quantization
             try:
+                if job.get("wire_qc", qc) != qc:
+                    # a flip changed the wire contract between assemble
+                    # and dispatch (rare — the window is one pipeline
+                    # handoff): the cast is lossy, so re-assemble from
+                    # the RAW payloads for THIS version's plane rather
+                    # than mis-feeding frames cast for the old one
+                    df = self._assemble_frame(job["live"], qc=qc)
+                    job["df"], job["wire_qc"] = df, qc
                 key = self._shape_key(df)
+                # bytes-on-wire evidence, by column dtype: what this
+                # dispatch actually moves host->device (u8 rows are 4x
+                # smaller than the f32 plane's)
+                wire: Dict[str, int] = {}
+                for c in df.columns:
+                    a = df[c]
+                    if a.dtype != np.dtype("O"):
+                        name = a.dtype.name
+                        wire[name] = wire.get(name, 0) + int(a.nbytes)
+                for name, nb in wire.items():
+                    self._m_wire_bytes.labels(name).inc(nb)
                 with self._stats_lock:
                     if key not in self._shapes_seen:
                         self.n_recompiles += 1
@@ -1652,6 +1785,9 @@ class ServingServer:
                 job["error"] = e
             span_attrs = {"bucket": df.num_rows,
                           "model_version": mv.version}
+            if qc is not None:
+                # a captured slow dispatch says which wire it rode
+                span_attrs["wire_dtype"] = qc.wire_dtype
             # tensor-parallel dispatch carries its placement on the
             # span (a cheap precomputed label like "data=4,model=2"),
             # so a captured slow dispatch says where it ran
@@ -2352,7 +2488,11 @@ class ServingCoordinator:
                         "application/json")
             try:
                 run = self.rollout(**args)
-            except TypeError as e:
+            except (TypeError, ValueError) as e:
+                # TypeError: unknown parameter; ValueError: a malformed
+                # value (e.g. a zero-scale quantization config) — both
+                # are client errors, refused before any worker is asked
+                # to stage anything
                 return (400, json.dumps(
                     {"error": f"bad rollout parameter: {e}"}).encode(),
                     "application/json")

@@ -21,6 +21,14 @@ import socket
 import time
 from typing import Dict, Iterable, Optional, Tuple
 
+try:
+    import ssl as _ssl
+except ImportError:  # pragma: no cover
+    _ssl = None  # type: ignore[assignment]
+
+_WANT = ((_ssl.SSLWantReadError, _ssl.SSLWantWriteError)
+         if _ssl is not None else ())
+
 __all__ = ["drive_keepalive", "build_request"]
 
 _CRLF2 = b"\r\n\r\n"
@@ -42,7 +50,7 @@ def build_request(host: str, path: str, payload: bytes,
 
 class _ClientConn:
     __slots__ = ("sock", "out", "buf", "t_send", "n_done", "awaiting",
-                 "connected")
+                 "connected", "hs")
 
     def __init__(self, sock: socket.socket):
         self.sock = sock
@@ -52,6 +60,7 @@ class _ClientConn:
         self.n_done = 0
         self.awaiting = False   # a response is outstanding
         self.connected = False
+        self.hs = False         # TLS handshake in progress
 
 
 def drive_keepalive(host: str, port: int, path: str = "/predict",
@@ -61,7 +70,10 @@ def drive_keepalive(host: str, port: int, path: str = "/predict",
                     requests_per_conn: Optional[int] = None,
                     extra_headers: Iterable[Tuple[str, str]] = (),
                     settle_timeout: float = 30.0,
-                    connect_burst: int = 256) -> Dict[str, object]:
+                    connect_burst: int = 256,
+                    ssl_context=None,
+                    tls_server_hostname: Optional[str] = None
+                    ) -> Dict[str, object]:
     """Drive ``n_connections`` concurrent keep-alive connections, each
     cycling serial request/response (a new request leaves only after
     the previous response arrived — pipelining-free, like real
@@ -120,6 +132,8 @@ def drive_keepalive(host: str, port: int, path: str = "/predict",
                 c.out = c.out[n:]
             except (BlockingIOError, InterruptedError):
                 pass
+            except _WANT:
+                pass
             except OSError:
                 fail(c)
                 return
@@ -129,6 +143,49 @@ def drive_keepalive(host: str, port: int, path: str = "/predict",
             sel.modify(c.sock, want, c)
         except (KeyError, ValueError, OSError):
             pass
+
+    def start_tls(c: _ClientConn) -> None:
+        """Upgrade a just-connected socket: re-register the wrapped
+        SSLSocket (wrap detaches the plain one) and drive the
+        handshake from loop events."""
+        try:
+            sel.unregister(c.sock)
+        except (KeyError, ValueError):
+            pass
+        try:
+            kw = {}
+            if tls_server_hostname is not None:
+                kw["server_hostname"] = tls_server_hostname
+            c.sock = ssl_context.wrap_socket(
+                c.sock, do_handshake_on_connect=False, **kw)
+        except (OSError, ValueError):
+            fail(c)
+            return
+        c.hs = True
+        sel.register(c.sock,
+                     selectors.EVENT_READ | selectors.EVENT_WRITE, c)
+        try_handshake(c)
+
+    def try_handshake(c: _ClientConn) -> None:
+        try:
+            c.sock.do_handshake()
+        except _ssl.SSLWantReadError:
+            try:
+                sel.modify(c.sock, selectors.EVENT_READ, c)
+            except (KeyError, ValueError, OSError):
+                pass
+            return
+        except _ssl.SSLWantWriteError:
+            try:
+                sel.modify(c.sock, selectors.EVENT_WRITE, c)
+            except (KeyError, ValueError, OSError):
+                pass
+            return
+        except OSError:
+            fail(c)
+            return
+        c.hs = False
+        send_next(c, time.perf_counter())
 
     # -- connect phase: bounded bursts so n_connections SYNs never
     # overflow the listen backlog at once
@@ -160,12 +217,18 @@ def drive_keepalive(host: str, port: int, path: str = "/predict",
         while pending and time.perf_counter() < t_burst:
             for key, _mask in sel.select(timeout=0.25):
                 c = key.data
+                if c.hs and c not in pending:
+                    try_handshake(c)     # earlier bursts' TLS upgrades
+                    continue
                 if c in pending:
                     err = c.sock.getsockopt(socket.SOL_SOCKET,
                                             socket.SO_ERROR)
                     pending.discard(c)
                     if err:
                         fail(c)
+                    elif ssl_context is not None:
+                        c.connected = True
+                        start_tls(c)     # handshake rides loop events
                     else:
                         c.connected = True
                         send_next(c, time.perf_counter())
@@ -192,6 +255,9 @@ def drive_keepalive(host: str, port: int, path: str = "/predict",
             c = key.data
             if c not in live:
                 continue
+            if c.hs:
+                try_handshake(c)
+                continue
             if mask & selectors.EVENT_WRITE:
                 if not c.connected:
                     c.connected = True
@@ -202,7 +268,17 @@ def drive_keepalive(host: str, port: int, path: str = "/predict",
                 continue
             try:
                 data = c.sock.recv(65536)
+                if ssl_context is not None and data:
+                    # decrypted bytes can sit in the SSL layer with
+                    # nothing left on the raw fd — drain them now
+                    while c.sock.pending():
+                        more = c.sock.recv(65536)
+                        if not more:
+                            break
+                        data += more
             except (BlockingIOError, InterruptedError):
+                continue
+            except _WANT:
                 continue
             except OSError:
                 fail(c)

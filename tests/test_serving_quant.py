@@ -1,0 +1,344 @@
+"""The quantized serving plane (ISSUE 13).
+
+Four pillars:
+
+* **parity with the f32 plane** — the same rows through a quantized
+  (u8-wire, on-device-dequant) server and an f32 server agree row-wise
+  within fp tolerance, on BOTH frontends;
+* **zero retraces** — the quantized warmup ladder closes the compiled
+  shape set: varied live batch sizes never grow ``n_recompiles``;
+* **the config is load-bearing end to end** — it rides the
+  ModelVersion through stage -> verify -> warmup -> flip (and a
+  persisted checkpoint carries its own), and a malformed
+  scale/zero-point is a 400 at the rollout endpoints, never a batch of
+  garbage;
+* **TP-aware ladders** — bucket targets round up to the model's batch
+  multiple once, at assemble time.
+"""
+
+import json
+
+import numpy as np
+import pytest
+import requests
+
+from mmlspark_tpu.core.dataframe import DataFrame
+from mmlspark_tpu.models.function import NNFunction
+from mmlspark_tpu.models.nn import NNModel
+from mmlspark_tpu.serving import QuantizationConfig, ServingServer
+
+D_IN = 8
+FN = NNFunction.init({"builder": "mlp", "hidden": [16],
+                      "num_outputs": 3}, input_shape=(D_IN,), seed=0)
+SCALE = 0.125
+ZP = -2.0
+
+
+def _model(**kw) -> NNModel:
+    return NNModel(model=FN, input_col="x", output_col="y",
+                   batch_size=64, cache_inputs=False,
+                   data_parallel=False, **kw)
+
+
+def _quant_server(**kw) -> ServingServer:
+    kw.setdefault("quantization",
+                  {"wire_dtype": "uint8", "scale": SCALE,
+                   "zero_point": ZP})
+    return ServingServer(_model(), max_latency_ms=0, max_batch_size=16,
+                         verify_checkpoints=False, **kw)
+
+
+class TestQuantizationConfig:
+
+    @pytest.mark.parametrize("bad", [
+        {"scale": 0}, {"scale": float("nan")}, {"scale": float("inf")},
+        {"zero_point": float("inf")}, {"zero_point": "x"},
+        {"wire_dtype": "u4"}, {"wire_dtype": "float32"},
+        {"columns": "x"}, {"columns": [1]},
+        {"zero_pont": 1.0},          # typoed key must not default
+        "uint8", 7,
+    ])
+    def test_malformed_configs_refused(self, bad):
+        with pytest.raises(ValueError):
+            QuantizationConfig.from_value(bad)
+
+    def test_saturating_cast_never_wraps(self):
+        qc = QuantizationConfig("uint8")
+        col = np.array([[-5.0, 0.0, 255.0, 300.0]])
+        out = qc.quantize_column(col)
+        assert out.dtype == np.uint8
+        assert out.tolist() == [[0, 0, 255, 255]]
+        i8 = QuantizationConfig("int8")
+        out8 = i8.quantize_column(np.array([[-200, -128, 127, 200]]))
+        assert out8.dtype == np.int8
+        assert out8.tolist() == [[-128, -128, 127, 127]]
+
+    def test_in_range_int_fast_path_matches_clip(self):
+        qc = QuantizationConfig("uint8")
+        a = np.arange(256, dtype=np.int64)
+        assert (qc.quantize_column(a)
+                == np.clip(a, 0, 255).astype(np.uint8)).all()
+
+    def test_column_scoping_and_objects_pass_through(self):
+        qc = QuantizationConfig("uint8", columns=["x"])
+        df = DataFrame({"x": np.array([[1.0, 2.0]]),
+                        "other": np.array([3.0])})
+        out = qc.quantize_frame(df)
+        assert out["x"].dtype == np.uint8
+        assert out["other"].dtype == np.float64
+        obj = qc.quantize_column(np.array([None, "s"], dtype=object))
+        assert obj.dtype == np.dtype("O")
+
+    def test_roundtrip_and_model_wiring(self):
+        qc = QuantizationConfig.from_value(
+            {"wire_dtype": "int8", "scale": 0.5, "zero_point": 1.0})
+        assert QuantizationConfig.from_value(qc) is qc
+        assert QuantizationConfig.from_value(qc.to_dict()) == qc
+        m = _model()
+        qc.configure_model(m)
+        assert m.input_dtype == "int8"
+        assert m.input_scale == 0.5 and m.input_offset == 1.0
+
+    def test_nnmodel_persists_its_quantization(self, tmp_path):
+        m = _model(quantization=QuantizationConfig(
+            "uint8", scale=SCALE, zero_point=ZP))
+        p = str(tmp_path / "qmodel")
+        m.save(p)
+        from mmlspark_tpu.core.stage import PipelineStage
+        loaded = PipelineStage.load(p)
+        assert loaded.quantization == m.quantization
+
+
+class TestQuantizedServing:
+
+    @pytest.mark.parametrize("frontend", ["eventloop", "threaded"])
+    def test_rowwise_parity_with_f32_plane(self, frontend):
+        rng = np.random.default_rng(0)
+        q_rows = rng.integers(0, 256, size=(6, D_IN))
+        f_rows = q_rows * SCALE + ZP
+        outs = {}
+        for name, srv in (
+                ("f32", ServingServer(_model(input_dtype="float32"),
+                                      max_latency_ms=0,
+                                      max_batch_size=16,
+                                      verify_checkpoints=False,
+                                      frontend=frontend)),
+                ("u8", _quant_server(frontend=frontend))):
+            with srv:
+                srv.warmup({"x": [0] * D_IN} if name == "u8"
+                           else {"x": [0.0] * D_IN})
+                rows = f_rows if name == "f32" else q_rows
+                ys = []
+                for r in rows:
+                    body = {"x": ([float(v) for v in r]
+                                  if name == "f32"
+                                  else [int(v) for v in r])}
+                    rsp = requests.post(srv.address, json=body,
+                                        timeout=30)
+                    assert rsp.status_code == 200
+                    ys.append(rsp.json()["y"])
+                outs[name] = np.asarray(ys, dtype=np.float64)
+        # the u8 grid's dequantized values are fed to the f32 plane
+        # exactly, so any difference is fp noise, not quantization
+        assert np.abs(outs["f32"] - outs["u8"]).max() < 1e-5
+
+    def test_quantized_warmup_closes_the_shape_set(self):
+        with _quant_server() as srv:
+            srv.warmup({"x": [0] * D_IN})
+            warmed = srv.n_recompiles
+            for n in (1, 2, 3, 5, 7, 11, 16):
+                for i in range(n):
+                    r = requests.post(
+                        srv.address,
+                        json={"x": [i % 256] * D_IN}, timeout=30)
+                    assert r.status_code == 200
+            assert srv.n_recompiles == warmed
+            stats = requests.get(
+                f"http://{srv.host}:{srv.port}/stats",
+                timeout=10).json()
+            assert stats["quantization"]["wire_dtype"] == "uint8"
+            met = requests.get(
+                f"http://{srv.host}:{srv.port}/metrics",
+                timeout=10).text
+            wire = [ln for ln in met.splitlines()
+                    if ln.startswith("serving_wire_bytes_total")]
+            # every dispatched byte was u8 — the f32 label never
+            # appears on a quantized worker's wire
+            assert wire and all('dtype="uint8"' in ln for ln in wire)
+
+    def test_out_of_range_payload_saturates_not_garbage(self):
+        with _quant_server() as srv:
+            srv.warmup({"x": [0] * D_IN})
+            hi = requests.post(srv.address,
+                               json={"x": [9999] * D_IN}, timeout=30)
+            capped = requests.post(srv.address,
+                                   json={"x": [255] * D_IN}, timeout=30)
+            assert hi.status_code == capped.status_code == 200
+            assert np.allclose(hi.json()["y"], capped.json()["y"])
+
+
+class TestQuantizedRollout:
+
+    def _staged_flip(self, tmp_path, stage_kwargs, expect):
+        m2 = _model()
+        p = str(tmp_path / "v2")
+        m2.save(p)
+        with ServingServer(_model(input_dtype="float32"),
+                           max_latency_ms=0, max_batch_size=8) as srv:
+            srv.warmup({"x": [0.0] * D_IN})
+            srv.versions.stage(source=p, version="v2", sync=True,
+                               **stage_kwargs)
+            staged = srv.versions.staged
+            assert staged.state == "staged", staged.error
+            assert staged.quantization == expect
+            srv.versions.flip()
+            active = srv.versions.active
+            assert active.version == "v2"
+            # the config survived the whole lifecycle
+            assert active.quantization == expect
+            if expect is not None:
+                assert active.model.input_dtype == expect.wire_dtype
+            # live traffic on the flipped quantized plane: no
+            # post-flip recompiles (the staged warmup compiled the
+            # WIRE dtypes), saturating ingest
+            for n in (1, 3, 8):
+                r = requests.post(
+                    srv.address,
+                    json={"x": [n] * D_IN}, timeout=30)
+                assert r.status_code == 200
+            assert active.n_post_flip_recompiles == 0
+
+    def test_config_survives_stage_verify_warmup_flip(self, tmp_path):
+        qc = QuantizationConfig("uint8", scale=SCALE, zero_point=ZP)
+        self._staged_flip(
+            tmp_path,
+            {"quantization": {"wire_dtype": "uint8", "scale": SCALE,
+                              "zero_point": ZP}}, qc)
+
+    def test_persisted_checkpoint_carries_its_own_config(self, tmp_path):
+        qc = QuantizationConfig("uint8", scale=SCALE, zero_point=ZP)
+        m2 = _model(quantization=qc)
+        p = str(tmp_path / "v2q")
+        m2.save(p)
+        with ServingServer(_model(input_dtype="float32"),
+                           max_latency_ms=0, max_batch_size=8) as srv:
+            srv.warmup({"x": [0.0] * D_IN})
+            srv.versions.stage(source=p, version="v2", sync=True)
+            staged = srv.versions.staged
+            assert staged.state == "staged", staged.error
+            # no config passed to stage(): the checkpoint's own wins
+            assert staged.quantization == qc
+
+    @pytest.mark.parametrize("frontend", ["eventloop", "threaded"])
+    def test_malformed_quant_config_400s_at_stage(self, tmp_path,
+                                                  frontend):
+        m2 = _model()
+        p = str(tmp_path / "v2")
+        m2.save(p)
+        with ServingServer(_model(), max_latency_ms=0,
+                           max_batch_size=8,
+                           frontend=frontend) as srv:
+            r = requests.post(
+                f"http://{srv.host}:{srv.port}/rollout/stage",
+                json={"path": p, "version": "v2",
+                      "quantization": {"wire_dtype": "uint8",
+                                       "scale": 0.0}},
+                timeout=30)
+            assert r.status_code == 400
+            assert "scale" in r.json()["error"]
+            # nothing was staged
+            assert srv.versions.staged is None
+
+    def test_malformed_config_refused_at_server_construction(self):
+        with pytest.raises(ValueError, match="scale"):
+            ServingServer(_model(), quantization={"scale": float("nan")})
+
+    def test_orchestrator_validates_up_front(self):
+        from mmlspark_tpu.serving import ServingCoordinator
+        with ServingCoordinator() as coord:
+            r = requests.post(
+                f"http://{coord.host}:{coord.port}/rollout",
+                json={"version": "v2", "path": "/nope",
+                      "quantization": {"wire_dtype": "u4"}},
+                timeout=30)
+            assert r.status_code == 400
+            assert "wire_dtype" in r.json()["error"]
+
+
+class TestTpAwareLadders:
+
+    def test_bucket_target_and_ladder_with_multiple(self):
+        from mmlspark_tpu.parallel.sharding import (
+            _effective_cap, bucket_ladder, bucket_target,
+            round_to_multiple)
+        for cap in (1, 2, 7, 64, 100, 1024):
+            for m in (1, 2, 3, 8):
+                eff = _effective_cap(cap, m)
+                scan = sorted({bucket_target(n, cap, multiple=m)
+                               for n in range(1, eff + 1)})
+                assert scan == bucket_ladder(cap, m), (cap, m)
+                assert all(b % m == 0 for b in bucket_ladder(cap, m))
+        # the cap stays an operator CEILING: a non-dividing multiple
+        # rounds the cap DOWN (96, not 104, tops a 100-row budget over
+        # 8 shards); a multiple past the cap is the dispatch floor
+        assert bucket_ladder(100, 8)[-1] == 96
+        assert max(bucket_ladder(100, 8)) <= 100
+        assert bucket_target(5, 8, multiple=3) == 6   # ceil'd at eff 6
+        assert bucket_ladder(4, 8) == [8]             # multiple wins
+        assert round_to_multiple(10, 4) == 12
+        assert round_to_multiple(10, 4, up=False) == 8
+        assert round_to_multiple(2, 4, up=False) == 4  # never below
+
+    def test_server_ladder_tracks_the_model_multiple(self):
+        class Multi:
+            batch_multiple = 4
+
+            def transform(self, df):
+                return df
+
+        srv = ServingServer(Multi(), max_latency_ms=0,
+                            max_batch_size=16,
+                            verify_checkpoints=False)
+        try:
+            assert srv._bucket_sizes() == [4, 8, 16]
+            srv.warmup({"x": 1.0})
+            # every dispatched bucket honors the multiple: sharded
+            # dispatch never needs to re-pad inside put_batch
+            assert all(b % 4 == 0 for b in
+                       {k[0] for k in srv._shapes_seen})
+        finally:
+            srv.stop(drain=False)
+
+    def test_staged_version_warms_its_own_ladder(self):
+        """A staged model whose sharding differs from the active one's
+        must warm ITS ladder (the buckets live traffic dispatches
+        after the flip), not the active model's — or the flip lands in
+        a recompile storm."""
+        class Plain:
+            def transform(self, df):
+                return df
+
+        class Multi(Plain):
+            batch_multiple = 4
+
+        srv = ServingServer(Plain(), max_latency_ms=0,
+                            max_batch_size=16,
+                            verify_checkpoints=False)
+        try:
+            srv.warmup({"x": 1.0})
+            assert srv._bucket_sizes() == [1, 2, 4, 8, 16]
+            srv.versions.stage(model=Multi(), version="v2", sync=True)
+            staged = srv.versions.staged
+            assert staged.state == "staged", staged.error
+            assert staged.warmed_buckets == [4, 8, 16]
+            srv.versions.flip()
+            assert srv._bucket_sizes() == [4, 8, 16]
+        finally:
+            srv.stop(drain=False)
+
+    def test_nnmodel_batch_multiple_is_config_derived(self):
+        import jax
+        n_dev = len(jax.devices())
+        assert _model().batch_multiple == 1   # data_parallel off
+        dp = NNModel(model=FN, input_col="x", output_col="y")
+        assert dp.batch_multiple == max(n_dev, 1)

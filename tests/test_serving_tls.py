@@ -1,0 +1,234 @@
+"""TLS termination at the event-loop edge (ISSUE 13).
+
+The handshake is a first-class connection state (non-blocking,
+WantRead/WantWrite re-registration), so every event-loop property must
+survive encryption: keep-alive reuse, chunked-SSE token streaming,
+idle/slow-loris sweeps, and clean rejection of non-TLS bytes. Tests
+skip when the box cannot mint a self-signed cert or the interpreter
+lacks the server-side TLS protocol.
+"""
+
+import json
+import socket
+import ssl
+import time
+
+import numpy as np
+import pytest
+import requests
+
+from mmlspark_tpu.core.stage import Transformer
+from mmlspark_tpu.serving import ServingServer
+from mmlspark_tpu.testing.load import drive_keepalive
+from mmlspark_tpu.testing.tls import (
+    client_context, generate_self_signed_cert, tls_supported,
+)
+
+_OK, _WHY = tls_supported()
+pytestmark = pytest.mark.skipif(not _OK, reason=f"TLS tests: {_WHY}")
+
+
+class Identity(Transformer):
+    def transform(self, df):
+        return df.with_column("y", np.asarray(df["x"],
+                                              dtype=np.float64))
+
+
+@pytest.fixture(scope="module")
+def cert_pair(tmp_path_factory):
+    d = tmp_path_factory.mktemp("tls")
+    return generate_self_signed_cert(str(d))
+
+
+def _tls_server(cert_pair, **kw):
+    cert, key = cert_pair
+    return ServingServer(Identity(), max_latency_ms=0,
+                         max_batch_size=16, tls_cert=cert, tls_key=key,
+                         verify_checkpoints=False, **kw)
+
+
+class TestTlsEdge:
+
+    def test_keepalive_drive_zero_errors(self, cert_pair):
+        """The acceptance harness: concurrent keep-alive connections
+        over TLS, serial request/response cycles, ZERO connection or
+        HTTP errors, reuse held."""
+        with _tls_server(cert_pair) as srv:
+            srv.warmup({"x": 0.0})
+            warm = srv.n_recompiles
+            out = drive_keepalive(
+                srv.host, srv.port, srv.api_path, b'{"x": 1.5}',
+                n_connections=50, requests_per_conn=8,
+                ssl_context=client_context(cert_pair[0]))
+            assert out["conn_errors"] == 0
+            assert out["http_errors"] == 0
+            assert out["requests"] == 50 * 8
+            assert out["reuse_rate"] == pytest.approx(1 - 1 / 8)
+            assert srv.n_recompiles == warm
+            fe = srv._frontend.stats()
+            assert fe["tls"] is True
+            assert fe["tls_handshakes_total"] == 50
+            assert fe["tls_handshake_failures_total"] == 0
+
+    def test_requests_https_client_and_replay(self, cert_pair):
+        """An off-the-shelf HTTPS client (requests) speaks to the
+        edge: predict, /stats, and the exactly-once replay journal all
+        ride the encrypted socket."""
+        with _tls_server(cert_pair) as srv:
+            srv.warmup({"x": 0.0})
+            with requests.Session() as s:
+                # per-request verify: a REQUESTS_CA_BUNDLE env var (CI
+                # images set one) silently overrides Session.verify
+                cert = cert_pair[0]
+                base = f"https://127.0.0.1:{srv.port}"
+                r = s.post(base + srv.api_path, json={"x": 2.0},
+                           headers={"X-Request-Id": "tls-1"},
+                           verify=cert, timeout=30)
+                assert r.status_code == 200 and r.json()["y"] == 2.0
+                r2 = s.post(base + srv.api_path, json={"x": 2.0},
+                            headers={"X-Request-Id": "tls-1"},
+                            verify=cert, timeout=30)
+                assert r2.headers.get("X-Replayed") == "1"
+                assert s.get(base + "/stats", verify=cert,
+                             timeout=30).json()[
+                    "frontend"]["tls"] is True
+
+    def test_plaintext_byte_on_tls_port_closes_cleanly(self, cert_pair):
+        with _tls_server(cert_pair) as srv:
+            s = socket.create_connection((srv.host, srv.port),
+                                         timeout=10)
+            s.sendall(b"GET /healthz HTTP/1.1\r\n\r\n")
+            # the server treats the plaintext bytes as a failed
+            # handshake: connection closed (EOF or RST), never a hang
+            # or a served request
+            s.settimeout(5)
+            try:
+                data = s.recv(256)
+            except (ConnectionResetError, socket.timeout):
+                data = b""
+            assert data == b""
+            s.close()
+            t_end = time.monotonic() + 5
+            while srv._frontend.n_tls_handshake_failures == 0 \
+                    and time.monotonic() < t_end:
+                time.sleep(0.01)
+            assert srv._frontend.n_tls_handshake_failures >= 1
+            # and the edge still serves TLS afterwards
+            r = requests.post(f"https://127.0.0.1:{srv.port}"
+                              + srv.api_path, json={"x": 1.0},
+                              verify=cert_pair[0], timeout=30)
+            assert r.status_code == 200
+
+    def test_mid_handshake_stall_reaped(self, cert_pair):
+        """A peer that connects and never speaks is the TLS
+        slow-loris: reaped by the sweep on the handshake's age."""
+        with _tls_server(cert_pair, idle_timeout=0.3) as srv:
+            s = socket.create_connection((srv.host, srv.port),
+                                         timeout=10)
+            t_end = time.monotonic() + 5
+            reaped = False
+            while time.monotonic() < t_end:
+                s.settimeout(0.2)
+                try:
+                    if s.recv(64) == b"":
+                        reaped = True
+                        break
+                except socket.timeout:
+                    continue
+                except OSError:
+                    reaped = True
+                    break
+            assert reaped
+            assert srv._frontend.n_idle_reaped >= 1
+            s.close()
+
+    def test_tls_needs_eventloop_frontend(self, cert_pair):
+        cert, key = cert_pair
+        with pytest.raises(ValueError, match="eventloop"):
+            ServingServer(Identity(), frontend="threaded",
+                          tls_cert=cert, tls_key=key)
+
+    def test_cert_without_key_refused(self, cert_pair):
+        with pytest.raises(ValueError, match="BOTH"):
+            ServingServer(Identity(), tls_cert=cert_pair[0])
+
+
+class TestTlsStreaming:
+    """Chunked-SSE token streaming rides the encrypted socket."""
+
+    def test_streamed_decode_over_tls(self, cert_pair):
+        from mmlspark_tpu.models import transformer as T
+        from mmlspark_tpu.serving import (
+            DecodeScheduler, TransformerDecoder)
+        cfg = T.TransformerConfig(vocab=64, d_model=16, n_heads=2,
+                                  d_head=8, d_ff=32, n_stages=1,
+                                  layers_per_stage=2)
+        params = T.init_params(cfg, seed=0)
+        sched = DecodeScheduler(
+            TransformerDecoder(params, cfg, n_slots=2, max_len=32),
+            max_new_tokens_default=8)
+        cert, key = cert_pair
+        with ServingServer(Identity(), decoder=sched,
+                           max_latency_ms=1.0, tls_cert=cert,
+                           tls_key=key,
+                           verify_checkpoints=False) as srv:
+            ctx = client_context(cert)
+            raw = socket.create_connection((srv.host, srv.port),
+                                           timeout=30)
+            s = ctx.wrap_socket(raw)
+            body = json.dumps({"prompt": [1, 2, 3],
+                               "max_new_tokens": 4}).encode()
+            s.sendall(b"POST /generate?stream=1 HTTP/1.1\r\n"
+                      b"Host: x\r\nContent-Type: application/json\r\n"
+                      b"Content-Length: %d\r\n\r\n%s"
+                      % (len(body), body))
+            head, events = _read_chunked_sse(s)
+            assert b" 200 " in head.split(b"\r\n")[0]
+            assert b"text/event-stream" in head
+            toks = [e["token"] for e in events if "done" not in e]
+            final = [e for e in events if e.get("done")][0]
+            assert toks == final["tokens"] and len(toks) == 4
+            # keep-alive after the terminal chunk, same TLS socket
+            body2 = json.dumps({"prompt": [1, 2, 3],
+                                "max_new_tokens": 2}).encode()
+            s.sendall(b"POST /generate HTTP/1.1\r\nHost: x\r\n"
+                      b"Content-Length: %d\r\n\r\n%s"
+                      % (len(body2), body2))
+            buf = b""
+            t_end = time.monotonic() + 20
+            while (b"\r\n\r\n" not in buf or b"tokens" not in buf) \
+                    and time.monotonic() < t_end:
+                c = s.recv(65536)
+                if not c:
+                    break
+                buf += c
+            assert b" 200 " in buf.split(b"\r\n")[0]
+            s.close()
+            assert srv.decoder.pool.n_free == 2
+
+
+def _read_chunked_sse(sock):
+    """One chunked SSE response off ``sock`` (TLS-aware recv)."""
+    buf = b""
+    while b"\r\n\r\n" not in buf:
+        buf += sock.recv(65536)
+    head, _, rest = buf.partition(b"\r\n\r\n")
+    data = rest
+    while b"0\r\n\r\n" not in data:
+        chunk = sock.recv(65536)
+        if not chunk:
+            break
+        data += chunk
+    body = b""
+    while data:
+        line, _, data = data.partition(b"\r\n")
+        if not line:
+            continue
+        n = int(line, 16)
+        if n == 0:
+            break
+        body += data[:n]
+        data = data[n + 2:]
+    events = [json.loads(e.split(b"data: ", 1)[1])
+              for e in body.split(b"\n\n") if e.strip()]
+    return head, events

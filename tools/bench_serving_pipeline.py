@@ -77,18 +77,30 @@ def _identity_model():
     return Identity()
 
 
-def _nn_model():
+def _nn_model(wire_dtype: str = "float32"):
     from mmlspark_tpu.models.function import NNFunction
     from mmlspark_tpu.models.nn import NNModel
 
     fn = NNFunction.init({"builder": "mlp", "hidden": [32],
                           "num_outputs": 4}, input_shape=(8,), seed=0)
+    kw = {}
+    if wire_dtype != "float32":
+        # the quantized wire (docs/serving.md "The quantized wire"):
+        # one config drives the server-side cast AND the on-device
+        # dequant fused into the model's first layer
+        from mmlspark_tpu.serving import QuantizationConfig
+        kw["quantization"] = QuantizationConfig(wire_dtype=wire_dtype,
+                                                scale=1.0 / 7.0)
     return NNModel(model=fn, input_col="x", output_col="y", batch_size=64,
-                   cache_inputs=False, data_parallel=False)
+                   cache_inputs=False, data_parallel=False, **kw)
 
 
-def _payload(model_kind: str, i: int) -> bytes:
+def _payload(model_kind: str, i: int,
+             wire_dtype: str = "float32") -> bytes:
     if model_kind == "nn":
+        if wire_dtype != "float32":
+            return json.dumps({"x": [(i + j) % 7 for j in range(8)]}
+                              ).encode()
         return json.dumps({"x": [float((i + j) % 7) for j in range(8)]}
                           ).encode()
     return json.dumps({"x": float(i)}).encode()
@@ -139,10 +151,11 @@ def _metrics_text(srv) -> str:
 def run_mode(mode: str, model_kind: str, n_clients: int,
              duration_s: float, max_batch_size: int,
              burst: int, metrics_dump: str = "",
-             trace_dump: str = "") -> dict:
+             trace_dump: str = "", wire_dtype: str = "float32") -> dict:
     from mmlspark_tpu.serving import ServingServer
 
-    model = _nn_model() if model_kind == "nn" else _identity_model()
+    model = (_nn_model(wire_dtype) if model_kind == "nn"
+             else _identity_model())
     pipelined = mode == "pipelined"
     counts = [0] * n_clients
     lat = [[] for _ in range(n_clients)]
@@ -158,13 +171,13 @@ def run_mode(mode: str, model_kind: str, n_clients: int,
                        bucket_batches=pipelined,
                        **({"tracer": tracer, "slow_trace_ms": 0.0}
                           if tracer else {})) as srv:
-        srv.warmup(json.loads(_payload(model_kind, 0)))
+        srv.warmup(json.loads(_payload(model_kind, 0, wire_dtype)))
         recompiles_warm = _stats(srv)["n_recompiles"]
         deadline = time.perf_counter() + duration_s
         threads = [threading.Thread(
             target=_client,
-            args=(srv, _payload(model_kind, i), counts, lat, i, deadline,
-                  burst))
+            args=(srv, _payload(model_kind, i, wire_dtype), counts, lat,
+                  i, deadline, burst))
             for i in range(n_clients)]
         for t in threads:
             t.start()
@@ -210,27 +223,55 @@ def run_mode(mode: str, model_kind: str, n_clients: int,
 
 
 def run_connections(frontend: str, model_kind: str, n_connections: int,
-                    cycles: int, max_batch_size: int) -> dict:
+                    cycles: int, max_batch_size: int,
+                    wire_dtype: str = "float32",
+                    tls: bool = False) -> dict:
     """One many-connection keep-alive window against a fresh worker on
-    the given socket edge (same pipelined data plane either way)."""
+    the given socket edge (same pipelined data plane either way).
+    ``tls=True`` terminates TLS at the event-loop edge (a throwaway
+    self-signed cert) and drives the window over encrypted sockets."""
+    import tempfile
+
     from mmlspark_tpu.serving import ServingServer
     from mmlspark_tpu.testing.load import drive_keepalive
 
-    model = _nn_model() if model_kind == "nn" else _identity_model()
-    with ServingServer(model, max_latency_ms=2,
-                       max_batch_size=max_batch_size,
-                       max_queue=max(4 * n_connections, 1024),
-                       frontend=frontend) as srv:
-        srv.warmup(json.loads(_payload(model_kind, 0)))
-        recompiles_warm = _stats(srv)["n_recompiles"]
-        out = drive_keepalive(
-            srv.host, srv.port, srv.api_path, _payload(model_kind, 0),
-            n_connections=n_connections, requests_per_conn=cycles)
-        stats = _stats(srv)
-        out["frontend"] = frontend
-        out["recompiles_after_warmup"] = \
-            stats["n_recompiles"] - recompiles_warm
-        out["frontend_stats"] = stats["frontend"]
+    model = (_nn_model(wire_dtype) if model_kind == "nn"
+             else _identity_model())
+    srv_kw = {}
+    client_kw = {}
+    tmpdir = None
+    if tls:
+        from mmlspark_tpu.testing.tls import (
+            client_context, generate_self_signed_cert, tls_supported)
+        ok, why = tls_supported()
+        if not ok:
+            raise SystemExit(f"--tls unavailable: {why}")
+        tmpdir = tempfile.TemporaryDirectory()
+        cert, key = generate_self_signed_cert(tmpdir.name)
+        srv_kw = {"tls_cert": cert, "tls_key": key}
+        client_kw = {"ssl_context": client_context(cert)}
+    try:
+        with ServingServer(model, max_latency_ms=2,
+                           max_batch_size=max_batch_size,
+                           max_queue=max(4 * n_connections, 1024),
+                           frontend=frontend, **srv_kw) as srv:
+            srv.warmup(json.loads(_payload(model_kind, 0, wire_dtype)))
+            recompiles_warm = srv.n_recompiles
+            out = drive_keepalive(
+                srv.host, srv.port, srv.api_path,
+                _payload(model_kind, 0, wire_dtype),
+                n_connections=n_connections, requests_per_conn=cycles,
+                **client_kw)
+            out["frontend"] = frontend
+            out["tls"] = tls
+            out["wire_dtype"] = wire_dtype
+            out["recompiles_after_warmup"] = \
+                srv.n_recompiles - recompiles_warm
+            out["frontend_stats"] = srv._frontend.stats() \
+                if srv._frontend is not None else {"kind": "threaded"}
+    finally:
+        if tmpdir is not None:
+            tmpdir.cleanup()
     return out
 
 
@@ -262,15 +303,58 @@ def main() -> None:
                     help="serial request/response cycles per "
                          "connection in --connections mode (reuse "
                          "rate = 1 - 1/cycles when keep-alive holds)")
+    ap.add_argument("--wire-dtype", choices=("float32", "uint8"),
+                    default="float32",
+                    help="request wire dtype for --model nn: uint8 "
+                         "rides the quantized serving plane (integer "
+                         "payloads, server-side wire cast, on-device "
+                         "dequant) — docs/serving.md 'The quantized "
+                         "wire'")
+    ap.add_argument("--tls", action="store_true",
+                    help="with --connections: terminate TLS at the "
+                         "event-loop edge (throwaway self-signed "
+                         "cert) and A/B it against the plaintext "
+                         "event loop — gates ZERO connection/HTTP "
+                         "errors on the encrypted arm")
     args = ap.parse_args()
     if args.smoke:
         args.clients, args.seconds = min(args.clients, 4), 1.0
         args.max_batch_size = min(args.max_batch_size, 32)
     if args.connections > 0:
+        if args.tls:
+            # TLS A/B: encrypted vs plaintext, both on the event loop
+            # (the threaded plane stays the plaintext-only baseline)
+            results = {}
+            for arm, tls in (("tls", True), ("plaintext", False)):
+                r = run_connections("eventloop", args.model,
+                                    args.connections, args.cycles,
+                                    args.max_batch_size,
+                                    args.wire_dtype, tls=tls)
+                results[arm] = r
+                print(json.dumps(r), flush=True)
+            enc = results["tls"]
+            if enc["conn_errors"] or enc["http_errors"]:
+                raise SystemExit(
+                    f"FAIL: TLS edge dropped requests at "
+                    f"{args.connections} connections "
+                    f"({enc['conn_errors']} connection errors, "
+                    f"{enc['http_errors']} HTTP errors)")
+            print(json.dumps({
+                "metric": "serving_tls_ab",
+                "connections": args.connections,
+                "tls_cost": round(
+                    results["plaintext"]["rps"]
+                    / max(enc["rps"], 1e-9), 3),
+                "tls_reuse_rate": enc["reuse_rate"],
+                "tls_handshakes":
+                    enc["frontend_stats"]["tls_handshakes_total"]}),
+                flush=True)
+            return
         results = {}
         for fe in ("eventloop", "threaded"):
             r = run_connections(fe, args.model, args.connections,
-                                args.cycles, args.max_batch_size)
+                                args.cycles, args.max_batch_size,
+                                args.wire_dtype)
             results[fe] = r
             print(json.dumps(r), flush=True)
         ev, th = results["eventloop"], results["threaded"]
@@ -291,7 +375,7 @@ def main() -> None:
     for mode in ("serial", "pipelined"):
         r = run_mode(mode, args.model, args.clients, args.seconds,
                      args.max_batch_size, args.burst, args.metrics_dump,
-                     args.trace_dump)
+                     args.trace_dump, args.wire_dtype)
         results[mode] = r
         print(json.dumps(r), flush=True)
     if results["pipelined"]["recompiles_after_warmup"] != 0:
