@@ -72,6 +72,22 @@ Plus (no era analogue, utilization/latency evidence):
                                    -> canary rollout -> coherent fleet
                                    on the retrained version, zero
                                    dropped replies
+ 19. multihost_pipeline_v1       — pipeline-parallel serving over
+                                   mesh slices: >= 2 stages really
+                                   placed, row parity with the fused
+                                   forward, zero post-warmup
+                                   recompiles through a live server,
+                                   measured bubble fraction, and
+                                   rows/s vs a single stage's devices
+                                   (speedup_justification on CPU
+                                   sandboxes)
+ 20. multiprocess_dcn_v1         — the REAL 2-process drill: gloo
+                                   cross-process psum through
+                                   put_batch, 2-process fit parity
+                                   <= 1e-6, pipeline stages split
+                                   across processes, cooperative
+                                   2-process sharded save restored
+                                   bit-exact by 1 process
 
 Every line carries chip metadata (platform/device kind/count) so the
 numbers are interpretable across hosts.
@@ -1462,6 +1478,34 @@ def bench_decode_speculative():
             "passed": ok, "chip": _chip()}
 
 
+def _spawn_evidence(argv, timeout: float):
+    """Run a tools/* evidence harness in its OWN process (device-count
+    XLA_FLAGS must precede backend init; this process's jax is live)
+    and parse its last stdout line as the evidence JSON. Returns
+    ``(rc, evidence_dict)`` — a timeout or unparseable output becomes
+    a failed evidence dict, never an exception: a hung or crashed
+    harness must fail its OWN metric line, not the whole bench run."""
+    import subprocess
+    import sys as _sys
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)
+    try:
+        proc = subprocess.run([_sys.executable] + argv,
+                              capture_output=True, text=True, env=env,
+                              timeout=timeout)
+        line = (proc.stdout.strip().splitlines() or ["{}"])[-1]
+        try:
+            return proc.returncode, json.loads(line)
+        except ValueError:
+            return proc.returncode, {
+                "passed": False,
+                "error": proc.stdout[-2000:] or proc.stderr[-2000:]}
+    except subprocess.TimeoutExpired as e:
+        return 1, {"passed": False,
+                   "error": f"{os.path.basename(argv[0])} timed out "
+                            f"after {e.timeout}s"}
+
+
 def bench_multihost_scaling():
     """Multi-device scaling + parity gate (ISSUE 10 acceptance).
 
@@ -1483,32 +1527,13 @@ def bench_multihost_scaling():
     * sharded checkpoints **round-trip across a topology change**
       (2x2 save -> 4x1 and 1x1 restore, digests strict-verified).
     """
-    import subprocess
-    import sys as _sys
-    env = dict(os.environ)
-    env.pop("XLA_FLAGS", None)   # the tool sets its own device count
-    rc = 1
-    try:
-        proc = subprocess.run(
-            [_sys.executable,
-             os.path.join(os.path.dirname(os.path.abspath(__file__)),
-                          "tools", "bench_multihost.py"),
-             "--json", "--devices", "8"],
-            capture_output=True, text=True, env=env, timeout=1200)
-        rc = proc.returncode
-        line = (proc.stdout.strip().splitlines() or ["{}"])[-1]
-        try:
-            ev = json.loads(line)
-        except ValueError:
-            ev = {"passed": False, "error": proc.stdout[-2000:]
-                  or proc.stderr[-2000:]}
-    except subprocess.TimeoutExpired as e:
-        # a hung harness (e.g. an XLA:CPU collective rendezvous stall)
-        # must fail THIS gate's line, not crash the whole bench run
-        ev = {"passed": False,
-              "error": f"bench_multihost timed out after {e.timeout}s"}
+    rc, ev = _spawn_evidence(
+        [os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                      "tools", "bench_multihost.py"),
+         "--json", "--devices", "8", "--dcn"], timeout=1800)
     by_n = {c["devices"]: c["steps_per_s"]
             for c in ev.get("curve", ())}
+    dcn = ev.get("dcn") or {}
     return {"metric": "multihost_scaling_v1",
             "value": by_n.get(4) or by_n.get(max(by_n) if by_n else 0, 0),
             "unit": "steps/sec@4dev",
@@ -1518,8 +1543,94 @@ def bench_multihost_scaling():
             "parity": ev.get("parity"),
             "tp_serving": ev.get("serving"),
             "checkpoint_topology": ev.get("checkpoint"),
+            # the REAL multi-process story (ISSUE 14): the 2-process
+            # gloo drill's smoke sub-result — cross-process psum, fit
+            # parity, stage split across processes, cooperative save
+            "dcn": {"passed": dcn.get("passed"),
+                    "phases": {k: (v.get("ok") if isinstance(v, dict)
+                                   else v)
+                               for k, v in (dcn.get("phases")
+                                            or {}).items()},
+                    "checkpoint_restore": dcn.get("checkpoint_restore")},
             "baseline": by_n.get(1),
             "vs_baseline": ev.get("speedup_4x_vs_1"),
+            "error": ev.get("error"),
+            "passed": bool(ev.get("passed")) and rc == 0,
+            "chip": _chip()}
+
+
+def bench_multihost_pipeline():
+    """Pipeline-parallel serving over mesh slices (ISSUE 14 acceptance
+    — ``multihost_pipeline_v1``).
+
+    Spawns ``tools/bench_multihost.py --phase pipeline`` (own process:
+    the 2-virtual-device + one-eigen-thread XLA_FLAGS must precede
+    backend init). Gates: a deep MLP REALLY partitioned into >= 2
+    pipeline stages on distinct device slices
+    (``NNModel(pipeline_parallel=2)``), row-parity with the fused
+    forward, **zero post-warmup recompiles** through a live
+    ServingServer (whose ``/stats`` carries the pipeline block),
+    measured **bubble fraction** reported, and >= 1.25x rows/s vs
+    serving the same model on a single stage's devices — or the
+    explicit ``speedup_justification`` when the CPU sandbox cannot
+    express inter-stage overlap (virtual slices share the host's
+    cores; the satellite contract of ISSUE 14).
+    """
+    rc, ev = _spawn_evidence(
+        [os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                      "tools", "bench_multihost.py"),
+         "--json", "--phase", "pipeline"], timeout=900)
+    return {"metric": "multihost_pipeline_v1",
+            "value": ev.get("pipeline_rows_per_s"),
+            "unit": "rows/sec",
+            "n_stages": ev.get("n_stages"),
+            "stages": ev.get("stages"),
+            "bubble_ratio": ev.get("bubble_ratio"),
+            "parity_max_diff": ev.get("parity_max_diff"),
+            "post_warmup_recompiles": ev.get("post_warmup_recompiles"),
+            "live_stats_pipeline_block":
+                ev.get("live_stats_pipeline_block"),
+            "speedup_vs_single_stage":
+                ev.get("speedup_vs_single_stage"),
+            "speedup_justification": ev.get("speedup_justification"),
+            "baseline": ev.get("single_stage_rows_per_s"),
+            "vs_baseline": ev.get("speedup_vs_single_stage"),
+            "error": ev.get("error"),
+            "passed": bool(ev.get("passed")) and rc == 0,
+            "chip": _chip()}
+
+
+def bench_multiprocess_dcn():
+    """The 2-process DCN drill (ISSUE 14 acceptance —
+    ``multiprocess_dcn_v1``): REAL cross-process collectives, not
+    simulation.
+
+    Spawns ``tools/launch_multiprocess.py``: two OS processes x 4
+    virtual CPU devices join one jax.distributed runtime (gloo TCP
+    collectives — XLA:CPU's default refuses multi-process outright)
+    and must (a) execute a genuine cross-process psum through the
+    ``put_batch`` / ``make_array_from_process_local_data`` path,
+    (b) reproduce the single-process fit's scores to <= 1e-6 from
+    per-host input sharding, (c) run the pjit train step with its two
+    pipeline stages SPLIT ACROSS THE PROCESSES (stage-0 weights wholly
+    on process 0), and (d) cooperatively save ONE sharded checkpoint
+    from both processes that restores bit-exact in a single process
+    (topology-change restore across process counts).
+    """
+    rc, ev = _spawn_evidence(
+        [os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                      "tools", "launch_multiprocess.py"),
+         "--json", "--timeout", "180"], timeout=900)
+    phases = ev.get("phases") or {}
+    return {"metric": "multiprocess_dcn_v1",
+            "value": (phases.get("fit") or {}).get("max_score_diff"),
+            "unit": "max_score_diff(2proc vs 1proc)",
+            "psum": phases.get("psum"),
+            "fit": phases.get("fit"),
+            "pipe": phases.get("pipe"),
+            "checkpoint_restore": ev.get("checkpoint_restore"),
+            "baseline": 0.0,
+            "vs_baseline": None,
             "error": ev.get("error"),
             "passed": bool(ev.get("passed")) and rc == 0,
             "chip": _chip()}
@@ -1768,7 +1879,8 @@ BENCHES = [bench_gbdt_quantile, bench_adult_census, bench_cifar10_scoring,
            bench_telemetry_overhead, bench_tracing_overhead,
            bench_trace_propagation, bench_decode_continuous,
            bench_decode_paged, bench_decode_speculative,
-           bench_multihost_scaling, bench_retrain_loop]
+           bench_multihost_scaling, bench_retrain_loop,
+           bench_multihost_pipeline, bench_multiprocess_dcn]
 
 
 def main() -> None:

@@ -146,6 +146,28 @@ class NNModel(Model, HasInputCol, HasOutputCol):
                             "the same bucket/pipeline machinery, with "
                             "placement visible in /stats and dispatch "
                             "spans", ptype=int)
+    pipeline_parallel = Param(0, "pipeline-parallel stage count (0/1 = "
+                              "off): the layer chain is partitioned "
+                              "into this many contiguous stages "
+                              "(parallel/pipeline.plan_stages — "
+                              "balanced by param bytes), each placed "
+                              "on its own contiguous device slice, and "
+                              "every transform drives micro-batched "
+                              "frames through the stages with "
+                              "device_put boundary transfers — a model "
+                              "too big (or too slow) for one slice "
+                              "still serves, with the fill/drain "
+                              "bubble measured and visible in /stats. "
+                              "Composes with tensor_parallel: each "
+                              "stage's params shard over a 'model' "
+                              "axis of that width WITHIN its slice",
+                              ptype=int)
+    pipeline_microbatches = Param(4, "micro-batches per pipelined "
+                                  "frame: more fills the bubble "
+                                  "((K-1)/(M+K-1)) but shrinks each "
+                                  "dispatch; capped by the frame's "
+                                  "rows / the stage data multiple",
+                                  ptype=int)
     input_dtype = Param("auto", "host-side cast before transfer: auto casts "
                         "to bfloat16 for bfloat16 models (halves host->HBM "
                         "bytes; the first layer casts activations anyway) | "
@@ -220,6 +242,9 @@ class NNModel(Model, HasInputCol, HasOutputCol):
         self.__dict__.pop("_jitted", None)
         self.__dict__.pop("_setup_sharded", None)
         self.__dict__.pop("_setup_single_cache", None)
+        self.__dict__.pop("_setup_pipeline", None)
+        self.__dict__.pop("_pipeline_out_shape", None)
+        self.__dict__.pop("_pipeline_plan", None)
         self.__dict__.pop("_placement_mesh", None)
         self.__dict__.pop("_placement_label", None)
         self.__dict__.pop("_placement_single", None)
@@ -238,6 +263,17 @@ class NNModel(Model, HasInputCol, HasOutputCol):
             return 1
         import jax
         n_dev = len(jax.devices())
+        pp = int(self.pipeline_parallel or 0)
+        if pp > 1:
+            # pipelined dispatch: rows shard over ONE stage slice's
+            # data axis (each micro-batch visits every slice in turn)
+            if n_dev % pp:
+                return 1
+            slice_n = n_dev // pp
+            tp = int(self.tensor_parallel or 0)
+            if tp > 1:
+                return slice_n // tp if slice_n % tp == 0 else 1
+            return max(slice_n, 1)
         tp = int(self.tensor_parallel or 0)
         if tp > 1:
             return n_dev // tp if n_dev % tp == 0 else 1
@@ -272,7 +308,19 @@ class NNModel(Model, HasInputCol, HasOutputCol):
         first dispatch. Cheap — shapes + sharding metadata, no device
         sync."""
         out: Dict[str, Any] = {"tensor_parallel":
-                               int(self.tensor_parallel or 0)}
+                               int(self.tensor_parallel or 0),
+                               "pipeline_parallel":
+                               int(self.pipeline_parallel or 0)}
+        if self._pipeline_active() and "_setup_pipeline" in self.__dict__:
+            runner, _ = self.__dict__["_setup_pipeline"]
+            out["mode"] = "pipeline_parallel"
+            out["n_stages"] = runner.n_stages
+            out["stages"] = [{"stage": k, "devices": list(devs)}
+                             for k, (_, _, _, devs)
+                             in enumerate(runner.stages)]
+            out["n_devices"] = sum(len(s["devices"])
+                                   for s in out["stages"])
+            return out
         mesh = self.__dict__.get("_placement_mesh")
         if mesh is None:
             single = self.__dict__.get("_placement_single")
@@ -296,12 +344,12 @@ class NNModel(Model, HasInputCol, HasOutputCol):
             placed[0] if placed else self.model.params, mesh))
         return out
 
-    @functools.cached_property
-    def _jitted(self):
-        import jax
+    def _dequant_constants(self):
+        """(scale, offset, deq_dtype): the on-device input transform
+        constants — shared by the fused single forward and the
+        pipelined stage-0 forward, so a pipeline split can never
+        change the dequant semantics."""
         import jax.numpy as jnp
-        out_layer = self._resolve_output_layer()
-        module = self.model.module()
         is_int = np.issubdtype(self._transfer_dtype(), np.integer)
         if self.quantization is not None:
             # ONE object carries wire dtype + dequant constants: the
@@ -317,6 +365,15 @@ class NNModel(Model, HasInputCol, HasOutputCol):
         arch = getattr(self.model, "arch", None) or {}
         deq_dtype = (jnp.bfloat16 if arch.get("dtype") == "bfloat16"
                      else jnp.float32)
+        return scale, offset, deq_dtype
+
+    @functools.cached_property
+    def _jitted(self):
+        import jax
+        import jax.numpy as jnp
+        out_layer = self._resolve_output_layer()
+        module = self.model.module()
+        scale, offset, deq_dtype = self._dequant_constants()
 
         def forward(params, x):
             if jnp.issubdtype(x.dtype, jnp.integer) \
@@ -358,6 +415,218 @@ class NNModel(Model, HasInputCol, HasOutputCol):
     def _setup_single_cache(self):
         return {}  # device -> (params-on-device, None, 1)
 
+    # -- pipeline parallelism (parallel/pipeline.py) -------------------------
+
+    def _pipeline_active(self) -> bool:
+        """Pipelined dispatch really engages only when the stage
+        split is placeable: >= 2 stages, devices divide into equal
+        slices, data_parallel on, and no pinned single-device scope
+        (config alone never forces it — same honesty rule as
+        tensor_parallel)."""
+        pp = int(self.pipeline_parallel or 0)
+        if pp < 2 or not self.data_parallel:
+            return False
+        import jax
+        from mmlspark_tpu.parallel.topology import in_single_device_scope
+        if in_single_device_scope():
+            return False
+        n_dev = len(jax.devices())
+        return n_dev >= pp and n_dev % pp == 0
+
+    @functools.cached_property
+    def _setup_pipeline(self):
+        """(runner, stage_data_multiple): the staged model.
+
+        The layer chain is cut by :func:`~mmlspark_tpu.parallel.
+        pipeline.plan_stages` (costs = per-layer param bytes; the
+        slowest stage paces the pipeline, so balance is the rule),
+        each stage's sub-module + remapped params are placed on their
+        device slice (sharded over a per-slice data x model mesh when
+        ``tensor_parallel`` composes in), and stage inputs transfer
+        via ``device_put`` to the slice's batch sharding. Stage
+        forwards are jitted with the INPUT buffer donated — the
+        boundary buffer is reused for same-shaped outputs instead of
+        allocating per hop."""
+        import re
+        import jax
+        import jax.numpy as jnp
+        from mmlspark_tpu.parallel import MeshSpec, dist
+        from mmlspark_tpu.parallel.pipeline import (
+            PipelineRunner, plan_stages)
+        from mmlspark_tpu.models.function import LayeredModel
+
+        pp = int(self.pipeline_parallel)
+        module = self.model.module()
+        layers = list(module.layers)
+        out_layer = self._resolve_output_layer()
+        if out_layer is not None:
+            names = [n for n, _ in layers]
+            layers = layers[:names.index(out_layer) + 1]
+        # per-layer param ownership: flax names the chain's modules by
+        # tuple path ("layers_<i>_<j>"), across every collection
+        pat = re.compile(r"layers_(\d+)(_.+)?$")
+        per_layer: list = [dict() for _ in layers]
+        for cname, cdict in (self.model.params or {}).items():
+            for key, sub in cdict.items():
+                m = pat.match(key)
+                if m is None or int(m.group(1)) >= len(layers):
+                    continue
+                per_layer[int(m.group(1))].setdefault(cname, {})[key] = sub
+
+        def _bytes(tree) -> float:
+            import jax as _j
+            return float(sum(
+                int(np.prod(np.shape(x), dtype=np.int64))
+                * np.dtype(getattr(x, "dtype", np.float32)).itemsize
+                for x in _j.tree_util.tree_leaves(tree)))
+
+        costs = [max(sum(_bytes(c) for c in coll.values()), 1.0)
+                 for coll in per_layer]
+        plan = plan_stages(costs, pp, jax.devices())
+        tp = int(self.tensor_parallel or 0)
+        scale, offset, deq_dtype = self._dequant_constants()
+        stages = []
+        stage_data = 1
+        for k, ((a, b), devs) in enumerate(zip(plan.boundaries,
+                                               plan.devices)):
+            sub_module = LayeredModel(layers=tuple(layers[a:b]))
+            sub_params: Dict[str, Any] = {}
+            for i in range(a, b):
+                for cname, keys in per_layer[i].items():
+                    for key, sub in keys.items():
+                        m = pat.match(key)
+                        new = f"layers_{int(m.group(1)) - a}" \
+                              f"{m.group(2) or ''}"
+                        sub_params.setdefault(cname, {})[new] = sub
+            slice_n = len(devs)
+            if tp > 1 and slice_n % tp:
+                raise ValueError(
+                    f"tensor_parallel={tp} does not divide the "
+                    f"{slice_n}-device pipeline slice")
+            shape = ({"data": slice_n // tp, "model": tp} if tp > 1
+                     else {"data": slice_n})
+            mesh_k = build_mesh(MeshSpec.from_dict(shape),
+                                devices=list(devs))
+            stage_data = mesh_k.shape["data"]
+            placed = dist.shard_state(sub_params, mesh_k)
+            placement = batch_sharding(mesh_k)
+            first = k == 0
+
+            def make_fn(sub_module, first):
+                def fwd(p, x):
+                    if first and (jnp.issubdtype(x.dtype, jnp.integer)
+                                  or scale != 1.0 or offset != 0.0):
+                        x = x.astype(deq_dtype) * deq_dtype(scale) \
+                            + deq_dtype(offset)
+                    return sub_module.apply(p, x)
+                # the boundary buffer is donated: a stage's input is
+                # dead the moment its output exists, so XLA may reuse
+                # it in place instead of allocating per hop
+                return jax.jit(fwd, donate_argnums=(1,))
+
+            stages.append((make_fn(sub_module, first), placed, placement,
+                           tuple(str(d) for d in devs)))
+        runner = PipelineRunner(stages,
+                                microbatches=self.pipeline_microbatches)
+        self.__dict__["_pipeline_plan"] = plan
+        self.__dict__["_placement_label"] = \
+            f"pipe={pp},data={stage_data},model={max(tp, 1)}"
+        return runner, stage_data
+
+    def pipeline_report(self) -> Optional[Dict[str, Any]]:
+        """The ``/stats`` "pipeline" block: stages, per-stage
+        placement, measured bubble ratio, in-flight micro-batches.
+        None when pipelining is off or nothing has dispatched yet (no
+        device work is forced just to report)."""
+        if not self._pipeline_active() \
+                or "_setup_pipeline" not in self.__dict__:
+            return None
+        runner, stage_data = self.__dict__["_setup_pipeline"]
+        rep = runner.report()
+        plan = self.__dict__.get("_pipeline_plan")
+        if plan is not None:
+            for entry, (bounds, cost) in zip(rep["stages"],
+                                             zip(plan.boundaries,
+                                                 plan.costs)):
+                entry["layers"] = list(bounds)
+                entry["param_bytes"] = int(cost)
+        rep["stage_data_multiple"] = stage_data
+        rep["tensor_parallel"] = int(self.tensor_parallel or 0)
+        return rep
+
+    def _transform_pipelined(self, df: DataFrame) -> DataFrame:
+        """The pipelined dispatch: frame rows -> micro-batches ->
+        staged forward with device_put boundary hops. One host thread
+        (the serving executor, when dispatched from the serving
+        plane) drives the whole schedule; async dispatch keeps every
+        slice busy. The first frame also runs one *blocked* probe
+        pass to measure per-stage service times — the bubble-ratio
+        evidence — off the steady-state path.
+
+        The ``cache_inputs`` device-frame LRU applies to the fused
+        path only: pipelined micro-batches hop BETWEEN slices, so a
+        cached single-placement copy could not serve them — repeated
+        offline scoring of one frame through a pipelined model
+        re-uploads per pass (documented tradeoff; serving frames are
+        one-shot and never cached on either path)."""
+        from mmlspark_tpu.core.tracing import ambient_tracer
+        from mmlspark_tpu.parallel import pad_to_bucket, round_to_multiple
+        from mmlspark_tpu.parallel.pipeline import split_rows
+
+        runner, stage_data = self._setup_pipeline
+        col = df[self.input_col]
+        tdtype = self._transfer_dtype()
+        x = _stack_column(col).astype(tdtype, copy=False)
+        n_rows = len(x)
+        meta = schema.make_role_meta(schema.SCORES_KIND, self.uid)
+        if n_rows == 0:
+            if x.ndim > 1:
+                # the output width is a fixed model property: probe it
+                # once (stage_data rows = the ladder's smallest bucket,
+                # so no off-ladder shape compiles), then empty frames
+                # cost nothing
+                width = self.__dict__.get("_pipeline_out_shape")
+                if width is None:
+                    dummy = np.zeros((stage_data, *x.shape[1:]), tdtype)
+                    width = np.asarray(runner.run([dummy])[0]).shape[1:]
+                    self.__dict__["_pipeline_out_shape"] = width
+                return df.with_column(
+                    self.output_col,
+                    np.zeros((0, *width), np.float32),
+                    metadata=meta)
+            return df.with_column(self.output_col,
+                                  np.zeros((0, 0), np.float32),
+                                  metadata=meta)
+        # bounded like the fused path: frames process in batch_size
+        # chunks (a 10M-row offline frame must not device_put itself
+        # whole), and the ragged last chunk pads on the bucket ladder
+        # — the micro-batch shape set stays FIXED per model config, so
+        # arbitrary offline frame sizes never grow the compiled set
+        bs = round_to_multiple(max(self.batch_size, stage_data),
+                               stage_data, up=False)
+        tracer = ambient_tracer()
+        outs = []
+        for start in range(0, n_rows, bs):
+            chunk = x[start:start + bs]
+            padded, n = pad_to_bucket(chunk, cap=bs, pad_mode="edge",
+                                      multiple=stage_data)
+            ranges = split_rows(len(padded),
+                                self.pipeline_microbatches, stage_data)
+            mbs = [padded[a:b] for a, b in ranges]
+            ys = runner.run(mbs, tracer=tracer)
+            if not runner._probed:
+                # warmup-time evidence pass: blocked per-stage timings
+                # on an already-compiled shape (compilation just
+                # happened in run above); never again on the live path
+                runner.probe(mbs[0])
+            got = (np.asarray(ys[0]) if len(ys) == 1
+                   else np.concatenate([np.asarray(y) for y in ys]))
+            outs.append(got[:n])
+        result = outs[0] if len(outs) == 1 else np.concatenate(outs)
+        return df.with_column(self.output_col,
+                              np.asarray(result, dtype=np.float32),
+                              metadata=meta)
+
     @property
     def _device_setup(self):
         """Placement: (device params, batch sharding, n shards).
@@ -388,6 +657,8 @@ class NNModel(Model, HasInputCol, HasOutputCol):
         return cache[dev]
 
     def transform(self, df: DataFrame) -> DataFrame:
+        if self._pipeline_active():
+            return self._transform_pipelined(df)
         import jax
         from mmlspark_tpu.parallel import round_to_multiple
         col = df[self.input_col]

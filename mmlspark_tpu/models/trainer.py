@@ -482,6 +482,10 @@ class NNLearner(Estimator, HasLabelCol, HasFeaturesCol):
         metrics = _metrics()
         m_step, m_eps = metrics["step_ms"], metrics["examples_per_sec"]
         global_step = 0
+        # ragged-tail staging reuse: the last batch of every epoch pads
+        # to the data multiple through ONE buffer instead of a fresh
+        # allocation per step (dist.put_batch pad_cache contract)
+        pad_cache: dict = {}
         # per-attempt dispatch-shape memory: a batch shape this attempt
         # has not dispatched yet forces a jit retrace, and the step's
         # span marks it (recompile=True) so a captured slow step says
@@ -537,7 +541,8 @@ class NNLearner(Estimator, HasLabelCol, HasFeaturesCol):
                         plo, phi = _dist.process_local_rows(len(xp), mesh)
                         xp, yp, wp = xp[plo:phi], yp[plo:phi], wp[plo:phi]
                     placed, _ = _dist.put_batch(
-                        {"x": xp, "y": yp, "w": wp}, mesh)
+                        {"x": xp, "y": yp, "w": wp}, mesh,
+                        pad_cache=pad_cache)
                     xb, yb, wb = placed["x"], placed["y"], placed["w"]
                     params, opt_state, loss = step(params, opt_state,
                                                    xb, yb, wb)
@@ -573,17 +578,10 @@ class NNLearner(Estimator, HasLabelCol, HasFeaturesCol):
     def _checkpoint_manager(self):
         if not self.checkpoint_dir:
             return None
-        import jax
-        if jax.process_count() > 1:
-            # the native store is single-process (save_sharded would
-            # raise at the FIRST checkpoint, which the restart loop
-            # would then misread as a transient step fault and re-fit
-            # from scratch max_restarts times): fail before any
-            # training work is spent
-            raise NotImplementedError(
-                "checkpoint_dir is single-process for now: the native "
-                "sharded store cannot write one directory from "
-                "multiple hosts (see io/checkpoint.save_sharded)")
+        # multi-process runtimes save cooperatively into ONE directory
+        # (io/checkpoint.save_sharded: per-slice shard ownership +
+        # barriers, manifest by process 0) — checkpoint_dir must sit
+        # on a filesystem every host shares, the standard pod setup
         from mmlspark_tpu.io import checkpoint as _ckpt
         return _ckpt.manager(self.checkpoint_dir)
 
@@ -780,6 +778,7 @@ class _StreamTrainerSink:
         opt_state = jax.device_put(opt_state, opt_repl)
         self._repl, self._opt_repl = repl, opt_repl
         self._dist = _dist
+        self._pad_cache: dict = {}
         self._mngr = learner._checkpoint_manager()
         if self._mngr is not None:
             # host-side template BEFORE any step: the donated buffers
@@ -893,7 +892,8 @@ class _StreamTrainerSink:
         for _ in range(self.steps_per_batch):
             t0 = time.perf_counter()
             placed, _ = self._dist.put_batch(
-                {"x": xp, "y": yp, "w": wp}, self._mesh)
+                {"x": xp, "y": yp, "w": wp}, self._mesh,
+                pad_cache=self._pad_cache)
             self._params, self._opt, loss = self._step(
                 self._params, self._opt,
                 placed["x"], placed["y"], placed["w"])

@@ -944,13 +944,55 @@ def reference_loss(params, tokens, labels, mask, cfg: TransformerConfig,
 # train step
 
 
+def _validate_mesh_config(cfg: TransformerConfig, mesh) -> "_Axes":
+    """The shared build-time checks of BOTH train-step formulations
+    (manual shard_map and pjit): every mesh/config mismatch fails
+    loudly at build, never as a cryptic XLA partitioning error."""
+    ax = _Axes.of(mesh)
+    if ax.pipe and mesh.shape[ax.pipe] != cfg.n_stages:
+        raise ValueError(
+            f"n_stages={cfg.n_stages} != pipe axis size {mesh.shape[ax.pipe]}")
+    if not ax.pipe and cfg.n_stages != 1:
+        raise ValueError("n_stages > 1 requires a 'pipe' mesh axis")
+    if ax.model and cfg.n_heads % mesh.shape[ax.model]:
+        raise ValueError("n_heads must divide over the model axis")
+    if ax.model and cfg.d_ff % mesh.shape[ax.model]:
+        raise ValueError("d_ff must divide over the model axis")
+    if ax.expert and cfg.n_experts and cfg.n_experts % mesh.shape[ax.expert]:
+        raise ValueError("n_experts must divide over the expert axis")
+    if cfg.n_experts and not 1 <= cfg.moe_top_k <= cfg.n_experts:
+        raise ValueError(
+            f"moe_top_k={cfg.moe_top_k} must be in [1, n_experts="
+            f"{cfg.n_experts}]")
+    return ax
+
+
 def build_spmd_train_step(cfg: TransformerConfig, mesh,
                           learning_rate: float = 0.1,
                           momentum: float = 0.9,
                           donate: bool = True,
-                          check_vma: bool = True):
+                          check_vma: bool = True,
+                          impl: str = "auto"):
     """Jitted full train step over ``mesh``: fwd + bwd + per-leaf grad
-    psum + momentum-SGD update, all inside one shard_map.
+    psum + momentum-SGD update.
+
+    Two interchangeable formulations exist (``impl``):
+
+    * ``"shard_map"`` — the manual per-device program (explicit
+      psum/ppermute/all_to_all; maps 1:1 onto ICI). Needs the VMA-era
+      jax: its backward relies on vma types to insert the
+      replicated-parameter grad psums.
+    * ``"pjit"`` — the same math as ONE global GSPMD program
+      (:func:`build_pjit_train_step`): XLA inserts every collective
+      from the ``NamedSharding`` annotations, so it runs on pre-VMA
+      jaxes too. Fixed-seed parity between the two is test-pinned
+      wherever a VMA jax exists.
+
+    ``"auto"`` picks shard_map on a VMA jax and pjit elsewhere —
+    which is what deleted the old loud pre-VMA build failure.
+    ``check_vma=False`` (test-only; see the warning below) always
+    takes the shard_map path: its documented under-reduction boundary
+    is itself pinned by tests.
 
     Returns ``step(params, velocity, tokens, labels, mask) ->
     (params, velocity, loss)`` where params/velocity are device arrays
@@ -969,23 +1011,20 @@ def build_spmd_train_step(cfg: TransformerConfig, mesh,
     """
     from jax.sharding import PartitionSpec as P
 
-    ax = _Axes.of(mesh)
-    if ax.pipe and mesh.shape[ax.pipe] != cfg.n_stages:
-        raise ValueError(
-            f"n_stages={cfg.n_stages} != pipe axis size {mesh.shape[ax.pipe]}")
-    if not ax.pipe and cfg.n_stages != 1:
-        raise ValueError("n_stages > 1 requires a 'pipe' mesh axis")
-    if ax.model and cfg.n_heads % mesh.shape[ax.model]:
-        raise ValueError("n_heads must divide over the model axis")
-    if ax.model and cfg.d_ff % mesh.shape[ax.model]:
-        raise ValueError("d_ff must divide over the model axis")
-    if ax.expert and cfg.n_experts and cfg.n_experts % mesh.shape[ax.expert]:
-        raise ValueError("n_experts must divide over the expert axis")
-    if cfg.n_experts and not 1 <= cfg.moe_top_k <= cfg.n_experts:
-        raise ValueError(
-            f"moe_top_k={cfg.moe_top_k} must be in [1, n_experts="
-            f"{cfg.n_experts}]")
+    if impl not in ("auto", "shard_map", "pjit"):
+        raise ValueError(f"unknown train-step impl {impl!r}")
+    if impl == "auto":
+        from mmlspark_tpu.parallel import compat
+        # check_vma=False is a shard_map-specific contract (the
+        # interpret-mode escape hatch + the documented under-reduction
+        # boundary) — it must keep meaning the manual path
+        impl = ("shard_map" if not check_vma or compat.vma_native()
+                else "pjit")
+    if impl == "pjit":
+        return build_pjit_train_step(cfg, mesh, learning_rate, momentum,
+                                     donate=donate)
 
+    ax = _validate_mesh_config(cfg, mesh)
     specs = param_specs(cfg, mesh)
     data_spec = P(ax.data, ax.seq)
 
@@ -1014,6 +1053,359 @@ def build_spmd_train_step(cfg: TransformerConfig, mesh,
     # HBM instead of allocating (and copying into) a second full copy
     # of the model state every step
     return jax.jit(sharded, donate_argnums=(0, 1) if donate else ())
+
+
+# ---------------------------------------------------------------------------
+# pjit (GSPMD) train step — the global-array formulation
+#
+# The manual shard_map program above expresses every collective
+# explicitly; this one expresses NONE: the same math is written over
+# the global arrays, params/batch arrive with NamedSharding layouts
+# (the identical `param_specs` tree), and XLA/GSPMD inserts the grad
+# allreduces and TP/EP collectives from the annotations. Because no
+# vma typing is involved, it builds and runs on pre-VMA jaxes — the
+# trainer path no longer has a jax-version boundary. The one semantic
+# subtlety is capacity-factor MoE: the manual step computes its
+# capacity C and drops overflow *per rank's token shard*, so the
+# global formulation reproduces that grouping exactly (tokens split
+# into data x seq x expert contiguous groups — `_token_groups`), which
+# keeps the two formulations bit-comparable drop-for-drop.
+
+
+def _token_groups(h, D: int, Q: int):
+    """Global ``[B, S, ...]`` -> rank-local token blocks
+    ``[D*Q, T_local, ...]`` in exactly the manual step's order (batch
+    sharded over ``data``, sequence over ``seq``, rows flattened
+    batch-major within a rank)."""
+    B, S = h.shape[0], h.shape[1]
+    rest = h.shape[2:]
+    g = h.reshape(D, B // D, Q, S // Q, *rest)
+    g = jnp.moveaxis(g, 2, 1)                  # [D, Q, B/D, S/Q, ...]
+    return g.reshape(D * Q, (B // D) * (S // Q), *rest)
+
+
+def _ungroup_tokens(g, D: int, Q: int, B: int, S: int):
+    """Inverse of :func:`_token_groups`."""
+    rest = g.shape[2:]
+    g = g.reshape(D, Q, B // D, S // Q, *rest)
+    g = jnp.moveaxis(g, 1, 2)                  # [D, B/D, Q, S/Q, ...]
+    return g.reshape(B, S, *rest)
+
+
+def _pjit_moe_grouped(bp, x, cfg: TransformerConfig, D: int, Q: int,
+                      E_ax: int, wsc=None):
+    """Capacity-factor token-choice MoE, group-wise: the global twin of
+    :func:`_moe_capacity`. Each of the ``D*Q*E_ax`` token groups
+    builds its own capacity queues (same engines, same overflow
+    drops); expert FFNs run on the full queue set — numerically what
+    the manual step's all_to_all round-trip computes."""
+    import math
+    dt = _compute_dtype(cfg)
+    h = _rmsnorm(x, bp["ln2"])
+    # jax-0.4.x XLA:CPU SPMD mis-lowers the grouped top-k/queue/
+    # scatter chains when their operands carry mesh shardings
+    # (repro'd: 1e-3..3e-2 divergence vs the identical eager math on
+    # data x expert meshes) — this fallback formulation therefore pins
+    # the whole capacity/EC block replicated: forward AND backward
+    # then match the unsharded golden exactly. The manual shard_map
+    # formulation keeps the truly-parallel dispatch.
+    h = wsc(h) if wsc is not None else h
+    logits = jnp.einsum("bsd,de->bse", h, bp["router"])
+    probs = jax.nn.softmax(logits, axis=-1)
+    B, S, d = x.shape
+    T, E = B * S, cfg.n_experts
+    n_rank = D * Q
+    if T % n_rank:
+        raise ValueError(f"tokens ({T}) must divide over data x seq "
+                         f"({n_rank})")
+    T_local = T // n_rank
+    if T_local % E_ax:
+        raise ValueError(
+            f"capacity MoE dispatch needs local tokens ({T_local}) "
+            f"divisible by the expert axis ({E_ax})")
+    T_sh = T_local // E_ax
+    k = cfg.moe_top_k
+    C = max(int(math.ceil(cfg.moe_capacity_factor * T_sh * k / E)), 1)
+    hg = _token_groups(h, D, Q)                # [n_rank, T_local, d]
+    pg = _token_groups(probs, D, Q)            # [n_rank, T_local, E]
+    engine = (_sorted_capacity_queues if cfg.moe_dispatch == "sort"
+              else _scatter_capacity_queues)
+    ew1 = wsc(bp["ew1"]) if wsc is not None else bp["ew1"]
+    ew2 = wsc(bp["ew2"]) if wsc is not None else bp["ew2"]
+    if cfg.moe_dispatch not in ("sort", "scatter"):
+        raise ValueError(f"unknown moe_dispatch {cfg.moe_dispatch!r}")
+    out_groups = []
+    for g in range(n_rank):
+        wts, experts = _route_top_k(pg[g], k)  # [T_local, k]
+        parts = []
+        for er in range(E_ax):
+            sl = slice(er * T_sh, (er + 1) * T_sh)
+            top = experts[sl].reshape(T_sh * k)
+            wf = wts[sl].reshape(T_sh * k)
+            disp, combine = engine(
+                jnp.repeat(hg[g][sl].astype(dt), k, axis=0),
+                top, wf, E, C, dt)
+            z = jax.nn.relu(jnp.einsum("ecd,edf->ecf", disp,
+                                       ew1.astype(dt)))
+            y = jnp.einsum("ecf,efd->ecd", z,
+                           ew2.astype(dt)).astype(jnp.float32)
+            yflat = combine(y)                 # [T_sh*k, d]
+            parts.append(jnp.sum(yflat.reshape(T_sh, k, d), axis=1))
+        out_groups.append(jnp.concatenate(parts, axis=0))
+    ytok = jnp.stack(out_groups)               # [n_rank, T_local, d]
+    y = _ungroup_tokens(ytok, D, Q, B, S)
+    y = wsc(y) if wsc is not None else y       # exit the block replicated
+    # aux statistics are token-LINEAR, so the global means equal the
+    # manual step's pmean-over-token-axes exactly (equal-size groups)
+    E_ = cfg.n_experts
+    f_stat = (jnp.zeros(E_, jnp.float32), jnp.zeros(E_, jnp.float32))
+    if cfg.moe_aux_weight > 0:
+        _, exp_all = _route_top_k(probs.reshape(T, E), k)
+        f_stat = _router_stats(probs.reshape(T, E), exp_all[:, 0], E, ())
+    z_stat = jnp.float32(0.0)
+    if cfg.moe_zloss_weight > 0:
+        lse = jax.nn.logsumexp(logits.reshape(T, E), axis=-1)
+        z_stat = jnp.mean(jnp.square(lse))
+    return y, (*f_stat, z_stat)
+
+
+def _pjit_moe_expert_choice(bp, x, cfg: TransformerConfig, D: int,
+                            Q: int, E_ax: int, wsc=None):
+    """Expert-choice routing, group-wise: the global twin of
+    :func:`_moe_expert_choice` (experts pick their top-C tokens WITHIN
+    each rank-shaped token group)."""
+    import math
+    dt = _compute_dtype(cfg)
+    h = _rmsnorm(x, bp["ln2"])
+    # same SPMD-lowering pin as the capacity path (see above)
+    h = wsc(h) if wsc is not None else h
+    logits = jnp.einsum("bsd,de->bse", h, bp["router"])
+    probs = jax.nn.softmax(logits, axis=-1)
+    B, S, d = x.shape
+    T, E = B * S, cfg.n_experts
+    n_rank = D * Q
+    T_local = T // n_rank
+    if T_local % E_ax:
+        raise ValueError(
+            f"expert-choice MoE needs local tokens ({T_local}) "
+            f"divisible by the expert axis ({E_ax})")
+    T_sh = T_local // E_ax
+    C = max(int(math.ceil(cfg.moe_capacity_factor * T_sh / E)), 1)
+    hg = _token_groups(h, D, Q).reshape(n_rank * E_ax, T_sh, d)
+    pg = _token_groups(probs, D, Q).reshape(n_rank * E_ax, T_sh, E)
+    # same SPMD-lowering pin as the capacity path (see above)
+    ew1 = wsc(bp["ew1"]) if wsc is not None else bp["ew1"]
+    ew2 = wsc(bp["ew2"]) if wsc is not None else bp["ew2"]
+    outs = []
+    for g in range(n_rank * E_ax):
+        wts, idx = jax.lax.top_k(pg[g].T, min(C, T_sh))  # (E, C)
+        disp = hg[g][idx].astype(dt)
+        z = jax.nn.relu(jnp.einsum("ecd,edf->ecf", disp,
+                                   ew1.astype(dt)))
+        y = jnp.einsum("ecf,efd->ecd", z,
+                       ew2.astype(dt)).astype(jnp.float32)
+        outs.append(jnp.zeros((T_sh, d), jnp.float32)
+                    .at[idx.reshape(-1)]
+                    .add(y.reshape(-1, d) * wts.reshape(-1)[:, None]))
+    ytok = jnp.stack(outs).reshape(n_rank, T_local, d)
+    y = _ungroup_tokens(ytok, D, Q, B, S)
+    y = wsc(y) if wsc is not None else y       # exit the block replicated
+    E_ = cfg.n_experts
+    stats = (jnp.zeros(E_, jnp.float32), jnp.zeros(E_, jnp.float32))
+    z_stat = jnp.float32(0.0)
+    if cfg.moe_zloss_weight > 0:
+        lse = jax.nn.logsumexp(logits.reshape(T, E), axis=-1)
+        z_stat = jnp.mean(jnp.square(lse))
+    return y, (*stats, z_stat)
+
+
+def _pjit_moe_dense(bp, x, cfg: TransformerConfig):
+    """Dense-dispatch token-choice MoE over the global batch — the
+    global twin of :func:`_moe`'s default branch (identical to the
+    reference math)."""
+    dt = _compute_dtype(cfg)
+    h = _rmsnorm(x, bp["ln2"])
+    logits = jnp.einsum("bsd,de->bse", h, bp["router"])
+    probs = jax.nn.softmax(logits, axis=-1)
+    wts, experts = _route_top_k(probs, cfg.moe_top_k)
+    h_c = h.astype(dt)
+    y = jnp.zeros_like(x)
+    for e in range(cfg.n_experts):
+        sel = jnp.sum((experts == e).astype(jnp.float32) * wts, axis=-1)
+        z = jax.nn.relu(jnp.einsum("bsd,df->bsf", h_c,
+                                   bp["ew1"][e].astype(dt)))
+        z = jnp.einsum("bsf,fd->bsd", z,
+                       bp["ew2"][e].astype(dt)).astype(jnp.float32)
+        y = y + z * sel[..., None]
+    E = cfg.n_experts
+    f_stat = (jnp.zeros(E, jnp.float32), jnp.zeros(E, jnp.float32))
+    if cfg.moe_aux_weight > 0:
+        f_stat = _router_stats(probs.reshape(-1, E),
+                               experts[..., 0].reshape(-1), E, ())
+    z_stat = jnp.float32(0.0)
+    if cfg.moe_zloss_weight > 0:
+        lse = jax.nn.logsumexp(logits, axis=-1)
+        z_stat = jnp.mean(jnp.square(lse))
+    return y, (*f_stat, z_stat)
+
+
+def _pjit_moe(bp, x, cfg: TransformerConfig, D: int, Q: int, E_ax: int,
+              wsc=None):
+    """MoE branch selection mirroring :func:`_moe`, global form."""
+    if cfg.moe_router == "expert_choice":
+        if cfg.moe_capacity_factor <= 0:
+            raise ValueError("moe_router='expert_choice' needs "
+                             "moe_capacity_factor > 0 (defines C)")
+        return _pjit_moe_expert_choice(bp, x, cfg, D, Q, E_ax, wsc)
+    if cfg.moe_router != "token":
+        raise ValueError(f"unknown moe_router {cfg.moe_router!r}")
+    if cfg.moe_capacity_factor > 0:
+        return _pjit_moe_grouped(bp, x, cfg, D, Q, E_ax, wsc)
+    return _pjit_moe_dense(bp, x, cfg)
+
+
+def _pjit_attention(bp, x, cfg: TransformerConfig, pos):
+    """Global-batch attention with the manual step's mixed-precision
+    flow (heavy matmuls in ``cfg.dtype``, rope/softmax/residuals f32).
+    Always the XLA dense engine: the Pallas kernels are per-device
+    programs and stay with the shard_map formulation."""
+    dt = _compute_dtype(cfg)
+    mm_dt = dt if dt != jnp.float32 else None
+    h = _rmsnorm(x, bp["ln1"]).astype(dt)
+    q = jnp.einsum("bsd,dhk->bshk", h, bp["wq"].astype(dt)).astype(jnp.float32)
+    k = jnp.einsum("bsd,dhk->bshk", h, bp["wk"].astype(dt)).astype(jnp.float32)
+    v = jnp.einsum("bsd,dhk->bshk", h, bp["wv"].astype(dt)).astype(jnp.float32)
+    q, k = _rope(q, pos), _rope(k, pos)
+    a = dense_attention(q, k, v, causal=True, compute_dtype=mm_dt)
+    return jnp.einsum("bshk,hkd->bsd", a.astype(dt),
+                      bp["wo"].astype(dt)).astype(jnp.float32)
+
+
+def _pjit_loss(params, tokens, labels, mask, cfg: TransformerConfig,
+               groups: "Tuple[int, int, int]", ce_impl: str, wsc=None):
+    """The global-array loss: identical math to ``local_loss`` (same
+    CE, same aux/z-loss formulas, group-faithful capacity dispatch)
+    with the pipeline schedule flattened to a sequential stage loop —
+    a pure perf schedule, not a semantic one, so the loss is
+    unchanged."""
+    D, Q, E_ax = groups
+    B, S = tokens.shape
+    x = params["embed"][tokens]
+    pos = jnp.arange(S)
+    aux_total = jnp.float32(0.0)
+    z_total = jnp.float32(0.0)
+    for s in range(cfg.n_stages):
+        for bp_all in params["blocks"]:
+            bp = {k: v[s] for k, v in bp_all.items()}
+            x = x + _pjit_attention(bp, x, cfg, pos)
+            if cfg.n_experts:
+                y, (f, P_, z) = _pjit_moe(bp, x, cfg, D, Q, E_ax, wsc)
+                x = x + y
+                if cfg.moe_aux_weight > 0:
+                    aux_total = aux_total + cfg.n_experts * jnp.sum(f * P_)
+                if cfg.moe_zloss_weight > 0:
+                    z_total = z_total + z
+            else:
+                dt = _compute_dtype(cfg)
+                h = _rmsnorm(x, bp["ln2"]).astype(dt)
+                z = jax.nn.relu(
+                    jnp.einsum("bsd,df->bsf", h, bp["w1"].astype(dt))
+                    + bp["b1"].astype(dt))
+                y = jnp.einsum("bsf,fd->bsd", z,
+                               bp["w2"].astype(dt)).astype(jnp.float32)
+                x = x + y + bp["b2"]
+    h = _rmsnorm(x, params["final_norm"])
+    dt = _compute_dtype(cfg)
+    if ce_impl in ("fused", "fused_interpret"):
+        from mmlspark_tpu.ops.fused_ce import fused_softmax_xent
+        ce = fused_softmax_xent(
+            h.reshape(B * S, cfg.d_model), params["head"],
+            labels.reshape(B * S), compute_dtype=dt,
+            interpret=ce_impl == "fused_interpret").reshape(B, S)
+    else:
+        if dt != jnp.float32:
+            logits = jnp.einsum("bsd,dv->bsv", h.astype(dt),
+                                params["head"].astype(dt),
+                                preferred_element_type=jnp.float32)
+        else:
+            logits = jnp.einsum("bsd,dv->bsv", h, params["head"])
+        lse = jax.nn.logsumexp(logits, axis=-1)
+        gold = jnp.take_along_axis(logits, labels[..., None],
+                                   axis=-1)[..., 0]
+        ce = lse - gold
+    loss = jnp.sum(ce * mask) / jnp.maximum(jnp.sum(mask), 1.0)
+    if cfg.n_experts and cfg.moe_aux_weight > 0:
+        loss = loss + cfg.moe_aux_weight * aux_total
+    if cfg.n_experts and cfg.moe_zloss_weight > 0:
+        loss = loss + cfg.moe_zloss_weight * z_total
+    return loss
+
+
+def build_pjit_train_step(cfg: TransformerConfig, mesh,
+                          learning_rate: float = 0.1,
+                          momentum: float = 0.9,
+                          donate: bool = True):
+    """The train step as ONE global GSPMD program (pjit): same
+    signature, layouts (:func:`param_specs`), and math as the
+    shard_map formulation — XLA inserts every collective from the
+    ``NamedSharding`` annotations, so this builds and runs on pre-VMA
+    jaxes (jax 0.4.x) where the manual step's replication checker
+    cannot. ``build_spmd_train_step(impl="auto")`` selects it there
+    automatically; fixed-seed parity between the formulations is
+    pinned in tests/test_transformer.py wherever a VMA jax exists.
+
+    The Pallas attention/CE kernels are per-device programs: this
+    formulation uses the XLA engines except on a single-device mesh,
+    where an explicitly requested fused CE still runs (the ``auto``
+    resolution matches ``local_loss``'s gates there)."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    ax = _validate_mesh_config(cfg, mesh)
+    n_dev = int(mesh.devices.size)
+    specs = param_specs(cfg, mesh)
+    is_spec = lambda s: isinstance(s, P)  # noqa: E731
+    p_sh = jax.tree.map(lambda s: NamedSharding(mesh, s), specs,
+                        is_leaf=is_spec)
+    data_sh = NamedSharding(mesh, P(ax.data, ax.seq))
+    repl = NamedSharding(mesh, P())
+    groups = (mesh.shape.get(ax.data, 1) if ax.data else 1,
+              mesh.shape.get(ax.seq, 1) if ax.seq else 1,
+              mesh.shape.get(ax.expert, 1) if ax.expert else 1)
+    ce_impl = cfg.ce_impl
+    if ce_impl == "auto":
+        # the fused-CE kernel is a per-device program; "auto" under the
+        # global formulation resolves to the XLA path (explicit
+        # requests still run it on a single-device mesh, where no
+        # partitioning exists to break it)
+        ce_impl = "xla"
+    elif ce_impl in ("fused", "fused_interpret") and n_dev > 1:
+        import warnings
+        warnings.warn(
+            f"ce_impl={cfg.ce_impl!r} is a per-device Pallas kernel; "
+            f"the pjit train-step formulation on a {n_dev}-device mesh "
+            f"uses the XLA CE path instead (the shard_map formulation "
+            f"runs the kernel per shard)", stacklevel=2)
+        ce_impl = "xla"
+
+    wsc = None
+    if n_dev > 1:
+        def wsc(t, _repl=repl):
+            return jax.lax.with_sharding_constraint(t, _repl)
+
+    def step(params, velocity, tokens, labels, mask):
+        loss, grads = jax.value_and_grad(_pjit_loss)(
+            params, tokens, labels, mask, cfg, groups, ce_impl, wsc)
+        velocity = jax.tree.map(lambda v, g: momentum * v + g,
+                                velocity, grads)
+        params = jax.tree.map(lambda p, v: p - learning_rate * v,
+                              params, velocity)
+        return params, velocity, loss
+
+    return jax.jit(
+        step,
+        in_shardings=(p_sh, p_sh, data_sh, data_sh, data_sh),
+        out_shardings=(p_sh, p_sh, repl),
+        donate_argnums=(0, 1) if donate else ())
 
 
 def shard_params(params, cfg: TransformerConfig, mesh):
@@ -1508,9 +1900,42 @@ def build_paged_decode_step(cfg: TransformerConfig, n_slots: int,
     rows = jnp.arange(n_slots)
     idx = jnp.arange(V)
     use_pallas = attn_impl in ("pallas", "pallas_interpret")
+    tp_mesh = None
     if use_pallas:
         from mmlspark_tpu.parallel.pallas_attention import (
             paged_decode_attention)
+        if cache_sharding is not None \
+                and cache_sharding.mesh.shape.get(AXIS_MODEL, 1) > 1:
+            # sharding-aware kernel dispatch: heads are independent in
+            # paged attention, so under a TP mesh each model-axis
+            # shard runs the SAME kernel on its own head slice (q
+            # [N, H/t, Dh], pool [pages, page, H/t, Dh]) with the
+            # page tables/positions replicated — per-shard head-slice
+            # grids, no collective in either direction. check_vma is
+            # irrelevant here (forward-only, nothing replicated is
+            # produced); False keeps interpret-mode parity tests
+            # runnable on pre-VMA jaxes.
+            tp_mesh = cache_sharding.mesh
+
+    def _paged_attn(q, k_pool, v_pool, page_tables, pos):
+        interp = attn_impl == "pallas_interpret"
+        if tp_mesh is None:
+            return paged_decode_attention(
+                q, k_pool, v_pool, page_tables, pos, scale=scale,
+                page_size=page_size, interpret=interp)
+        from jax.sharding import PartitionSpec as P
+        f = jax.shard_map(
+            lambda q_, k_, v_, t_, p_: paged_decode_attention(
+                q_, k_, v_, t_, p_, scale=scale,
+                page_size=page_size, interpret=interp),
+            mesh=tp_mesh,
+            in_specs=(P(None, AXIS_MODEL, None),
+                      P(None, None, AXIS_MODEL, None),
+                      P(None, None, AXIS_MODEL, None),
+                      P(None, None), P(None)),
+            out_specs=P(None, AXIS_MODEL, None),
+            check_vma=False)
+        return f(q, k_pool, v_pool, page_tables, pos)
 
     def step(params, cache, tokens, pos, page_tables):
         x = params["embed"][tokens]                    # [N, D]
@@ -1526,10 +1951,7 @@ def build_paged_decode_step(cfg: TransformerConfig, n_slots: int,
             ck = ck.at[l, pg, row].set(k)
             cv = cv.at[l, pg, row].set(v)
             if use_pallas:
-                a = paged_decode_attention(
-                    q, ck[l], cv[l], page_tables, pos,
-                    scale=scale, page_size=page_size,
-                    interpret=attn_impl == "pallas_interpret")
+                a = _paged_attn(q, ck[l], cv[l], page_tables, pos)
             else:
                 lk = _gather_lane(ck[l], page_tables, n_slots, V, cfg)
                 lv = _gather_lane(cv[l], page_tables, n_slots, V, cfg)

@@ -27,6 +27,13 @@ from mmlspark_tpu.parallel.dist import (
     state_specs,
     train_mesh,
 )
+from mmlspark_tpu.parallel.pipeline import (
+    PipelineRunner,
+    StagePlan,
+    bubble_ratio,
+    plan_stages,
+    split_rows,
+)
 from mmlspark_tpu.parallel.ring_attention import (
     dense_attention,
     ring_attention,

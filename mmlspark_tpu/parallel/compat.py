@@ -17,6 +17,21 @@ i.e. before any mesh program can be built.
 
 from __future__ import annotations
 
+#: names of the shims :func:`install` actually installed on this jax —
+#: the honest record of what is bridged vs native. ``"shard_map"`` in
+#: here means this jax predates the VMA type system (check_rep era).
+SHIMMED: set = set()
+
+
+def vma_native() -> bool:
+    """True when this jax carries the VMA-era ``jax.shard_map``
+    natively (varying-manual-axes types; ``check_vma``). On a pre-VMA
+    jax the manual 5-axis shard_map trainer cannot build (check_rep
+    cannot infer its replicated-grad psums), so
+    ``build_spmd_train_step`` re-expresses itself as pjit instead —
+    the selection this predicate drives."""
+    return "shard_map" not in SHIMMED
+
 
 def install() -> bool:
     """Publish ``jax.shard_map`` / ``jax.lax.axis_size`` on jaxes that
@@ -39,6 +54,7 @@ def install() -> bool:
             return int(_core.axis_frame(axis_name))
 
         jax.lax.axis_size = axis_size
+        SHIMMED.add("axis_size")
 
     if not hasattr(jax, "typeof"):
         class _AvalView:
@@ -59,6 +75,7 @@ def install() -> bool:
             return _AvalView(jax.core.get_aval(x))
 
         jax.typeof = typeof
+        SHIMMED.add("typeof")
 
     import inspect as _inspect
     if "vma" not in _inspect.signature(
@@ -75,6 +92,7 @@ def install() -> bool:
                 super().__init__(shape, dtype, *args, **kwargs)
 
         jax.ShapeDtypeStruct = ShapeDtypeStruct
+        SHIMMED.add("ShapeDtypeStruct")
 
     if not hasattr(jax.lax, "pcast"):
         # with check_rep replication tracking there is no varying/
@@ -84,6 +102,7 @@ def install() -> bool:
             return x
 
         jax.lax.pcast = pcast
+        SHIMMED.add("pcast")
 
     if hasattr(jax, "shard_map"):
         return False
@@ -100,6 +119,7 @@ def install() -> bool:
         "\n\n(compat wrapper: check_vma maps to check_rep — "
         "mmlspark_tpu.parallel.compat)")
     jax.shard_map = shard_map
+    SHIMMED.add("shard_map")
     return True
 
 

@@ -146,8 +146,42 @@ def process_local_rows(n_global: int, mesh, axis: str = AXIS_DATA
     return pid * per, (pid + 1) * per
 
 
+def _pad_cached(cache: dict, name: str, arr: np.ndarray, multiple: int,
+                pad_value) -> Tuple[np.ndarray, int]:
+    """Pad ``arr``'s leading dim via a REUSED host staging buffer.
+
+    A ragged tail (rows not divisible by the multiple) normally
+    allocates a fresh padded array per call; here the padded buffer is
+    allocated ONCE per (name, target-shape, dtype) — its pad rows are
+    written at allocation and never again — and subsequent tails of
+    the same shape just copy their real rows in. The pipeline driver's
+    steady-state contract: the tail micro-batch of every frame reuses
+    one buffer instead of re-allocating per micro-batch. Divisible
+    batches pass through untouched (no copy at all)."""
+    n = arr.shape[0]
+    target = ((n + multiple - 1) // multiple) * multiple
+    if target == n:
+        return arr, n
+    key = (name, target) + arr.shape[1:] + (arr.dtype.str,)
+    buf = cache.get(key)
+    if buf is None:
+        buf = np.full((target,) + arr.shape[1:], pad_value,
+                      dtype=arr.dtype)
+        cache[key] = buf
+        cache[(key, "dirty_to")] = 0
+    # a SMALLER tail reusing a buffer last filled by a LARGER one must
+    # re-clean the rows the larger fill dirtied ([n, dirty_to)), or
+    # the previous batch's data (e.g. nonzero sample weights) silently
+    # rides into this dispatch; an empty slice when dirty_to <= n
+    buf[:n] = arr
+    buf[n:cache[(key, "dirty_to")]] = pad_value
+    cache[(key, "dirty_to")] = n
+    return buf, n
+
+
 def put_batch(arrays: Dict[str, np.ndarray], mesh,
-              axis: str = AXIS_DATA, pad_value=0
+              axis: str = AXIS_DATA, pad_value=0,
+              pad_cache: Optional[dict] = None
               ) -> Tuple[Dict[str, Any], int]:
     """Place a dict of host arrays as ``data``-sharded global arrays.
 
@@ -157,6 +191,14 @@ def put_batch(arrays: Dict[str, np.ndarray], mesh,
     arrays are taken as *process-local* rows and assembled into global
     arrays (``jax.make_array_from_process_local_data``) — the per-host
     input-sharding path, where no host ever holds the global batch.
+
+    ``pad_cache`` (any dict the caller keeps alive) opts into reused
+    host staging buffers for ragged tails: a final micro-batch smaller
+    than the data-axis multiple then never re-allocates its padded
+    array (see :func:`_pad_cached`) — the pipeline driver and the
+    trainer's steady-state loops pass one. The buffers are host-side
+    staging only: ``device_put`` copies out of them, so reuse on the
+    next call is safe.
     """
     import jax
     from mmlspark_tpu.parallel.sharding import pad_to_multiple
@@ -178,7 +220,11 @@ def put_batch(arrays: Dict[str, np.ndarray], mesh,
     n_true: Optional[int] = None
     for name, arr in arrays.items():
         arr = np.asarray(arr)
-        padded, n = pad_to_multiple(arr, multiple, pad_value=pad_value)
+        if pad_cache is not None:
+            padded, n = _pad_cached(pad_cache, name, arr, multiple,
+                                    pad_value)
+        else:
+            padded, n = pad_to_multiple(arr, multiple, pad_value=pad_value)
         if n_true is None:
             n_true = n
         if multi:

@@ -1,0 +1,310 @@
+"""Pipeline parallelism over mesh slices: the third serving axis.
+
+Data parallelism replicates a model per device; tensor parallelism
+shards one copy across a mesh; both cap out when a model does not fit
+(or does not divide) one slice. Pipeline parallelism partitions the
+model's **stage graph** — an ordered chain of layers — across device
+slices and drives **micro-batched frames** through the stages: while
+slice 1 runs micro-batch *i* through its layers, slice 0 is already
+running micro-batch *i+1* through the earlier layers. Steady state
+keeps every slice busy except for the fill/drain **bubble**, whose
+fraction for a balanced K-stage pipeline over M micro-batches is the
+GPipe number ``(K-1)/(M+K-1)``.
+
+This module owns the three mechanical pieces:
+
+* :func:`plan_stages` — the **stage placement rule**: a contiguous
+  partition of per-layer costs minimizing the slowest stage (classic
+  linear-partition DP), mapped onto contiguous device slices.
+* :class:`PipelineRunner` — the **micro-batch driver**: dispatches
+  each micro-batch through the stage chain with a ``device_put``
+  boundary transfer between slices. JAX dispatch is asynchronous, so
+  one host thread (the serving plane's executor stage thread, when a
+  :class:`~mmlspark_tpu.models.nn.NNModel` with ``pipeline_parallel``
+  is dispatched) keeps every slice's queue full — the inter-stage
+  overlap happens on the devices, exactly as on real chips.
+* **bubble accounting** — per-stage service times from a blocked probe
+  pass plus the schedule model give a measured ``bubble_ratio`` (the
+  ``/stats`` "pipeline" block; dispatch spans carry
+  ``pipeline_stage=k``).
+
+The boundary buffers ride donation where the stage functions donate
+(jit-level concern of the stage builder); ragged tail micro-batches
+reuse one padded staging buffer via ``dist.put_batch(pad_cache=...)``
+semantics (see :func:`split_rows` — sizes are derived once from the
+bucketed frame, so the tail never re-pads per call).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+__all__ = ["StagePlan", "plan_stages", "split_rows", "PipelineRunner",
+           "bubble_ratio"]
+
+
+@dataclasses.dataclass(frozen=True)
+class StagePlan:
+    """A contiguous layer partition mapped onto device slices."""
+
+    #: per-stage ``(start, stop)`` layer index ranges (python slices)
+    boundaries: Tuple[Tuple[int, int], ...]
+    #: per-stage device lists (contiguous slices of the host's devices)
+    devices: Tuple[Tuple[Any, ...], ...]
+    #: per-stage summed layer costs (the balance evidence)
+    costs: Tuple[float, ...]
+
+    @property
+    def n_stages(self) -> int:
+        return len(self.boundaries)
+
+
+def _partition_costs(costs: Sequence[float], k: int) -> List[int]:
+    """Contiguous k-partition of ``costs`` minimizing the max part sum
+    (linear-partition DP, O(n^2 k) — layer counts are tens, not
+    millions). Returns the k-1 cut points."""
+    n = len(costs)
+    prefix = [0.0]
+    for c in costs:
+        prefix.append(prefix[-1] + float(c))
+
+    def part_sum(i, j):               # costs[i:j]
+        return prefix[j] - prefix[i]
+
+    # dp[j][p] = minimal max-part-sum partitioning costs[:j] into p parts
+    INF = float("inf")
+    dp = [[INF] * (k + 1) for _ in range(n + 1)]
+    cut = [[0] * (k + 1) for _ in range(n + 1)]
+    dp[0][0] = 0.0
+    for j in range(1, n + 1):
+        for p in range(1, min(j, k) + 1):
+            for i in range(p - 1, j):
+                cand = max(dp[i][p - 1], part_sum(i, j))
+                if cand < dp[j][p]:
+                    dp[j][p] = cand
+                    cut[j][p] = i
+    cuts = []
+    j, p = n, k
+    while p > 1:
+        i = cut[j][p]
+        cuts.append(i)
+        j, p = i, p - 1
+    return sorted(cuts)
+
+
+def plan_stages(costs: Sequence[float], n_stages: int,
+                devices: Optional[Sequence[Any]] = None) -> StagePlan:
+    """The stage placement rule: partition a layer chain's ``costs``
+    into ``n_stages`` contiguous stages minimizing the slowest stage
+    (the pipeline's pace-setter), and map stage *k* onto the *k*-th
+    contiguous slice of ``devices``.
+
+    ``costs`` is one number per layer — the stage builder passes param
+    bytes (a serviceable proxy for per-layer work on the serving
+    forward; paramless activation layers cost an epsilon so they glue
+    to their neighbors). Every stage gets at least one layer and every
+    slice the same device count (``len(devices)`` must divide by
+    ``n_stages``)."""
+    import jax
+    n_stages = int(n_stages)
+    if n_stages < 2:
+        raise ValueError(f"pipeline needs n_stages >= 2 (got {n_stages})")
+    if len(costs) < n_stages:
+        raise ValueError(
+            f"cannot split {len(costs)} layers into {n_stages} stages")
+    devices = list(devices) if devices is not None else list(jax.devices())
+    if len(devices) < n_stages:
+        raise ValueError(
+            f"{n_stages} stages need >= {n_stages} devices "
+            f"(have {len(devices)})")
+    if len(devices) % n_stages:
+        raise ValueError(
+            f"{len(devices)} devices do not split into {n_stages} "
+            f"equal slices")
+    per = len(devices) // n_stages
+    cuts = _partition_costs(list(costs), n_stages)
+    bounds = []
+    start = 0
+    for c in cuts + [len(costs)]:
+        bounds.append((start, c))
+        start = c
+    slices = tuple(tuple(devices[k * per:(k + 1) * per])
+                   for k in range(n_stages))
+    stage_costs = tuple(float(sum(costs[a:b])) for a, b in bounds)
+    return StagePlan(boundaries=tuple(bounds), devices=slices,
+                     costs=stage_costs)
+
+
+def split_rows(n_rows: int, microbatches: int, multiple: int = 1
+               ) -> List[Tuple[int, int]]:
+    """Micro-batch row ranges for an ``n_rows`` frame: up to
+    ``microbatches`` contiguous ranges, every range divisible by
+    ``multiple`` (the stage mesh's data-axis size) except possibly by
+    construction none — the frame arrives bucket-padded to the
+    multiple, so ranges derived here never force a re-pad. Sizes are a
+    deterministic function of (n_rows, microbatches, multiple): for a
+    fixed bucket ladder the micro-batch shape set is fixed, which is
+    what keeps the compiled-executable set bounded."""
+    multiple = max(int(multiple), 1)
+    if n_rows <= 0:
+        return []
+    if n_rows % multiple:
+        raise ValueError(
+            f"pipeline frames must arrive padded to the stage multiple "
+            f"({multiple}); got {n_rows} rows — the bucket ladder "
+            f"should have rounded this up")
+    units = n_rows // multiple
+    m = max(min(int(microbatches), units), 1)
+    per = (units + m - 1) // m * multiple     # equal-ish, multiple-divisible
+    out = []
+    start = 0
+    while start < n_rows:
+        stop = min(start + per, n_rows)
+        out.append((start, stop))
+        start = stop
+    return out
+
+
+def bubble_ratio(stage_ms: Sequence[float], n_micro: int) -> float:
+    """Measured steady-state bubble fraction of one pipelined frame.
+
+    With per-stage service times ``t_k`` and ``M`` micro-batches, the
+    schedule's wall bound is ``(M-1) * t_max + sum_k t_k`` (the slowest
+    stage paces steady state; the chain sum is the fill+drain) and the
+    busy device-time is ``M * sum_k t_k`` over ``K`` slices:
+    ``bubble = 1 - busy / (K * wall)``. For balanced stages this is
+    exactly GPipe's ``(K-1)/(M+K-1)``."""
+    ts = [max(float(t), 1e-9) for t in stage_ms]
+    K, M = len(ts), max(int(n_micro), 1)
+    if K < 2:
+        return 0.0
+    t_max, t_sum = max(ts), sum(ts)
+    wall = (M - 1) * t_max + t_sum
+    return max(0.0, min(1.0, 1.0 - (M * t_sum) / (K * wall)))
+
+
+class PipelineRunner:
+    """Drive micro-batches through a chain of placed stage functions.
+
+    ``stages`` is a list of ``(fn, params, placement, devices)``:
+    ``fn(params, x) -> y`` (jitted, bound to its slice via the
+    placements), ``placement`` the sharding/device its INPUT must be
+    transferred to (the ``device_put`` boundary), ``devices`` the
+    human-readable slice for reports. The driver dispatches mb-major
+    (the GPipe order); JAX's async dispatch keeps all slices busy from
+    one host thread. ``probe()`` runs one micro-batch through the
+    chain *blocked* to measure per-stage service times — the bubble
+    evidence — and is called once at warmup, never on the live path.
+    """
+
+    def __init__(self, stages: List[Tuple[Callable, Any, Any, Tuple[str, ...]]],
+                 microbatches: int = 4):
+        if len(stages) < 2:
+            raise ValueError("PipelineRunner needs >= 2 stages")
+        self.stages = stages
+        self.microbatches = max(int(microbatches), 2)
+        self.stage_ms: List[float] = [0.0] * len(stages)
+        self._probed = False
+        self.last_n_micro = 0
+        self.last_wall_ms = 0.0
+        self.last_rows = 0
+        self.n_frames = 0
+        #: micro-batches the IN-PROGRESS frame has dispatched so far —
+        #: a live mid-frame gauge only (0 between frames); completed
+        #: frames report their schedule via last_n_micro
+        self.in_flight = 0
+
+    @property
+    def n_stages(self) -> int:
+        return len(self.stages)
+
+    def probe(self, mb) -> List[float]:
+        """One blocked pass: per-stage service times in ms (device
+        compute + boundary transfer, measured synchronously). Warmup
+        calls this after compiling; the live path never blocks."""
+        import jax
+        times = []
+        y = mb
+        for fn, params, placement, _ in self.stages:
+            y = jax.device_put(y, placement)
+            jax.block_until_ready(y)
+            t0 = time.perf_counter()
+            y = fn(params, y)
+            jax.block_until_ready(y)
+            times.append((time.perf_counter() - t0) * 1000.0)
+        self.stage_ms = times
+        self._probed = True
+        return times
+
+    def run(self, microbatches: List[Any], tracer=None, span_attrs=None
+            ) -> List[Any]:
+        """Dispatch every micro-batch through the stage chain; returns
+        the per-micro-batch outputs (device arrays, NOT fetched — the
+        caller unpads/concatenates/fetches like any async dispatch).
+        Records one ``pipeline_stage`` span per stage (host dispatch
+        window, ``pipeline_stage=k`` attr) under the ambient span when
+        a tracer rides along."""
+        import jax
+        t_wall = time.perf_counter()
+        windows = [[None, None] for _ in self.stages]
+        ys: List[Any] = []
+        self.in_flight = 0
+        for mb in microbatches:
+            y = mb
+            for k, (fn, params, placement, _) in enumerate(self.stages):
+                t0 = time.perf_counter()
+                y = jax.device_put(y, placement)
+                y = fn(params, y)
+                t1 = time.perf_counter()
+                if windows[k][0] is None:
+                    windows[k][0] = t0
+                windows[k][1] = t1
+            ys.append(y)
+            self.in_flight += 1
+        self.last_n_micro = len(microbatches)
+        self.last_wall_ms = (time.perf_counter() - t_wall) * 1000.0
+        self.n_frames += 1
+        # dispatched work is handed back to the caller here; the live
+        # gauge returns to idle
+        self.in_flight = 0
+        if tracer is not None:
+            # one child span per stage under the ambient (batch-
+            # representative) span: the host-side dispatch window with
+            # pipeline_stage=k — a captured slow dispatch says which
+            # stage backed up. Probe-measured service times live in
+            # report(); these windows are dispatch evidence, not
+            # compute times (dispatch is async).
+            from mmlspark_tpu.core.tracing import current_span
+            parent = current_span()
+            if parent is not None:
+                for k, (w0, w1) in enumerate(windows):
+                    if w0 is not None:
+                        tracer.add("pipeline_stage", w0, w1,
+                                   parent=parent, pipeline_stage=k,
+                                   devices=",".join(self.stages[k][3]),
+                                   **(span_attrs or {}))
+        return ys
+
+    def report(self) -> Dict[str, Any]:
+        """The ``/stats`` "pipeline" block."""
+        m = self.last_n_micro or self.microbatches
+        return {
+            "n_stages": self.n_stages,
+            "microbatches": self.microbatches,
+            "last_n_micro": self.last_n_micro,
+            "in_flight_micro_batches": self.in_flight,
+            "stages": [{
+                "stage": k,
+                "devices": list(devs),
+                "service_ms": round(self.stage_ms[k], 3),
+            } for k, (_, _, _, devs) in enumerate(self.stages)],
+            "stage_probe_valid": self._probed,
+            "bubble_ratio": round(bubble_ratio(self.stage_ms, m), 4)
+            if self._probed else None,
+            "last_wall_ms": round(self.last_wall_ms, 3),
+            "n_frames": self.n_frames,
+        }

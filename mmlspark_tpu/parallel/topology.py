@@ -199,11 +199,30 @@ def distributed_init(coordinator_address: Optional[str] = None,
     The one-call replacement for the reference's entire driver-socket
     rendezvous + ssh/scp/MPI machinery. No-ops when single-process (env
     unset), so the same program runs unchanged from laptop to pod.
+
+    On a CPU backend this also selects the **gloo** TCP collectives
+    implementation (when this jax ships it): XLA:CPU's default refuses
+    multi-process computations outright ("Multiprocess computations
+    aren't implemented on the CPU backend"), so without gloo a CPU
+    "multi-host" run could rendezvous but never execute a
+    cross-process psum — the gap that kept the 2-process DCN drill
+    simulated. Gloo rides the same coordinator the rendezvous uses; on
+    TPU the flag is irrelevant (collectives ride ICI/DCN natively).
+    Must run before the backend initializes, like ``use_cpu_devices``.
     """
     import jax
     addr = coordinator_address or os.environ.get("MMLSPARK_TPU_COORDINATOR")
     if addr is None and num_processes is None:
         return  # single-process
+    if os.environ.get("JAX_PLATFORMS", "").strip() == "cpu" \
+            or jax.config.jax_platforms == "cpu":
+        try:
+            jax.config.update("jax_cpu_collectives_implementation", "gloo")
+        except (AttributeError, ValueError):
+            from mmlspark_tpu.core.logs import get_logger
+            get_logger("parallel.topology").warning(
+                "this jax has no gloo CPU collectives: cross-process "
+                "computations will fail on the CPU backend")
     jax.distributed.initialize(coordinator_address=addr,
                                num_processes=num_processes,
                                process_id=process_id)

@@ -175,23 +175,21 @@ class TransformerDecoder:
             # scalar prefetch — no per-layer lane materialization in
             # HBM) and the dense gather everywhere else; "dense" /
             # "pallas" / "pallas_interpret" force an engine
-            # (interpret = the CPU parity-test mode). The kernel is
-            # not sharding-aware, so a TP mesh keeps the dense gather
-            # (XLA partitions it).
+            # (interpret = the CPU parity-test mode). Under a TP mesh
+            # the kernel dispatches sharding-aware: heads are
+            # independent, so each model-axis shard runs the kernel
+            # on its own head slice of the pool (a shard_map inside
+            # the step — per-shard head-slice grids, page tables
+            # replicated; token-for-token parity vs the dense gather
+            # is test-pinned for the mesh path too).
             if attn_impl not in ("auto", "dense", "pallas",
                                  "pallas_interpret"):
                 raise ValueError(f"unknown attn_impl {attn_impl!r}")
             if attn_impl == "auto":
                 from mmlspark_tpu.parallel.pallas_attention import (
                     paged_attention_available)
-                attn_impl = ("pallas" if mesh is None
-                             and paged_attention_available()
+                attn_impl = ("pallas" if paged_attention_available()
                              else "dense")
-            elif attn_impl.startswith("pallas") and mesh is not None:
-                raise ValueError(
-                    "the fused paged-attention kernel is not "
-                    "sharding-aware; use attn_impl='dense' (or "
-                    "'auto') with a mesh")
             self.attn_impl = attn_impl
             self.cache = T.init_paged_kv_cache(cfg, self.n_pages,
                                                self.page_size)

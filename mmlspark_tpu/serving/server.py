@@ -962,6 +962,14 @@ class ServingServer:
                     # sharded/replicated leaf split, bytes per device —
                     # None for models that don't report placement
                     "placement": self._model_placement(),
+                    # pipeline-parallel dispatch (when the active
+                    # model stages itself over mesh slices): stages,
+                    # per-stage placement + probe-measured service
+                    # times, bubble ratio, in-flight micro-batches.
+                    # None = not pipelined. (The "pipeline" key above
+                    # is the serving DATA plane's staged-thread flag —
+                    # an older, unrelated surface.)
+                    "pipeline_parallel": self._model_pipeline(),
                     # the LIVE tail-capture threshold (adaptive
                     # refreshes move it; fixed config pins it)
                     "slow_trace_ms":
@@ -1070,6 +1078,18 @@ class ServingServer:
         (NNModel.placement / TransformerDecoder.placement) — scrapes
         must never fail on a model without the surface."""
         fn = getattr(self.versions.active.model, "placement", None)
+        if fn is None:
+            return None
+        try:
+            return fn()
+        except Exception:  # noqa: BLE001 — stats never 500 on a model
+            return None
+
+    def _model_pipeline(self) -> Optional[dict]:
+        """The active model's pipeline-parallel report (stage
+        placement, bubble ratio, in-flight micro-batches) when it has
+        one — the ``/stats`` "pipeline_parallel" block."""
+        fn = getattr(self.versions.active.model, "pipeline_report", None)
         if fn is None:
             return None
         try:

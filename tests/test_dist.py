@@ -403,3 +403,219 @@ class TestTensorParallelDecode:
         st = sched.stats()
         assert st["placement"]["mode"] == "tensor_parallel"
         assert st["placement"]["mesh"] == {"data": 4, "model": 2}
+
+
+class TestPutBatchPadCache:
+    """The ragged-tail staging contract (ISSUE 14 satellite): a tail
+    smaller than the data multiple reuses ONE padded host buffer
+    across calls instead of allocating per micro-batch."""
+
+    @staticmethod
+    def _buffers(cache):
+        return [v for k, v in cache.items()
+                if isinstance(v, np.ndarray)]
+
+    def test_tail_reuses_one_buffer(self):
+        mesh = dist.train_mesh({"data": 4})
+        cache: dict = {}
+        a1 = np.ones((6, 3), np.float32)
+        out1, n1 = dist.put_batch({"x": a1}, mesh, pad_cache=cache)
+        assert n1 == 6 and out1["x"].shape == (8, 3)
+        assert len(self._buffers(cache)) == 1
+        buf1 = self._buffers(cache)[0]
+        # second ragged tail of the same shape: the SAME buffer object
+        a2 = np.full((6, 3), 2.0, np.float32)
+        out2, _ = dist.put_batch({"x": a2}, mesh, pad_cache=cache)
+        assert self._buffers(cache)[0] is buf1
+        # and the device values reflect THIS call's rows + zero pad
+        host = np.asarray(out2["x"])
+        np.testing.assert_array_equal(host[:6], a2)
+        np.testing.assert_array_equal(host[6:], 0.0)
+
+    def test_smaller_tail_recleans_dirty_pad_rows(self):
+        # the review-found hazard: a 7-row fill then a 5-row fill of
+        # the same 8-row buffer must not leak row 5/6 of the first
+        # batch into the second's pad region (nonzero sample weights
+        # riding into the gradient was the failure mode)
+        mesh = dist.train_mesh({"data": 4})
+        cache: dict = {}
+        dist.put_batch({"w": np.full((7,), 9.0, np.float32)}, mesh,
+                       pad_cache=cache)
+        out, n = dist.put_batch({"w": np.full((5,), 2.0, np.float32)},
+                                mesh, pad_cache=cache)
+        host = np.asarray(out["w"])
+        assert n == 5
+        np.testing.assert_array_equal(host[:5], 2.0)
+        np.testing.assert_array_equal(host[5:], 0.0)
+
+    def test_divisible_batches_bypass_the_cache(self):
+        mesh = dist.train_mesh({"data": 4})
+        cache: dict = {}
+        out, n = dist.put_batch({"x": np.ones((8, 3), np.float32)},
+                                mesh, pad_cache=cache)
+        assert n == 8 and not cache      # no copy, no staging entry
+
+    def test_distinct_shapes_get_distinct_buffers(self):
+        mesh = dist.train_mesh({"data": 4})
+        cache: dict = {}
+        dist.put_batch({"x": np.ones((6, 3), np.float32)}, mesh,
+                       pad_cache=cache)
+        dist.put_batch({"x": np.ones((2, 3), np.float32),
+                        "y": np.ones((2,), np.float32)}, mesh,
+                       pad_cache=cache)
+        # (x,8,3), (x,4,3), (y,4)
+        assert len(self._buffers(cache)) == 3
+
+
+class TestGlobalShardPlan:
+    """The multi-process save's shard-ownership rule: derived from
+    sharding metadata, identical on every process, covering exactly
+    the unique slices replica-0 dedup yields."""
+
+    def test_plan_matches_unique_shards_single_process(self):
+        mesh = dist.train_mesh({"data": 2, "model": 2})
+        arr = jax.device_put(
+            np.arange(64 * 32, dtype=np.float32).reshape(64, 32),
+            dist.state_shardings({"w": np.zeros((64, 32))}, mesh)["w"])
+        plan = ckpt._global_shard_plan(arr)
+        local = {idx for idx, _ in ckpt._unique_shards(arr)}
+        assert {idx for idx, _ in plan} == local
+        # writers are devices (single process: all local)
+        assert all(dev is not None and dev.process_index == 0
+                   for _, dev in plan)
+
+    def test_replicated_leaf_has_one_writer(self):
+        mesh = dist.train_mesh({"data": 8})
+        arr = jax.device_put(np.ones((16,), np.float32),
+                             dist.state_shardings(
+                                 {"b": np.zeros((16,))}, mesh)["b"])
+        plan = ckpt._global_shard_plan(arr)
+        assert len(plan) == 1
+        # deterministic: the lowest-id holder owns the slice
+        assert plan[0][1].id == min(
+            d.id for d in arr.sharding.device_set)
+
+
+class TestTensorParallelPagedAttention:
+    """ISSUE 14 satellite: attn_impl='auto' selects the fused Pallas
+    kernel under a TP mesh too — per-shard head-slice grids via
+    shard_map — instead of silently falling back to dense gather.
+    Interpret mode is the CPU parity harness; the selection rule and
+    token-for-token parity are what these pin."""
+
+    _CFG = dict(vocab=96, d_model=32, n_heads=4, d_head=8, d_ff=64,
+                n_stages=1, layers_per_stage=2)
+
+    def _greedy(self, dec, prompt, n_tokens=8):
+        seq = [dec.prefill(0, prompt)]
+        toks = np.zeros(dec.n_slots, np.int32)
+        pos = np.zeros(dec.n_slots, np.int32)
+        toks[0], pos[0] = seq[0], len(prompt)
+        for _ in range(n_tokens):
+            out = dec.step(toks, pos)
+            seq.append(int(out[0]))
+            toks[0] = out[0]
+            pos[0] += 1
+        return seq
+
+    def test_tp_pallas_interpret_matches_dense_gather(self):
+        cfg = T.TransformerConfig(**self._CFG)
+        params = T.init_params(cfg, seed=0)
+        prompt = np.asarray([5, 9, 77, 3], np.int32)
+        mesh = dist.train_mesh({"data": 2, "model": 2})
+        d_dense = TransformerDecoder(params, cfg, n_slots=4, max_len=32,
+                                     mesh=mesh, attn_impl="dense")
+        d_pal = TransformerDecoder(params, cfg, n_slots=4, max_len=32,
+                                   mesh=mesh,
+                                   attn_impl="pallas_interpret")
+        base = d_pal.warmup()
+        t_dense = self._greedy(d_dense, prompt)
+        t_pal = self._greedy(d_pal, prompt)
+        assert t_dense == t_pal
+        # compile-once holds through the sharded kernel path
+        assert d_pal.n_compiles() == base
+
+    def test_auto_no_longer_forces_dense_under_mesh(self):
+        # the selection rule itself: on TPU, auto->pallas with a mesh;
+        # on CPU the gate keeps dense (kernel can't compile), but an
+        # EXPLICIT pallas_interpret + mesh must be accepted — the old
+        # refusal is gone
+        cfg = T.TransformerConfig(**self._CFG)
+        params = T.init_params(cfg, seed=0)
+        mesh = dist.train_mesh({"data": 1, "model": 2})
+        dec = TransformerDecoder(params, cfg, n_slots=2, max_len=32,
+                                 mesh=mesh,
+                                 attn_impl="pallas_interpret")
+        assert dec.attn_impl == "pallas_interpret"
+        from mmlspark_tpu.parallel.pallas_attention import (
+            paged_attention_available)
+        auto = TransformerDecoder(params, cfg, n_slots=2, max_len=32,
+                                  mesh=mesh, attn_impl="auto")
+        assert auto.attn_impl == (
+            "pallas" if paged_attention_available() else "dense")
+
+
+@pytest.mark.slow
+class TestProcessCountTopology:
+    """Extends TestShardedCheckpointTopology beyond simulated meshes:
+    a checkpoint saved cooperatively by TWO real OS processes (gloo
+    collectives, per-slice shard ownership, manifest by process 0)
+    restores bit-exact in ONE process — topology change across
+    process counts (ISSUE 14 satellite)."""
+
+    _WORKER = r"""
+import sys
+import numpy as np
+from mmlspark_tpu.parallel.topology import use_cpu_devices, distributed_init
+pid, port, out_dir = int(sys.argv[1]), sys.argv[2], sys.argv[3]
+use_cpu_devices(4)
+distributed_init(coordinator_address=f"127.0.0.1:{port}",
+                 num_processes=2, process_id=pid)
+import jax
+from mmlspark_tpu.parallel import dist
+from mmlspark_tpu.io import checkpoint as ckpt
+assert jax.process_count() == 2
+rng = np.random.default_rng(123)
+tree = {"w": rng.normal(size=(64, 32)).astype(np.float32),
+        "b": rng.normal(size=(32,)).astype(np.float32)}
+sharded = dist.shard_state(tree, dist.train_mesh({"data": 4, "model": 2}))
+ckpt.manager(out_dir).save(3, sharded)
+print(f"RANK{pid}_SAVED", flush=True)
+"""
+
+    def test_two_process_save_restores_single_process(self, tmp_path):
+        import socket
+        import subprocess
+        import sys as _sys
+        with socket.socket() as s:
+            s.bind(("127.0.0.1", 0))
+            port = s.getsockname()[1]
+        env = dict(os.environ)
+        env.pop("XLA_FLAGS", None)
+        out_dir = str(tmp_path / "ckpt2p")
+        procs = [subprocess.Popen(
+            [_sys.executable, "-c", self._WORKER, str(pid), str(port),
+             out_dir],
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+            env=env, cwd=os.path.dirname(os.path.dirname(__file__)))
+            for pid in range(2)]
+        outs = [p.communicate(timeout=300)[0] for p in procs]
+        for pid, (p, out) in enumerate(zip(procs, outs)):
+            assert p.returncode == 0, f"rank {pid} failed:\n{out}"
+            assert f"RANK{pid}_SAVED" in out
+        # restore in THIS (single) process, strict digests, bit-exact
+        rng = np.random.default_rng(123)
+        tree = {"w": rng.normal(size=(64, 32)).astype(np.float32),
+                "b": rng.normal(size=(32,)).astype(np.float32)}
+        mngr = ckpt.manager(out_dir, create=False)
+        ok, detail = ckpt.verify_digest(mngr._step_dir(3), strict=True)
+        assert ok, detail
+        restored = mngr.restore(3, tree, strict_digest=True)
+        for a, b in zip(jax.tree.leaves(restored), jax.tree.leaves(tree)):
+            np.testing.assert_array_equal(np.asarray(a), b)
+        # and onto a sharded mesh too (process-count AND layout change)
+        mesh = dist.train_mesh({"data": 2, "model": 2})
+        r2 = mngr.restore(3, tree,
+                          shardings=dist.state_shardings(tree, mesh))
+        for a, b in zip(jax.tree.leaves(r2), jax.tree.leaves(tree)):
+            np.testing.assert_array_equal(np.asarray(a), b)

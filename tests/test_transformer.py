@@ -492,7 +492,12 @@ class TestSpmdTrainStep:
             return float(max(np.bincount(top, minlength=4) / len(top)))
 
         before = max_frac(params)
-        for _ in range(10):
+        # 30 steps: the momentum transient of the first few steps is
+        # formulation-sensitive (the pjit and shard_map steps are
+        # parity-pinned per step, but a marginal 10-step snapshot can
+        # flip on fp-level compilation differences); the aux's
+        # balancing pressure is the claim, and it must have won by 30
+        for _ in range(30):
             params, vel, _ = step(params, vel, tokens, labels, mask)
         after = max_frac(params)
         assert after <= before + 1e-6, (before, after)
@@ -535,6 +540,8 @@ class TestSpmdTrainStep:
             step = T.build_spmd_train_step(cfg, mesh, 0.1, 0.9)
             cost = step.lower(params, vel, tokens, labels,
                               mask).compile().cost_analysis()
+            if isinstance(cost, (list, tuple)):   # jax-version shape
+                cost = cost[0]
             return float(cost["flops"])
 
         cap_2, cap_8 = step_flops(2, 1.0), step_flops(8, 1.0)
@@ -576,6 +583,82 @@ class TestSpmdTrainStep:
         assert MeshSpec.full_spmd(1).resolve(1)["data"] == 1
         assert int(np.prod(list(MeshSpec.full_spmd(32).resolve(32)
                                 .values()))) == 32
+
+
+class TestPjitFormulation:
+    """The pjit (global GSPMD) train step — the formulation that runs
+    on pre-VMA jaxes (ISSUE 14). On THIS container's jax the whole
+    TestSpmdTrainStep suite above already exercises it via
+    ``impl="auto"``; these pin the selection contract itself."""
+
+    def test_explicit_pjit_impl_builds_anywhere(self):
+        cfg = T.TransformerConfig(**_DENSE, layers_per_stage=2)
+        mesh = submesh({"data": 2, "model": 2})
+        step = T.build_spmd_train_step(cfg, mesh, 0.1, 0.9, donate=False,
+                                       impl="pjit")
+        rng = np.random.default_rng(3)
+        tokens, labels, mask = T.make_batch(rng, cfg, 8, 16)
+        sp = T.shard_params(T.init_params(cfg, 0), cfg, mesh)
+        sv = T.shard_params(
+            jax.tree.map(jnp.zeros_like, T.init_params(cfg, 0)), cfg, mesh)
+        _, _, loss = step(sp, sv, tokens, labels, mask)
+        assert np.isfinite(float(loss))
+
+    def test_unknown_impl_refused(self):
+        cfg = T.TransformerConfig(**_DENSE)
+        with pytest.raises(ValueError, match="impl"):
+            T.build_spmd_train_step(cfg, submesh({"data": 2}),
+                                    impl="magic")
+
+    def test_check_vma_false_keeps_shard_map_path(self):
+        """check_vma=False is a shard_map-specific contract (the
+        documented under-reduction boundary): the auto selection must
+        not silently reroute it to pjit — where the boundary does not
+        exist and its guard test would lie."""
+        cfg = T.TransformerConfig(**_DENSE, layers_per_stage=1)
+        mesh = submesh({"data": 2})
+        step = T.build_spmd_train_step(cfg, mesh, 0.1, 0.0, donate=False,
+                                       check_vma=False)
+        rng = np.random.default_rng(1)
+        tokens, labels, mask = T.make_batch(rng, cfg, 4, 16)
+        params = T.init_params(cfg, seed=0)
+        _, g = jax.value_and_grad(T.reference_loss)(
+            params, tokens, labels, mask, cfg)
+        ref_head = params["head"] - 0.1 * g["head"]
+        sp = T.shard_params(params, cfg, mesh)
+        sv = T.shard_params(jax.tree.map(jnp.zeros_like, params), cfg, mesh)
+        sp, sv, _ = step(sp, sv, tokens, labels, mask)
+        # the shard_map check_rep=False boundary: replicated-param
+        # grads under-reduce — exactly what proves the manual path ran
+        assert float(jnp.abs(sp["head"] - ref_head).max()) > 1e-4
+
+    def test_pjit_matches_shard_map_fixed_seed(self):
+        """Fixed-seed parity between the two formulations — pinned
+        wherever a VMA jax exists (the only place both can build)."""
+        from mmlspark_tpu.parallel import compat
+        if not compat.vma_native():
+            pytest.skip("shard_map formulation needs a VMA jax; on "
+                        "this jax the pjit path is pinned against the "
+                        "unsharded golden instead (TestSpmdTrainStep)")
+        cfg = T.TransformerConfig(**_DENSE, layers_per_stage=2)
+        mesh = submesh({"data": 2, "model": 2})
+        rng = np.random.default_rng(7)
+        tokens, labels, mask = T.make_batch(rng, cfg, 8, 16)
+        params = T.init_params(cfg, seed=0)
+        results = {}
+        for impl in ("shard_map", "pjit"):
+            step = T.build_spmd_train_step(cfg, mesh, 0.1, 0.9,
+                                           donate=False, impl=impl)
+            sp = T.shard_params(params, cfg, mesh)
+            sv = T.shard_params(
+                jax.tree.map(jnp.zeros_like, params), cfg, mesh)
+            for _ in range(3):
+                sp, sv, loss = step(sp, sv, tokens, labels, mask)
+            results[impl] = (float(loss), jax.device_get(sp))
+        assert abs(results["pjit"][0] - results["shard_map"][0]) < 2e-5
+        diffs = jax.tree.map(lambda a, b: float(jnp.abs(a - b).max()),
+                             results["pjit"][1], results["shard_map"][1])
+        assert max(jax.tree_util.tree_leaves(diffs)) < 2e-4, diffs
 
 
 def _reference_greedy(params, cfg, prompt, n_new):

@@ -255,6 +255,159 @@ def serving_recompile_check(n_requests: int = 32) -> dict:
 
 
 # ---------------------------------------------------------------------------
+# pipeline-parallel serving (multihost_pipeline_v1)
+# ---------------------------------------------------------------------------
+
+
+def pipeline_check(rows: int = 512, repeats: int = 3,
+                   hidden=(256, 256, 256, 256)) -> dict:
+    """Pipeline-parallel serving A/B — the ``multihost_pipeline_v1``
+    evidence.
+
+    A deep MLP is partitioned into 2 pipeline stages over 2 device
+    slices (``NNModel(pipeline_parallel=2)``); the baseline serves the
+    SAME model on a single stage's devices (the pinned single-device
+    scope — exactly one slice's hardware when the harness runs with 2
+    devices). Gates: >= 2 stages actually placed, zero post-warmup
+    recompiles through a live ServingServer, bubble fraction measured
+    and reported, and >= 1.25x rows/s over the single-stage baseline —
+    with an explicit ``speedup_justification`` when the CPU sandbox
+    cannot express inter-stage overlap (virtual devices share cores)."""
+    import urllib.request
+    import numpy as np
+    import jax
+    from mmlspark_tpu.core.dataframe import DataFrame
+    from mmlspark_tpu.models.function import NNFunction
+    from mmlspark_tpu.models.nn import NNModel
+    from mmlspark_tpu.parallel.topology import single_device_scope
+    from mmlspark_tpu.serving.server import ServingServer
+
+    n_dev = len(jax.devices())
+    if n_dev < 2:
+        return {"skipped": "pipeline parallelism needs >= 2 devices",
+                "ok": True}
+    pp = 2
+    fn = NNFunction.init({"builder": "mlp", "hidden": list(hidden),
+                          "num_outputs": 8}, input_shape=(64,), seed=0)
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(rows, 64)).astype(np.float32)
+    df = DataFrame({"features": x})
+
+    model = NNModel(model=fn, input_col="features",
+                    pipeline_parallel=pp, pipeline_microbatches=4)
+    ref = NNModel(model=fn, input_col="features")
+
+    # parity first: the staged forward must equal the fused one
+    out_pp = model.transform(df)["scores"]
+    with single_device_scope():
+        out_ref = ref.transform(df)["scores"]
+    parity = float(np.abs(out_pp - out_ref).max())
+
+    def best_rows_per_s(run):
+        run()                                     # warm
+        best = 0.0
+        for _ in range(repeats):
+            t0 = time.perf_counter()
+            run()
+            best = max(best, rows / (time.perf_counter() - t0))
+        return best
+
+    pp_rps = best_rows_per_s(lambda: model.transform(df))
+
+    def base_run():
+        with single_device_scope():
+            ref.transform(df)
+    base_rps = best_rows_per_s(base_run)
+    speedup = pp_rps / max(base_rps, 1e-9)
+
+    report = model.pipeline_report() or {}
+
+    # zero post-warmup recompiles through a LIVE pipelined server,
+    # with the /stats pipeline block as evidence
+    srv = ServingServer(model, max_batch_size=16, max_latency_ms=2.0)
+    srv.warmup({"features": [0.0] * 64})
+    srv.start()
+    try:
+        base = f"http://{srv.host}:{srv.port}"
+        rec0 = srv.n_recompiles
+        for _ in range(24):
+            payload = json.dumps(
+                {"features": [float(v) for v in rng.normal(size=64)]}
+            ).encode()
+            req = urllib.request.Request(
+                base + "/predict", data=payload,
+                headers={"Content-Type": "application/json"})
+            urllib.request.urlopen(req, timeout=10).read()
+        stats = json.loads(urllib.request.urlopen(
+            base + "/stats", timeout=10).read())
+        live_pipe = stats.get("pipeline_parallel") or {}
+        recompiles = srv.n_recompiles - rec0
+    finally:
+        srv.stop()
+
+    on_cpu = jax.default_backend() == "cpu"
+    speedup_ok = speedup >= 1.25
+    out = {
+        "n_stages": report.get("n_stages"),
+        "stages": report.get("stages"),
+        "bubble_ratio": report.get("bubble_ratio"),
+        "parity_max_diff": parity,
+        "pipeline_rows_per_s": round(pp_rps, 1),
+        "single_stage_rows_per_s": round(base_rps, 1),
+        "speedup_vs_single_stage": round(speedup, 3),
+        "post_warmup_recompiles": int(recompiles),
+        "live_stats_pipeline_block": bool(live_pipe.get("n_stages")),
+        "live_bubble_ratio": live_pipe.get("bubble_ratio"),
+    }
+    if not speedup_ok and on_cpu:
+        out["speedup_justification"] = (
+            "CPU sandbox: virtual devices share one host's cores, so "
+            "inter-stage overlap may not express as wall-clock "
+            f"speedup (measured {speedup:.2f}x); the gate rides "
+            "parity + staged placement + zero recompiles + measured "
+            "bubble. Real-chip numbers land in MULTICHIP_r0*.json.")
+    out["ok"] = bool(
+        (report.get("n_stages") or 0) >= 2
+        and parity < 1e-5
+        and recompiles == 0
+        and report.get("bubble_ratio") is not None
+        and live_pipe.get("n_stages")
+        and (speedup_ok or "speedup_justification" in out))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# 2-process DCN drill (multiprocess_dcn_v1 — subprocess, opt-in)
+# ---------------------------------------------------------------------------
+
+
+def dcn_drill(timeout: float = 300.0, smoke: bool = True) -> dict:
+    """Spawn tools/launch_multiprocess.py: the REAL 2-process drill
+    (gloo cross-process psum, fit parity, pipe-stage split, 2-process
+    cooperative checkpoint save -> 1-process restore). Subprocess-
+    isolated — the drill owns its jax runtimes — with the per-phase
+    timeout degrading to a failed metric line, never a hung bench."""
+    import subprocess
+    script = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                          "launch_multiprocess.py")
+    cmd = [sys.executable, script, "--json",
+           "--timeout", str(int(timeout))]
+    if smoke:
+        cmd.append("--smoke")
+    try:
+        p = subprocess.run(cmd, capture_output=True, text=True,
+                           timeout=timeout * 3)
+    except subprocess.TimeoutExpired as e:
+        return {"passed": False,
+                "error": f"dcn drill timed out after {e.timeout}s"}
+    try:
+        return json.loads(p.stdout.strip().splitlines()[-1])
+    except (ValueError, IndexError):
+        return {"passed": False, "rc": p.returncode,
+                "error": (p.stdout + p.stderr)[-1200:]}
+
+
+# ---------------------------------------------------------------------------
 # sharded-checkpoint topology drill
 # ---------------------------------------------------------------------------
 
@@ -304,13 +457,55 @@ def checkpoint_topology_drill() -> dict:
 # ---------------------------------------------------------------------------
 
 
-def run_all(counts=(1, 2, 4, 8), quick: bool = False) -> dict:
-    parity = parity_check(steps_epochs=3 if quick else 5)
-    curve = scaling_curve(counts=counts,
-                          n_long=20 if quick else 40,
-                          repeats=2 if quick else 3)
-    serving = serving_recompile_check(n_requests=16 if quick else 32)
-    ckpt = checkpoint_topology_drill()
+def _run_phase(name: str, fn, timeout_s: float) -> dict:
+    """Run one in-process phase under a watchdog: a hung phase (the
+    XLA:CPU collective-rendezvous deadlock class) degrades to a failed
+    metric line instead of hanging the whole bench past its caller's
+    budget. The worker thread is daemonized — it cannot be killed, but
+    the bench reports and moves on (and the process exit reaps it)."""
+    import threading
+    box: dict = {}
+
+    def work():
+        try:
+            box["result"] = fn()
+        except Exception as e:  # noqa: BLE001 — failed phase = failed line
+            box["error"] = f"{type(e).__name__}: {e}"
+
+    t = threading.Thread(target=work, daemon=True, name=f"phase-{name}")
+    t0 = time.time()
+    t.start()
+    t.join(timeout_s)
+    if t.is_alive():
+        return {"ok": False, "passed": False,
+                "error": f"phase {name!r} timed out after {timeout_s}s "
+                         f"(thread abandoned)"}
+    if "error" in box:
+        return {"ok": False, "passed": False, "error": box["error"],
+                "elapsed_s": round(time.time() - t0, 1)}
+    return box["result"]
+
+
+def run_all(counts=(1, 2, 4, 8), quick: bool = False,
+            phase_timeout: float = 300.0, with_dcn: bool = False) -> dict:
+    parity = _run_phase(
+        "parity", lambda: parity_check(steps_epochs=3 if quick else 5),
+        phase_timeout)
+    curve = _run_phase(
+        "curve", lambda: scaling_curve(counts=counts,
+                                       n_long=20 if quick else 40,
+                                       repeats=2 if quick else 3),
+        phase_timeout)
+    if isinstance(curve, dict):          # timed out / raised
+        curve_err, curve = curve, []
+    else:
+        curve_err = None
+    serving = _run_phase(
+        "serving",
+        lambda: serving_recompile_check(n_requests=16 if quick else 32),
+        phase_timeout)
+    ckpt = _run_phase("checkpoint", checkpoint_topology_drill,
+                      phase_timeout)
     by_n = {c["devices"]: c["steps_per_s"] for c in curve}
     speedup_4x = ((by_n[4] / by_n[1])
                   if (4 in by_n and by_n.get(1)) else None)
@@ -327,6 +522,17 @@ def run_all(counts=(1, 2, 4, 8), quick: bool = False) -> dict:
         "backend": jax.default_backend(),
         "n_devices": len(jax.devices()),
     }
+    if curve_err is not None:
+        out["curve_error"] = curve_err
+    if with_dcn:
+        # the REAL multi-process story: opt-in (subprocess-heavy), a
+        # smoke-mode sub-result so multihost_scaling_v1 carries DCN
+        # evidence without blowing the tier-1/bench budget
+        # capped well below the caller's outer budget: the drill's
+        # graceful phase-group timeouts must all fire (failed metric
+        # line) before any outer kill could orphan the gloo workers
+        out["dcn"] = dcn_drill(timeout=min(phase_timeout, 150.0),
+                               smoke=True)
     if not speedup_ok:
         # the acceptance contract: when the environment can't express
         # (or reach) the 1.5x target, the measured number is REPORTED
@@ -348,10 +554,12 @@ def run_all(counts=(1, 2, 4, 8), quick: bool = False) -> dict:
                    f"hardware; reported explicitly per the "
                    f"acceptance contract")
         out["speedup_justification"] = why
-    out["passed"] = bool(parity["ok"] and serving["ok"] and ckpt["ok"]
-                         and curve
+    out["passed"] = bool(parity.get("ok") and serving.get("ok")
+                         and ckpt.get("ok") and curve
                          and (speedup_ok
-                              or "speedup_justification" in out))
+                              or "speedup_justification" in out)
+                         and (not with_dcn
+                              or out["dcn"].get("passed")))
     return out
 
 
@@ -362,11 +570,38 @@ def main() -> None:
     ap.add_argument("--json", action="store_true",
                     help="print the evidence JSON only")
     ap.add_argument("--devices", type=int, default=8)
+    ap.add_argument("--phase", default="all",
+                    choices=("all", "pipeline", "dcn"),
+                    help="all = the multihost_scaling_v1 suite; "
+                         "pipeline = the multihost_pipeline_v1 check "
+                         "alone; dcn = the 2-process drill alone")
+    ap.add_argument("--dcn", action="store_true",
+                    help="include the 2-process DCN drill sub-result "
+                         "in the full suite")
+    ap.add_argument("--phase-timeout", type=float, default=300.0,
+                    help="per-phase watchdog: a hung phase becomes a "
+                         "failed metric line, not a hung bench")
     args = ap.parse_args()
 
-    _ensure_devices(args.devices)
+    if args.phase == "dcn":
+        out = dcn_drill(timeout=args.phase_timeout, smoke=args.smoke)
+        print(json.dumps(out, indent=None if args.json else 2))
+        sys.exit(0 if out.get("passed") else 1)
+
+    _ensure_devices(2 if args.phase == "pipeline" else args.devices)
+    if args.phase == "pipeline":
+        out = _run_phase(
+            "pipeline",
+            lambda: pipeline_check(rows=256 if args.smoke else 512,
+                                   repeats=2 if args.smoke else 3),
+            args.phase_timeout)
+        out["passed"] = bool(out.get("ok"))
+        print(json.dumps(out, indent=None if args.json else 2))
+        sys.exit(0 if out["passed"] else 1)
+
     counts = tuple(n for n in (1, 2, 4, 8) if n <= args.devices)
-    out = run_all(counts=counts, quick=args.smoke)
+    out = run_all(counts=counts, quick=args.smoke,
+                  phase_timeout=args.phase_timeout, with_dcn=args.dcn)
     print(json.dumps(out, indent=None if args.json else 2))
     if not out["passed"]:
         sys.exit(1)
