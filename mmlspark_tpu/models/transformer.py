@@ -1855,6 +1855,113 @@ def build_paged_prefill(cfg: TransformerConfig, page_size: int,
     return jax.jit(prefill, donate_argnums=(1,) if donate else (), **kw)
 
 
+def build_paged_prefix_prefill(cfg: TransformerConfig, page_size: int,
+                               pages_per_slot: int, donate: bool = True,
+                               cache_sharding=None):
+    """Jitted ``prefill(params, cache, tokens, page_table, length,
+    hit_len) -> (cache, next_token, last_logits)`` — the **partial /
+    offset** prefill behind the cross-request prefix cache
+    (docs/serving.md "Prefix cache").
+
+    When the radix index matched a prompt's first ``hit_len`` tokens
+    (page-aligned) to cached pages, only the uncached suffix needs
+    compute: ``tokens`` is the suffix ``prompt[hit_len:]`` padded to a
+    bucket ``[S_pad]`` (one compile per SUFFIX bucket — the same pow2
+    ladder as cold prefill), ``page_table`` the slot's full table whose
+    first ``hit_len // page_size`` entries are the SHARED prefix pages
+    and the rest the slot's private pages. Each suffix position ``j``
+    embeds/ropes at virtual position ``hit_len + j`` (``hit_len`` is a
+    traced scalar — hit depth is data, not shape), writes its K/V row
+    through the table at that virtual row (hit_len is page-aligned, so
+    suffix chunks start on a page boundary), and attends over the
+    WHOLE virtual lane — prefix rows come straight from the shared
+    pages, never recomputed — masked causally to ``index <= hit_len +
+    j``. Exact, not approximate: the lane holds the same K/V a cold
+    prefill would have produced (the shared pages ARE a previous cold
+    prefill's output), so greedy/sampled/speculative decode from an
+    offset prefill is token-for-token the cold path (test-pinned).
+
+    Shared pages are READ-only here by construction: every write lands
+    at virtual row ``>= hit_len``, i.e. pages ``>= hit_len //
+    page_size`` — the immutability invariant the scheduler's sharing
+    model rests on. ``next_token`` is the greedy argmax at virtual
+    position ``length - 1`` (suffix row ``length - 1 - hit_len``;
+    the cache layer caps ``hit_len < length``, so the last prompt
+    position is always computed, never cached)."""
+    _check_decode_config(cfg)
+    page_size, pages_per_slot = int(page_size), int(pages_per_slot)
+    V = page_size * pages_per_slot
+    scale = cfg.d_head ** -0.5
+    idx = jnp.arange(V)
+
+    def prefill(params, cache, tokens, page_table, length, hit_len):
+        S = tokens.shape[0]
+        x = params["embed"][tokens]                    # [S, D]
+        pos = hit_len + jnp.arange(S)                  # virtual rows
+        start_page = hit_len // page_size
+        ck, cv = cache["k"], cache["v"]
+        # query j at virtual row hit_len + j reads index <= hit_len + j
+        mask = idx[None, None, :] <= pos[:, None, None]  # [S, 1, V]
+        for l, bp in enumerate(_decode_block_params(params, cfg)):
+            h = _rmsnorm(x, bp["ln1"])
+            q = _rope_at(jnp.einsum("sd,dhk->shk", h, bp["wq"]), pos)
+            k = _rope_at(jnp.einsum("sd,dhk->shk", h, bp["wk"]), pos)
+            v = jnp.einsum("sd,dhk->shk", h, bp["wv"])
+            if S >= page_size:
+                # hit_len is page-aligned: suffix chunk c fills page
+                # table[start_page + c] exactly. The bucket can
+                # overshoot the lane end (start_page + n_chunks >
+                # pages_per_slot when hit_len + S_pad > max_len) — a
+                # clamped dynamic_slice would silently re-aim those
+                # chunks at EARLIER table entries, i.e. write padding
+                # over the SHARED prefix pages, so overflow chunks
+                # route to the scratch page instead (the verify step's
+                # overshoot convention).
+                n_chunks = S // page_size
+                cpos = start_page + jnp.arange(n_chunks)
+                pgs = jnp.where(
+                    cpos < pages_per_slot,
+                    page_table[jnp.minimum(cpos, pages_per_slot - 1)],
+                    0)
+                ck = ck.at[l, pgs].set(
+                    k.reshape(n_chunks, page_size,
+                              cfg.n_heads, cfg.d_head))
+                cv = cv.at[l, pgs].set(
+                    v.reshape(n_chunks, page_size,
+                              cfg.n_heads, cfg.d_head))
+            else:
+                # a sub-page suffix bucket: one partial write into the
+                # first private page, rows [0, S)
+                pg = jax.lax.dynamic_index_in_dim(
+                    page_table, start_page, keepdims=False)
+                ck = jax.lax.dynamic_update_slice(
+                    ck, k[None, None], (l, pg, 0, 0, 0))
+                cv = jax.lax.dynamic_update_slice(
+                    cv, v[None, None], (l, pg, 0, 0, 0))
+            # attend over the whole virtual lane: shared prefix rows
+            # are read from their pages, suffix rows were just written
+            lk = ck[l, page_table].reshape(V, cfg.n_heads, cfg.d_head)
+            lv = cv[l, page_table].reshape(V, cfg.n_heads, cfg.d_head)
+            s = jnp.einsum("shk,vhk->shv", q, lk) * scale
+            s = jnp.where(mask, s, -1e30)
+            p = jax.nn.softmax(s, axis=-1)
+            a = jnp.einsum("shv,vhk->shk", p, lv)
+            x = x + jnp.einsum("shk,hkd->sd", a, bp["wo"])
+            x = x + _decode_ffn(bp, _rmsnorm(x, bp["ln2"]), cfg)
+        h = _rmsnorm(x, params["final_norm"])          # [S, D]
+        last = jax.lax.dynamic_index_in_dim(
+            h, length - 1 - hit_len, axis=0, keepdims=False)
+        logits = last @ params["head"]
+        return ({"k": ck, "v": cv},
+                jnp.argmax(logits, -1).astype(jnp.int32), logits)
+
+    kw = {}
+    out_sh = _decode_out_shardings(cache_sharding)
+    if out_sh is not None:
+        kw["out_shardings"] = out_sh
+    return jax.jit(prefill, donate_argnums=(1,) if donate else (), **kw)
+
+
 def _gather_lane(c_l, page_tables, n_slots, virtual_len, cfg):
     """Assemble each slot's virtual lane from its pages:
     ``c_l [n_pages, page_size, H, Dh]`` gathered through

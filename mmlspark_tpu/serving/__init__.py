@@ -23,8 +23,8 @@ from mmlspark_tpu.serving.server import (
 from mmlspark_tpu.serving.capture import TrafficCapture
 from mmlspark_tpu.serving.consolidator import PartitionConsolidator
 from mmlspark_tpu.serving.decode import (
-    DecodeOverloaded, DecodeScheduler, PagePool, Sampler, SlotPool,
-    TransformerDecoder,
+    DecodeOverloaded, DecodeScheduler, PagePool, PrefixCache, Sampler,
+    SlotPool, TransformerDecoder,
 )
 from mmlspark_tpu.serving.frontend import EventLoopFrontend
 from mmlspark_tpu.serving.policy import (
@@ -39,6 +39,7 @@ __all__ = ["ServingServer", "ServingCoordinator", "ServingClient",
            "PartitionConsolidator", "EventLoopFrontend",
            "ModelVersionManager", "RolloutError", "RolloutOrchestrator",
            "DecodeScheduler", "DecodeOverloaded", "SlotPool", "PagePool",
+           "PrefixCache",
            "TransformerDecoder", "AdaptiveBatchPolicy",
            "QuantizationConfig",
            "SpeculationPolicy", "Sampler", "TrafficCapture"]

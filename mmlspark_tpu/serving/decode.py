@@ -34,7 +34,17 @@ plus per-slot page tables, so cache HBM is spent on rows sequences
 actually occupy — a :class:`PagePool` claims/frees pages between
 steps with the same no-leak ledger as slots, admission sheds 429 on
 page exhaustion, and a pool that runs dry mid-decode preempts (partial
-tokens, ``pages_exhausted``) instead of OOMing. With a draft model
+tokens, ``pages_exhausted``) instead of OOMing. The page pool is
+**content-addressable across requests** (docs/serving.md "Prefix
+cache"): a :class:`PrefixCache` radix index keyed by
+``page_size``-token prompt chunks maps a new prompt to its longest
+cached prefix, whose pages attach to the new slot's table by
+REFERENCE (``PagePool`` refcounts — a shared page frees only when its
+last reader leaves), a finishing request's prompt-complete pages are
+published into the index instead of freed (LRU-bounded; eviction
+reclaims unreferenced pages under claim pressure), and the prefill
+computes only the uncached suffix — exact, token-for-token the cold
+path. With a draft model
 configured, the scheduler runs **speculative rounds** (fused k-token
 draft propose + one width-k target verify; exact greedy prefix
 acceptance, rejection sampling for sampled opt-ins, acceptance-gated
@@ -118,7 +128,8 @@ class TransformerDecoder:
                  n_pages: Optional[int] = None,
                  draft_params=None, draft_cfg=None, spec_k: int = 4,
                  attn_impl: str = "auto",
-                 verify_ce_impl: Optional[str] = None):
+                 verify_ce_impl: Optional[str] = None,
+                 prefix_cache: bool = True):
         from mmlspark_tpu.models import transformer as T
         self.cfg = cfg
         self.n_slots = int(n_slots)
@@ -200,6 +211,17 @@ class TransformerDecoder:
                 cfg, self.n_slots, self.page_size, self.pages_per_slot,
                 donate=donate, cache_sharding=cache_sharding,
                 attn_impl=attn_impl)
+            # the cross-request prefix cache's compute half: a
+            # partial/offset prefill that computes KV only for the
+            # uncached suffix [hit_len, S) while attending over the
+            # shared prefix pages (the scheduler's PrefixCache is the
+            # index half; prefix_cache=False skips building/warming it
+            # — the A/B baseline)
+            self._prefix_prefill = (
+                T.build_paged_prefix_prefill(
+                    cfg, self.page_size, self.pages_per_slot,
+                    donate=donate, cache_sharding=cache_sharding)
+                if prefix_cache else None)
             if 1 + self.n_slots * self.pages_per_slot <= self.n_pages:
                 self._identity_tables = (
                     1 + np.arange(self.n_slots * self.pages_per_slot,
@@ -221,6 +243,7 @@ class TransformerDecoder:
             self.n_pages = 0
             self.attn_impl = "dense"
             self._identity_tables = None
+            self._prefix_prefill = None
             self.cache = T.init_kv_cache(cfg, self.n_slots,
                                          self.max_len)
             self._prefill = T.build_prefill(
@@ -283,6 +306,10 @@ class TransformerDecoder:
     @property
     def has_draft(self) -> bool:
         return self._verify is not None
+
+    @property
+    def has_prefix_prefill(self) -> bool:
+        return self._prefix_prefill is not None
 
     def placement(self) -> Dict[str, Any]:
         """Where this decoder's params + KV pool live (the
@@ -356,6 +383,40 @@ class TransformerDecoder:
                 page_table=None) -> int:
         """Greedy :meth:`prefill_logits` (compat surface)."""
         return self.prefill_logits(slot, prompt, page_table)[0]
+
+    def prefill_prefix_logits(self, slot: int, prompt: np.ndarray,
+                              hit_len: int, page_table,
+                              draft: bool = True
+                              ) -> "tuple[int, Any]":
+        """Partial/offset prefill: the prompt's first ``hit_len``
+        tokens (page-aligned, ``< len(prompt)``) already live in the
+        shared prefix pages at the head of ``page_table`` — compute
+        K/V only for the suffix (padded to its own bucket) while
+        attending over the whole virtual lane. Token-for-token
+        equivalent to :meth:`prefill_logits` (the shared pages ARE a
+        previous cold prefill's rows). The draft cache (speculation)
+        has no page plane, so the draft still prefills the FULL prompt
+        into its dense slot lane — already-warmed prompt buckets, and
+        the draft's cost is the cheap fraction by construction."""
+        import jax.numpy as jnp
+        if hit_len <= 0:
+            return self.prefill_logits(slot, prompt, page_table,
+                                       draft=draft)
+        if hit_len % self.page_size or hit_len >= len(prompt):
+            raise ValueError(
+                f"hit_len={hit_len} must be page-aligned and < "
+                f"prompt length {len(prompt)}")
+        padded = self.pad_prompt(prompt[hit_len:])
+        self.cache, nxt, logits = self._prefix_prefill(
+            self.params, self.cache, jnp.asarray(padded),
+            jnp.asarray(self._table_for(slot, page_table)),
+            np.int32(len(prompt)), np.int32(hit_len))
+        if self.has_draft and draft:
+            self.draft_cache, _, _ = self._draft_prefill(
+                self.draft_params, self.draft_cache,
+                jnp.asarray(self.pad_prompt(prompt)), np.int32(slot),
+                np.int32(len(prompt)))
+        return int(nxt), logits
 
     def step_logits(self, tokens: np.ndarray, pos: np.ndarray,
                     page_tables=None) -> "tuple[np.ndarray, Any]":
@@ -434,7 +495,8 @@ class TransformerDecoder:
         retraces."""
         n = int(self._prefill._cache_size() + self._step._cache_size())
         for fn in (self._draft_prefill, self._draft_step,
-                   self._propose, self._verify):
+                   self._propose, self._verify,
+                   self._prefix_prefill):
             if fn is not None:
                 n += int(fn._cache_size())
         return n
@@ -453,6 +515,16 @@ class TransformerDecoder:
             self.prefill(0, np.zeros(min(bucket, self.max_len - 1),
                                      np.int32),
                          zero_tables[0] if self.paged else None)
+        if self._prefix_prefill is not None:
+            # the offset prefill compiles per SUFFIX bucket — the same
+            # pow2 ladder (hit depth is a traced scalar, not a shape)
+            import jax.numpy as jnp
+            for bucket in self.prompt_buckets():
+                self.cache, _, _ = self._prefix_prefill(
+                    self.params, self.cache,
+                    jnp.asarray(np.zeros(bucket, np.int32)),
+                    jnp.asarray(zero_tables[0]),
+                    np.int32(1), np.int32(0))
         if self.has_draft:
             self.propose(zeros_t, zeros_t.copy())
             self.draft_step_logits(zeros_t, zeros_t.copy())
@@ -522,23 +594,32 @@ class Sampler:
 
 
 class SlotPool:
-    """Free-slot index pool. Claim/release are O(1) under one lock;
+    """Free-slot index pool. Claim/release are O(1) under one lock —
+    release checks the claimed SET, not the free list (the old ``slot
+    in self._free`` scan was O(n_free) per release inside the step
+    loop, the same ledger mistake :class:`PagePool` already fixed);
     the scheduler loop is the only claimer, but cancel paths and tests
     read ``n_free`` concurrently."""
 
     def __init__(self, n_slots: int):
         self.n_slots = int(n_slots)
         self._free = list(range(self.n_slots - 1, -1, -1))
+        self._claimed: set = set()
         self._lock = threading.Lock()
 
     def claim(self) -> Optional[int]:
         with self._lock:
-            return self._free.pop() if self._free else None
+            if not self._free:
+                return None
+            slot = self._free.pop()
+            self._claimed.add(slot)
+            return slot
 
     def release(self, slot: int) -> None:
         with self._lock:
-            if slot in self._free:
+            if slot not in self._claimed:
                 raise RuntimeError(f"slot {slot} double-released")
+            self._claimed.discard(slot)
             self._free.append(slot)
 
     @property
@@ -548,22 +629,30 @@ class SlotPool:
 
 
 class PagePool:
-    """Free-page index pool over the paged KV cache. Page 0 is the
-    scratch page (unclaimed table entries route writes there) and is
-    never handed out, so a pool of ``n_pages`` holds ``n_pages - 1``
-    claimable pages. ``claim`` is all-or-nothing — a request either
-    gets every page it asked for or none (no partial grabs to leak on
-    the error path). The high-water mark and the ``n_free ==
-    n_pages - 1`` idle invariant are the page-leak ledger the chaos
-    tests assert."""
+    """Refcounted free-page index pool over the paged KV cache. Page 0
+    is the scratch page (unclaimed table entries route writes there)
+    and is never handed out, so a pool of ``n_pages`` holds
+    ``n_pages - 1`` claimable pages.
+
+    Every claimed page carries a **refcount**: ``claim`` hands out
+    fresh pages at refcount 1, ``ref`` adds a reader to
+    already-claimed pages (how a request attaches a cached prefix —
+    and how the :class:`PrefixCache` itself pins the pages it
+    publishes), and ``release`` drops a reference — a page returns to
+    the free list only when its LAST holder releases it. ``claim`` is
+    all-or-nothing — a request either gets every page it asked for or
+    none (no partial grabs to leak on the error path). The high-water
+    mark and the idle invariant (``n_free`` plus index-held pages ==
+    ``n_pages - 1``, every surviving refcount exactly the index's own)
+    are the page-leak ledger the chaos tests assert — refcounts, not
+    raw ownership."""
 
     def __init__(self, n_pages: int):
         self.n_pages = int(n_pages)
         self._free = list(range(self.n_pages - 1, 0, -1))
-        # claimed-page set: O(1) double-release detection (a list scan
-        # would cost O(pages_released * n_free) per request teardown
-        # inside the step loop)
-        self._claimed: set = set()
+        # page -> refcount for claimed pages: O(1) double-release
+        # detection AND the sharing ledger in one structure
+        self._ref: Dict[int, int] = {}
         self._lock = threading.Lock()
         self.high_water = 0
 
@@ -572,23 +661,322 @@ class PagePool:
             if n > len(self._free):
                 return None
             pages = [self._free.pop() for _ in range(n)]
-            self._claimed.update(pages)
-            if len(self._claimed) > self.high_water:
-                self.high_water = len(self._claimed)
+            for p in pages:
+                self._ref[p] = 1
+            if len(self._ref) > self.high_water:
+                self.high_water = len(self._ref)
             return pages
+
+    def ref(self, pages: List[int]) -> None:
+        """Add one reader to each already-claimed page (attaching a
+        shared prefix). Raises on a page nobody holds — refcounts on
+        free pages would resurrect reclaimed state."""
+        with self._lock:
+            for p in pages:
+                if p not in self._ref:
+                    raise RuntimeError(
+                        f"page {p} ref'd while unclaimed")
+            for p in pages:
+                self._ref[p] += 1
 
     def release(self, pages: List[int]) -> None:
         with self._lock:
             for p in pages:
-                if p not in self._claimed:
+                if p not in self._ref:
                     raise RuntimeError(f"page {p} double-released")
-                self._claimed.discard(p)
-                self._free.append(p)
+                self._ref[p] -= 1
+                if self._ref[p] == 0:
+                    del self._ref[p]
+                    self._free.append(p)
+
+    def refcount(self, page: int) -> int:
+        with self._lock:
+            return self._ref.get(page, 0)
 
     @property
     def n_free(self) -> int:
         with self._lock:
             return len(self._free)
+
+    @property
+    def n_claimed(self) -> int:
+        with self._lock:
+            return len(self._ref)
+
+
+class _RadixNode:
+    """One cached page: keyed in its parent by the ``page_size``-token
+    chunk whose K/V rows the page holds. ``parent``/``key`` back-links
+    make leaf eviction O(log n) per victim (pop a leaf, its parent
+    becomes the next candidate) instead of a full re-walk each."""
+
+    __slots__ = ("children", "page", "last_used", "parent", "key")
+
+    def __init__(self, page: int, now: float, parent=None, key=None):
+        self.children: Dict[tuple, "_RadixNode"] = {}
+        self.page = page
+        self.last_used = now
+        self.parent = parent
+        self.key = key
+
+
+class PrefixCache:
+    """Content-addressed index over the paged KV pool: a radix tree
+    keyed at page granularity (``page_size``-token chunks of prompt
+    token ids) mapping a new prompt to its longest cached prefix
+    (docs/serving.md "Prefix cache").
+
+    The tree holds ONE reference on every published page (via
+    :meth:`PagePool.ref` semantics — publication transfers the
+    finishing request's reference instead of freeing the page), so a
+    cached page with refcount 1 is **unreferenced** — evictable — and
+    refcount > 1 means live readers are attached. ``lookup`` walks
+    whole chunks, refs the matched pages for the caller (the caller
+    releases them at finish like any claimed page), and stamps the
+    path's LRU clocks; ``publish`` inserts a finished request's
+    fully-written PROMPT pages (never a page its owner might still
+    write: generated-token pages and the partial tail page stay
+    private and are freed). ``evict_for`` reclaims LRU unreferenced
+    leaves under pressure; ``max_pages`` bounds the resident set.
+
+    Thread safety: one lock over the tree. Pool refcount mutations for
+    matched/published pages happen under it, so a concurrent
+    ``release`` can never free a page between the radix match and the
+    ``ref`` that pins it."""
+
+    def __init__(self, pool: PagePool, page_size: int,
+                 max_pages: Optional[int] = None,
+                 clock: Clock = SYSTEM_CLOCK):
+        self.pool = pool
+        self.page_size = int(page_size)
+        # default bound: the whole claimable pool — eviction under
+        # claim pressure keeps live requests ahead of cache residency
+        self.max_pages = (int(max_pages) if max_pages is not None
+                          else pool.n_pages - 1)
+        self.clock = clock
+        self._root = _RadixNode(page=0, now=0.0)
+        self._lock = threading.Lock()
+        self.n_cached = 0
+        self.n_lookups = 0
+        self.n_hits = 0
+        self.n_hit_tokens = 0
+        self.n_published = 0
+        self.n_evicted = 0
+
+    def _chunks(self, tokens, n: int):
+        ps = self.page_size
+        return [tuple(int(t) for t in tokens[i * ps:(i + 1) * ps])
+                for i in range(n)]
+
+    def lookup(self, prompt) -> "tuple[int, List[int]]":
+        """Longest cached prefix of ``prompt`` -> ``(hit_len,
+        pages)``, with the pages ref'd for the caller. ``hit_len`` is
+        page-aligned and capped at ``len(prompt) - 1`` — the last
+        prompt position is always computed by the (partial) prefill,
+        which needs its logits for the first generated token.
+
+        Does NOT count itself: a head-of-line request short of suffix
+        pages re-queues and looks up again next pass, so the exported
+        (monotonic) counters are bumped once per ADMITTED request via
+        :meth:`count` instead of once per attempt."""
+        max_chunks = (len(prompt) - 1) // self.page_size
+        with self._lock:
+            node, pages = self._root, []
+            now = self.clock.now()
+            for chunk in self._chunks(prompt, max_chunks):
+                child = node.children.get(chunk)
+                if child is None:
+                    break
+                child.last_used = now
+                pages.append(child.page)
+                node = child
+            if not pages:
+                return 0, []
+            self.pool.ref(pages)
+            return len(pages) * self.page_size, pages
+
+    def count(self, hit_len: int) -> None:
+        """Record one admitted request's lookup outcome in the hit
+        ledger (monotonic — these back Prometheus counters)."""
+        with self._lock:
+            self.n_lookups += 1
+            if hit_len > 0:
+                self.n_hits += 1
+                self.n_hit_tokens += hit_len
+
+    def miss_count(self) -> int:
+        """``misses = lookups - hits`` from ONE locked snapshot — the
+        two fields update together under the lock, so an unlocked
+        two-field read could tear mid-update and hand Prometheus a
+        transiently decreasing counter (read as a reset)."""
+        with self._lock:
+            return self.n_lookups - self.n_hits
+
+    def publish(self, prompt, pages: List[int]) -> "set":
+        """Insert a finished request's prompt-complete pages
+        (``pages[i]`` holds prompt rows ``[i*ps, (i+1)*ps)``) into the
+        tree. Only pages newly ABSORBED by the index (their reference
+        transferred from the request to the cache) are returned — the
+        caller releases everything else: chunks already present keep
+        the incumbent page (identical content — K/V is a pure function
+        of the token prefix) and the duplicate stays the caller's to
+        free. Absorption respects ``max_pages``: LRU unreferenced
+        pages are evicted to make room, and when nothing is evictable
+        the remaining chunks simply stay unpublished."""
+        n_chunks = min(len(prompt) // self.page_size, len(pages))
+        if n_chunks == 0:
+            return set()
+        absorbed: set = set()
+        with self._lock:
+            # size the eviction ONCE: count the chunks actually
+            # missing (cheap path walk), then a single heap-seeded
+            # _evict_locked covers them all — the per-chunk fallback
+            # below only fires when eviction came up short, so a warm
+            # cache at its bound pays one tree walk per publish, not
+            # one per fresh chunk
+            chunks = self._chunks(prompt, n_chunks)
+            node, missing = self._root, 0
+            for chunk in chunks:
+                if node is not None:
+                    node = node.children.get(chunk)
+                if node is None:
+                    missing += 1
+            shortfall = self.n_cached + missing - self.max_pages
+            if missing and shortfall > 0:
+                self._evict_locked(shortfall)
+            node = self._root
+            now = self.clock.now()
+            path: set = set()            # every node on this publish's
+            # chain — fresh or matched. A mid-publish eviction that
+            # removed one (a fresh page is a refcount-1 leaf until the
+            # next chunk lands; a MATCHED incumbent can be refcount-1
+            # too when this publisher duplicated rather than attached
+            # it) would orphan the subtree being extended — its pages
+            # unreachable forever, the ledger permanently dirty.
+            for i, chunk in enumerate(chunks):
+                child = node.children.get(chunk)
+                if child is None:
+                    if self.n_cached >= self.max_pages and \
+                            not self._evict_locked(1, exclude=path):
+                        break            # full and pinned: stop here
+                    child = _RadixNode(pages[i], now,
+                                       parent=node, key=chunk)
+                    node.children[chunk] = child
+                    self.n_cached += 1
+                    self.n_published += 1
+                    absorbed.add(pages[i])
+                else:
+                    child.last_used = now
+                path.add(id(child))
+                node = child
+        return absorbed
+
+    def _nodes_locked(self):
+        """Every node in the tree (root excluded). Caller holds the
+        lock."""
+        stack = [self._root]
+        while stack:
+            nd = stack.pop()
+            for child in nd.children.values():
+                yield child
+                stack.append(child)
+
+    def _evict_locked(self, n: int, exclude=frozenset()) -> int:
+        """Evict up to ``n`` LRU leaves whose page has no reader
+        beyond the index itself (refcount 1). Leaves only: an
+        interior node's descendants are reachable exclusively through
+        it — but evicting a leaf can TURN its parent into one, so
+        candidates ride a heap seeded by one walk and parents join as
+        their last child goes (O(n_cached + evicted·log) instead of a
+        full re-walk per victim). ``exclude`` holds the node ids an
+        in-flight publish is building under (never evict the chain
+        being extended)."""
+        import heapq
+        heap = [(nd.last_used, i, nd)
+                for i, nd in enumerate(self._nodes_locked())
+                if not nd.children]
+        heapq.heapify(heap)
+        seq = len(heap)
+        evicted = 0
+        while evicted < n and heap:
+            _, _, nd = heapq.heappop(heap)
+            if nd.children or nd.parent is None \
+                    or nd.parent.children.get(nd.key) is not nd:
+                continue                 # stale entry: re-parented or
+                # already evicted this round
+            if id(nd) in exclude or \
+                    self.pool.refcount(nd.page) != 1:
+                continue                 # pinned or publish-in-flight
+            nd.parent.children.pop(nd.key)
+            self.pool.release([nd.page])
+            self.n_cached -= 1
+            self.n_evicted += 1
+            evicted += 1
+            parent = nd.parent
+            if not parent.children and parent is not self._root:
+                heapq.heappush(heap, (parent.last_used, seq, parent))
+                seq += 1
+        return evicted
+
+    def evict_for(self, n_needed: int) -> int:
+        """Reclaim LRU unreferenced cached pages until the pool can
+        hand out ``n_needed`` pages (or nothing evictable remains).
+        Returns the number evicted."""
+        with self._lock:
+            short = n_needed - self.pool.n_free
+            return self._evict_locked(short) if short > 0 else 0
+
+    @property
+    def n_evictable(self) -> int:
+        """Cached pages no live request holds — reclaimable headroom.
+        O(n_cached) tree walk with a pool-lock hop per page: a stats /
+        test surface, NOT for per-request paths (admission uses the
+        O(1) ``n_cached`` upper bound instead)."""
+        with self._lock:
+            return sum(1 for nd in self._nodes_locked()
+                       if self.pool.refcount(nd.page) == 1)
+
+    def ledger_clean(self) -> bool:
+        """The IDLE/drain refcount invariant: every cached page is
+        held by exactly the index (refcount 1) and free + cached
+        accounts for the whole claimable pool — no request left a
+        reference behind. Meaningful only with no requests live (a
+        healthy reader mid-decode holds refcount 2); scrape it at
+        drain, alert on it at idle."""
+        with self._lock:
+            pages = [nd.page for nd in self._nodes_locked()]
+            if len(pages) != self.n_cached:
+                return False
+        if any(self.pool.refcount(p) != 1 for p in pages):
+            return False
+        return (self.pool.n_free + len(pages)
+                == self.pool.n_pages - 1)
+
+    def clear(self) -> int:
+        """Release every cached page back to the pool (drain /
+        shutdown). Pages with live readers lose only the index's
+        reference. Returns the number of entries dropped."""
+        with self._lock:
+            pages = [nd.page for nd in self._nodes_locked()]
+            self._root.children.clear()
+            dropped, self.n_cached = self.n_cached, 0
+            if pages:
+                self.pool.release(pages)
+            return dropped
+
+    def stats(self) -> Dict[str, Any]:
+        return {"page_size": self.page_size,
+                "max_pages": self.max_pages,
+                "cached_pages": self.n_cached,
+                "evictable_pages": self.n_evictable,
+                "lookups": self.n_lookups,
+                "hits": self.n_hits,
+                "hit_rate": (round(self.n_hits / self.n_lookups, 4)
+                             if self.n_lookups else None),
+                "hit_tokens": self.n_hit_tokens,
+                "published_pages": self.n_published,
+                "evicted_pages": self.n_evicted,
+                "ledger_clean": self.ledger_clean()}
 
 
 class _DecodeRequest:
@@ -598,7 +986,7 @@ class _DecodeRequest:
 
     __slots__ = ("pending", "prompt", "max_new", "produced", "slot",
                  "cancelled", "t_submit", "t_prefill", "t_decode",
-                 "sampler", "spec", "pages")
+                 "sampler", "spec", "pages", "hit_len")
 
     def __init__(self, pending, prompt: np.ndarray, max_new: int,
                  sampler: Optional[Sampler] = None,
@@ -614,7 +1002,10 @@ class _DecodeRequest:
         self.spec = spec
         self.produced: List[int] = []       # incremental emission
         self.slot: Optional[int] = None
-        self.pages: List[int] = []          # claimed KV pages (paged)
+        self.pages: List[int] = []          # held KV pages (paged):
+        # the first hit_len // page_size are SHARED prefix pages
+        # (ref'd, read-only), the rest privately claimed
+        self.hit_len = 0                    # cached-prefix depth
         self.cancelled = False
         self.t_submit: float = 0.0
         self.t_prefill: float = 0.0
@@ -651,7 +1042,9 @@ class DecodeScheduler:
                  fault_plan=None,
                  registry=None, tracer=None,
                  idle_wait_s: float = 0.02,
-                 spec_policy="auto"):
+                 spec_policy="auto",
+                 prefix_cache="auto",
+                 prefix_cache_pages: Optional[int] = None):
         from mmlspark_tpu.serving.policy import SpeculationPolicy
         self.decoder = decoder
         # acceptance-gated speculation (serving/policy.py): "auto"
@@ -674,10 +1067,28 @@ class DecodeScheduler:
         # verify read — unclaimed entries stay 0 (the scratch page)
         self.pages: Optional[PagePool] = None
         self._tables: Optional[np.ndarray] = None
+        self.prefix: Optional[PrefixCache] = None
         if decoder.paged:
             self.pages = PagePool(decoder.n_pages)
             self._tables = np.zeros(
                 (decoder.n_slots, decoder.pages_per_slot), np.int32)
+            # the cross-request prefix cache: "auto" turns it on
+            # exactly when the decoder built the offset-prefill
+            # machinery (prefix_cache=False there is the A/B baseline)
+            if prefix_cache == "auto":
+                prefix_cache = decoder.has_prefix_prefill
+            if prefix_cache:
+                if not decoder.has_prefix_prefill:
+                    raise ValueError(
+                        "prefix_cache=True needs a decoder built "
+                        "with prefix_cache=True (the offset-prefill "
+                        "machinery)")
+                self.prefix = PrefixCache(
+                    self.pages, decoder.page_size,
+                    max_pages=prefix_cache_pages, clock=clock)
+        elif prefix_cache is True:
+            raise ValueError("the prefix cache rides the paged pool "
+                             "(paged=True)")
         self._waiting: deque = deque()
         self._by_rid: Dict[str, _DecodeRequest] = {}
         self._active: Dict[int, _DecodeRequest] = {}
@@ -694,6 +1105,11 @@ class DecodeScheduler:
         self.n_steps = 0
         self.n_tokens = 0
         self.n_prefills = 0
+        # the prefill-throughput ledger the prefix-cache A/B gates on:
+        # prompt tokens SERVED (cached prefix included) over prefill
+        # wall-clock — a hit shrinks the wall, not the numerator
+        self.n_prompt_tokens = 0
+        self.prefill_s = 0.0
         self.n_step_faults = 0
         self.slots_high_water = 0
         self.n_page_preempts = 0
@@ -770,12 +1186,36 @@ class DecodeScheduler:
                     "Free KV-cache pages in the shared pool."
                     ).set_function(lambda: self.pages.n_free)
             m.gauge("serving_decode_pages_in_use",
-                    "KV-cache pages currently claimed by live slots."
-                    ).set_function(
-                lambda: (self.pages.n_pages - 1) - self.pages.n_free)
+                    "KV-cache pages currently held by live slots "
+                    "(prefix-cache residents are NOT in use — see "
+                    "serving_decode_pages_cached).").set_function(
+                lambda: (self.pages.n_pages - 1) - self.pages.n_free
+                - (self.prefix.n_cached
+                   if self.prefix is not None else 0))
             m.gauge("serving_decode_page_high_water",
                     "Most pages ever simultaneously claimed."
                     ).set_function(lambda: self.pages.high_water)
+        if self.prefix is not None:
+            m.gauge("serving_decode_pages_cached",
+                    "KV-cache pages resident in the prefix-cache "
+                    "radix index (held by the index; refcount 1 = "
+                    "evictable).").set_function(
+                lambda: self.prefix.n_cached)
+            lk = m.counter(
+                "serving_decode_prefix_lookups_total",
+                "Prefix-cache radix lookups at admission, by result.",
+                labels=("result",))
+            lk.labels("hit").set_function(lambda: self.prefix.n_hits)
+            lk.labels("miss").set_function(
+                lambda: self.prefix.miss_count())
+            m.counter("serving_decode_prefix_hit_tokens_total",
+                      "Prompt tokens served from cached prefix pages "
+                      "instead of recomputed at prefill."
+                      ).set_function(lambda: self.prefix.n_hit_tokens)
+            m.counter("serving_decode_prefix_evicted_pages_total",
+                      "Cached pages reclaimed by LRU eviction under "
+                      "pool pressure.").set_function(
+                lambda: self.prefix.n_evicted)
         self._m_prefill = m.histogram(
             "serving_prefill_latency_ms",
             "Prompt prefill wall-clock per prompt bucket.",
@@ -880,6 +1320,34 @@ class DecodeScheduler:
         ps = self.decoder.page_size
         return max((int(rows) + ps - 1) // ps, 1)
 
+    def _claim_pages(self, n: int) -> Optional[List[int]]:
+        """Claim ``n`` fresh pages, evicting LRU unreferenced cached
+        pages first when the free list alone cannot cover it."""
+        got = self.pages.claim(n)
+        if got is None and self.prefix is not None:
+            self.prefix.evict_for(n)
+            got = self.pages.claim(n)
+        return got
+
+    def _release_pages(self, req: _DecodeRequest,
+                       publish: bool) -> None:
+        """Drop the request's page references. On a clean finish the
+        prompt-complete pages are PUBLISHED into the prefix index
+        (their reference transfers to the cache — a future prompt
+        sharing the prefix attaches them instead of recomputing);
+        everything else — shared-prefix refs, the partial prompt tail,
+        generated-token pages — is released. Publication is refused
+        for ``error`` finishes: a faulted step's cache state is
+        suspect, and poisoning the index would wrong every future
+        match."""
+        pages, req.pages = req.pages, []
+        absorbed = set()
+        if self.prefix is not None and publish:
+            absorbed = self.prefix.publish(req.prompt, pages)
+        rest = [p for p in pages if p not in absorbed]
+        if rest:
+            self.pages.release(rest)
+
     def _spec_capable(self, req: _DecodeRequest) -> bool:
         """Whether this request may EVER enter a speculative cohort:
         explicit payload opt-in/out wins; greedy defaults on, sampled
@@ -915,10 +1383,21 @@ class DecodeScheduler:
             # full pool into an honest 429 instead of a queued
             # request that can never start.
             need = self._pages_for(len(prompt) + 1)
-            if self.pages.n_free < need:
+            # cache-full admission sheds BEFORE touching shared state:
+            # cached pages count as reclaimable headroom (eviction
+            # frees them at claim time), but no lookup, ref, or
+            # eviction happens for a request that only sheds.
+            # n_cached is the O(1) UPPER bound (pinned cached pages
+            # are not really evictable) — an optimistic admit just
+            # waits head-of-line like any page-tight request, which
+            # this check is already advisory about.
+            avail = self.pages.n_free + (
+                self.prefix.n_cached if self.prefix is not None
+                else 0)
+            if avail < need:
                 raise DecodeOverloaded(
                     f"decode page pool exhausted ({need} pages "
-                    f"needed, {self.pages.n_free} free)")
+                    f"needed, {avail} free or evictable)")
         with self._lock:
             if len(self._waiting) >= self.max_waiting:
                 raise DecodeOverloaded("decode waiting queue full")
@@ -982,8 +1461,7 @@ class DecodeScheduler:
                            finish_reason=reason)
             req.slot = None
         if req.pages:
-            self.pages.release(req.pages)
-            req.pages = []
+            self._release_pages(req, publish=reason != "error")
         with self._lock:
             self._by_rid.pop(req.pending.rid, None)
             self.releases[reason] = self.releases.get(reason, 0) + 1
@@ -1127,15 +1605,27 @@ class DecodeScheduler:
                              error="client disconnected")
                 continue
             pages: List[int] = []
+            hit_len = 0
             if self.pages is not None:
-                pages = self.pages.claim(
-                    self._pages_for(len(req.prompt) + 1))
-                if pages is None:
+                shared: List[int] = []
+                if self.prefix is not None:
+                    # longest cached prefix: matched pages arrive
+                    # ref'd — on any bail-out below they are released
+                    # (the cache keeps its own reference)
+                    hit_len, shared = self.prefix.lookup(req.prompt)
+                own = self._claim_pages(
+                    self._pages_for(len(req.prompt) + 1) - len(shared))
+                if own is None:
                     # not enough pages YET: head-of-line waits for
-                    # running requests to release theirs
+                    # running requests to release theirs (it looks up
+                    # afresh next pass — the hit ledger only counts
+                    # ADMITTED requests, so retry ticks cost nothing)
+                    if shared:
+                        self.pages.release(shared)
                     with self._lock:
                         self._waiting.appendleft(req)
                     return
+                pages = shared + own
             slot = self.pool.claim()
             if slot is None:      # raced a concurrent release? retry
                 if pages:
@@ -1143,6 +1633,9 @@ class DecodeScheduler:
                 with self._lock:
                     self._waiting.appendleft(req)
                 return
+            if self.prefix is not None:
+                # one monotonic hit-ledger bump per ADMITTED request
+                self.prefix.count(hit_len)
             t0 = self._now()
             self._add_span(req, "queue_wait", req.t_submit, t0)
             if self._m_queue_wait is not None:
@@ -1157,9 +1650,15 @@ class DecodeScheduler:
                 if self.fault_plan is not None:
                     self.fault_plan.raise_at("decode_prefill",
                                              clock=self.clock)
-                first, last_logits = self.decoder.prefill_logits(
-                    slot, req.prompt, table,
-                    draft=self._spec_capable(req))
+                if hit_len > 0:
+                    first, last_logits = \
+                        self.decoder.prefill_prefix_logits(
+                            slot, req.prompt, hit_len, table,
+                            draft=self._spec_capable(req))
+                else:
+                    first, last_logits = self.decoder.prefill_logits(
+                        slot, req.prompt, table,
+                        draft=self._spec_capable(req))
                 if req.sampler is not None:
                     # the request's own seeded PRNG picks the first
                     # generated token from the prompt's last logits
@@ -1179,15 +1678,19 @@ class DecodeScheduler:
             req.t_prefill = t1
             req.t_decode = t1
             self.n_prefills += 1
+            self.n_prompt_tokens += len(req.prompt)
+            self.prefill_s += t1 - t0
             if self._m_prefill is not None:
                 self._m_prefill.labels(
                     bucket_target(len(req.prompt),
                                   self.decoder.max_len)).observe(
                     (t1 - t0) * 1000.0)
             self._add_span(req, "prefill", t0, t1, slot=slot,
-                           prompt_len=len(req.prompt))
+                           prompt_len=len(req.prompt),
+                           prefix_hit=hit_len)
             req.slot = slot
             req.pages = pages
+            req.hit_len = hit_len
             req.produced.append(first)
             self.n_tokens += 1
             self._tokens[slot] = first
@@ -1248,7 +1751,9 @@ class DecodeScheduler:
         have = len(req.pages)
         if need <= have:
             return True
-        got = self.pages.claim(need - have)
+        # growth evicts unreferenced cached pages before giving up:
+        # live decodes always outrank cache residency
+        got = self._claim_pages(need - have)
         if got is None:
             return False
         self._tables[req.slot, have:need] = got
@@ -1511,6 +2016,7 @@ class DecodeScheduler:
                   "n_tokens": len(r.produced),   # incremental progress
                   "max_new_tokens": r.max_new,
                   "n_pages": len(r.pages),
+                  "prefix_hit_tokens": r.hit_len,
                   "streaming": r.stream is not None,
                   "sampling": (r.sampler.describe()
                                if r.sampler is not None else None)}
@@ -1520,10 +2026,15 @@ class DecodeScheduler:
             from mmlspark_tpu.parallel.dist import tree_bytes
             claimable = self.pages.n_pages - 1
             free = self.pages.n_free
+            cached = (self.prefix.n_cached
+                      if self.prefix is not None else 0)
             pages = {"page_size": self.decoder.page_size,
                      "n_pages": claimable,
                      "free": free,
-                     "in_use": claimable - free,
+                     # pages live requests hold (shared prefix pages
+                     # count once however many readers share them)
+                     "in_use": claimable - free - cached,
+                     "cached": cached,
                      "high_water": self.pages.high_water,
                      "n_preempts": self.n_page_preempts,
                      "pool_bytes": tree_bytes(self.decoder.cache),
@@ -1559,6 +2070,11 @@ class DecodeScheduler:
                 # gather (CPU/mesh fallback)
                 "attn_impl": self.decoder.attn_impl,
                 "pages": pages,
+                # the cross-request prefix cache (None = disabled):
+                # radix hit counters, resident/evictable pages, and
+                # the refcount ledger verdict
+                "prefix_cache": (self.prefix.stats()
+                                 if self.prefix is not None else None),
                 "speculative": spec,
                 "placement": self.decoder.placement(),
                 "waiting": waiting,
@@ -1567,6 +2083,14 @@ class DecodeScheduler:
                 "n_steps": self.n_steps,
                 "n_tokens": self.n_tokens,
                 "n_prefills": self.n_prefills,
+                "n_prompt_tokens": self.n_prompt_tokens,
+                "prefill_s": round(self.prefill_s, 4),
+                # prompt tokens served per prefill wall second —
+                # cached-prefix tokens count (the cache shrinks the
+                # denominator), so this is the prefix-cache A/B metric
+                "prefill_tokens_per_s": (
+                    round(self.n_prompt_tokens / self.prefill_s, 1)
+                    if self.prefill_s > 0 else None),
                 "n_step_faults": self.n_step_faults,
                 "n_compiles": self.decoder.n_compiles(),
                 "releases": releases,

@@ -46,19 +46,50 @@ class DecodeJob:
 def make_workload(vocab: int, n_requests: int, seed: int = 0,
                   mean_gap_ms: float = 30.0,
                   prompt_lens=(3, 5, 8, 12),
-                  max_new=(8, 16, 24)) -> List[DecodeJob]:
+                  max_new=(8, 16, 24),
+                  prefix_share: float = 0.0,
+                  prefix_len: int = 16,
+                  prefix_pool: int = 2) -> List[DecodeJob]:
     """Seeded mixed-arrival workload: exponential inter-arrival gaps
     (the memoryless traffic shape), cycled prompt lengths and token
-    budgets — so requests genuinely join and leave mid-flight."""
+    budgets — so requests genuinely join and leave mid-flight.
+
+    ``prefix_share`` shapes the multi-tenant prompt-overlap regime the
+    prefix cache targets (shared system preambles / few-shot
+    templates): that fraction of requests draws its first
+    ``prefix_len`` tokens from a small pool of ``prefix_pool`` shared
+    prefixes (then a unique ``prompt_lens``-cycled suffix); the rest
+    get a unique random prefix of the SAME length, so both arms of a
+    cache A/B see identical prompt-length distributions and only the
+    overlap differs. One generator serves ``bench.py
+    decode_prefix_cache_v1`` and ``tools/bench_decode.py
+    --prefix-share``."""
     rng = np.random.default_rng(seed)
     gaps = rng.exponential(mean_gap_ms / 1000.0, size=n_requests)
     arrivals = np.cumsum(gaps)
+    # draw the shared pool ONLY when the knob is on: prefix_share=0
+    # callers (every pre-existing seeded workload) must keep their
+    # exact historical prompt streams at the same seed
+    shared = ([rng.integers(0, vocab, size=int(prefix_len))
+               .astype(np.int32) for _ in range(max(prefix_pool, 1))]
+              if prefix_share > 0.0 else [])
     jobs = []
     for i in range(n_requests):
         plen = prompt_lens[i % len(prompt_lens)]
+        if prefix_share > 0.0:
+            head = (shared[i % len(shared)]
+                    if rng.random() < prefix_share
+                    else rng.integers(0, vocab, size=int(prefix_len))
+                    .astype(np.int32))
+            prompt = np.concatenate(
+                [head, rng.integers(0, vocab, size=plen)
+                 .astype(np.int32)])
+        else:
+            prompt = rng.integers(0, vocab,
+                                  size=plen).astype(np.int32)
         jobs.append(DecodeJob(
             arrival_s=float(arrivals[i]),
-            prompt=rng.integers(0, vocab, size=plen).astype(np.int32),
+            prompt=prompt,
             max_new=int(max_new[i % len(max_new)])))
     return jobs
 
@@ -251,19 +282,30 @@ def make_spec_model_pair(cfg, draft_layers: int = 1,
 
 
 def run_scheduler_sessions(scheduler, jobs: List[DecodeJob],
-                           timeout_s: float = 300.0) -> Dict[str, Any]:
+                           timeout_s: float = 300.0,
+                           payload_extra: Optional[Dict[str, Any]]
+                           = None,
+                           rid_prefix: str = "bench"
+                           ) -> Dict[str, Any]:
     """Drive a live :class:`DecodeScheduler` with the whole workload
     (backlogged submission — every request queued up front, so
     concurrency is bounded by slots/pages, not arrival gaps) and
     collect the sessions-at-fixed-HBM evidence: peak concurrent
-    sessions, tokens/s, per-request token sequences (the cross-layout
-    parity probe), compile-count delta, and the donation pointer."""
+    sessions, tokens/s, prefill tokens/s (the prefix-cache A/B
+    metric), per-request token sequences (the cross-layout parity
+    probe), compile-count delta, and the donation pointer.
+    ``payload_extra`` merges into every request's payload (sampling
+    knobs for the seeded-parity probes)."""
     import json
     compiles_before = scheduler.decoder.n_compiles()
+    prefill_s0 = scheduler.prefill_s
+    prompt_tokens0 = scheduler.n_prompt_tokens
+    prefills0 = scheduler.n_prefills
     ptr0 = scheduler.decoder.cache["k"].unsafe_buffer_pointer()
     pendings = [_BenchPending(
-        {"prompt": [int(t) for t in j.prompt],
-         "max_new_tokens": int(j.max_new)}, f"bench-{i}")
+        dict({"prompt": [int(t) for t in j.prompt],
+              "max_new_tokens": int(j.max_new)},
+             **(payload_extra or {})), f"{rid_prefix}-{i}")
         for i, j in enumerate(jobs)]
     t0 = time.perf_counter()
     for p in pendings:
@@ -296,10 +338,25 @@ def run_scheduler_sessions(scheduler, jobs: List[DecodeJob],
         "slots_all_freed":
             scheduler.pool.n_free == scheduler.decoder.n_slots,
     }
+    d_wall = scheduler.prefill_s - prefill_s0
+    d_tokens = scheduler.n_prompt_tokens - prompt_tokens0
+    out["prefill_tokens_per_s"] = (round(d_tokens / d_wall, 1)
+                                   if d_wall > 0 else None)
+    out["mean_prefill_ms"] = round(1000.0 * d_wall / max(
+        scheduler.n_prefills - prefills0, 1), 3)
     if scheduler.pages is not None:
-        out["pages_all_freed"] = (scheduler.pages.n_free
-                                  == scheduler.pages.n_pages - 1)
+        # the refcounted idle invariant: free + index-cached covers
+        # the claimable pool, every cached page held exactly once
+        cached = (scheduler.prefix.n_cached
+                  if scheduler.prefix is not None else 0)
+        out["pages_all_freed"] = (
+            scheduler.pages.n_free + cached
+            == scheduler.pages.n_pages - 1
+            and (scheduler.prefix is None
+                 or scheduler.prefix.ledger_clean()))
         out["page_high_water"] = scheduler.pages.high_water
+    if scheduler.prefix is not None:
+        out["prefix_cache"] = scheduler.prefix.stats()
     spec = scheduler.stats().get("speculative")
     if spec is not None:
         out["acceptance_rate"] = spec["acceptance_rate"]

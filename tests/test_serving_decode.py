@@ -77,6 +77,18 @@ class _Pending:
         self.trace = rid
 
 
+def _pages_idle(sched) -> bool:
+    """The refcounted page-leak ledger at idle: every claimable page
+    is either free or held EXACTLY once by the prefix index (cached,
+    evictable) — no request left a reference behind. With the prefix
+    cache off this degrades to the raw-ownership invariant."""
+    claimable = sched.pages.n_pages - 1
+    if sched.prefix is None:
+        return sched.pages.n_free == claimable
+    return (sched.pages.n_free + sched.prefix.n_cached == claimable
+            and sched.prefix.ledger_clean())
+
+
 class Identity(Transformer):
     def transform(self, df):
         return df
@@ -107,6 +119,114 @@ class TestSlotPool:
         pool.release(s)
         with pytest.raises(RuntimeError, match="double-released"):
             pool.release(s)
+
+    def test_release_of_never_claimed_raises(self):
+        """The claimed-set ledger (O(1), no free-list scan) catches a
+        release of a slot that was never handed out."""
+        pool = SlotPool(3)
+        with pytest.raises(RuntimeError, match="double-released"):
+            pool.release(1)
+
+
+class TestPrefixCacheUnit:
+    """The refcounted page pool + radix index without a model: claim/
+    ref/release arithmetic, lookup/publish keying, LRU eviction, and
+    the idle ledger."""
+
+    def _cache(self, n_pages=17, page_size=4, max_pages=None):
+        from mmlspark_tpu.serving import PagePool, PrefixCache
+        pool = PagePool(n_pages)
+        return pool, PrefixCache(pool, page_size,
+                                 max_pages=max_pages)
+
+    def test_refcounts_share_and_release(self):
+        from mmlspark_tpu.serving import PagePool
+        pool = PagePool(4)
+        (p,) = pool.claim(1)
+        pool.ref([p])                     # second reader attaches
+        assert pool.refcount(p) == 2
+        pool.release([p])                 # first reader leaves
+        assert pool.refcount(p) == 1 and pool.n_free == 2
+        pool.release([p])                 # last reader frees it
+        assert pool.refcount(p) == 0 and pool.n_free == 3
+        with pytest.raises(RuntimeError, match="double-released"):
+            pool.release([p])
+        with pytest.raises(RuntimeError, match="unclaimed"):
+            pool.ref([p])
+
+    def test_lookup_publish_roundtrip_and_cap(self):
+        pool, pc = self._cache()
+        prompt = np.arange(10, dtype=np.int32)   # 2 full chunks + 2
+        pages = pool.claim(3)
+        absorbed = pc.publish(prompt, pages)
+        # only the 2 prompt-complete chunks are published; the partial
+        # tail page stays the caller's
+        assert absorbed == set(pages[:2])
+        pool.release([p for p in pages if p not in absorbed])
+        hit, got = pc.lookup(prompt)
+        assert (hit, got) == (8, pages[:2])
+        assert all(pool.refcount(p) == 2 for p in got)
+        pool.release(got)
+        # an exact-prefix prompt (len == published depth) caps at
+        # len - 1: the last position must be computed for its logits
+        hit, got = pc.lookup(prompt[:8])
+        assert hit == 4 and got == pages[:1]
+        pool.release(got)
+        # diverging second chunk: longest shared prefix is 1 chunk
+        other = prompt.copy()
+        other[6] = 63
+        hit, got = pc.lookup(other)
+        assert hit == 4 and got == pages[:1]
+        pool.release(got)
+        assert pc.lookup(np.asarray([9, 9, 9, 9, 9], np.int32)) \
+            == (0, [])
+        assert pc.ledger_clean()
+
+    def test_publish_dedupe_keeps_incumbent(self):
+        pool, pc = self._cache()
+        prompt = np.arange(8, dtype=np.int32)
+        first = pool.claim(2)
+        assert pc.publish(prompt, first) == set(first)
+        dup = pool.claim(2)
+        assert pc.publish(prompt, dup) == set()   # incumbent kept
+        pool.release(dup)
+        assert pc.n_cached == 2 and pc.ledger_clean()
+
+    def test_lru_eviction_spares_referenced_pages(self):
+        pool, pc = self._cache(max_pages=4)
+        p_a = pool.claim(2)
+        pc.publish(np.arange(8, dtype=np.int32), p_a)
+        p_b = pool.claim(2)
+        pc.publish(np.arange(8, 16, dtype=np.int32), p_b)
+        assert pc.n_cached == 4
+        # a reader pins prefix A (older), so pressure must evict B
+        hit, got = pc.lookup(np.arange(9, dtype=np.int32))
+        assert got == p_a
+        assert pc.evict_for(pool.n_free + 2) == 2
+        assert pc.n_cached == 2
+        assert pc.lookup(np.arange(8, 16, dtype=np.int32))[1] == []
+        hit2, got2 = pc.lookup(np.arange(9, dtype=np.int32))
+        assert got2 == p_a               # the pinned prefix survived
+        pool.release(got + got2)
+        assert pc.ledger_clean()
+
+    def test_max_pages_bounds_publication(self):
+        pool, pc = self._cache(max_pages=2)
+        p_a = pool.claim(2)
+        assert len(pc.publish(np.arange(8, dtype=np.int32), p_a)) == 2
+        # the bound forces LRU turnover, never growth past max_pages
+        p_b = pool.claim(2)
+        absorbed = pc.publish(np.arange(8, 16, dtype=np.int32), p_b)
+        pool.release([p for p in p_b if p not in absorbed])
+        assert pc.n_cached <= 2 and pc.ledger_clean()
+
+    def test_clear_returns_every_cached_page(self):
+        pool, pc = self._cache()
+        pages = pool.claim(4)
+        pc.publish(np.arange(16, dtype=np.int32), pages)
+        assert pool.n_free == 16 - 4
+        assert pc.clear() == 4
+        assert pool.n_free == 16 and pc.n_cached == 0
 
 
 class TestSchedulerDirect:
@@ -890,7 +1010,7 @@ class TestPagedScheduler:
         finally:
             sched.stop()
         assert sched.pool.n_free == 3
-        assert sched.pages.n_free == sched.pages.n_pages - 1
+        assert _pages_idle(sched)
         assert sched.pages.high_water > 0
 
     def test_page_exhaustion_429_then_readmit(self):
@@ -921,7 +1041,7 @@ class TestPagedScheduler:
             assert retry.status == 200
         finally:
             sched.stop()
-        assert sched.pages.n_free == 4
+        assert _pages_idle(sched)
 
     def test_mid_decode_page_preempt_never_ooms(self):
         """When running slots outgrow the pool, the starved request
@@ -953,7 +1073,7 @@ class TestPagedScheduler:
                 assert 0 < out_a["n_tokens"] < 12
         finally:
             sched.stop()
-        assert sched.pages.n_free == 3
+        assert _pages_idle(sched)
         assert sched.pool.n_free == 2
 
     def test_undersized_pool_raises_without_scheduler_tables(self):
@@ -969,6 +1089,224 @@ class TestPagedScheduler:
         dec = _decoder(max_len=32)
         assert dec.prompt_buckets() == bucket_ladder(32) == sorted(
             {bucket_target(n, 32) for n in range(1, 33)})
+
+
+class TestPrefixScheduler:
+    """The cross-request prefix cache end to end (ISSUE 15): radix
+    hits through the scheduler with exact parity, shared-page
+    immutability, 429-before-shared-state admission, eviction under
+    pressure, and the refcount ledger under chaos."""
+
+    def _shared_prompts(self, seed, head_len=9, n=4, tail=3):
+        rng = np.random.default_rng(seed)
+        head = _prompt(rng, head_len)
+        return head, [head + _prompt(rng, tail) for _ in range(n)]
+
+    def _run(self, sched, payloads, timeout=60):
+        ps = [_Pending(p, f"px{i}") for i, p in enumerate(payloads)]
+        for p in ps:
+            sched.submit(p)
+        for p in ps:
+            assert p.event.wait(timeout), "stranded"
+        return ps
+
+    def test_hits_match_reference_with_flat_compiles(self):
+        sched = DecodeScheduler(
+            _decoder(n_slots=2, page_size=4)).start()
+        try:
+            warm = sched.decoder.warmup()
+            head, prompts = self._shared_prompts(61)
+            prompts.append(head)        # exact-prefix prompt rides too
+            done = self._run(sched, [
+                {"prompt": pr, "max_new_tokens": 4} for pr in prompts])
+            for pr, p in zip(prompts, done):
+                assert json.loads(p.reply)["tokens"] == \
+                    _greedy_reference(pr, 4)
+            pc = sched.stats()["prefix_cache"]
+            assert pc["hits"] >= 3 and pc["hit_tokens"] >= 24
+            assert sched.decoder.n_compiles() == warm
+        finally:
+            sched.stop()
+        assert _pages_idle(sched)
+
+    def test_sampled_and_cacheoff_parity(self):
+        """Seeded sampling through a prefix hit draws the same tokens
+        as with the cache disabled — offset prefill is exact."""
+        outs = {}
+        for on in (False, True):
+            sched = DecodeScheduler(
+                _decoder(n_slots=2, page_size=4,
+                         prefix_cache=on)).start()
+            try:
+                head, prompts = self._shared_prompts(62)
+                done = self._run(sched, [
+                    {"prompt": pr, "max_new_tokens": 5,
+                     "temperature": 0.8, "top_k": 8, "seed": 99}
+                    for pr in prompts])
+                outs[on] = [json.loads(p.reply)["tokens"]
+                            for p in done]
+            finally:
+                sched.stop()
+        assert outs[True] == outs[False]
+
+    def test_shared_pages_are_immutable(self):
+        """The invariant sharing rests on: an attaching request NEVER
+        writes a shared prefix page (decode appends only to its
+        private tail) — cached page content is bit-stable across a
+        full borrow/decode/release cycle."""
+        sched = DecodeScheduler(
+            _decoder(n_slots=2, page_size=4)).start()
+        try:
+            head, prompts = self._shared_prompts(63, head_len=9)
+            (first,) = self._run(sched, [
+                {"prompt": prompts[0], "max_new_tokens": 3}])
+            pc = sched.prefix
+            with pc._lock:
+                cached = [ch.page for ch in
+                          pc._root.children.values()]
+                assert cached
+            before = {p: np.asarray(
+                sched.decoder.cache["k"])[:, p].copy()
+                for p in cached}
+            done = self._run(sched, [
+                {"prompt": pr, "max_new_tokens": 6}
+                for pr in prompts[1:]])
+            assert all(p.status == 200 for p in done)
+            assert sched.stats()["prefix_cache"]["hits"] >= 1
+            after = np.asarray(sched.decoder.cache["k"])
+            for p, snap in before.items():
+                assert np.array_equal(snap, after[:, p]), \
+                    f"shared page {p} was mutated"
+        finally:
+            sched.stop()
+        assert _pages_idle(sched)
+
+    def test_admission_429_before_touching_shared_state(self):
+        """A submit the pool cannot hold (even counting evictable
+        cached pages) sheds WITHOUT a lookup, a ref, or an eviction."""
+        sched = DecodeScheduler(
+            _decoder(n_slots=2, max_len=16, page_size=4, n_pages=5))
+        sched.start()
+        rng = np.random.default_rng(64)
+        try:
+            hog = _Pending({"prompt": _prompt(rng, 13),
+                            "max_new_tokens": 10_000}, "hog")
+            sched.submit(hog)
+            t_end = time.monotonic() + 10
+            while sched.pages.n_free > 0 and time.monotonic() < t_end:
+                time.sleep(0.001)
+            lookups_before = sched.prefix.n_lookups
+            evicted_before = sched.prefix.n_evicted
+            with pytest.raises(DecodeOverloaded, match="page pool"):
+                sched.submit(_Pending({"prompt": _prompt(rng, 8),
+                                       "max_new_tokens": 2}, "v"))
+            assert sched.prefix.n_lookups == lookups_before
+            assert sched.prefix.n_evicted == evicted_before
+            sched.cancel("hog")
+            assert hog.event.wait(30)
+        finally:
+            sched.stop()
+        assert _pages_idle(sched)
+
+    def test_eviction_under_pressure_all_complete(self):
+        """Non-overlapping prompts churning a small pool force LRU
+        eviction of cached pages; every request still completes and
+        the ledger ends clean."""
+        sched = DecodeScheduler(
+            _decoder(n_slots=2, max_len=32, page_size=4,
+                     n_pages=17)).start()
+        rng = np.random.default_rng(65)
+        try:
+            prompts = [_prompt(rng, 9) for _ in range(10)]
+            done = self._run(sched, [
+                {"prompt": pr, "max_new_tokens": 3}
+                for pr in prompts])
+            for pr, p in zip(prompts, done):
+                assert json.loads(p.reply)["tokens"] == \
+                    _greedy_reference(pr, 3)
+            assert sched.prefix.n_evicted > 0
+        finally:
+            sched.stop()
+        assert _pages_idle(sched)
+
+    @pytest.mark.chaos
+    def test_chaos_on_shared_pages_keeps_refcounts_coherent(self):
+        """Mid-decode cancel, deadline expiry, and an injected step
+        fault on requests HOLDING shared prefix pages: refcounts end
+        coherent, the survivors' cached pages stay valid, and the
+        idle invariant holds (the sharing analogue of
+        test_page_reclaim_after_every_release_reason)."""
+        clock = ManualClock()
+        sched = DecodeScheduler(
+            _decoder(n_slots=3, max_len=256, page_size=4),
+            clock=clock).start()
+        try:
+            head, prompts = self._shared_prompts(66, n=3)
+            # seed the cache (cold publish), then attach three readers
+            self._run(sched, [{"prompt": prompts[0],
+                               "max_new_tokens": 2}])
+            waves = [
+                _Pending({"prompt": prompts[0],
+                          "max_new_tokens": 10_000}, "c-cancel"),
+                _Pending({"prompt": prompts[1],
+                          "max_new_tokens": 10_000}, "c-deadline",
+                         deadline=Deadline(1.0, clock=clock)),
+                _Pending({"prompt": prompts[2],
+                          "max_new_tokens": 10_000}, "c-fault"),
+            ]
+            for p in waves:
+                sched.submit(p)
+            t_end = time.monotonic() + 10
+            while sched.stats()["slots_in_use"] < 3 and \
+                    time.monotonic() < t_end:
+                time.sleep(0.002)
+            # all three happen while sharing the head's pages
+            sched.cancel("c-cancel")
+            clock.advance(2.0)
+            sched.fault_plan = FaultPlan(
+                script={"decode_step": ["fail"]})
+            for p in waves:
+                assert p.event.wait(30)
+            sched.fault_plan = None
+            reasons = {json.loads(p.reply)["finish_reason"]
+                       for p in waves}
+            assert {"cancelled"} <= reasons
+            # the cache survived the churn: a fresh reader still hits
+            # and decodes correctly
+            (again,) = self._run(sched, [
+                {"prompt": prompts[1], "max_new_tokens": 4}])
+            assert json.loads(again.reply)["tokens"] == \
+                _greedy_reference(prompts[1], 4)
+        finally:
+            sched.stop()
+        assert sched.pool.n_free == 3
+        assert _pages_idle(sched)
+
+    @pytest.mark.chaos
+    def test_preempt_while_sharing_keeps_ledger(self):
+        """A request that grows into pages_exhausted while HOLDING
+        shared pages releases its refs without dropping the cache's —
+        and the 'error' publish refusal keeps faulted content out of
+        the index."""
+        sched = DecodeScheduler(
+            _decoder(n_slots=2, max_len=32, page_size=4,
+                     n_pages=11)).start()
+        rng = np.random.default_rng(67)
+        try:
+            head = _prompt(rng, 9)
+            self._run(sched, [{"prompt": head + _prompt(rng, 2),
+                               "max_new_tokens": 2}])
+            # two readers attach the cached head and grow until the
+            # pool (10 claimable) runs out: at least one preempts
+            done = self._run(sched, [
+                {"prompt": head + _prompt(rng, 2),
+                 "max_new_tokens": 30} for _ in range(2)])
+            reasons = {json.loads(p.reply)["finish_reason"]
+                       for p in done}
+            assert reasons <= {"pages_exhausted", "length"}
+        finally:
+            sched.stop()
+        assert _pages_idle(sched)
 
 
 class TestStreaming:
@@ -1055,7 +1393,7 @@ class TestStreaming:
                     sched.pool.n_free != sched.decoder.n_slots:
                 time.sleep(0.02)
             assert sched.pool.n_free == sched.decoder.n_slots
-            assert sched.pages.n_free == sched.pages.n_pages - 1
+            assert _pages_idle(sched)
             assert sched.stats()["releases"].get(
                 "disconnected", 0) >= 1
 
@@ -1140,7 +1478,7 @@ class TestSpeculativeScheduler:
         finally:
             sched.stop()
         assert sched.pool.n_free == 3
-        assert sched.pages.n_free == sched.pages.n_pages - 1
+        assert _pages_idle(sched)
 
     def test_per_slot_opt_out(self):
         params, cfg, dec = _spec_setup()
@@ -1292,4 +1630,4 @@ class TestReviewHardening:
         finally:
             sched.stop()
         assert sched.pool.n_free == 2
-        assert sched.pages.n_free == sched.pages.n_pages - 1
+        assert _pages_idle(sched)

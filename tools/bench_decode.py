@@ -18,7 +18,16 @@ non-zero on violation. It also runs the ISSUE 11 acceptance pair
 (already CI-sized): ``decode_paged_v1`` (>= 2x concurrent sessions at
 fixed cache HBM, dense-parity, zero recompiles, donated page pool)
 and ``decode_speculative_v1`` (>= 1.3x tokens/s at measured
-acceptance >= 0.6 with exact greedy parity).
+acceptance >= 0.6 with exact greedy parity), plus the ISSUE 15 gate
+``decode_prefix_cache_v1`` (>= 1.5x prefill tokens/s at a
+shared-prefix workload, exact parity, clean refcount ledger).
+
+``--prefix-share P`` shapes the workload so fraction ``P`` of
+requests draw their prompt head from a small pool of shared prefixes
+(``--prefix-len`` tokens) — the same ``make_workload`` generator the
+``decode_prefix_cache_v1`` gate drives — and additionally runs the
+scheduler-level prefix-cache on/off A/B (prefill tokens/s, hit rate,
+token parity, refcount ledger).
 
 ``--http`` additionally drives the full serving stack (HTTP ->
 admission -> DecodeScheduler) with concurrent clients and reports the
@@ -57,19 +66,22 @@ def build_decoder(smoke: bool):
                               max_len=max_len)
 
 
-def run_engine_ab(decoder, smoke: bool) -> dict:
+def run_engine_ab(decoder, smoke: bool,
+                  prefix_share: float = 0.0,
+                  prefix_len: int = 16) -> dict:
     from mmlspark_tpu.testing.decode_load import (
         make_workload, run_continuous, run_static,
     )
+    share = dict(prefix_share=prefix_share, prefix_len=prefix_len)
     if smoke:
         jobs = make_workload(decoder.cfg.vocab, n_requests=16, seed=0,
                              mean_gap_ms=3.0, prompt_lens=(3, 5, 8),
-                             max_new=(4, 8, 20))
+                             max_new=(4, 8, 20), **share)
     else:
         jobs = make_workload(decoder.cfg.vocab, n_requests=96, seed=0,
                              mean_gap_ms=4.0,
                              prompt_lens=(8, 16, 32, 64),
-                             max_new=(8, 32, 96))
+                             max_new=(8, 32, 96), **share)
     warm = decoder.warmup()
     static = run_static(decoder, jobs)
     cont = run_continuous(decoder, jobs)
@@ -134,18 +146,89 @@ def run_http(decoder, n_clients: int = 8) -> dict:
         srv.stop()
 
 
+def run_prefix_ab(smoke: bool, prefix_share: float,
+                  prefix_len: int) -> dict:
+    """The prefix-cache A/B at the scheduler level (the engine-level
+    ``run_continuous`` never touches the radix index — page sharing is
+    the SCHEDULER'S machinery): the same ``--prefix-share`` workload
+    through a cache-on and a cache-off scheduler, prefill tokens/s,
+    hit rate, parity, and the refcount ledger."""
+    from mmlspark_tpu.models import transformer as T
+    from mmlspark_tpu.serving.decode import (
+        DecodeScheduler, TransformerDecoder,
+    )
+    from mmlspark_tpu.testing.decode_load import (
+        make_workload, run_scheduler_sessions,
+    )
+
+    if smoke:
+        cfg = T.TransformerConfig(vocab=128, d_model=32, n_heads=2,
+                                  d_head=16, d_ff=64, n_stages=1,
+                                  layers_per_stage=2)
+        n_slots, max_len, page, n_req = 4, 64, 8, 16
+    else:
+        cfg = T.TransformerConfig(vocab=4096, d_model=256, n_heads=8,
+                                  d_head=32, d_ff=1024, n_stages=1,
+                                  layers_per_stage=6)
+        n_slots, max_len, page, n_req = 8, 512, 16, 48
+    params = T.init_params(cfg, seed=0)
+    jobs = make_workload(cfg.vocab, n_requests=n_req, seed=0,
+                         mean_gap_ms=0.0, prompt_lens=(3, 5, 6),
+                         max_new=(4, 6, 8),
+                         prefix_share=prefix_share,
+                         prefix_len=prefix_len)
+    out = {}
+    for name, prefix_on in (("off", False), ("on", True)):
+        dec = TransformerDecoder(
+            params, cfg, n_slots=n_slots, max_len=max_len,
+            page_size=page,
+            n_pages=1 + n_slots * (max_len // page)
+            + 2 * (max_len // page),
+            prefix_cache=prefix_on)
+        sched = DecodeScheduler(dec, max_waiting=n_req + 1).start()
+        try:
+            dec.warmup()
+            out[name] = run_scheduler_sessions(sched, jobs,
+                                               rid_prefix=name)
+        finally:
+            sched.stop()
+    out["prefill_speedup"] = round(
+        out["on"]["prefill_tokens_per_s"]
+        / max(out["off"]["prefill_tokens_per_s"], 1e-9), 3)
+    out["token_parity"] = (out["off"]["sequences"]
+                           == out["on"]["sequences"])
+    for arm in ("off", "on"):
+        out[arm] = {k: v for k, v in out[arm].items()
+                    if k != "sequences"}
+    return out
+
+
 def main() -> int:
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--smoke", action="store_true",
                     help="small model + workload, assert the gates")
     ap.add_argument("--http", action="store_true",
                     help="also drive the full HTTP serving stack")
+    ap.add_argument("--prefix-share", type=float, default=0.0,
+                    metavar="P",
+                    help="fraction of requests drawing their prompt "
+                         "head from a small pool of shared prefixes "
+                         "(the prefix-cache workload knob; > 0 also "
+                         "runs the scheduler-level cache A/B)")
+    ap.add_argument("--prefix-len", type=int, default=40,
+                    help="shared/unique prompt-head length for "
+                         "--prefix-share workloads")
     args = ap.parse_args()
 
     decoder = build_decoder(args.smoke)
     out = {"smoke": args.smoke,
            "n_slots": decoder.n_slots, "max_len": decoder.max_len,
-           "engine": run_engine_ab(decoder, args.smoke)}
+           "engine": run_engine_ab(decoder, args.smoke,
+                                   prefix_share=args.prefix_share,
+                                   prefix_len=args.prefix_len)}
+    if args.prefix_share > 0:
+        out["prefix"] = run_prefix_ab(args.smoke, args.prefix_share,
+                                      args.prefix_len)
     if args.http:
         out["http"] = run_http(build_decoder(args.smoke))
 
@@ -162,13 +245,21 @@ def main() -> int:
         gates["http_no_errors"] = not out["http"]["errors"]
         gates["http_slots_all_freed"] = (out["http"]["slots_free"]
                                          == out["http"]["n_slots"])
+    if args.prefix_share > 0:
+        gates["prefix_token_parity"] = out["prefix"]["token_parity"]
+        gates["prefix_ledger_clean"] = \
+            out["prefix"]["on"]["pages_all_freed"]
+        gates["prefix_hits"] = \
+            out["prefix"]["on"]["prefix_cache"]["hits"] > 0
     if args.smoke:
-        # the ISSUE 11 acceptance pair, CI-sized already: paged
-        # sessions-at-fixed-HBM + speculative tokens/s A/B, each with
-        # its own recompile/donation/parity gates baked in
+        # the ISSUE 11 acceptance pair + the ISSUE 15 prefix-cache
+        # gate, CI-sized already: paged sessions-at-fixed-HBM,
+        # speculative tokens/s, and prefix-cache prefill tokens/s
+        # A/Bs, each with recompile/donation/parity gates baked in
         import bench as _bench
         paged = _bench.bench_decode_paged()
         spec = _bench.bench_decode_speculative()
+        prefix = _bench.bench_decode_prefix_cache()
         out["paged"] = {k: paged[k] for k in
                         ("value", "baseline", "vs_baseline",
                          "tokens_per_s", "token_parity", "passed")}
@@ -176,8 +267,13 @@ def main() -> int:
                               ("value", "baseline", "vs_baseline",
                                "acceptance_rate", "token_parity",
                                "passed")}
+        out["prefix_cache"] = {k: prefix[k] for k in
+                               ("value", "baseline", "vs_baseline",
+                                "hit_rate", "token_parity",
+                                "ledger_clean", "passed")}
         gates["paged_2x_sessions_at_fixed_hbm"] = paged["passed"]
         gates["speculative_speedup"] = spec["passed"]
+        gates["prefix_cache_prefill_speedup"] = prefix["passed"]
     out["gates"] = gates
     out["passed"] = all(gates.values())
     print(json.dumps(out, indent=2))

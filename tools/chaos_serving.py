@@ -33,6 +33,15 @@ the flip), ``GET /fleet`` reports ONE coherent version set across the
 responding workers, and no logical client request was dropped or
 answered wrongly at any point. ``--rollout-workers 0`` skips the phase.
 
+A fourth phase drills the decode plane's CROSS-REQUEST PREFIX CACHE
+(docs/serving.md "Prefix cache"): a live decode worker serves a
+shared-prefix burst twice — pass 1 cold (prompt pages publish into
+the radix index), pass 2 the same prompts under fresh rids (cached
+pages attach, only suffixes prefill) — and the drill asserts hit
+rate > 0, ZERO wrong tokens (pass 2 token-for-token equals pass 1),
+and a clean refcount ledger on drain. ``--prefix-requests 0`` skips
+it; ``--prefix-only`` runs JUST this phase (the fast smoke mode).
+
 Runs on CPU; phases 1-2 need no model artifact (workers serve an
 inline doubler); phase 3 persists real ``ScaleColumn`` checkpoints.
 """
@@ -63,6 +72,33 @@ srv = ServingServer(Doubler(), max_latency_ms=1,
                     journal_path=sys.argv[2],
                     slow_trace_ms=0.0).start()
 ServingCoordinator.register_worker(sys.argv[1], srv.host, srv.port)
+print(srv.port, flush=True)
+while True:
+    time.sleep(1)
+"""
+
+
+DECODE_WORKER_SCRIPT = """
+import os, sys, time
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+from mmlspark_tpu.models import transformer as T
+from mmlspark_tpu.serving import DecodeScheduler, ServingServer, \\
+    TransformerDecoder
+from mmlspark_tpu.core.stage import Transformer
+
+class Identity(Transformer):
+    def transform(self, df):
+        return df
+
+cfg = T.TransformerConfig(vocab=128, d_model=32, n_heads=2, d_head=16,
+                          d_ff=64, n_stages=1, layers_per_stage=2)
+dec = TransformerDecoder(T.init_params(cfg, seed=0), cfg, n_slots=4,
+                         max_len=64, page_size=8)
+sched = DecodeScheduler(dec)
+srv = ServingServer(Identity(), port=0, decoder=sched,
+                    max_latency_ms=1, journal_path=sys.argv[2],
+                    verify_checkpoints=False).start()
+dec.warmup()
 print(srv.port, flush=True)
 while True:
     time.sleep(1)
@@ -306,6 +342,78 @@ def rollout_drill(tmp: str, seed: int, n_workers: int = 3) -> dict:
     }
 
 
+def prefix_drill(tmp: str, seed: int, n_requests: int = 16) -> dict:
+    """Phase 4 (smoke-fast, CPU-only): a shared-prefix decode burst
+    through a LIVE decode worker — the cross-request prefix cache
+    drill (docs/serving.md "Prefix cache").
+
+    Pass 1 sends ``n_requests`` shared-prefix prompts cold (their
+    prompt pages publish into the radix index on finish); pass 2
+    replays the SAME prompts under fresh request ids, so they attach
+    the cached pages and prefill only their suffixes. Asserts: the
+    worker's ``/decode/stats`` shows a hit rate > 0, pass 2's tokens
+    match pass 1's token-for-token (ZERO wrong tokens — cached pages
+    served exactly what cold prefill computed), and on drain the
+    refcount ledger is clean (free + cached == claimable,
+    ``ledger_clean``)."""
+    import requests
+
+    from mmlspark_tpu.testing.decode_load import make_workload
+
+    w = spawn_worker("unused", os.path.join(tmp, "decode.jsonl"),
+                     script=DECODE_WORKER_SCRIPT)
+    url = f"http://127.0.0.1:{w.port}"
+    jobs = make_workload(128, n_requests=n_requests, seed=seed,
+                         mean_gap_ms=0.0, prompt_lens=(3, 5),
+                         max_new=(4, 6), prefix_share=0.75,
+                         prefix_len=24, prefix_pool=2)
+    try:
+        passes = []
+        for pi in range(2):
+            toks, errors = [], 0
+            for i, job in enumerate(jobs):
+                r = requests.post(
+                    url + "/generate",
+                    json={"prompt": [int(t) for t in job.prompt],
+                          "max_new_tokens": int(job.max_new)},
+                    headers={"X-Request-Id":
+                             f"prefix-{seed}-{pi}-{i}"},
+                    timeout=30)
+                if r.status_code != 200:
+                    errors += 1
+                    toks.append(None)
+                else:
+                    toks.append(r.json()["tokens"])
+            passes.append({"tokens": toks, "errors": errors})
+        stats = requests.get(url + "/decode/stats",
+                             timeout=10).json()
+        pc = stats["prefix_cache"]
+        pages = stats["pages"]
+        wrong = sum(1 for a, b in zip(passes[0]["tokens"],
+                                      passes[1]["tokens"]) if a != b)
+        ledger_clean = (pc["ledger_clean"]
+                        and pages["free"] + pages["cached"]
+                        == pages["n_pages"])
+        ok = (passes[0]["errors"] == passes[1]["errors"] == 0
+              and wrong == 0
+              and (pc["hit_rate"] or 0) > 0
+              and pc["hit_tokens"] > 0
+              and ledger_clean)
+        return {"n_requests": n_requests, "n_passes": 2,
+                "errors": [p["errors"] for p in passes],
+                "wrong_tokens": wrong,
+                "hit_rate": pc["hit_rate"],
+                "hit_tokens": pc["hit_tokens"],
+                "cached_pages": pc["cached_pages"],
+                "evicted_pages": pc["evicted_pages"],
+                "ledger_clean": ledger_clean,
+                "ok": ok}
+    finally:
+        if w.poll() is None:
+            w.kill()
+            w.wait()
+
+
 def main() -> int:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("--requests", type=int, default=120)
@@ -324,7 +432,22 @@ def main() -> int:
                     help="phase-3 kill-mid-rollout drill fleet size "
                          "(0 skips the phase; needs >= 3 so a "
                          "non-canary worker can die)")
+    ap.add_argument("--prefix-requests", type=int, default=16,
+                    help="phase-4 shared-prefix decode burst size "
+                         "(0 skips the phase)")
+    ap.add_argument("--prefix-only", action="store_true",
+                    help="run ONLY the phase-4 prefix-cache drill "
+                         "(the fast smoke mode)")
     args = ap.parse_args()
+
+    if args.prefix_only:
+        tmp = tempfile.mkdtemp(prefix="chaos_prefix_")
+        drill = prefix_drill(tmp, args.seed,
+                             n_requests=args.prefix_requests or 16)
+        print(json.dumps({"what": "prefix-cache drill (smoke)",
+                          "prefix": drill}, indent=2))
+        print("RESULT:", "PASS" if drill["ok"] else "FAIL")
+        return 0 if drill["ok"] else 1
 
     from mmlspark_tpu.serving.server import (
         ServingClient, ServingCoordinator)
@@ -404,6 +527,10 @@ def main() -> int:
             rollout = rollout_drill(tmp, args.seed,
                                     n_workers=max(args.rollout_workers,
                                                   3))
+        prefix = None
+        if args.prefix_requests > 0:
+            prefix = prefix_drill(tmp, args.seed,
+                                  n_requests=args.prefix_requests)
         wall = time.perf_counter() - t0
 
         per_worker = [worker_status(w.port) for w in workers]
@@ -422,6 +549,7 @@ def main() -> int:
                           "journal_recovered")} for s in per_worker],
             **({"burst": burst} if burst is not None else {}),
             **({"rollout": rollout} if rollout is not None else {}),
+            **({"prefix": prefix} if prefix is not None else {}),
             "wall_s": round(wall, 3),
         }
         print(json.dumps(report, indent=2))
@@ -436,7 +564,8 @@ def main() -> int:
               and recovered
               and stats.get("fleet_traces_ok", True)
               and (burst is None or burst["ok"])
-              and (rollout is None or rollout["ok"]))
+              and (rollout is None or rollout["ok"])
+              and (prefix is None or prefix["ok"]))
         print("RESULT:", "PASS" if ok else "FAIL")
         return 0 if ok else 1
     finally:
