@@ -167,6 +167,11 @@ class BoundedLabelSet:
         self.cap = int(cap)
         self.overflow = overflow
         self._seen: set = set()
+        #: monotonic count of :meth:`key` calls that folded into the
+        #: overflow label — the observable evidence that the cap is
+        #: too small for the live value domain (per-tenant metric rows
+        #: surface it so an operator sees "other" is hiding tenants)
+        self.n_overflowed = 0
 
     def key(self, value: str) -> Tuple[str, bool]:
         if value in self._seen:        # set membership: atomic under GIL
@@ -174,7 +179,13 @@ class BoundedLabelSet:
         if len(self._seen) < self.cap:
             self._seen.add(value)
             return value, False
+        self.n_overflowed += 1
         return self.overflow, True
+
+    def values(self) -> Tuple[str, ...]:
+        """The tracked (non-overflow) label values, sorted — a stats
+        surface, not for hot paths."""
+        return tuple(sorted(self._seen))
 
 
 def _escape_label(value: str) -> str:

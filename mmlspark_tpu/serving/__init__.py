@@ -28,11 +28,14 @@ from mmlspark_tpu.serving.decode import (
 )
 from mmlspark_tpu.serving.frontend import EventLoopFrontend
 from mmlspark_tpu.serving.policy import (
-    AdaptiveBatchPolicy, SpeculationPolicy,
+    AdaptiveBatchPolicy, PriorityShedPolicy, SpeculationPolicy,
 )
 from mmlspark_tpu.serving.quant import QuantizationConfig
 from mmlspark_tpu.serving.rollout import (
     ModelVersionManager, RolloutError, RolloutOrchestrator,
+)
+from mmlspark_tpu.serving.tenancy import (
+    FairCycle, Tenant, TenantRegistry, TokenBucket, extract_api_key,
 )
 
 __all__ = ["ServingServer", "ServingCoordinator", "ServingClient",
@@ -42,4 +45,6 @@ __all__ = ["ServingServer", "ServingCoordinator", "ServingClient",
            "PrefixCache",
            "TransformerDecoder", "AdaptiveBatchPolicy",
            "QuantizationConfig",
-           "SpeculationPolicy", "Sampler", "TrafficCapture"]
+           "SpeculationPolicy", "Sampler", "TrafficCapture",
+           "Tenant", "TenantRegistry", "TokenBucket", "FairCycle",
+           "PriorityShedPolicy", "extract_api_key"]

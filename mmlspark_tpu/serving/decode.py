@@ -77,6 +77,9 @@ import numpy as np
 from mmlspark_tpu.core.logs import get_logger
 from mmlspark_tpu.core.resilience import SYSTEM_CLOCK, Clock
 from mmlspark_tpu.parallel.sharding import bucket_ladder, bucket_target
+from mmlspark_tpu.serving.tenancy import (
+    ANONYMOUS_ID, FairCycle, ReleaseRateEwma,
+)
 
 logger = get_logger("serving.decode")
 
@@ -710,14 +713,20 @@ class _RadixNode:
     make leaf eviction O(log n) per victim (pop a leaf, its parent
     becomes the next candidate) instead of a full re-walk each."""
 
-    __slots__ = ("children", "page", "last_used", "parent", "key")
+    __slots__ = ("children", "page", "last_used", "parent", "key",
+                 "tenant")
 
-    def __init__(self, page: int, now: float, parent=None, key=None):
+    def __init__(self, page: int, now: float, parent=None, key=None,
+                 tenant: str = ""):
         self.children: Dict[tuple, "_RadixNode"] = {}
         self.page = page
         self.last_used = now
         self.parent = parent
         self.key = key
+        # the tenant whose finished request published this page ("" =
+        # unattributed): quota charging and over-quota-first eviction
+        # key off it; SHARING stays tenant-blind (lookup never checks)
+        self.tenant = tenant
 
 
 class PrefixCache:
@@ -762,6 +771,31 @@ class PrefixCache:
         self.n_hit_tokens = 0
         self.n_published = 0
         self.n_evicted = 0
+        # per-tenant residency: publication charges the owning tenant;
+        # quotas bound a tenant's resident pages (eviction inside the
+        # over-quota tenant first — one flood cannot monopolize the
+        # shared index). Tenants without a quota are unbounded.
+        self._quotas: Dict[str, int] = {}
+        self._tenant_pages: Dict[str, int] = {}
+
+    def set_quota(self, tenant_id: str,
+                  max_pages: Optional[int]) -> None:
+        """Bound ``tenant_id``'s resident cached pages (``None``
+        removes the bound). Enforced at publish time: an over-quota
+        tenant evicts ITS OWN LRU pages to make room, never another
+        tenant's."""
+        with self._lock:
+            if max_pages is None:
+                self._quotas.pop(tenant_id, None)
+            else:
+                self._quotas[tenant_id] = int(max_pages)
+
+    def _charge_locked(self, tenant: str, n: int) -> None:
+        c = self._tenant_pages.get(tenant, 0) + n
+        if c > 0:
+            self._tenant_pages[tenant] = c
+        else:
+            self._tenant_pages.pop(tenant, None)
 
     def _chunks(self, tokens, n: int):
         ps = self.page_size
@@ -812,7 +846,8 @@ class PrefixCache:
         with self._lock:
             return self.n_lookups - self.n_hits
 
-    def publish(self, prompt, pages: List[int]) -> "set":
+    def publish(self, prompt, pages: List[int],
+                tenant: Optional[str] = None) -> "set":
         """Insert a finished request's prompt-complete pages
         (``pages[i]`` holds prompt rows ``[i*ps, (i+1)*ps)``) into the
         tree. Only pages newly ABSORBED by the index (their reference
@@ -822,10 +857,17 @@ class PrefixCache:
         of the token prefix) and the duplicate stays the caller's to
         free. Absorption respects ``max_pages``: LRU unreferenced
         pages are evicted to make room, and when nothing is evictable
-        the remaining chunks simply stay unpublished."""
+        the remaining chunks simply stay unpublished. ``tenant``
+        attributes the fresh pages to their owner: a tenant at its
+        :meth:`set_quota` bound evicts its OWN LRU pages first, and
+        when none are evictable its surplus chunks stay unpublished
+        (the caller frees them) — other tenants' residency is never
+        taxed for one tenant's churn."""
         n_chunks = min(len(prompt) // self.page_size, len(pages))
         if n_chunks == 0:
             return set()
+        owner = tenant or ""
+        quota = self._quotas.get(owner) if owner else None
         absorbed: set = set()
         with self._lock:
             # size the eviction ONCE: count the chunks actually
@@ -843,7 +885,7 @@ class PrefixCache:
                     missing += 1
             shortfall = self.n_cached + missing - self.max_pages
             if missing and shortfall > 0:
-                self._evict_locked(shortfall)
+                self._evict_pressure_locked(shortfall)
             node = self._root
             now = self.clock.now()
             path: set = set()            # every node on this publish's
@@ -856,14 +898,20 @@ class PrefixCache:
             for i, chunk in enumerate(chunks):
                 child = node.children.get(chunk)
                 if child is None:
+                    if quota is not None and \
+                            self._tenant_pages.get(owner, 0) >= quota \
+                            and not self._evict_locked(
+                                1, exclude=path, tenant=owner):
+                        break    # at quota, nothing of OURS evictable
                     if self.n_cached >= self.max_pages and \
                             not self._evict_locked(1, exclude=path):
                         break            # full and pinned: stop here
-                    child = _RadixNode(pages[i], now,
-                                       parent=node, key=chunk)
+                    child = _RadixNode(pages[i], now, parent=node,
+                                       key=chunk, tenant=owner)
                     node.children[chunk] = child
                     self.n_cached += 1
                     self.n_published += 1
+                    self._charge_locked(owner, 1)
                     absorbed.add(pages[i])
                 else:
                     child.last_used = now
@@ -881,7 +929,8 @@ class PrefixCache:
                 yield child
                 stack.append(child)
 
-    def _evict_locked(self, n: int, exclude=frozenset()) -> int:
+    def _evict_locked(self, n: int, exclude=frozenset(),
+                      tenant: Optional[str] = None) -> int:
         """Evict up to ``n`` LRU leaves whose page has no reader
         beyond the index itself (refcount 1). Leaves only: an
         interior node's descendants are reachable exclusively through
@@ -890,11 +939,13 @@ class PrefixCache:
         their last child goes (O(n_cached + evicted·log) instead of a
         full re-walk per victim). ``exclude`` holds the node ids an
         in-flight publish is building under (never evict the chain
-        being extended)."""
+        being extended). ``tenant`` restricts victims to one tenant's
+        pages (the over-quota-first path)."""
         import heapq
         heap = [(nd.last_used, i, nd)
                 for i, nd in enumerate(self._nodes_locked())
-                if not nd.children]
+                if not nd.children
+                and (tenant is None or nd.tenant == tenant)]
         heapq.heapify(heap)
         seq = len(heap)
         evicted = 0
@@ -911,11 +962,37 @@ class PrefixCache:
             self.pool.release([nd.page])
             self.n_cached -= 1
             self.n_evicted += 1
+            self._charge_locked(nd.tenant, -1)
             evicted += 1
             parent = nd.parent
-            if not parent.children and parent is not self._root:
+            if not parent.children and parent is not self._root \
+                    and (tenant is None or parent.tenant == tenant):
                 heapq.heappush(heap, (parent.last_used, seq, parent))
                 seq += 1
+        return evicted
+
+    def _evict_pressure_locked(self, n: int,
+                               exclude=frozenset()) -> int:
+        """Claim-pressure eviction: reclaim from OVER-QUOTA tenants
+        first (most-over first), then fall back to global LRU — so a
+        tenant camping past its budget pays for pool pressure before
+        anyone inside theirs does."""
+        evicted = 0
+        if self._quotas:
+            over = sorted(
+                ((self._tenant_pages.get(t, 0) - q, t)
+                 for t, q in self._quotas.items()
+                 if self._tenant_pages.get(t, 0) > q),
+                reverse=True)
+            for surplus, t in over:
+                if evicted >= n:
+                    break
+                evicted += self._evict_locked(
+                    min(n - evicted, surplus), exclude=exclude,
+                    tenant=t)
+        if evicted < n:
+            evicted += self._evict_locked(n - evicted,
+                                          exclude=exclude)
         return evicted
 
     def evict_for(self, n_needed: int) -> int:
@@ -924,7 +1001,8 @@ class PrefixCache:
         Returns the number evicted."""
         with self._lock:
             short = n_needed - self.pool.n_free
-            return self._evict_locked(short) if short > 0 else 0
+            return self._evict_pressure_locked(short) if short > 0 \
+                else 0
 
     @property
     def n_evictable(self) -> int:
@@ -960,6 +1038,7 @@ class PrefixCache:
             pages = [nd.page for nd in self._nodes_locked()]
             self._root.children.clear()
             dropped, self.n_cached = self.n_cached, 0
+            self._tenant_pages.clear()
             if pages:
                 self.pool.release(pages)
             return dropped
@@ -976,6 +1055,8 @@ class PrefixCache:
                 "hit_tokens": self.n_hit_tokens,
                 "published_pages": self.n_published,
                 "evicted_pages": self.n_evicted,
+                "tenant_pages": dict(self._tenant_pages),
+                "tenant_quotas": dict(self._quotas),
                 "ledger_clean": self.ledger_clean()}
 
 
@@ -1121,6 +1202,12 @@ class DecodeScheduler:
         self.spec_proposal_logp = None
         self.n_spec_accepted = 0
         self.releases: Dict[str, int] = {}   # finish_reason -> count
+        # tenancy hooks (wired by bind() against the server's
+        # registry): slot-release EWMA feeds honest decode-429
+        # Retry-After; the fair cycle orders slot claims per tenant
+        self._server = None
+        self.release_ewma = ReleaseRateEwma(clock=clock)
+        self._fair = FairCycle()
         self._m_prefill = None
         self._m_step = None
         self._m_spec_round = None
@@ -1137,6 +1224,14 @@ class DecodeScheduler:
         self.clock = server.clock
         self.tracer = server.tracer
         self._commit = server._commit
+        self._server = server
+        self.release_ewma = ReleaseRateEwma(clock=server.clock)
+        # per-tenant prefix-cache page budgets come from the registry
+        if self.prefix is not None \
+                and getattr(server, "tenancy", None) is not None:
+            for t in server.tenancy.tenants.values():
+                if t.max_cache_pages is not None:
+                    self.prefix.set_quota(t.id, t.max_cache_pages)
         self._register_metrics(server.registry)
 
     def _register_metrics(self, m) -> None:
@@ -1235,6 +1330,17 @@ class DecodeScheduler:
 
     def overloaded(self) -> bool:
         return len(self._waiting) >= self.max_waiting
+
+    def queue_pressure(self) -> "tuple[int, int]":
+        """``(depth, capacity)`` of the waiting queue — the pressure
+        signal priority-aware shedding evaluates."""
+        return len(self._waiting), self.max_waiting
+
+    def retry_after_hint(self) -> Optional[float]:
+        """Honest decode-429 ``Retry-After`` from the slot-release
+        EWMA scaled by the queue ahead; ``None`` while the EWMA is
+        cold or stale (caller falls back to the constant)."""
+        return self.release_ewma.retry_after(len(self._waiting))
 
     def parse(self, payload: Any
               ) -> "tuple[np.ndarray, int, Optional[Sampler], Optional[bool]]":
@@ -1343,7 +1449,9 @@ class DecodeScheduler:
         pages, req.pages = req.pages, []
         absorbed = set()
         if self.prefix is not None and publish:
-            absorbed = self.prefix.publish(req.prompt, pages)
+            absorbed = self.prefix.publish(
+                req.prompt, pages,
+                tenant=getattr(req.pending, "tenant", None))
         rest = [p for p in pages if p not in absorbed]
         if rest:
             self.pages.release(rest)
@@ -1454,6 +1562,7 @@ class DecodeScheduler:
             if self._tables is not None:
                 self._tables[req.slot, :] = 0
             self.pool.release(req.slot)
+            self.release_ewma.note()
             t1 = self._now()
             self._add_span(req, "decode", req.t_decode, t1,
                            status="ok" if status == 200 else "error",
@@ -1466,6 +1575,12 @@ class DecodeScheduler:
             self._by_rid.pop(req.pending.rid, None)
             self.releases[reason] = self.releases.get(reason, 0) + 1
         p = req.pending
+        # emitted tokens billed to the owning tenant exactly once, at
+        # resolution (partial emissions from preempts/faults included)
+        tid = getattr(p, "tenant", None)
+        if tid and req.produced and self._server is not None \
+                and getattr(self._server, "tenancy", None) is not None:
+            self._server.tenancy.note_tokens(tid, len(req.produced))
         if status == 200:
             p.status = 200
             body = {"tokens": req.produced,
@@ -1577,8 +1692,35 @@ class DecodeScheduler:
                              error="deadline exceeded before decode")
 
     def _pop_waiting(self) -> Optional[_DecodeRequest]:
+        """Next waiter to try for a slot. FIFO without tenancy; with
+        fair-share on, a deficit-weighted round-robin across the
+        tenants PRESENT in the queue picks whose oldest request goes
+        next — a 10:1 flood from one tenant still leaves the victim
+        claiming slots at its weighted share (the bounded-starvation
+        guarantee lives in :class:`~mmlspark_tpu.serving.tenancy.
+        FairCycle`)."""
         with self._lock:
-            return self._waiting.popleft() if self._waiting else None
+            if not self._waiting:
+                return None
+            ten = (getattr(self._server, "tenancy", None)
+                   if self._server is not None else None)
+            if ten is None or not ten.fair_share \
+                    or len(self._waiting) == 1:
+                return self._waiting.popleft()
+            present: Dict[str, float] = {}
+            for r in self._waiting:
+                tid = getattr(r.pending, "tenant", None) or ANONYMOUS_ID
+                if tid not in present:
+                    present[tid] = ten.weight_of(tid)
+            if len(present) == 1:
+                return self._waiting.popleft()
+            pick = self._fair.choose(present)
+            for i, r in enumerate(self._waiting):
+                if (getattr(r.pending, "tenant", None)
+                        or ANONYMOUS_ID) == pick:
+                    del self._waiting[i]
+                    return r
+            return self._waiting.popleft()
 
     def _admit_waiting(self) -> None:
         """Between steps: claim free slots (and, paged, the prompt's
@@ -2093,5 +2235,14 @@ class DecodeScheduler:
                     if self.prefill_s > 0 else None),
                 "n_step_faults": self.n_step_faults,
                 "n_compiles": self.decoder.n_compiles(),
+                # the live honest-429 inputs: slot-release gap EWMA
+                # and the Retry-After a shed client would be told now
+                # (None while the EWMA is cold — constant fallback)
+                "release_gap_s": (
+                    round(self.release_ewma.gap_s(), 4)
+                    if self.release_ewma.gap_s() is not None else None),
+                "retry_after_hint": (
+                    round(self.retry_after_hint(), 4)
+                    if self.retry_after_hint() is not None else None),
                 "releases": releases,
                 "active": slots}

@@ -1234,6 +1234,7 @@ class EventLoopFrontend:
         self._conns_per_ip: Dict[str, int] = {}
         self.per_ip_high_water = 0
         self.n_per_ip_rejected = 0
+        self.n_per_ip_underflow = 0
         # -- HTTP/1.1 pipelining fairness: at most this many buffered
         # requests served per connection per _advance pass; the rest
         # are deferred to the next loop iteration so one flooding
@@ -1298,7 +1299,13 @@ class EventLoopFrontend:
             return
         with self._ip_lock:
             n = self._conns_per_ip.get(ip, 0) - 1
-            if n <= 0:
+            if n < 0:
+                # a release with no matching acquire: clamped, counted
+                # — the leak-check test asserts this stays 0 (every
+                # teardown path funnels through _Loop._close exactly
+                # once; its conns-dict pop guards the double call)
+                self.n_per_ip_underflow += 1
+            elif n == 0:
                 self._conns_per_ip.pop(ip, None)
             else:
                 self._conns_per_ip[ip] = n
@@ -1454,6 +1461,11 @@ class EventLoopFrontend:
             "pipelining_deferred_total": self.n_pipelining_deferred,
             "per_ip_rejected_total": self.n_per_ip_rejected,
             "per_ip_conns_high_water": self.per_ip_high_water,
+            # live per-IP ledger: tracked addresses and release-
+            # without-acquire underflows — the leak-check test's
+            # public surface (0 tracked and 0 underflows at idle)
+            "per_ip_tracked": len(self._conns_per_ip),
+            "per_ip_underflow_total": self.n_per_ip_underflow,
             "reply_flush_batches_total": self.n_reply_flushes,
             "batched_replies_total": self.n_batched_replies,
             "streams_total": self.n_streams,

@@ -32,6 +32,43 @@ from typing import Callable, Dict, List, Optional, Sequence, Tuple
 
 from mmlspark_tpu.core.resilience import SYSTEM_CLOCK, Clock
 
+#: Tenant priority classes, most- to least-latency-sensitive. The
+#: ordering is the shed ordering under pressure: ``background`` sheds
+#: first, ``batch`` next, ``interactive`` only when the queue is full.
+PRIORITY_CLASSES = ("interactive", "batch", "background")
+
+
+class PriorityShedPolicy:
+    """Map queue pressure to a per-priority-class shed verdict.
+
+    Below the ``high_water`` fraction of capacity nobody sheds; above
+    it the classes peel off in reverse priority order — ``background``
+    at ``high_water``, ``batch`` midway between high water and full,
+    ``interactive`` only at a genuinely full queue (which is exactly
+    the pre-tenancy behavior, so latency-sensitive traffic is never
+    worse off under this policy than under the plain full-queue
+    check). A full queue sheds every class regardless.
+    """
+
+    def __init__(self, high_water: float = 0.5):
+        hw = min(max(float(high_water), 0.0), 1.0)
+        self.high_water = hw
+        self._thresholds = {"background": hw,
+                            "batch": (hw + 1.0) / 2.0,
+                            "interactive": 1.0}
+
+    def threshold(self, priority: str) -> float:
+        """Pressure fraction at which ``priority`` starts shedding."""
+        return self._thresholds.get(priority, 1.0)
+
+    def should_shed(self, depth: int, capacity: int,
+                    priority: str) -> bool:
+        if capacity <= 0:
+            return False
+        if depth >= capacity:
+            return True
+        return depth >= self._thresholds.get(priority, 1.0) * capacity
+
 
 class AdaptiveBatchPolicy:
     """Learn the arrival-rate/batch-size tradeoff online.

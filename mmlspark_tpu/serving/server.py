@@ -61,6 +61,8 @@ from typing import Any, Dict, List, Optional, Tuple
 
 import numpy as np
 
+from collections import deque
+
 from mmlspark_tpu.core.dataframe import DataFrame
 from mmlspark_tpu.core.logs import get_logger
 from mmlspark_tpu.core.profiling import (
@@ -94,6 +96,9 @@ from mmlspark_tpu.serving.quant import QuantizationConfig
 from mmlspark_tpu.serving.rollout import (
     ModelVersionManager, RolloutError, RolloutOrchestrator,
 )
+from mmlspark_tpu.serving.tenancy import (
+    ANONYMOUS_ID, FairCycle, TenantRegistry, extract_api_key,
+)
 
 logger = get_logger("serving")
 
@@ -122,7 +127,8 @@ _MAX_SHAPES_TRACKED = 1024
 
 class _PendingRequest:
     __slots__ = ("rid", "payload", "event", "reply", "status", "deadline",
-                 "trace", "span", "t_enqueue", "callbacks", "stream")
+                 "trace", "span", "t_enqueue", "callbacks", "stream",
+                 "tenant")
 
     def __init__(self, payload: Any, rid: Optional[str] = None,
                  deadline: Optional[Deadline] = None,
@@ -155,6 +161,10 @@ class _PendingRequest:
         # scheduler emits per-token SSE events through it and finishes
         # the chunked body at resolution; None for everything else
         self.stream = None
+        # owning tenant id while this request holds a tenant in-flight
+        # slot (tenancy enabled); cleared by the release funnel so the
+        # slot can never be returned twice
+        self.tenant: Optional[str] = None
 
 
 class _ThreadedStream:
@@ -239,6 +249,7 @@ class ServingServer:
                  tls_cert: Optional[str] = None,
                  tls_key: Optional[str] = None,
                  ssl_context=None,
+                 tenancy=None,
                  clock: Clock = SYSTEM_CLOCK):
         self.api_path = api_path
         self.max_batch_size = int(max_batch_size)
@@ -417,6 +428,27 @@ class ServingServer:
         self.max_queue = int(max_queue)
         self.shed_retry_after = float(shed_retry_after)
         self.clock = clock
+        # -- tenant isolation (optional): ``tenancy`` is a
+        # TenantRegistry / config dict / JSON path; when omitted the
+        # MMLSPARK_TENANTS env var is consulted. With a registry, API
+        # keys resolve to tenants at the edge, _admit charges token
+        # buckets + in-flight caps per tenant, shedding becomes
+        # priority-aware past the registry's high-water mark, and the
+        # collector assembles batches in deficit-weighted round-robin
+        # order per tenant (see serving/tenancy.py and docs/serving.md
+        # "Tenancy & overload control"). All of it is host-side
+        # bookkeeping BEFORE batch assembly — dispatch shapes, and
+        # therefore the compiled-executable set, are tenant-blind.
+        self.tenancy: Optional[TenantRegistry] = \
+            TenantRegistry.from_value(tenancy, clock=clock)
+        if self.tenancy is None and tenancy is None:
+            self.tenancy = TenantRegistry.from_env(clock=clock)
+        # collector-thread-only fair-share state (never touched by the
+        # ingress threads — they only feed self._queue)
+        self._fair_cycle = FairCycle()
+        self._fair_q: Dict[str, "deque[_PendingRequest]"] = {}
+        self._fair_total = 0
+        self._m_tenant_latency = None
         self.n_shed = 0
         self.n_deadline_expired = 0
         # 5xx replies committed (model/encode failures): the per-worker
@@ -606,6 +638,8 @@ class ServingServer:
         m.gauge("serving_journal_entries",
                 "Live replay-journal entries."
                 ).set_function(lambda: len(self._journal))
+        if self.tenancy is not None:
+            self._register_tenant_metric_views()
         # process vitals belong to the PROCESS-wide registry: two
         # co-hosted workers read the same RSS, and the fleet merge
         # (which scrapes ?scope=server) must not sum it once per worker
@@ -617,6 +651,53 @@ class ServingServer:
             "process_rss_bytes",
             "Resident set size (leak evidence across chaos drills)."
         ).set_function(lambda: process_rss_bytes() or 0)
+
+    def _register_tenant_metric_views(self) -> None:
+        """Per-tenant metric families, exposition-time views over the
+        registry's plain counters. Cardinality is bounded by the
+        registry's BoundedLabelSet: the first ``label_cap`` tenants
+        (declaration order) get their own label value, the tail folds
+        into ``other`` — a child's view function sums every state
+        mapped to its label, so ``other`` is one honest aggregate row,
+        not last-writer-wins."""
+        m, reg = self.registry, self.tenancy
+        c_req = m.counter(
+            "serving_tenant_requests_total",
+            "Requests admitted per tenant (replays and sheds are "
+            "counted separately).", labels=("tenant",))
+        c_shed = m.counter(
+            "serving_tenant_shed_total",
+            "Requests refused per tenant, by reason: rate (token "
+            "bucket empty), concurrency (in-flight cap), overload "
+            "(priority-aware queue-pressure shed).",
+            labels=("tenant", "reason"))
+        c_tok = m.counter(
+            "serving_tenant_tokens_total",
+            "Decode-plane tokens generated per tenant.",
+            labels=("tenant",))
+        g_inf = m.gauge(
+            "serving_tenant_inflight",
+            "Requests currently holding a tenant in-flight slot.",
+            labels=("tenant",))
+        self._m_tenant_latency = m.histogram(
+            "serving_tenant_request_latency_ms",
+            "Enqueue->commit wall-clock per tenant (the per-tenant "
+            "dispatch-latency surface; admission-rejected requests "
+            "never reach it).", labels=("tenant",))
+        for label in reg.labels():
+            states = reg.states_for_label(label)
+            c_req.labels(label).set_function(
+                lambda ss=states: sum(s.n_requests for s in ss))
+            c_tok.labels(label).set_function(
+                lambda ss=states: sum(s.n_tokens for s in ss))
+            g_inf.labels(label).set_function(
+                lambda ss=states: sum(s.inflight for s in ss))
+            for reason, attr in (("rate", "n_shed_rate"),
+                                 ("concurrency", "n_shed_concurrency"),
+                                 ("overload", "n_shed_overload")):
+                c_shed.labels(label, reason).set_function(
+                    lambda ss=states, a=attr:
+                    sum(getattr(s, a) for s in ss))
 
     # -- HTTP side -----------------------------------------------------------
 
@@ -775,9 +856,16 @@ class ServingServer:
                 deadline = Deadline.from_headers(self.headers,
                                                  clock=serving.clock)
                 rid = self.headers.get("X-Request-Id")
-                kind, pending, committed, window_missed = \
+                tenant = serving._resolve_tenant(self.headers)
+                if tenant is serving._TENANT_REJECTED:
+                    self._reply(401, serving._UNKNOWN_KEY_BODY,
+                                trace=tid)
+                    return "error"
+                if tenant is not None:
+                    root.set_attr("tenant", tenant.id)
+                kind, pending, committed, window_missed, shed = \
                     serving._admit(payload, rid, deadline, tid,
-                                   decode=decode)
+                                   decode=decode, tenant=tenant)
                 if rid:
                     root.set_attr("rid", rid)
                 if kind == "replay":
@@ -786,8 +874,8 @@ class ServingServer:
                                 replayed=True, trace=tid)
                     return "ok"
                 if kind == "shed":
-                    self._reply(429, b'{"error": "overloaded"}',
-                                retry_after=serving.shed_retry_after,
+                    self._reply(429, shed["body"],
+                                retry_after=shed["retry_after"],
                                 trace=tid)
                     return "shed"
                 if kind == "doa":
@@ -806,9 +894,9 @@ class ServingServer:
                             e_status, e_body = err
                             self._reply(
                                 e_status, e_body, trace=tid,
-                                retry_after=(serving.shed_retry_after
-                                             if e_status == 429
-                                             else None))
+                                retry_after=(
+                                    serving._decode_retry_after()
+                                    if e_status == 429 else None))
                             return ("shed" if e_status == 429
                                     else "error")
                         if stream is not None:
@@ -998,6 +1086,11 @@ class ServingServer:
                     # RSS spots the leak
                     "uptime_s": round(process_uptime_s(), 3),
                     "rss_bytes": process_rss_bytes(),
+                    # per-tenant admission ledger: quotas, in-flight,
+                    # shed counts by reason, tokens — None when the
+                    # server runs without a tenant registry
+                    "tenancy": (self.tenancy.stats()
+                                if self.tenancy is not None else None),
                 }
             return 200, json.dumps(stats).encode(), "application/json", ()
         if base == "/traces":
@@ -1163,30 +1256,89 @@ class ServingServer:
                 "application/json")
         return None
 
+    #: sentinel: the API key was missing/unknown under the "reject"
+    #: policy — the frontends answer 401 without touching _admit
+    _TENANT_REJECTED = object()
+    _UNKNOWN_KEY_BODY = b'{"error": "unknown or missing API key"}'
+
+    def _resolve_tenant(self, headers):
+        """Identity at the edge: API key (``X-Api-Key`` /
+        ``Authorization: Bearer``) → tenant. ``None`` when tenancy is
+        off; :data:`_TENANT_REJECTED` when the registry's policy
+        refuses the credential (the caller 401s)."""
+        if self.tenancy is None:
+            return None
+        tenant = self.tenancy.resolve(extract_api_key(headers))
+        return tenant if tenant is not None else self._TENANT_REJECTED
+
+    def _decode_retry_after(self) -> float:
+        """Honest decode-plane Retry-After: the scheduler's
+        slot-release EWMA scaled by the waiting depth, falling back to
+        the configured constant while cold/stale."""
+        hint = (self.decoder.retry_after_hint()
+                if self.decoder is not None else None)
+        return hint if hint is not None else self.shed_retry_after
+
+    def _shed_info(self, reason: str, decode: bool,
+                   retry_after: Optional[float] = None) -> dict:
+        """The 429 detail a shed decision carries back to the
+        frontends: reason-specific body plus the most honest
+        ``Retry-After`` available — the bucket's refill math for rate
+        sheds, the decode slot-release EWMA for decode-plane pressure,
+        the configured constant otherwise."""
+        if retry_after is None or retry_after <= 0:
+            retry_after = (self._decode_retry_after() if decode
+                           else self.shed_retry_after)
+        body = (b'{"error": "overloaded"}' if reason == "overload"
+                else json.dumps({"error": "tenant quota exceeded",
+                                 "reason": reason}).encode())
+        return {"reason": reason, "body": body,
+                "retry_after": round(max(float(retry_after), 1e-3), 3)}
+
+    def _overload_shed(self, tenant, decode: bool) -> bool:
+        """The overload verdict for NEW work: the plain full-queue
+        check without tenancy; priority-aware (background sheds at the
+        high-water mark, batch midway, interactive only when full)
+        with it."""
+        if tenant is None or self.tenancy is None:
+            return (self.decoder.overloaded() if decode
+                    else self._overloaded())
+        if decode:
+            depth, cap = self.decoder.queue_pressure()
+        else:
+            depth, cap = self.backlog(), self.max_queue
+        return self.tenancy.should_shed(tenant, depth, cap)
+
     def _admit(self, payload: Any, rid: Optional[str],
                deadline: Optional[Deadline], tid: str,
-               decode: bool = False
+               decode: bool = False, tenant=None
                ) -> Tuple[str, Optional[_PendingRequest],
-                          Optional[tuple], bool]:
+                          Optional[tuple], bool, Optional[dict]]:
         """Ingress admission, shared by both frontends AND both data
         planes (``decode=True`` sheds on the decode scheduler's
         waiting-queue depth instead of the frame backlog; everything
         else — replay, join, doa — is identical). Returns ``(kind,
-        pending, committed_entry, window_missed)`` with kind one of:
+        pending, committed_entry, window_missed, shed)`` with kind one
+        of:
 
         * ``"replay"`` — the rid's reply is already committed
           (``committed_entry`` is the journal tuple);
         * ``"join"``   — the rid is in flight: wait on / watch
           ``pending`` without enqueuing a second compute;
-        * ``"shed"``   — overloaded, refuse with 429;
+        * ``"shed"``   — refused with 429; ``shed`` carries the
+          reason-specific body and honest Retry-After;
         * ``"doa"``    — the deadline was spent before admission:
           ``pending`` is already resolved with its 504;
         * ``"enqueue"`` — ``pending`` is fresh; the caller enqueues it
           (:meth:`_enqueue`) and awaits resolution.
-        """
+
+        With ``tenant`` set, quota checks run AFTER the replay/join
+        short-circuits (a replay returns the journaled reply without
+        re-charging the tenant's bucket or in-flight cap — retries of
+        answered work are free) and BEFORE the pending is created, so
+        every charged admission has exactly one release in the
+        resolution funnel."""
         window_missed = False
-        overloaded = (self.decoder.overloaded if decode
-                      else self._overloaded)
         if rid:
             with self._commit_lock:
                 self._reap_expired_locked()
@@ -1195,15 +1347,29 @@ class ServingServer:
                            if committed is None else None)
                 if committed is not None:
                     self.n_replayed += 1
-                    return "replay", None, committed, False
+                    if self.tenancy is not None:
+                        # replay attribution follows the JOURNALED
+                        # owner when the entry carries one (a replay
+                        # through a different key still bills the
+                        # tenant that paid for the compute)
+                        owner = (committed[4] if len(committed) > 4
+                                 and committed[4] else
+                                 tenant.id if tenant is not None
+                                 else None)
+                        if owner:
+                            self.tenancy.note_replay(owner)
+                    return "replay", None, committed, False, None
                 if pending is not None:
-                    return "join", pending, None, False
-                if overloaded():
+                    return "join", pending, None, False, None
+                if self._overload_shed(tenant, decode):
                     # shedding applies to NEW work only: replays and
                     # in-flight joins above cost no inference and
                     # always succeed
                     self.n_shed += 1
-                    return "shed", None, None, False
+                    if tenant is not None:
+                        self.tenancy.note_shed_overload(tenant.id)
+                    return ("shed", None, None, False,
+                            self._shed_info("overload", decode))
                 # request ids are unique per logical request, so a rid
                 # in the evicted ring can only be a retry that outlived
                 # the replay window — detected, warned, and re-executed
@@ -1211,8 +1377,17 @@ class ServingServer:
                 window_missed = rid in self._evicted
                 if window_missed:
                     self.n_window_missed += 1
+                if tenant is not None:
+                    quota = self.tenancy.admit(tenant)
+                    if quota is not None:
+                        self.n_shed += 1
+                        return ("shed", None, None, False,
+                                self._shed_info(quota[0], decode,
+                                                quota[1]))
                 pending = _PendingRequest(payload, rid, deadline,
                                           trace=tid)
+                if tenant is not None:
+                    pending.tenant = tenant.id
                 self._inflight[rid] = pending
             if window_missed:
                 logger.warning(
@@ -1221,12 +1396,25 @@ class ServingServer:
                     "re-executing", rid, self.journal_size,
                     self.journal_ttl)
         else:
-            if overloaded():
+            if self._overload_shed(tenant, decode):
                 with self._commit_lock:
                     self.n_shed += 1
-                return "shed", None, None, False
+                if tenant is not None:
+                    self.tenancy.note_shed_overload(tenant.id)
+                return ("shed", None, None, False,
+                        self._shed_info("overload", decode))
+            if tenant is not None:
+                quota = self.tenancy.admit(tenant)
+                if quota is not None:
+                    with self._commit_lock:
+                        self.n_shed += 1
+                    return ("shed", None, None, False,
+                            self._shed_info(quota[0], decode,
+                                            quota[1]))
             pending = _PendingRequest(payload, deadline=deadline,
                                       trace=tid)
+            if tenant is not None:
+                pending.tenant = tenant.id
         if deadline is not None and deadline.expired:
             # dead on arrival: the client's budget is already spent —
             # never enqueue work nobody will read. The pending is
@@ -1241,8 +1429,8 @@ class ServingServer:
             with self._commit_lock:
                 self._inflight.pop(pending.rid, None)
             self._release(pending)
-            return "doa", pending, None, window_missed
-        return "enqueue", pending, None, window_missed
+            return "doa", pending, None, window_missed, None
+        return "enqueue", pending, None, window_missed, None
 
     def _enqueue(self, pending: _PendingRequest, root) -> None:
         """Hand an admitted request to the data plane. The root span
@@ -1277,11 +1465,27 @@ class ServingServer:
             with self._commit_lock:
                 self._inflight.pop(pending.rid, None)
                 self.n_shed += 1
+            self._release_tenant(pending)
             return 429, b'{"error": "overloaded"}'
         except ValueError as e:
             with self._commit_lock:
                 self._inflight.pop(pending.rid, None)
+            self._release_tenant(pending)
             return 400, json.dumps({"error": str(e)}).encode()
+
+    def _release_tenant(self, p: _PendingRequest) -> None:
+        """Return ``p``'s tenant in-flight slot (idempotent: the slot
+        id is cleared first, so every resolution path may call this
+        and the slot still comes back exactly once)."""
+        owner, p.tenant = p.tenant, None
+        if owner is None or self.tenancy is None:
+            return
+        self.tenancy.release(owner)
+        if self._m_tenant_latency is not None \
+                and p.t_enqueue is not None:
+            self._m_tenant_latency.labels(
+                self.tenancy.label_of(owner)).observe(
+                (self.tracer.clock.now() - p.t_enqueue) * 1000.0)
 
     def _release(self, p: _PendingRequest) -> None:
         """Resolve a pending request: wake any threaded-frontend
@@ -1289,6 +1493,7 @@ class ServingServer:
         callbacks. A callback registered concurrently with release may
         fire twice (see :meth:`_add_waiter`); the event-loop frontend
         drops the duplicate reply by connection generation."""
+        self._release_tenant(p)
         p.event.set()
         for cb in p.callbacks:
             try:
@@ -1386,8 +1591,16 @@ class ServingServer:
             return "error"
         deadline = Deadline.from_headers(headers, clock=self.clock)
         rid = headers.get("X-Request-Id")
-        kind, pending, committed, window_missed = \
-            self._admit(payload, rid, deadline, tid, decode=decode)
+        tenant = self._resolve_tenant(headers)
+        if tenant is self._TENANT_REJECTED:
+            reply(401, self._UNKNOWN_KEY_BODY,
+                  extra=((TRACE_HEADER, tid),))
+            return "error"
+        if tenant is not None:
+            root.set_attr("tenant", tenant.id)
+        kind, pending, committed, window_missed, shed = \
+            self._admit(payload, rid, deadline, tid, decode=decode,
+                        tenant=tenant)
         if rid:
             root.set_attr("rid", rid)
         if kind == "replay":
@@ -1396,9 +1609,9 @@ class ServingServer:
                   extra=((TRACE_HEADER, tid), ("X-Replayed", "1")))
             return "ok"
         if kind == "shed":
-            reply(429, b'{"error": "overloaded"}',
+            reply(429, shed["body"],
                   extra=((TRACE_HEADER, tid),
-                         ("Retry-After", str(self.shed_retry_after))))
+                         ("Retry-After", str(shed["retry_after"]))))
             return "shed"
         if kind == "doa":
             reply(504, pending.reply, extra=((TRACE_HEADER, tid),))
@@ -1442,6 +1655,7 @@ class ServingServer:
                 except ValueError as e:
                     with self._commit_lock:
                         self._inflight.pop(pending.rid, None)
+                    self._release_tenant(pending)
                     reply(400, json.dumps({"error": str(e)}).encode(),
                           extra=((TRACE_HEADER, tid),))
                     return "error"
@@ -1463,7 +1677,7 @@ class ServingServer:
                 extra = [(TRACE_HEADER, tid)]
                 if e_status == 429:
                     extra.append(("Retry-After",
-                                  str(self.shed_retry_after)))
+                                  str(self._decode_retry_after())))
                 reply(e_status, e_body, extra=tuple(extra))
                 return "shed" if e_status == 429 else "error"
             if stream is not None:
@@ -1495,6 +1709,8 @@ class ServingServer:
         return self.max_queue > 0 and self.backlog() >= self.max_queue
 
     def _collect_batch(self) -> List[_PendingRequest]:
+        if self.tenancy is not None and self.tenancy.fair_share:
+            return self._collect_batch_fair()
         try:
             first = self._queue.get(timeout=0.05)
         except Empty:
@@ -1504,6 +1720,79 @@ class ServingServer:
         # not the idle 0.05s polls of an unloaded server
         with self.timings.span("collect"):
             return self._collect_rest(first)
+
+    # -- fair-share batch assembly (tenancy + fair_share on) ----------------
+    #
+    # The ingress SimpleQueue stays the handoff (frontend threads only
+    # ever put); the collector drains it into per-tenant FIFO deques
+    # and pops in deficit-weighted round-robin order, so one tenant's
+    # burst can reorder only its OWN requests — a 10:1 flood fills at
+    # most its fair share of every batch once another tenant is
+    # waiting. All of this is collector-thread-local state: no lock,
+    # no hot-path cost for the ingress threads, and the batch still
+    # pads to the same shape buckets (fairness reorders rows, never
+    # reshapes the dispatch).
+
+    def _fair_push(self, p: _PendingRequest) -> None:
+        tid_t = p.tenant or ANONYMOUS_ID
+        self._fair_q.setdefault(tid_t, deque()).append(p)
+        self._fair_total += 1
+
+    def _fair_drain_ingress(self) -> None:
+        try:
+            while True:
+                self._fair_push(self._queue.get_nowait())
+        except Empty:
+            pass
+
+    def _fair_pop(self) -> Optional[_PendingRequest]:
+        present = {t: self.tenancy.weight_of(t)
+                   for t, dq in self._fair_q.items() if dq}
+        if not present:
+            return None
+        t = self._fair_cycle.choose(present)
+        dq = self._fair_q[t]
+        p = dq.popleft()
+        if not dq:
+            del self._fair_q[t]
+        self._fair_total -= 1
+        return p
+
+    def _collect_batch_fair(self) -> List[_PendingRequest]:
+        self._fair_drain_ingress()
+        if self._fair_total == 0:
+            try:
+                self._fair_push(self._queue.get(timeout=0.05))
+            except Empty:
+                return []
+            self._fair_drain_ingress()
+        with self.timings.span("collect"):
+            return self._collect_rest_fair()
+
+    def _collect_rest_fair(self) -> List[_PendingRequest]:
+        batch = [self._fair_pop()]
+        limit = min(self.max_batch_size, self._bucket_sizes()[-1])
+        window_ms = self.max_latency_ms
+        if self.adaptive_batcher is not None:
+            decided = self.adaptive_batcher.decide_wait_ms(
+                1 + self._fair_total + self._queue.qsize())
+            if decided is not None:
+                window_ms = decided
+        deadline = time.monotonic() + max(window_ms, 0.0) / 1000.0
+        while len(batch) < limit:
+            p = self._fair_pop()
+            if p is not None:
+                batch.append(p)
+                continue
+            remaining = deadline - time.monotonic()
+            if remaining <= 0:
+                break
+            try:
+                self._fair_push(self._queue.get(timeout=remaining))
+            except Empty:
+                break
+            self._fair_drain_ingress()
+        return batch
 
     def _collect_rest(self, first: _PendingRequest
                       ) -> List[_PendingRequest]:
@@ -1991,7 +2280,8 @@ class ServingServer:
                     continue
                 self._journal.pop(rid, None)      # newest record wins
                 self._journal[rid] = (status, reply, now_mono - age,
-                                      str(rec.get("trace", "")))
+                                      str(rec.get("trace", "")),
+                                      str(rec.get("tenant", "")))
             while len(self._journal) > self.journal_size:
                 self._journal.popitem(last=False)
             self.n_journal_recovered = len(self._journal)
@@ -2004,11 +2294,13 @@ class ServingServer:
     def _journal_line(rid, entry, t_wall) -> str:
         # the trace id rides every journal line, so a committed reply
         # correlates with its ingress/dispatch/egress log records even
-        # after a restart replays the file
+        # after a restart replays the file; the tenant id rides along
+        # so a replay across a restart still bills the owner
         return json.dumps({"rid": rid, "status": entry[0],
                            "reply": entry[1].decode(),
                            "t": round(t_wall, 3),
-                           "trace": entry[3] if len(entry) > 3 else ""
+                           "trace": entry[3] if len(entry) > 3 else "",
+                           "tenant": entry[4] if len(entry) > 4 else ""
                            }) + "\n"
 
     def _compact_journal(self) -> None:
@@ -2097,7 +2389,8 @@ class ServingServer:
     def _commit_locked(self, p: _PendingRequest) -> None:
         if self._inflight.pop(p.rid, None) is not None \
                 and p.status == 200:
-            entry = (p.status, p.reply or b"{}", time.monotonic(), p.trace)
+            entry = (p.status, p.reply or b"{}", time.monotonic(),
+                     p.trace, p.tenant or "")
             self._journal[p.rid] = entry
             if self._journal_fh is not None:
                 # enqueue only: the writer thread does the file I/O
@@ -2811,12 +3104,40 @@ class ServingCoordinator:
                            for s in per_worker.values()
                            if isinstance(s, dict)
                            and s.get("model_version")})
+        # per-tenant ledgers merged fleet-wide: counters sum, in-flight
+        # sums (a gauge, but per-tenant concurrency IS additive across
+        # workers), priority/quota config taken from the first worker
+        # that names the tenant. None when no responding worker runs a
+        # tenant registry.
+        tenants: Dict[str, Dict[str, Any]] = {}
+        for s in per_worker.values():
+            if not isinstance(s, dict):
+                continue
+            ten = (s.get("tenancy") or {}).get("tenants") or []
+            for row in ten:
+                tid = str(row.get("id", ""))
+                if not tid:
+                    continue
+                agg = tenants.get(tid)
+                if agg is None:
+                    tenants[tid] = dict(row)
+                    continue
+                for k, v in row.items():
+                    if isinstance(v, (int, float)) \
+                            and not isinstance(v, bool) \
+                            and k not in ("rate_per_s", "burst",
+                                          "max_inflight",
+                                          "max_cache_pages", "weight"):
+                        agg[k] = agg.get(k, 0) + v
         return {"n_workers": len(per_worker), "n_responding": n_live,
                 "totals": totals, "rates_per_s": rates,
                 "rate_interval_s": interval, "stage_timings": merged,
                 "slowest_stage": slowest, "widest_bucket": widest,
                 "model_versions": versions,
                 "version_coherent": len(versions) <= 1,
+                "tenants": (sorted(tenants.values(),
+                                   key=lambda r: str(r.get("id", "")))
+                            if tenants else None),
                 "workers": per_worker}
 
     def fleet_metrics(self, timeout: float = 5.0) -> str:
@@ -2966,10 +3287,15 @@ class ServingClient:
                  retry_policy: Optional[RetryPolicy] = None,
                  breakers: Optional[BreakerBoard] = None,
                  tracer=None,
+                 api_key: Optional[str] = None,
                  clock: Clock = SYSTEM_CLOCK):
         self.coordinator_url = coordinator_url.rstrip("/")
         self.api_path = api_path
         self.timeout = timeout
+        # tenant identity: sent as X-Api-Key on every attempt so a
+        # tenancy-enabled fleet (docs/serving.md "Tenancy & overload
+        # control") bills the whole failover schedule to one tenant
+        self.api_key = api_key
         # spans record through this tracer (None = the ambient one at
         # call time, falling back to the process TRACER): one "predict"
         # root per logical request with an egress child per attempt,
@@ -3068,6 +3394,8 @@ class ServingClient:
             breaker = self.breakers.get(url)
             retry_after = None
             headers = {"X-Request-Id": rid, TRACE_HEADER: trace}
+            if self.api_key is not None:
+                headers["X-Api-Key"] = self.api_key
             if deadline is not None:
                 headers[Deadline.HEADER] = deadline.to_header()
             # attempt 0, plus one same-worker retry after a timeout: the
