@@ -216,7 +216,10 @@ class NNModel(Model, HasInputCol, HasOutputCol):
     def _transfer_dtype(self):
         mode = self.input_dtype
         if self.quantization is not None:
-            mode = self.quantization.wire_dtype
+            wire = self.quantization.wire_dtype
+            # "none" = compute-only quantization: payloads stay in the
+            # model's native transfer dtype
+            mode = "auto" if wire == "none" else wire
         if mode == "auto":
             arch = getattr(self.model, "arch", None) or {}
             mode = ("bfloat16" if arch.get("dtype") == "bfloat16"
@@ -240,6 +243,7 @@ class NNModel(Model, HasInputCol, HasOutputCol):
     def _set_param(self, name, value):
         # param changes invalidate the compiled forward and device placement
         self.__dict__.pop("_jitted", None)
+        self.__dict__.pop("_quant_state", None)
         self.__dict__.pop("_setup_sharded", None)
         self.__dict__.pop("_setup_single_cache", None)
         self.__dict__.pop("_setup_pipeline", None)
@@ -367,6 +371,37 @@ class NNModel(Model, HasInputCol, HasOutputCol):
                      else jnp.float32)
         return scale, offset, deq_dtype
 
+    @property
+    def _compute_quant(self):
+        """The :class:`~mmlspark_tpu.serving.quant.ComputeQuantization`
+        riding this model's config, or None (f32 compute)."""
+        return getattr(self.quantization, "compute", None) \
+            if self.quantization is not None else None
+
+    @functools.cached_property
+    def _quant_state(self):
+        """``(int8-kernel param tree, {leaf path: per-channel
+        scales})`` — the scale-derivation step, run ONCE per configured
+        model (rollout stage time: ``configure_model`` sets the config,
+        the warmup's first placement lands here) and cached until a
+        param changes; None without a compute section. The quantized
+        tree keeps the f32 tree's exact structure — scales ride
+        OUTSIDE it as constants of the jitted forward — so sharding
+        and placement machinery see nothing new."""
+        comp = self._compute_quant
+        if comp is None:
+            return None
+        from mmlspark_tpu.serving.quant import quantize_param_tree
+        return quantize_param_tree(self.model.params, comp)
+
+    @property
+    def _served_params(self):
+        """The tree placement uploads: int8 kernels under compute
+        quantization (4x less HBM and host->device link per kernel),
+        the f32 tree otherwise."""
+        qs = self._quant_state
+        return self.model.params if qs is None else qs[0]
+
     @functools.cached_property
     def _jitted(self):
         import jax
@@ -374,6 +409,12 @@ class NNModel(Model, HasInputCol, HasOutputCol):
         out_layer = self._resolve_output_layer()
         module = self.model.module()
         scale, offset, deq_dtype = self._dequant_constants()
+        comp = self._compute_quant
+        if comp is not None:
+            from mmlspark_tpu.serving.quant import (
+                dequantize_param_tree)
+            qscales = self._quant_state[1]
+            act_dtype = jnp.dtype(comp.activation_dtype)
 
         def forward(params, x):
             if jnp.issubdtype(x.dtype, jnp.integer) \
@@ -382,9 +423,79 @@ class NNModel(Model, HasInputCol, HasOutputCol):
                 # the first layer, so integer payloads cross the link raw
                 x = x.astype(deq_dtype) * deq_dtype(scale) \
                     + deq_dtype(offset)
+            if comp is not None:
+                # int8-compute: kernels dequantize into their matmuls
+                # (w_q -> f32 * scale -> activation dtype, fused by
+                # XLA — no dequantized copy persists), activations
+                # meet them as act_dtype with f32 MXU accumulation,
+                # and the reply comes back f32 so downstream serving
+                # surfaces never see a bf16 column
+                params = dequantize_param_tree(params, qscales,
+                                               comp.activation_dtype)
+                x = x.astype(act_dtype)
+                out = module.apply(params, x, output_layer=out_layer)
+                return out.astype(jnp.float32)
             return module.apply(params, x, output_layer=out_layer)
 
         return jax.jit(forward)
+
+    def quant_parity_report(self, df, rtol: Optional[float] = None
+                            ) -> Dict[str, Any]:
+        """Row-wise parity of the int8-compute forward against the f32
+        reference on one frame — the rollout verify step's evidence
+        (docs/serving.md "Quantization").
+
+        Both forwards run the PURE function (``module.apply``) on the
+        same dequantized input: the reference with the f32 tree, the
+        candidate with the int8 tree dequantized exactly as the served
+        forward does it. A row passes when every element satisfies
+        ``|q - ref| <= tol + tol * |ref|`` (``np.isclose`` with
+        ``atol = rtol = tol``): the tolerance bounds the RELATIVE
+        error on large outputs and the ABSOLUTE error on near-zero
+        ones — int8 weight error is additive at logit scale, so a
+        purely relative bound would fail any logit near zero on
+        noise. ``tol`` defaults to the config's ``tolerance``. The
+        two throwaway executables compile at stage time and are
+        dropped — the served forward's compile-once contract is
+        untouched."""
+        comp = self._compute_quant
+        if comp is None:
+            return {"passed": True, "rows": 0, "bad_rows": 0,
+                    "max_rel": 0.0, "rtol": None}
+        import jax.numpy as jnp
+        from mmlspark_tpu.serving.quant import dequantize_param_tree
+        out_layer = self._resolve_output_layer()
+        module = self.model.module()
+        scale, offset, deq_dtype = self._dequant_constants()
+        x = _stack_column(df[self.input_col]).astype(
+            self._transfer_dtype(), copy=False)
+        xj = jnp.asarray(x)
+        if jnp.issubdtype(xj.dtype, jnp.integer) \
+                or scale != 1.0 or offset != 0.0:
+            xj = xj.astype(deq_dtype) * deq_dtype(scale) \
+                + deq_dtype(offset)
+        ref = np.asarray(
+            module.apply(self.model.params, xj,
+                         output_layer=out_layer), np.float32)
+        qparams, qscales = self._quant_state
+        deq = dequantize_param_tree(qparams, qscales,
+                                    comp.activation_dtype)
+        got = np.asarray(
+            module.apply(deq, xj.astype(jnp.dtype(
+                comp.activation_dtype)), output_layer=out_layer),
+            np.float32)
+        tol = float(rtol if rtol is not None else comp.tolerance)
+        ok = np.isclose(got, ref, rtol=tol, atol=tol)
+        flat_ok = ok.reshape(len(ok), -1) if ok.ndim > 1 \
+            else ok.reshape(-1, 1)
+        row_ok = flat_ok.all(axis=1)
+        denom = np.maximum(np.abs(ref), 1.0)
+        max_rel = float(np.max(np.abs(got - ref) / denom)) \
+            if ref.size else 0.0
+        return {"passed": bool(row_ok.all()),
+                "rows": int(len(row_ok)),
+                "bad_rows": int((~row_ok).sum()),
+                "max_rel": max_rel, "rtol": tol}
 
     @functools.cached_property
     def _setup_sharded(self):
@@ -404,11 +515,12 @@ class NNModel(Model, HasInputCol, HasOutputCol):
             mesh = build_mesh(MeshSpec.from_dict(
                 {"data": n_dev // tp, "model": tp}))
             self._placement_mesh = mesh
-            return (dist.shard_state(self.model.params, mesh),
+            return (dist.shard_state(self._served_params, mesh),
                     batch_sharding(mesh), mesh.shape["data"])
         mesh = build_mesh()
         self._placement_mesh = mesh
-        return (jax.device_put(self.model.params, replicated_sharding(mesh)),
+        return (jax.device_put(self._served_params,
+                               replicated_sharding(mesh)),
                 batch_sharding(mesh), mesh.shape["data"])
 
     @functools.cached_property
@@ -573,6 +685,12 @@ class NNModel(Model, HasInputCol, HasOutputCol):
         from mmlspark_tpu.parallel import pad_to_bucket, round_to_multiple
         from mmlspark_tpu.parallel.pipeline import split_rows
 
+        if self._compute_quant is not None:
+            raise NotImplementedError(
+                "compute quantization with pipeline_parallel is not "
+                "wired: the stage split remaps params per slice and "
+                "would need per-stage scale trees — serve int8 compute "
+                "on the fused or tensor-parallel paths")
         runner, stage_data = self._setup_pipeline
         col = df[self.input_col]
         tdtype = self._transfer_dtype()
@@ -648,7 +766,8 @@ class NNModel(Model, HasInputCol, HasOutputCol):
         dev = jax.config.jax_default_device or jax.local_devices()[0]
         cache = self._setup_single_cache
         if dev not in cache:
-            cache[dev] = (jax.device_put(self.model.params, dev), None, 1)
+            cache[dev] = (jax.device_put(self._served_params, dev),
+                          None, 1)
         # remember that dispatch really happened (single-device), so
         # placement() can distinguish "served on one device" from
         # "never dispatched" — a thread race on this plain attribute

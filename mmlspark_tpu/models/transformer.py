@@ -1538,6 +1538,63 @@ def _check_decode_config(cfg: TransformerConfig) -> None:
             "MoE decodes via dense dispatch (_decode_ffn).")
 
 
+def _q_matmul(x, w_q, w_s, act_dtype=jnp.bfloat16):
+    """int8-weight matmul for the quantized decode FFN: ``x`` [T, I]
+    f32, ``w_q`` [I, O] int8, ``w_s`` [O] f32 per-output-channel
+    scales. The activation and the (exactly representable) int8
+    weights meet as ``act_dtype`` on the MXU with f32 accumulation
+    (``preferred_element_type``), and the scales fold into the f32
+    accumulator AFTER the contraction — one multiply per output
+    element, full scale precision. Returns f32 [T, O]."""
+    acc = jax.lax.dot_general(
+        x.astype(act_dtype), w_q.astype(act_dtype),
+        (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)
+    return acc * w_s
+
+
+def quantize_decode_ffn(params, cfg: TransformerConfig,
+                        scale_multiplier: float = 1.0):
+    """Per-channel int8 quantization of the decode FFN weights —
+    computed ONCE (rollout stage time), served forever.
+
+    For every stage's ``w1`` [s, D, F] / ``w2`` [s, F, D], symmetric
+    per-output-channel scales ``amax(|w|, axis=input) / 127`` (f32,
+    zero-channels guard to 1.0), weights rounded into ``w1_q``/
+    ``w2_q`` int8 with ``w1_s``/``w2_s`` scale vectors alongside; the
+    f32 originals are dropped from the returned tree (the HBM win —
+    biases and everything outside the FFN stay f32: rope, softmax,
+    attention, and the residual stream keep the reference numerics,
+    mirroring the ``cfg.dtype`` flow in the train path). MoE configs
+    are refused — dense dispatch re-runs every expert per token, so
+    there is no hot single matmul to win on yet.
+
+    ``scale_multiplier`` deliberately corrupts the stored scales when
+    != 1.0 — the chaos knob the rollout-verify tests use to prove a
+    broken quantized config fails parity and never flips."""
+    _check_decode_config(cfg)
+    if cfg.n_experts:
+        raise NotImplementedError(
+            "quantized decode FFN supports dense-MLP configs only")
+    out = dict(params)
+    blocks = []
+    for bp_all in params["blocks"]:
+        b = {k: v for k, v in bp_all.items()
+             if k not in ("w1", "w2")}
+        for name, axis in (("w1", 1), ("w2", 1)):
+            w = jnp.asarray(bp_all[name], jnp.float32)  # [s, I, O]
+            s = jnp.max(jnp.abs(w), axis=axis) / 127.0  # [s, O]
+            s = jnp.where(s > 0, s, 1.0)
+            q = jnp.clip(jnp.round(w / s[:, None, :]),
+                         -127, 127).astype(jnp.int8)
+            b[name + "_q"] = q
+            b[name + "_s"] = (s * float(scale_multiplier)
+                              ).astype(jnp.float32)
+        blocks.append(b)
+    out["blocks"] = blocks
+    return out
+
+
 def _decode_ffn(bp, h, cfg: TransformerConfig):
     """The decode paths' FFN over post-``ln2`` activations ``h``
     ([..., D] — [1, S, D] prefill, [N, D] step, [N, W, D] verify).
@@ -1564,17 +1621,31 @@ def _decode_ffn(bp, h, cfg: TransformerConfig):
             z = jax.nn.relu(hf @ bp["ew1"][e])
             y = y + (z @ bp["ew2"][e]) * sel[:, None]
         return y.reshape(shape)
+    if "w1_q" in bp:
+        # int8-compute FFN (quantize_decode_ffn): int8 weights meet
+        # bf16 activations on the MXU, f32 accumulate, per-channel
+        # dequant on the accumulator; biases and the residual add
+        # stay f32
+        z = jax.nn.relu(_q_matmul(hf, bp["w1_q"], bp["w1_s"])
+                        + bp["b1"])
+        return (_q_matmul(z, bp["w2_q"], bp["w2_s"])
+                + bp["b2"]).reshape(shape)
     z = jax.nn.relu(hf @ bp["w1"] + bp["b1"])
     return (z @ bp["w2"] + bp["b2"]).reshape(shape)
 
 
-def decode_param_specs(cfg: TransformerConfig, mesh) -> Dict[str, Any]:
+def decode_param_specs(cfg: TransformerConfig, mesh,
+                       quantized_ffn: bool = False) -> Dict[str, Any]:
     """PartitionSpec tree for the decode path's params under tensor
     parallelism: attention heads and the MLP hidden shard over the
     ``model`` axis (the Megatron split — each device holds its heads'
     K/V lanes and its hidden slice; XLA inserts the out-proj/MLP
     fan-in collectives), embed/head/norms replicated. Requires
-    ``n_heads`` and ``d_ff`` divisible by the model-axis size."""
+    ``n_heads`` and ``d_ff`` divisible by the model-axis size.
+    ``quantized_ffn`` describes a :func:`quantize_decode_ffn` tree:
+    the int8 weights take their f32 originals' split and each scale
+    vector shards with its matmul's OUTPUT channels (``w1_s`` over the
+    hidden like ``b1``, ``w2_s`` replicated like ``b2``)."""
     from jax.sharding import PartitionSpec as P
 
     _check_decode_config(cfg)
@@ -1603,6 +1674,13 @@ def decode_param_specs(cfg: TransformerConfig, mesh) -> Dict[str, Any]:
             b["router"] = P()
             b["ew1"] = P(None, None, None, model)
             b["ew2"] = P(None, None, model, None)
+        elif quantized_ffn:
+            b["w1_q"] = P(None, None, model)
+            b["w1_s"] = P(None, model)
+            b["b1"] = P(None, model)
+            b["w2_q"] = P(None, model, None)
+            b["w2_s"] = P()
+            b["b2"] = P()
         else:
             b["w1"] = P(None, None, model)
             b["b1"] = P(None, model)
@@ -1652,8 +1730,49 @@ def _decode_out_shardings(cache_sharding):
     return ({"k": cache_sharding, "v": cache_sharding}, repl, repl)
 
 
+def _make_inflight_attn(cfg: TransformerConfig, attn_impl: str,
+                        cache_sharding):
+    """Resolve the prefill builders' in-flight attention engine:
+    ``attn(q, k, v)`` over the [B, S, H, Dh] q/k/v a prefill just
+    computed. ``"dense"`` is the softmax path (the [S, S] score matrix
+    materializes), ``"pallas"`` the streaming flash kernel
+    (:func:`~mmlspark_tpu.parallel.pallas_attention.
+    flash_prefill_attention` — no [S, S] intermediate),
+    ``"pallas_interpret"`` the kernel interpreted for CPU parity.
+    Under a TP mesh the kernel runs per head-slice via ``shard_map``
+    (heads are independent — the decode kernel's dispatch, one shape
+    earlier in the request's life)."""
+    if attn_impl not in ("dense", "pallas", "pallas_interpret"):
+        raise ValueError(f"unknown attn_impl {attn_impl!r}")
+    scale = cfg.d_head ** -0.5
+    if attn_impl == "dense":
+        return lambda q, k, v: dense_attention(q, k, v, causal=True)
+    from mmlspark_tpu.parallel.pallas_attention import (
+        flash_prefill_attention)
+    interp = attn_impl == "pallas_interpret"
+    tp_mesh = None
+    if cache_sharding is not None \
+            and cache_sharding.mesh.shape.get(AXIS_MODEL, 1) > 1:
+        tp_mesh = cache_sharding.mesh
+
+    def attn(q, k, v):
+        if tp_mesh is None:
+            return flash_prefill_attention(q, k, v, scale, interp)
+        from jax.sharding import PartitionSpec as P
+        f = jax.shard_map(
+            lambda q_, k_, v_: flash_prefill_attention(
+                q_, k_, v_, scale, interp),
+            mesh=tp_mesh,
+            in_specs=(P(None, None, AXIS_MODEL, None),) * 3,
+            out_specs=P(None, None, AXIS_MODEL, None),
+            check_vma=False)
+        return f(q, k, v)
+
+    return attn
+
+
 def build_prefill(cfg: TransformerConfig, donate: bool = True,
-                  cache_sharding=None):
+                  cache_sharding=None, attn_impl: str = "dense"):
     """Jitted ``prefill(params, cache, tokens, slot, length) ->
     (cache, next_token, last_logits)``.
 
@@ -1667,8 +1786,10 @@ def build_prefill(cfg: TransformerConfig, donate: bool = True,
     writes in place, no second pool exists.
 
     ``next_token`` is the greedy argmax at position ``length - 1`` —
-    the first generated token."""
+    the first generated token. ``attn_impl`` picks the in-flight
+    attention engine (see :func:`_make_inflight_attn`)."""
     _check_decode_config(cfg)
+    attn = _make_inflight_attn(cfg, attn_impl, cache_sharding)
 
     def prefill(params, cache, tokens, slot, length):
         x = params["embed"][tokens][None]              # [1, S, D]
@@ -1684,7 +1805,7 @@ def build_prefill(cfg: TransformerConfig, donate: bool = True,
                 ck, k[0][None, None], (l, slot, 0, 0, 0))
             cv = jax.lax.dynamic_update_slice(
                 cv, v[0][None, None], (l, slot, 0, 0, 0))
-            a = dense_attention(q, k, v, causal=True)
+            a = attn(q, k, v)
             x = x + jnp.einsum("bshk,hkd->bsd", a, bp["wo"])
             x = x + _decode_ffn(bp, _rmsnorm(x, bp["ln2"]), cfg)
         h = _rmsnorm(x[0], params["final_norm"])       # [S, D]
@@ -1798,7 +1919,7 @@ def init_paged_kv_cache(cfg: TransformerConfig, n_pages: int,
 
 def build_paged_prefill(cfg: TransformerConfig, page_size: int,
                         pages_per_slot: int, donate: bool = True,
-                        cache_sharding=None):
+                        cache_sharding=None, attn_impl: str = "dense"):
     """Jitted ``prefill(params, cache, tokens, page_table, length) ->
     (cache, next_token, last_logits)`` — the paged analogue of
     :func:`build_prefill`.
@@ -1809,9 +1930,13 @@ def build_paged_prefill(cfg: TransformerConfig, page_size: int,
     the table: buckets >= ``page_size`` scatter whole page-shaped
     chunks, smaller buckets write one partial page. Chunks past the
     claimed page count ride the scratch-page convention (table entry
-    0), so bucket padding never corrupts another slot's pages."""
+    0), so bucket padding never corrupts another slot's pages.
+    ``attn_impl`` picks the in-flight attention engine (the cold
+    prefill attends over the q/k/v it just computed, not the pool —
+    see :func:`_make_inflight_attn`)."""
     _check_decode_config(cfg)
     page_size, pages_per_slot = int(page_size), int(pages_per_slot)
+    attn = _make_inflight_attn(cfg, attn_impl, cache_sharding)
 
     def prefill(params, cache, tokens, page_table, length):
         S = tokens.shape[0]
@@ -1838,7 +1963,7 @@ def build_paged_prefill(cfg: TransformerConfig, page_size: int,
                     ck, k[0][None, None], (l, page_table[0], 0, 0, 0))
                 cv = jax.lax.dynamic_update_slice(
                     cv, v[0][None, None], (l, page_table[0], 0, 0, 0))
-            a = dense_attention(q, k, v, causal=True)
+            a = attn(q, k, v)
             x = x + jnp.einsum("bshk,hkd->bsd", a, bp["wo"])
             x = x + _decode_ffn(bp, _rmsnorm(x, bp["ln2"]), cfg)
         h = _rmsnorm(x[0], params["final_norm"])       # [S, D]
@@ -1857,7 +1982,8 @@ def build_paged_prefill(cfg: TransformerConfig, page_size: int,
 
 def build_paged_prefix_prefill(cfg: TransformerConfig, page_size: int,
                                pages_per_slot: int, donate: bool = True,
-                               cache_sharding=None):
+                               cache_sharding=None,
+                               attn_impl: str = "dense"):
     """Jitted ``prefill(params, cache, tokens, page_table, length,
     hit_len) -> (cache, next_token, last_logits)`` — the **partial /
     offset** prefill behind the cross-request prefix cache
@@ -1887,12 +2013,52 @@ def build_paged_prefix_prefill(cfg: TransformerConfig, page_size: int,
     model rests on. ``next_token`` is the greedy argmax at virtual
     position ``length - 1`` (suffix row ``length - 1 - hit_len``;
     the cache layer caps ``hit_len < length``, so the last prompt
-    position is always computed, never cached)."""
+    position is always computed, never cached).
+
+    ``attn_impl`` picks the virtual-lane attention engine: ``"dense"``
+    gathers the whole lane through the table and softmaxes the [S, V]
+    score matrix; ``"pallas"`` runs the fused block-table kernel
+    (:func:`~mmlspark_tpu.parallel.pallas_attention.
+    paged_prefix_prefill_attention` — page DMAs aimed by scalar
+    prefetch, streaming softmax over (q-tile, page) steps, neither the
+    gathered lane nor the [S, V] scores ever reach HBM);
+    ``"pallas_interpret"`` is the CPU parity mode. Same scratch-page
+    overshoot semantics on every engine."""
     _check_decode_config(cfg)
     page_size, pages_per_slot = int(page_size), int(pages_per_slot)
     V = page_size * pages_per_slot
     scale = cfg.d_head ** -0.5
     idx = jnp.arange(V)
+    if attn_impl not in ("dense", "pallas", "pallas_interpret"):
+        raise ValueError(f"unknown attn_impl {attn_impl!r}")
+    use_flash = attn_impl in ("pallas", "pallas_interpret")
+    tp_mesh = None
+    if use_flash:
+        from mmlspark_tpu.parallel.pallas_attention import (
+            paged_prefix_prefill_attention)
+        if cache_sharding is not None \
+                and cache_sharding.mesh.shape.get(AXIS_MODEL, 1) > 1:
+            tp_mesh = cache_sharding.mesh
+
+    def _flash_lane_attn(q, k_pool, v_pool, page_table, hit_len):
+        interp = attn_impl == "pallas_interpret"
+        if tp_mesh is None:
+            return paged_prefix_prefill_attention(
+                q, k_pool, v_pool, page_table, hit_len, scale=scale,
+                page_size=page_size, interpret=interp)
+        from jax.sharding import PartitionSpec as P
+        f = jax.shard_map(
+            lambda q_, k_, v_, t_, h_: paged_prefix_prefill_attention(
+                q_, k_, v_, t_, h_, scale=scale, page_size=page_size,
+                interpret=interp),
+            mesh=tp_mesh,
+            in_specs=(P(None, AXIS_MODEL, None),
+                      P(None, None, AXIS_MODEL, None),
+                      P(None, None, AXIS_MODEL, None),
+                      P(None), P()),
+            out_specs=P(None, AXIS_MODEL, None),
+            check_vma=False)
+        return f(q, k_pool, v_pool, page_table, hit_len)
 
     def prefill(params, cache, tokens, page_table, length, hit_len):
         S = tokens.shape[0]
@@ -1901,7 +2067,10 @@ def build_paged_prefix_prefill(cfg: TransformerConfig, page_size: int,
         start_page = hit_len // page_size
         ck, cv = cache["k"], cache["v"]
         # query j at virtual row hit_len + j reads index <= hit_len + j
-        mask = idx[None, None, :] <= pos[:, None, None]  # [S, 1, V]
+        # (the flash kernel masks inside its (q-tile, page) steps — on
+        # that path no [S, V]-shaped value enters the jaxpr at all)
+        mask = None if use_flash \
+            else idx[None, None, :] <= pos[:, None, None]  # [S, 1, V]
         for l, bp in enumerate(_decode_block_params(params, cfg)):
             h = _rmsnorm(x, bp["ln1"])
             q = _rope_at(jnp.einsum("sd,dhk->shk", h, bp["wq"]), pos)
@@ -1940,12 +2109,18 @@ def build_paged_prefix_prefill(cfg: TransformerConfig, page_size: int,
                     cv, v[None, None], (l, pg, 0, 0, 0))
             # attend over the whole virtual lane: shared prefix rows
             # are read from their pages, suffix rows were just written
-            lk = ck[l, page_table].reshape(V, cfg.n_heads, cfg.d_head)
-            lv = cv[l, page_table].reshape(V, cfg.n_heads, cfg.d_head)
-            s = jnp.einsum("shk,vhk->shv", q, lk) * scale
-            s = jnp.where(mask, s, -1e30)
-            p = jax.nn.softmax(s, axis=-1)
-            a = jnp.einsum("shv,vhk->shk", p, lv)
+            if use_flash:
+                a = _flash_lane_attn(q, ck[l], cv[l], page_table,
+                                     hit_len)
+            else:
+                lk = ck[l, page_table].reshape(V, cfg.n_heads,
+                                               cfg.d_head)
+                lv = cv[l, page_table].reshape(V, cfg.n_heads,
+                                               cfg.d_head)
+                s = jnp.einsum("shk,vhk->shv", q, lk) * scale
+                s = jnp.where(mask, s, -1e30)
+                p = jax.nn.softmax(s, axis=-1)
+                a = jnp.einsum("shv,vhk->shk", p, lv)
             x = x + jnp.einsum("shk,hkd->sd", a, bp["wo"])
             x = x + _decode_ffn(bp, _rmsnorm(x, bp["ln2"]), cfg)
         h = _rmsnorm(x, params["final_norm"])          # [S, D]

@@ -1128,6 +1128,174 @@ def paged_attention_available() -> bool:
     return jax.default_backend() == "tpu"
 
 
+# ---------------------------------------------------------------------------
+# Flash prefill: streaming-softmax attention for the prefill builders
+# ---------------------------------------------------------------------------
+#
+# All three prefill builders in models/transformer.py historically
+# materialized the full score matrix through ``jax.nn.softmax`` — [S, S]
+# for the in-flight builders, [S, V] (V = pages_per_slot * page_size)
+# for the offset/prefix builder's whole-virtual-lane attention. At long
+# prompt buckets that intermediate dominates prefill HBM traffic the
+# same way the dense lane gather dominated decode. Two engines replace
+# it behind ``attn_impl``:
+#
+# * in-flight prefill (build_prefill / build_paged_prefill) attends
+#   over the q/k/v it just computed — :func:`flash_prefill_attention`,
+#   the (m, l, acc) streaming kernel above, normalized, forward-only;
+# * the prefix prefill attends over the slot's PAGED virtual lane —
+#   :func:`paged_prefix_prefill_attention` extends the
+#   ``paged_decode_attention`` scalar-prefetch idiom along the query
+#   axis: grid (q-tile, page), each page's DMA aimed by the table,
+#   running stats carried in VMEM scratch across pages, causal mask
+#   ``virtual_index <= hit_len + row`` — the scratch-page overshoot
+#   convention (dead pages skip compute; unclaimed entries aim at
+#   page 0 and are always dead) is preserved exactly.
+
+
+def flash_prefill_attention(q, k, v, scale=None,
+                            interpret: bool = False):
+    """Normalized causal flash self-attention for the in-flight
+    prefill path: ``q``/``k``/``v`` [B, S, H, Dh] -> [B, S, H, Dh],
+    forward-only, no [S, S] score matrix in HBM. Numerics match
+    ``dense_attention(q, k, v, causal=True)`` (same default
+    ``Dh**-0.5`` scale, f32 accumulation) to streaming-softmax
+    reassociation tolerance; token-for-token argmax parity is
+    test-pinned."""
+    return flash_attention(q, k, v, True, scale, interpret)
+
+
+def _paged_prefix_kernel(tbl_ref, hit_ref, q_ref, k_ref, v_ref, o_ref,
+                         acc, m_scr, l_scr, *, scale: float,
+                         page_size: int, s_real: int, q_tile: int):
+    """One (q-tile, page) step of prefix-prefill attention: queries
+    (H, TQ, Dh) at virtual positions ``hit_len + row`` against the
+    slot's p-th table page, streaming-softmax stats carried in VMEM
+    scratch across the page axis (the innermost grid dim)."""
+    i, p = pl.program_id(0), pl.program_id(1)
+
+    @pl.when(p == 0)
+    def _():
+        acc[:] = jnp.zeros_like(acc)
+        m_scr[:] = jnp.full_like(m_scr, _NEG_INF)
+        l_scr[:] = jnp.zeros_like(l_scr)
+
+    hit = hit_ref[0]
+    # bucket-pad rows past the real suffix clamp to the LAST real row:
+    # they become harmless duplicates (sliced off outside) and the
+    # dead-page liveness bound below stays exactly hit + s_real - 1 —
+    # padding never drags extra pages live
+    row = jnp.minimum(
+        i * q_tile + jax.lax.broadcasted_iota(jnp.int32,
+                                              (1, q_tile, 1), 1),
+        s_real - 1)
+    qpos = hit + row                                    # (1, TQ, 1)
+    base = p * page_size
+
+    # dead-page skip: the whole page starts past every query's
+    # position (every unclaimed scratch-aimed entry does) — the DMA
+    # was free-running but the compute is skipped
+    @pl.when(base <= hit + s_real - 1)
+    def _():
+        q = q_ref[:]                                    # (H, TQ, Dh)
+        k = k_ref[0]                                    # (page, H, Dh)
+        v = v_ref[0]
+        # per-head MXU scores: contract Dh, batch H -> (H, TQ, page)
+        s = jax.lax.dot_general(
+            q, k, (((2,), (2,)), ((0,), (1,))),
+            preferred_element_type=jnp.float32) * scale
+        idx = base + jax.lax.broadcasted_iota(
+            jnp.int32, (1, 1, page_size), 2)            # (1, 1, page)
+        mask = idx <= qpos                              # (1, TQ, page)
+        s = jnp.where(mask, s, _NEG_INF)
+        m_prev = m_scr[:]                               # (H, TQ, 1)
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=2, keepdims=True))
+        pw = jnp.where(mask, jnp.exp(s - m_new), 0.0)   # (H, TQ, page)
+        alpha = jnp.exp(m_prev - m_new)                 # (H, TQ, 1)
+        l_scr[:] = l_scr[:] * alpha + jnp.sum(pw, axis=2,
+                                              keepdims=True)
+        # P·V: contract the page axis, batch H -> (H, TQ, Dh)
+        acc[:] = acc[:] * alpha + jax.lax.dot_general(
+            pw, v.astype(jnp.float32), (((2,), (0,)), ((0,), (1,))),
+            preferred_element_type=jnp.float32)
+        m_scr[:] = m_new
+
+    @pl.when(p == pl.num_programs(1) - 1)
+    def _():
+        l_safe = jnp.maximum(l_scr[:], 1e-30)           # (H, TQ, 1)
+        o_ref[:] = (acc[:] / l_safe).astype(o_ref.dtype)
+
+
+@functools.partial(jax.jit, static_argnames=("scale", "page_size",
+                                             "interpret"))
+def paged_prefix_prefill_attention(q, k_pages, v_pages, page_table,
+                                   hit_len, scale: float,
+                                   page_size: int,
+                                   interpret: bool = False):
+    """Fused prefix-prefill attention for one layer of one slot.
+
+    ``q`` (S, H, Dh) — the suffix queries (rope applied at virtual
+    positions ``hit_len + j``); ``k_pages``/``v_pages``
+    (n_pages, page_size, H, Dh) — the layer's shared page pool AFTER
+    the suffix K/V scatter; ``page_table`` (pages_per_slot,) int32 —
+    the slot's full table (shared prefix pages first, then private
+    pages; unclaimed entries aim at scratch page 0); ``hit_len`` a
+    TRACED int32 scalar (hit depth is data, not shape). Returns the
+    normalized attention output (S, H, Dh) — numerically the dense
+    whole-virtual-lane gather+softmax path of
+    ``build_paged_prefix_prefill``, computed without ever
+    materializing the [S, V] score matrix or the gathered lane."""
+    s, h, d = q.shape
+    pps = page_table.shape[0]
+    # q tiles on the sublane axis: 128 for MXU-sized buckets, the
+    # 8-aligned minimum for short suffix buckets (Dh rides the lane
+    # axis unpadded, the decode kernel's convention)
+    q_tile = min(Q_TILE, _round_up(s, 8))
+    s_pad = _round_up(s, q_tile)
+    qt = jnp.pad(q.astype(jnp.float32), ((0, s_pad - s), (0, 0),
+                                         (0, 0))).transpose(1, 0, 2)
+    kernel = functools.partial(
+        _paged_prefix_kernel, scale=float(scale),
+        page_size=int(page_size), s_real=s, q_tile=q_tile)
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(s_pad // q_tile, pps),
+        in_specs=[
+            pl.BlockSpec((h, q_tile, d),
+                         lambda i_, p_, tbl, hl_: (0, i_, 0)),
+            # the paged gather: each page DMA aimed by the
+            # scalar-prefetched table, exactly the decode kernel's idiom
+            pl.BlockSpec((1, page_size, h, d),
+                         lambda i_, p_, tbl, hl_: (tbl[p_], 0, 0, 0)),
+            pl.BlockSpec((1, page_size, h, d),
+                         lambda i_, p_, tbl, hl_: (tbl[p_], 0, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((h, q_tile, d),
+                               lambda i_, p_, tbl, hl_: (0, i_, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((h, q_tile, d), jnp.float32),    # acc
+            pltpu.VMEM((h, q_tile, 1), jnp.float32),    # running max
+            pltpu.VMEM((h, q_tile, 1), jnp.float32),    # normalizer
+        ],
+    )
+    out = pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((h, s_pad, d), q.dtype),
+        interpret=interpret,
+    )(page_table.astype(jnp.int32),
+      jnp.reshape(hit_len, (1,)).astype(jnp.int32),
+      qt, k_pages, v_pages)
+    return out.transpose(1, 0, 2)[:s]
+
+
+def flash_prefill_available() -> bool:
+    """Whether the flash prefill kernels can run compiled on this
+    backend (TPU); everywhere else the dense-softmax paths are the
+    fallback and ``interpret=True`` serves the parity tests."""
+    return jax.default_backend() == "tpu"
+
+
 def folded_block_attn(q, k, v, scale, q_pos, k_pos, causal: bool,
                       interpret: bool = False):
     """:func:`flash_block_attn` twin in the folded layout: returns

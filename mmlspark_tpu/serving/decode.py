@@ -132,7 +132,8 @@ class TransformerDecoder:
                  draft_params=None, draft_cfg=None, spec_k: int = 4,
                  attn_impl: str = "auto",
                  verify_ce_impl: Optional[str] = None,
-                 prefix_cache: bool = True):
+                 prefix_cache: bool = True,
+                 quantized_ffn: bool = False):
         from mmlspark_tpu.models import transformer as T
         self.cfg = cfg
         self.n_slots = int(n_slots)
@@ -140,6 +141,16 @@ class TransformerDecoder:
         self.eos_id = eos_id
         self.mesh = mesh
         self.paged = bool(paged)
+        self.quantized_ffn = bool(quantized_ffn)
+        if self.quantized_ffn:
+            # int8-compute FFN (ISSUE 17 tentpole a): per-channel
+            # scales derived ONCE here — construction is rollout stage
+            # time, so the quantized tree warms/compiles pre-flip and
+            # serving never requantizes. Attention/rope/softmax/the
+            # residual stream stay f32 (quantize_decode_ffn docs);
+            # row-wise parity vs the f32 tree is the rollout verify's
+            # job, not an assumption.
+            params = T.quantize_decode_ffn(params, cfg)
         cache_sharding = None
         if mesh is not None:
             # tensor-parallel decode: ONE model + ONE KV pool span the
@@ -155,7 +166,9 @@ class TransformerDecoder:
             is_spec = lambda x: isinstance(x, PartitionSpec)  # noqa: E731
             p_sh = jax.tree.map(
                 lambda s: NamedSharding(mesh, s),
-                T.decode_param_specs(cfg, mesh), is_leaf=is_spec)
+                T.decode_param_specs(cfg, mesh,
+                                     quantized_ffn=self.quantized_ffn),
+                is_leaf=is_spec)
             params = jax.device_put(params, p_sh)
             cache_sharding = NamedSharding(mesh,
                                            T.decode_cache_spec(mesh))
@@ -207,9 +220,15 @@ class TransformerDecoder:
             self.attn_impl = attn_impl
             self.cache = T.init_paged_kv_cache(cfg, self.n_pages,
                                                self.page_size)
+            # the SAME resolved engine drives the prefill builders
+            # (ISSUE 17): "pallas" runs the streaming flash kernels —
+            # no [S, S] score matrix in the cold prefills, no [S, V]
+            # lane materialization in the offset/prefix prefill —
+            # "dense" keeps the softmax paths, interpret is CPU parity
             self._prefill = T.build_paged_prefill(
                 cfg, self.page_size, self.pages_per_slot,
-                donate=donate, cache_sharding=cache_sharding)
+                donate=donate, cache_sharding=cache_sharding,
+                attn_impl=attn_impl)
             self._step = T.build_paged_decode_step(
                 cfg, self.n_slots, self.page_size, self.pages_per_slot,
                 donate=donate, cache_sharding=cache_sharding,
@@ -223,7 +242,8 @@ class TransformerDecoder:
             self._prefix_prefill = (
                 T.build_paged_prefix_prefill(
                     cfg, self.page_size, self.pages_per_slot,
-                    donate=donate, cache_sharding=cache_sharding)
+                    donate=donate, cache_sharding=cache_sharding,
+                    attn_impl=attn_impl)
                 if prefix_cache else None)
             if 1 + self.n_slots * self.pages_per_slot <= self.n_pages:
                 self._identity_tables = (
@@ -2211,6 +2231,18 @@ class DecodeScheduler:
                 # block-table kernel, "dense" = the materialized-lane
                 # gather (CPU/mesh fallback)
                 "attn_impl": self.decoder.attn_impl,
+                # the prefill engine rides the same selection: under
+                # "pallas" the cold prefills run streaming flash
+                # attention (no [S, S] scores) and the prefix prefill
+                # the fused block-table kernel (no [S, V] lane); the
+                # non-paged decoder pins prefill to "dense"
+                "attn_impl_prefill": (
+                    self.decoder.attn_impl if self.decoder.paged
+                    else "dense"),
+                # int8-compute FFN: True when the served tree carries
+                # quantize_decode_ffn's int8 weights + scale vectors
+                "quantized_ffn": getattr(self.decoder,
+                                         "quantized_ffn", False),
                 "pages": pages,
                 # the cross-request prefix cache (None = disabled):
                 # radix hit counters, resident/evictable pages, and

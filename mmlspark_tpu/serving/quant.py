@@ -42,12 +42,177 @@ from typing import Any, Dict, List, Optional
 
 import numpy as np
 
-__all__ = ["QuantizationConfig"]
+__all__ = ["QuantizationConfig", "ComputeQuantization"]
 
 _WIRE_DTYPES = {
     "uint8": (np.uint8, 0, 255),
     "int8": (np.int8, -128, 127),
 }
+
+_ACT_DTYPES = ("bfloat16", "float32")
+
+
+class ComputeQuantization:
+    """The ON-DEVICE half of the quantization story (ISSUE 17): int8
+    weights / low-precision activations inside the matmuls themselves,
+    not just on the wire.
+
+    ``weight_dtype`` — ``"int8"`` (the only engine): every eligible
+    weight matrix is stored int8 in HBM with per-output-channel
+    symmetric scales (``amax(|w|) / 127`` over the input axes, f32,
+    computed ONCE at rollout stage time) and dequantizes into the
+    matmul — XLA fuses the ``w_q * scale`` into the contraction, the
+    int8->float cast is exact, and the accumulator stays f32.
+
+    ``activation_dtype`` — what the activations meet the weights as on
+    the MXU: ``"bfloat16"`` (the TPU-native fast path) or
+    ``"float32"`` (full-precision activations against int8 weights —
+    the conservative A/B arm). Softmax, normalization, and the
+    residual stream stay f32 either way, mirroring the train path's
+    ``cfg.dtype`` flow.
+
+    ``tolerance`` — the row-wise RELATIVE tolerance the quantized
+    plane must hold against the f32 reference: the rollout verify step
+    refuses to stage a config outside it (state -> ``error``, the
+    active version keeps serving — automatic rollback) and the
+    shadow-traffic comparator uses it instead of the exact-parity
+    default while an int8-compute version is staged.
+
+    ``scale_multiplier`` — a deliberate scale corruption (!= 1.0) for
+    chaos/rollback drills: the bench gate stages a broken config and
+    proves the verify step catches it BEFORE the flip.
+    """
+
+    __slots__ = ("weight_dtype", "activation_dtype", "tolerance",
+                 "scale_multiplier")
+
+    def __init__(self, weight_dtype: str = "int8",
+                 activation_dtype: str = "bfloat16",
+                 tolerance: float = 5e-2,
+                 scale_multiplier: float = 1.0):
+        if weight_dtype != "int8":
+            raise ValueError(
+                f"compute weight_dtype must be 'int8', got "
+                f"{weight_dtype!r}")
+        if activation_dtype not in _ACT_DTYPES:
+            raise ValueError(
+                f"compute activation_dtype must be one of "
+                f"{list(_ACT_DTYPES)}, got {activation_dtype!r}")
+        try:
+            tolerance = float(tolerance)
+            scale_multiplier = float(scale_multiplier)
+        except (TypeError, ValueError):
+            raise ValueError(
+                "compute tolerance/scale_multiplier must be numbers, "
+                f"got {tolerance!r} / {scale_multiplier!r}") from None
+        if not math.isfinite(tolerance) or tolerance <= 0.0:
+            raise ValueError(
+                f"compute tolerance must be finite and positive, got "
+                f"{tolerance!r}")
+        if not math.isfinite(scale_multiplier) \
+                or scale_multiplier == 0.0:
+            raise ValueError(
+                f"compute scale_multiplier must be finite and "
+                f"non-zero, got {scale_multiplier!r}")
+        self.weight_dtype = weight_dtype
+        self.activation_dtype = activation_dtype
+        self.tolerance = tolerance
+        self.scale_multiplier = scale_multiplier
+
+    @classmethod
+    def from_value(cls, value: Any) -> Optional["ComputeQuantization"]:
+        if value is None or isinstance(value, cls):
+            return value
+        if not isinstance(value, dict):
+            raise ValueError(
+                f"quantization compute must be a JSON object, got "
+                f"{type(value).__name__}")
+        unknown = set(value) - {"weight_dtype", "activation_dtype",
+                                "tolerance", "scale_multiplier"}
+        if unknown:
+            raise ValueError(
+                f"unknown quantization compute keys {sorted(unknown)}")
+        return cls(**value)
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {"weight_dtype": self.weight_dtype,
+                "activation_dtype": self.activation_dtype,
+                "tolerance": self.tolerance,
+                "scale_multiplier": self.scale_multiplier}
+
+    def __repr__(self) -> str:
+        return (f"ComputeQuantization("
+                f"weight_dtype={self.weight_dtype!r}, "
+                f"activation_dtype={self.activation_dtype!r}, "
+                f"tolerance={self.tolerance!r}, "
+                f"scale_multiplier={self.scale_multiplier!r})")
+
+    def __eq__(self, other) -> bool:
+        return isinstance(other, ComputeQuantization) and \
+            self.to_dict() == other.to_dict()
+
+
+def quantize_param_tree(params, comp: ComputeQuantization):
+    """Per-channel int8 quantization of a model param tree — the
+    scale-derivation step, run ONCE at rollout stage time.
+
+    Every eligible leaf (a ``kernel`` weight matrix of ndim >= 2 —
+    flax Dense ``(I, O)`` and Conv ``(..., I, O)`` kernels; biases,
+    norms, and everything 1-D stay f32) is replaced IN PLACE in the
+    returned tree by its int8 rounding under symmetric per-output-
+    channel scales ``amax(|w|, input axes) / 127`` (zero channels
+    guard to scale 1.0). The scales ride OUTSIDE the tree in a dict
+    keyed by the leaf's path string, so the quantized tree keeps the
+    exact structure placement/sharding machinery expects. The
+    config's ``scale_multiplier`` folds into the stored scales — the
+    deliberate-corruption knob the rollback drills stage."""
+    import jax
+
+    flat, treedef = jax.tree_util.tree_flatten_with_path(params)
+    scales: Dict[str, np.ndarray] = {}
+    leaves = []
+    for path, leaf in flat:
+        key = jax.tree_util.keystr(path)
+        arr = np.asarray(leaf)
+        name = str(path[-1]) if path else ""
+        if "kernel" in name and arr.ndim >= 2 \
+                and arr.dtype.kind == "f":
+            s = np.max(np.abs(arr), axis=tuple(range(arr.ndim - 1)))
+            s = (s / 127.0).astype(np.float32)
+            s = np.where(s > 0, s, np.float32(1.0))
+            q = np.clip(np.rint(arr / s), -127, 127).astype(np.int8)
+            scales[key] = (s * np.float32(comp.scale_multiplier))
+            leaves.append(q)
+        else:
+            leaves.append(leaf)
+    if not scales:
+        raise ValueError(
+            "compute quantization found no eligible kernel leaves in "
+            "the param tree — nothing would be quantized")
+    return jax.tree_util.tree_unflatten(treedef, leaves), scales
+
+
+def dequantize_param_tree(qparams, scales: Dict[str, np.ndarray],
+                          activation_dtype: str):
+    """The forward-time inverse: int8 kernels back to
+    ``activation_dtype`` via their per-channel scales (full-precision
+    f32 multiply first, one downcast after — XLA fuses the whole
+    dequant into the consuming matmul, so no dequantized copy persists
+    in HBM). Traced inside the jitted forward; the scale dict entries
+    become constants of the executable."""
+    import jax
+    import jax.numpy as jnp
+
+    act = jnp.dtype(activation_dtype)
+
+    def deq(path, leaf):
+        key = jax.tree_util.keystr(path)
+        s = scales.get(key)
+        if s is None:
+            return leaf
+        return (leaf.astype(jnp.float32) * s).astype(act)
+
+    return jax.tree_util.tree_map_with_path(deq, qparams)
 
 
 class QuantizationConfig:
@@ -55,27 +220,41 @@ class QuantizationConfig:
 
     ``wire_dtype`` — ``"uint8"`` or ``"int8"``: the integer dtype
     payload values are cast to for assembly + host->device transfer
-    (4x fewer bytes than f32, 2x than bf16).
+    (4x fewer bytes than f32, 2x than bf16). ``"none"`` leaves
+    payloads in their native float dtype — the compute-only shape
+    (``{"wire_dtype": "none", "compute": {...}}``) quantizes weights
+    on device without touching ingest.
 
     ``scale`` / ``zero_point`` — the on-device dequantization
     ``x * scale + zero_point``, fused into the model's first layer by
     XLA (for :class:`~mmlspark_tpu.models.nn.NNModel` via its
     ``input_scale``/``input_offset`` params). Defaults: ``1/255`` and
-    ``0.0`` — u8 images to ``[0, 1]``.
+    ``0.0`` — u8 images to ``[0, 1]`` (``1.0`` / ``0.0`` under
+    ``wire_dtype: "none"``, where there is no wire step to invert;
+    anything else there is refused).
 
     ``columns`` — the input columns the wire dtype applies to (None =
     every numeric input column; reply columns are never touched).
+
+    ``compute`` — optional :class:`ComputeQuantization`: int8 weights
+    / low-precision activations INSIDE the model's matmuls (the wire
+    fields above only cover ingest). None = f32 compute, the default.
     """
 
-    __slots__ = ("wire_dtype", "scale", "zero_point", "columns")
+    __slots__ = ("wire_dtype", "scale", "zero_point", "columns",
+                 "compute")
 
     def __init__(self, wire_dtype: str = "uint8",
-                 scale: float = 1.0 / 255.0, zero_point: float = 0.0,
-                 columns: Optional[List[str]] = None):
-        if wire_dtype not in _WIRE_DTYPES:
+                 scale: Optional[float] = None,
+                 zero_point: float = 0.0,
+                 columns: Optional[List[str]] = None,
+                 compute: Any = None):
+        if wire_dtype != "none" and wire_dtype not in _WIRE_DTYPES:
             raise ValueError(
-                f"wire_dtype must be one of {sorted(_WIRE_DTYPES)}, "
-                f"got {wire_dtype!r}")
+                f"wire_dtype must be one of "
+                f"{sorted(_WIRE_DTYPES) + ['none']}, got {wire_dtype!r}")
+        if scale is None:
+            scale = 1.0 if wire_dtype == "none" else 1.0 / 255.0
         try:
             scale = float(scale)
             zero_point = float(zero_point)
@@ -94,6 +273,14 @@ class QuantizationConfig:
             raise ValueError(
                 f"quantization zero_point must be finite, got "
                 f"{zero_point!r}")
+        if wire_dtype == "none" and (scale != 1.0
+                                     or zero_point != 0.0):
+            # no wire cast means no dequant step to invert — a
+            # non-identity scale here would silently rescale raw f32
+            # payloads
+            raise ValueError(
+                "wire_dtype 'none' requires scale=1.0/zero_point=0.0, "
+                f"got scale={scale!r} zero_point={zero_point!r}")
         if columns is not None:
             if not isinstance(columns, (list, tuple)) or \
                     not all(isinstance(c, str) for c in columns):
@@ -104,6 +291,7 @@ class QuantizationConfig:
         self.scale = scale
         self.zero_point = zero_point
         self.columns = columns
+        self.compute = ComputeQuantization.from_value(compute)
 
     # -- construction --------------------------------------------------------
 
@@ -121,7 +309,7 @@ class QuantizationConfig:
                 f"quantization must be a JSON object, got "
                 f"{type(value).__name__}")
         unknown = set(value) - {"wire_dtype", "scale", "zero_point",
-                                "columns"}
+                                "columns", "compute"}
         if unknown:
             raise ValueError(
                 f"unknown quantization keys {sorted(unknown)}")
@@ -131,6 +319,8 @@ class QuantizationConfig:
 
     @property
     def np_dtype(self) -> np.dtype:
+        if self.wire_dtype == "none":
+            return np.dtype(np.float32)
         return np.dtype(_WIRE_DTYPES[self.wire_dtype][0])
 
     def applies_to(self, column: str) -> bool:
@@ -142,7 +332,7 @@ class QuantizationConfig:
         standard quantized-tensor semantics; integer casts that WRAP
         would dispatch garbage for one out-of-range payload value).
         Non-numeric (object/string) columns pass through untouched."""
-        if arr.dtype == self.np_dtype:
+        if self.wire_dtype == "none" or arr.dtype == self.np_dtype:
             return arr
         if arr.dtype == np.dtype("O") or arr.dtype.kind not in "fiub":
             return arr
@@ -196,10 +386,21 @@ class QuantizationConfig:
         handle them as data."""
         if hasattr(model, "input_dtype") and \
                 hasattr(model, "input_scale"):
-            model.input_dtype = self.wire_dtype
+            # "none" = native float payloads: "auto" keeps the model's
+            # arch-driven transfer dtype and the identity scale/offset
+            # below make the input dequant a no-op
+            model.input_dtype = ("auto" if self.wire_dtype == "none"
+                                 else self.wire_dtype)
             model.input_scale = self.scale
             model.input_offset = self.zero_point
-            if getattr(model, "quantization", None) is not None \
+            if self.compute is not None:
+                # compute quantization lives ON the model (the int8
+                # tree + scales hang off model.quantization.compute) —
+                # a model staged without its own config must adopt
+                # this one or it serves f32 silently
+                if getattr(model, "quantization", None) != self:
+                    model.quantization = self
+            elif getattr(model, "quantization", None) is not None \
                     and model.quantization != self:
                 model.quantization = self
 
@@ -207,12 +408,14 @@ class QuantizationConfig:
 
     def to_dict(self) -> Dict[str, Any]:
         return {"wire_dtype": self.wire_dtype, "scale": self.scale,
-                "zero_point": self.zero_point, "columns": self.columns}
+                "zero_point": self.zero_point, "columns": self.columns,
+                "compute": (self.compute.to_dict()
+                            if self.compute is not None else None)}
 
     def __repr__(self) -> str:
         return (f"QuantizationConfig(wire_dtype={self.wire_dtype!r}, "
                 f"scale={self.scale!r}, zero_point={self.zero_point!r},"
-                f" columns={self.columns!r})")
+                f" columns={self.columns!r}, compute={self.compute!r})")
 
     def __eq__(self, other) -> bool:
         return isinstance(other, QuantizationConfig) and \

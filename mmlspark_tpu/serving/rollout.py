@@ -53,7 +53,8 @@ rollout before any flip.
 
 Fault points for chaos tests (``testing/faults``): a manager
 constructed with a ``fault_plan`` consults the sites ``rollout_load``,
-``rollout_verify``, ``rollout_warmup``, and ``rollout_flip``.
+``rollout_verify``, ``rollout_quant_verify`` (the int8-compute parity
+gate), ``rollout_warmup``, and ``rollout_flip``.
 
 See docs/serving.md "Zero-downtime rollout".
 """
@@ -87,7 +88,7 @@ class ModelVersion:
     __slots__ = ("version", "model", "source", "state", "error",
                  "digest_verified", "warmed_buckets", "shapes_seen",
                  "n_post_flip_recompiles", "created_unix", "flipped_unix",
-                 "quantization")
+                 "quantization", "quant_parity")
 
     def __init__(self, version: str, model: Any = None,
                  source: Optional[str] = None, state: str = "loading",
@@ -115,6 +116,10 @@ class ModelVersion:
         self.n_post_flip_recompiles = 0
         self.created_unix = time.time()
         self.flipped_unix: Optional[float] = None
+        #: the int8-compute staging gate's evidence (None until a
+        #: compute-quantized stage verifies): NNModel.
+        #: quant_parity_report's row-wise parity dict
+        self.quant_parity: Optional[Dict[str, Any]] = None
 
     def record_shape(self, key) -> None:
         """Count a dispatch shape against this version (GIL-atomic set
@@ -137,6 +142,7 @@ class ModelVersion:
             "warmed_buckets": list(self.warmed_buckets),
             "n_shapes": len(self.shapes_seen),
             "post_flip_recompiles": self.n_post_flip_recompiles,
+            "quant_parity": self.quant_parity,
             "created_unix": round(self.created_unix, 3),
             "flipped_unix": (round(self.flipped_unix, 3)
                              if self.flipped_unix is not None else None),
@@ -334,6 +340,17 @@ class ModelVersionManager:
                 # the model's on-device dequant must match the wire the
                 # dispatch stage will cast to — one config drives both
                 mv.quantization.configure_model(mv.model)
+            if mv.quantization is not None \
+                    and mv.quantization.compute is not None:
+                # int8-compute staging gate: the quantized forward must
+                # hold row-wise parity with the f32 reference within
+                # the config's tolerance BEFORE any warmup work — a
+                # broken scale config (or a model the quantization
+                # genuinely hurts) dies here, state -> "error", and the
+                # active version keeps serving: the automatic rollback
+                mv.state = "verifying"
+                self._fault("rollout_quant_verify")
+                self._verify_compute_quant(mv, warmup_payload)
             mv.state = "warming"
             self._fault("rollout_warmup")
             self._warm(mv, warmup_payload)
@@ -349,6 +366,57 @@ class ModelVersionManager:
             self.n_rollout_failures += 1
             logger.warning("staging model version %s failed: %s",
                            mv.version, mv.error)
+
+    def _verify_compute_quant(self, mv: ModelVersion,
+                              warmup_payload: Any) -> None:
+        """The int8-compute parity gate: score ONE reference frame
+        (the warmup payload at the smallest bucket) through the staged
+        model's quantized forward and its f32 reference, row-wise
+        within the config's tolerance (``NNModel.quant_parity_report``
+        — the same dequant math the served executable runs). Models
+        without the surface (no ``quant_parity_report``) refuse: a
+        compute section on a model that cannot honor it must not stage
+        silently."""
+        if not hasattr(mv.model, "quant_parity_report"):
+            raise RolloutError(
+                f"version {mv.version}: quantization.compute needs a "
+                f"model with the int8-compute surface "
+                f"(NNModel.quant_parity_report); "
+                f"{type(mv.model).__name__} has none")
+        srv = self._server
+        payload = warmup_payload if warmup_payload is not None \
+            else srv.warmup_payload
+        if payload is None:
+            raise RolloutError(
+                f"version {mv.version}: quantization.compute needs a "
+                "warmup payload to verify parity against (pass "
+                "warmup_payload, or warm the server once)")
+        if getattr(mv.model, "_compute_quant", None) is None:
+            # configure_model should have attached the config — a model
+            # that did not adopt it would pass a vacuous 0-row report
+            # and then serve f32
+            raise RolloutError(
+                f"version {mv.version}: model did not adopt the "
+                "compute quantization config (quantization.compute is "
+                "unset on the model)")
+        sizes = srv._bucket_sizes(model=mv.model)
+        df = srv._warmup_frame(payload, sizes[0], qc=mv.quantization)
+        report = mv.model.quant_parity_report(df)
+        mv.quant_parity = report
+        if not report["rows"]:
+            raise RolloutError(
+                f"version {mv.version}: int8-compute parity frame was "
+                "empty — nothing verified")
+        if not report["passed"]:
+            raise RolloutError(
+                f"version {mv.version}: int8-compute parity failed — "
+                f"{report['bad_rows']}/{report['rows']} rows outside "
+                f"rtol={report['rtol']} (max_rel={report['max_rel']:.4g})"
+            )
+        logger.info(
+            "model version %s int8-compute parity verified: %s rows "
+            "within rtol=%s (max_rel=%.4g)", mv.version,
+            report["rows"], report["rtol"], report["max_rel"])
 
     def _warm(self, mv: ModelVersion, warmup_payload: Any) -> None:
         """Dispatch one synthetic batch per shape bucket through the
@@ -522,7 +590,11 @@ class ModelVersionManager:
                 self._m_shadow_latency.observe(
                     (time.perf_counter() - t0) * 1000.0)
                 staged.record_shape(self._server._shape_key(df))
-                self._compare(df, out, shadow_out)
+                comp = (staged.quantization.compute
+                        if staged.quantization is not None else None)
+                self._compare(df, out, shadow_out,
+                              rtol=(comp.tolerance
+                                    if comp is not None else None))
                 self.n_shadow_batches += 1
                 # shadow-output sampling (the PR 7 follow-up): a
                 # bounded slice of each mirrored batch — inputs, live
@@ -540,16 +612,26 @@ class ModelVersionManager:
                 logger.warning("shadow dispatch on version %s failed: "
                                "%s", staged.version, e)
 
-    def _compare(self, df, live_out, shadow_out) -> None:
+    def _compare(self, df, live_out, shadow_out,
+                 rtol: Optional[float] = None) -> None:
         """Row-wise comparison over the columns the live model ADDED
         (the reply surface): numeric columns compare with a small
-        tolerance, everything else exactly."""
+        tolerance, everything else exactly. ``rtol`` widens the
+        numeric tolerance when the STAGED version quantizes compute
+        (its config's ``tolerance`` — int8-vs-f32 rows inside it are
+        the expected quantization step, not a mismatch; rows outside
+        it still count)."""
         cols = [c for c in live_out.columns
                 if c not in df.columns and c in shadow_out.columns]
         n = live_out.num_rows
         if not cols or n == 0:
             self.n_shadow_rows += n
             return
+        # under a compute-quantized staged version the tolerance bounds
+        # BOTH relative and absolute error (int8 weight noise is
+        # additive at logit scale — see NNModel.quant_parity_report)
+        num_rtol = 1e-5 if rtol is None else float(rtol)
+        num_atol = 1e-8 if rtol is None else float(rtol)
         mismatch = np.zeros(n, dtype=bool)
         for c in cols:
             a = np.asarray(live_out[c])
@@ -560,7 +642,8 @@ class ModelVersionManager:
             if a.dtype.kind in "fc" or b.dtype.kind in "fc":
                 bad = ~np.isclose(a.astype(np.float64),
                                   b.astype(np.float64),
-                                  rtol=1e-5, atol=1e-8, equal_nan=True)
+                                  rtol=num_rtol, atol=num_atol,
+                                  equal_nan=True)
             else:
                 bad = a != b
             mismatch |= bad.reshape(n, -1).any(axis=1)

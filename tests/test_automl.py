@@ -148,6 +148,7 @@ class TestSpaces:
 
 
 class TestTuneHyperparameters:
+    @pytest.mark.slow
     def test_random_search_cv(self):
         df = _binary_df(150)
         space = {"num_leaves": DiscreteHyperParam([3, 7]),

@@ -352,6 +352,7 @@ class TestTensorParallelDecode:
     CFG = T.TransformerConfig(vocab=64, d_model=16, n_heads=2, d_head=8,
                               d_ff=32, n_stages=1, layers_per_stage=2)
 
+    @pytest.mark.slow
     def test_tp_greedy_matches_single_device_flat_compiles(self):
         params = T.init_params(self.CFG, seed=0)
         prompt = np.asarray([3, 9, 11], np.int32)
@@ -518,6 +519,7 @@ class TestTensorParallelPagedAttention:
             pos[0] += 1
         return seq
 
+    @pytest.mark.slow
     def test_tp_pallas_interpret_matches_dense_gather(self):
         cfg = T.TransformerConfig(**self._CFG)
         params = T.init_params(cfg, seed=0)

@@ -103,18 +103,23 @@ class TestFusedCE:
             argnums=(0, 1))(h, w)
         assert gh1.dtype == gw1.dtype == jnp.float32
         np.testing.assert_allclose(float(l1), float(l0), rtol=0.02)
-        # calibrated against bf16's ~8-bit mantissa: probabilities carry
-        # ~4e-3 relative rounding; grads are prob-weighted sums over
-        # O(0.1)-scale inputs, so absolute error sits well under 1e-2
-        # while staying far above the f32 path's ~3e-5 (the assertion
-        # detects a precision REGRESSION, not noise)
-        np.testing.assert_allclose(np.asarray(gh1), np.asarray(gh0),
-                                   atol=8e-3)
-        np.testing.assert_allclose(np.asarray(gw1), np.asarray(gw0),
-                                   atol=8e-3)
-        # and the tolerance is tight enough to be meaningful: a fully
-        # broken backward (e.g. zero grads) is far outside it
-        assert float(jnp.abs(gh0).max()) > 8e-3
+        # calibrated against bf16's ~8-bit mantissa: probabilities
+        # carry ~4e-3 relative rounding, so the worst grad element
+        # lands ~0.5% of the reference grad's PEAK (measured 0.47%
+        # for dh, 0.63% for dW at this seed; the f32 path sits at
+        # ~1e-7). The bound is peak-RELATIVE — the mean reduction
+        # scales every grad by 1/t, so any absolute atol here either
+        # goes vacuous (atol > peak: even zero grads pass) or
+        # over-tightens the moment t changes. 2% = 3-4x margin over
+        # the measured bf16 error while a precision regression
+        # (fp16 accumulation, wrong-dtype rematerialization) or a
+        # broken backward (zero grads err at 100% of peak) is far
+        # outside it.
+        for got, ref in ((gh1, gh0), (gw1, gw0)):
+            peak = float(jnp.abs(ref).max())
+            assert peak > 0.0
+            err = float(jnp.abs(got - ref).max())
+            assert err <= 0.02 * peak, (err, peak)
 
     def test_inside_shard_map(self, rng):
         """Composes under VMA-checked shard_map: varying dh, psum'd
@@ -163,6 +168,7 @@ class TestTransformerFusedCE:
     _CFG = dict(vocab=256, d_model=128, n_heads=2, d_head=16, d_ff=64,
                 layers_per_stage=1)
 
+    @pytest.mark.slow
     def test_train_step_matches_golden_single_device(self):
         """ce_impl='fused_interpret' inside the SPMD step reproduces the
         unsharded reference_loss update exactly — params included
@@ -193,6 +199,7 @@ class TestTransformerFusedCE:
                              jax.device_get(sp), jax.device_get(ref_p))
         assert max(jax.tree_util.tree_leaves(diffs)) < 5e-5
 
+    @pytest.mark.slow
     def test_sharded_local_loss_grads_match_xla(self):
         """On a real multi-axis mesh, the fused kernel's local_loss
         gradients equal the XLA CE path's exactly (same psum structure,

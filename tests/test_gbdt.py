@@ -113,6 +113,7 @@ class TestObjectives:
 class TestBoosterQuality:
     """Benchmarks-style quality gates (values committed with precision)."""
 
+    @pytest.mark.slow
     def test_binary_auc_gate(self, breast_cancer):
         Xtr, ytr, Xte, yte = breast_cancer
         p = BoosterParams(objective="binary", num_iterations=60,
@@ -121,6 +122,7 @@ class TestBoosterQuality:
         auc = _auc(yte, b.predict(Xte))
         assert auc == pytest.approx(0.98, abs=0.02)  # gate: 0.98 +- 0.02
 
+    @pytest.mark.slow
     def test_rf_dart_goss_auc_gates(self, breast_cancer):
         Xtr, ytr, Xte, yte = breast_cancer
         gates = {"rf": 0.05, "dart": 0.03, "goss": 0.03}
@@ -203,6 +205,7 @@ class TestBoosterQuality:
 
 
 class TestBoosterMechanics:
+    @pytest.mark.slow
     def test_early_stopping(self, breast_cancer):
         Xtr, ytr, Xte, yte = breast_cancer
         p = BoosterParams(objective="binary", num_iterations=200,
@@ -257,6 +260,7 @@ class TestBoosterMechanics:
         np.testing.assert_allclose(serial.predict(Xte), feat.predict(Xte),
                                    rtol=1e-4, atol=1e-5)
 
+    @pytest.mark.slow
     def test_voting_parallel(self, breast_cancer):
         """With 2*top_k >= F voting selects every feature -> identical
         trees; with a small top_k it must still train a usable model."""
@@ -292,6 +296,7 @@ class TestBoosterMechanics:
         np.testing.assert_array_equal(b1.predict(Xte), b2.predict(Xte))
         assert roc_auc_score(yte, b1.predict(Xte)) > 0.95
 
+    @pytest.mark.slow
     def test_voting_small_leaves_high_index_features(self, rng):
         """Vote gains on small leaves must use shard-scaled gates: with
         all signal in HIGH-index features and leaves smaller than
@@ -622,6 +627,7 @@ class TestStages:
     def _df(self, X, y):
         return DataFrame({"features": X, "label": y})
 
+    @pytest.mark.slow
     def test_classifier_stage(self, breast_cancer, tmp_path):
         Xtr, ytr, Xte, yte = breast_cancer
         clf = GBDTClassifier(num_iterations=30, num_leaves=15,
@@ -720,6 +726,7 @@ class TestFusedEarlyStopping:
         np.testing.assert_allclose(b_fused.predict(Xv), b_host.predict(Xv),
                                    rtol=1e-4, atol=1e-5)
 
+    @pytest.mark.slow
     def test_fused_multiclass_early_stop(self, monkeypatch):
         rng = np.random.default_rng(5)
         X = rng.normal(size=(500, 6))

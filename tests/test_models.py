@@ -66,6 +66,7 @@ class TestNNFunction:
         with pytest.raises(KeyError):
             NNFunction(arch={"builder": "nope"}, params={}).module()
 
+    @pytest.mark.slow
     def test_imagenet_resnet_odd_width(self):
         """GroupNorm groups must divide channels for any width (e.g. 12)."""
         m = NNFunction.init({"builder": "imagenet_resnet", "depth": 50,

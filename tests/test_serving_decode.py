@@ -244,6 +244,7 @@ class TestSchedulerDirect:
             assert p.event.wait(timeout), "request stranded"
         return pendings
 
+    @pytest.mark.slow
     def test_greedy_tokens_match_reference(self):
         sched = DecodeScheduler(_decoder()).start()
         try:
@@ -1388,14 +1389,20 @@ class TestStreaming:
             # see the 200 head (stream live), then slam the socket
             assert b" 200 " in s.recv(4096)[:20]
             s.close()
+            # poll for the TERMINAL event (the disconnect release),
+            # not for a free pool: before the request claims its slot
+            # (admission can still be inside the prefill compile) the
+            # pool is trivially all-free and sampling the release
+            # ledger then is a race, not a check
             t_end = time.monotonic() + 15
             while time.monotonic() < t_end and \
-                    sched.pool.n_free != sched.decoder.n_slots:
+                    not sched.stats()["releases"].get(
+                        "disconnected", 0):
                 time.sleep(0.02)
-            assert sched.pool.n_free == sched.decoder.n_slots
-            assert _pages_idle(sched)
             assert sched.stats()["releases"].get(
                 "disconnected", 0) >= 1
+            assert sched.pool.n_free == sched.decoder.n_slots
+            assert _pages_idle(sched)
 
     def test_stream_stats_surface(self):
         with _serve() as srv:
@@ -1457,6 +1464,7 @@ class TestSpeculativeScheduler:
             assert p.event.wait(timeout), "stranded"
         return ps
 
+    @pytest.mark.slow
     def test_greedy_parity_and_acceptance(self):
         params, cfg, dec = _spec_setup()
         sched = DecodeScheduler(dec).start()
@@ -1601,6 +1609,7 @@ class TestReviewHardening:
         finally:
             fe.stop()
 
+    @pytest.mark.slow
     def test_draft_cache_stays_warm_through_suppressed_rounds(self):
         """Policy-suppressed rounds still advance the draft cache, so
         a probe round proposes from real rows and acceptance recovers

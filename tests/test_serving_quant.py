@@ -342,3 +342,169 @@ class TestTpAwareLadders:
         assert _model().batch_multiple == 1   # data_parallel off
         dp = NNModel(model=FN, input_col="x", output_col="y")
         assert dp.batch_multiple == max(n_dev, 1)
+
+
+class TestComputeQuant:
+    """The int8 on-device compute plane (ISSUE 17): per-channel weight
+    scales derived once, f32 accumulate, row-wise parity against the
+    f32 reference enforced at rollout stage time — and a corrupted
+    scale config refused BEFORE the flip, active version untouched."""
+
+    @staticmethod
+    def _qc(**kw):
+        return QuantizationConfig.from_value(
+            {"wire_dtype": "none",
+             "compute": dict({"weight_dtype": "int8",
+                              "activation_dtype": "bfloat16"}, **kw)})
+
+    @pytest.mark.parametrize("bad", [
+        {"weight_dtype": "int4"},
+        {"activation_dtype": "float16"},
+        {"tolerance": 0.0},
+        {"tolerance": -1.0},
+        {"tolerance": "wide"},
+        {"scale_multiplier": 0.0},
+        {"scale_multiplier": float("nan")},
+        {"surprise": 1},
+    ])
+    def test_malformed_compute_configs_refused(self, bad):
+        with pytest.raises(ValueError):
+            self._qc(**bad)
+
+    def test_wire_none_requires_identity_transform(self):
+        # "none" means payloads stay native floats: a scale or
+        # zero-point would silently never be applied
+        for bad in ({"scale": 0.5}, {"zero_point": 1.0}):
+            with pytest.raises(ValueError, match="none"):
+                QuantizationConfig.from_value(
+                    dict({"wire_dtype": "none"}, **bad))
+        qc = self._qc()
+        assert qc.wire_dtype == "none"
+        assert qc.compute.activation_dtype == "bfloat16"
+
+    def test_param_tree_roundtrip_per_channel(self):
+        from mmlspark_tpu.serving.quant import (
+            dequantize_param_tree, quantize_param_tree,
+        )
+        rng = np.random.default_rng(0)
+        w = rng.normal(size=(6, 4)).astype(np.float32)
+        w[:, 1] *= 40.0                      # wildly uneven channels
+        tree = {"dense": {"kernel": w,
+                          "bias": np.ones(4, np.float32)}}
+        qt, scales = quantize_param_tree(tree, self._qc().compute)
+        assert qt["dense"]["kernel"].dtype == np.int8
+        assert qt["dense"]["bias"].dtype == np.float32   # untouched
+        (key, s), = scales.items()
+        assert "kernel" in key and s.shape == (4,)
+        np.testing.assert_allclose(
+            s, np.max(np.abs(w), axis=0) / 127.0, rtol=1e-6)
+        deq = dequantize_param_tree(qt, scales, "float32")
+        # rounding error is bounded by half a quantization step,
+        # PER CHANNEL — the whole point of per-channel scales
+        err = np.abs(np.asarray(deq["dense"]["kernel"]) - w)
+        assert (err <= s[None, :] * 0.5 + 1e-6).all()
+        # the corruption knob folds into the STORED scales
+        _, s_broken = quantize_param_tree(
+            tree, self._qc(scale_multiplier=2.0).compute)
+        np.testing.assert_allclose(next(iter(s_broken.values())),
+                                   s * 2.0, rtol=1e-6)
+
+    def test_no_eligible_leaves_refused(self):
+        from mmlspark_tpu.serving.quant import quantize_param_tree
+        with pytest.raises(ValueError, match="eligible"):
+            quantize_param_tree({"bias": np.zeros(3, np.float32)},
+                                self._qc().compute)
+
+    def test_configure_model_wires_native_wire_and_config(self):
+        m = _model(input_dtype="float32")
+        qc = self._qc()
+        qc.configure_model(m)
+        assert m.input_dtype == "auto"       # no wire cast on "none"
+        assert m.quantization is qc
+        assert m._compute_quant is qc.compute
+
+    @pytest.mark.parametrize("act", ["bfloat16", "float32"])
+    def test_quantized_forward_tracks_f32_reference(self, act):
+        rng = np.random.default_rng(1)
+        x = rng.normal(size=(16, D_IN)).astype(np.float32)
+        ref = np.vstack(_model(input_dtype="float32")
+                        .transform(DataFrame({"x": x}))["y"])
+        m = _model(input_dtype="float32")
+        self._qc(activation_dtype=act).configure_model(m)
+        got = np.vstack(m.transform(DataFrame({"x": x}))["y"])
+        tol = m._compute_quant.tolerance
+        assert np.isclose(got, ref, rtol=tol, atol=tol).all()
+
+    def test_parity_report_passes_and_catches_corruption(self):
+        rng = np.random.default_rng(2)
+        df = DataFrame({"x": rng.normal(size=(12, D_IN))
+                        .astype(np.float32)})
+        m = _model(input_dtype="float32")
+        self._qc().configure_model(m)
+        report = m.quant_parity_report(df)
+        assert report["passed"] and report["rows"] == 12
+        assert report["bad_rows"] == 0
+        broken = _model(input_dtype="float32")
+        self._qc(scale_multiplier=9.0).configure_model(broken)
+        report = broken.quant_parity_report(df)
+        assert not report["passed"]
+        assert report["bad_rows"] > 0
+
+    def test_rollout_verifies_then_flips_without_recompiles(self):
+        with ServingServer(_model(input_dtype="float32"),
+                           max_latency_ms=0, max_batch_size=8,
+                           verify_checkpoints=False) as srv:
+            srv.warmup({"x": [0.5] * D_IN})
+            out = srv.versions.stage(
+                model=_model(input_dtype="float32"), version="v2q",
+                quantization={"wire_dtype": "none",
+                              "compute": {"weight_dtype": "int8"}},
+                sync=True)
+            assert out["state"] == "staged", out["error"]
+            assert out["quant_parity"]["passed"]
+            assert out["quant_parity"]["rows"] > 0
+            srv.versions.flip()
+            active = srv.versions.active
+            assert active.version == "v2q"
+            for n in (1, 3, 8):
+                r = requests.post(srv.address,
+                                  json={"x": [0.1 * n] * D_IN},
+                                  timeout=30)
+                assert r.status_code == 200
+            assert active.n_post_flip_recompiles == 0
+
+    def test_broken_scales_refused_before_flip(self):
+        with ServingServer(_model(input_dtype="float32"),
+                           max_latency_ms=0, max_batch_size=8,
+                           verify_checkpoints=False) as srv:
+            srv.warmup({"x": [0.5] * D_IN})
+            out = srv.versions.stage(
+                model=_model(input_dtype="float32"), version="v2-bad",
+                quantization={"wire_dtype": "none",
+                              "compute": {"weight_dtype": "int8",
+                                          "scale_multiplier": 9.0}},
+                sync=True)
+            assert out["state"] == "error"
+            assert "parity" in out["error"]
+            assert srv.versions.active.version != "v2-bad"
+            assert srv.versions.n_rollout_failures == 1
+            # the active f32 plane never stopped serving
+            r = requests.post(srv.address, json={"x": [0.5] * D_IN},
+                              timeout=30)
+            assert r.status_code == 200
+
+    def test_compute_config_needs_the_model_surface(self):
+        class Plain:
+            def transform(self, df):
+                return df
+
+        with ServingServer(Plain(), max_latency_ms=0, max_batch_size=8,
+                           verify_checkpoints=False) as srv:
+            srv.warmup({"x": 0.5})
+            out = srv.versions.stage(
+                model=Plain(), version="v2",
+                quantization={"wire_dtype": "none",
+                              "compute": {"weight_dtype": "int8"}},
+                sync=True)
+            assert out["state"] == "error"
+            assert "quant_parity_report" in out["error"]

@@ -686,6 +686,7 @@ class TestRolloutHistoryRing:
         assert fleet.stats["bad"] == 0
         assert fleet.stats["errors"] == []
 
+    @pytest.mark.slow
     def test_ring_is_bounded_and_keeps_failures(self, tmp_path):
         """Capacity evicts oldest-first, and a FAILED run stays in the
         ring — the audit trail an operator reads after an incident."""

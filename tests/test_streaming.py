@@ -99,6 +99,7 @@ class TestProfiling:
             time.sleep(0.01)
         assert span["seconds"] >= 0.01
 
+    @pytest.mark.slow
     def test_device_trace_writes(self, tmp_path):
         import jax.numpy as jnp
         from mmlspark_tpu.core.profiling import device_trace

@@ -60,6 +60,7 @@ class TestRingAttention:
                                    atol=2e-5)
 
     @pytest.mark.parametrize("causal", [True, False])
+    @pytest.mark.slow
     def test_folded_ring_is_differentiable(self, rng, causal):
         """block_impl='folded' is TRAINING-grade: a custom VJP over the
         whole ring (backward = a second ring pass with (dk, dv)
@@ -158,6 +159,7 @@ class TestFlashAttentionVJP:
                                        atol=5e-5, err_msg=f"d{name}")
 
     @pytest.mark.parametrize("causal", [True, False])
+    @pytest.mark.slow
     def test_folded_value_and_grads_match_dense(self, rng, causal):
         """The feature-major (folded) kernel — the engine the train
         bench runs at S=1024/dh=64 — against dense, value + grads."""
@@ -297,6 +299,7 @@ class TestSpmdTrainStep:
         assert max(jax.tree_util.tree_leaves(diffs)) == 0.0
 
     @pytest.mark.parametrize("capacity", [0.0, 4.0])
+    @pytest.mark.slow
     def test_top2_routing_matches_golden(self, capacity):
         # Mixtral-style top-2 (renormalized weights), dense AND capacity
         # dispatch, must equal the unsharded golden on the expert mesh
@@ -364,6 +367,7 @@ class TestSpmdTrainStep:
         ({"expert": 2}, 2), ({"data": 2}, 2),
         ({"data": 2, "expert": 2}, 4),
     ])
+    @pytest.mark.slow
     def test_expert_choice_matches_golden(self, mesh_shape, groups):
         """Expert-choice routing (experts pick top-C tokens — balanced
         by construction): the sharded step must equal the group-wise
@@ -695,6 +699,7 @@ class TestSlotDecode:
         return jnp.asarray(out)
 
     @pytest.mark.parametrize("plen", [1, 3, 7, 8])
+    @pytest.mark.slow
     def test_greedy_decode_matches_full_context(self, plen):
         params, cache, prefill, step = self._build()
         rng = np.random.default_rng(plen)
@@ -1177,3 +1182,134 @@ class TestVerifyScores:
     def test_engine_resolution(self):
         # CPU backend: auto always resolves to xla (fused needs TPU)
         assert T.verify_ce_engine(self.CFG, 64, 8) == "xla"
+
+
+class TestFlashPrefill:
+    """The streaming-softmax Pallas prefill kernel (ISSUE 17): every
+    prefill builder's flash engine must be token-for-token (and
+    cache-row-for-cache-row) equal to its dense engine, including
+    offset/partial prefix prefill and the scratch-page overshoot
+    convention — interpret mode is the CPU parity contract."""
+
+    CFG = T.TransformerConfig(**_DENSE, layers_per_stage=2)
+    PS, PPS = 8, 4
+
+    @pytest.mark.parametrize("s", [1, 5, 16, 63])
+    def test_kernel_matches_dense_attention(self, rng, s):
+        from mmlspark_tpu.parallel.pallas_attention import (
+            flash_prefill_attention)
+        from mmlspark_tpu.parallel.ring_attention import dense_attention
+        q, k, v = (jnp.asarray(rng.normal(size=(2, s, 3, 8)),
+                               jnp.float32) for _ in range(3))
+        ref = dense_attention(q, k, v, causal=True)
+        got = flash_prefill_attention(q, k, v, interpret=True)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                                   atol=1e-5, rtol=1e-5)
+
+    @pytest.mark.parametrize("plen", [3, 8, 13])
+    def test_cold_prefill_parity_both_layouts(self, rng, plen):
+        cfg = self.CFG
+        params = T.init_params(cfg, seed=0)
+        prompt = rng.integers(1, cfg.vocab, size=plen).astype(np.int32)
+        bucket = 1
+        while bucket < plen:
+            bucket *= 2
+        pad = np.zeros(bucket, np.int32)
+        pad[:plen] = prompt
+        outs = {}
+        for impl in ("dense", "pallas_interpret"):
+            # slot-lane layout
+            f = T.build_prefill(cfg, donate=False, attn_impl=impl)
+            _, nxt, logits = f(params, T.init_kv_cache(cfg, 2, 32),
+                               jnp.asarray(pad), jnp.int32(0),
+                               jnp.int32(plen))
+            # paged layout
+            fp = T.build_paged_prefill(cfg, self.PS, self.PPS,
+                                       donate=False, attn_impl=impl)
+            cache, pnxt, plogits = fp(
+                params, T.init_paged_kv_cache(cfg, 1 + self.PPS,
+                                              self.PS),
+                jnp.asarray(pad),
+                jnp.arange(1, 1 + self.PPS, dtype=jnp.int32),
+                jnp.int32(plen))
+            outs[impl] = (int(nxt), np.asarray(logits), int(pnxt),
+                          np.asarray(plogits), np.asarray(cache["k"]))
+        d, fl = outs["dense"], outs["pallas_interpret"]
+        assert d[0] == fl[0] and d[2] == fl[2]
+        np.testing.assert_allclose(fl[1], d[1], atol=1e-4, rtol=1e-4)
+        np.testing.assert_allclose(fl[3], d[3], atol=1e-4, rtol=1e-4)
+        # the K/V the decode steps will read are identical rows
+        np.testing.assert_allclose(fl[4], d[4], atol=1e-5, rtol=1e-5)
+
+    @pytest.mark.parametrize("pps,hit_pages,suffix", [
+        (4, 1, 11), (4, 2, 5),
+        # hit 4 pages + suffix bucket 32 reaches past the 7-page lane:
+        # the overflow chunk must ride scratch page 0, never re-aim at
+        # a shared page
+        (7, 4, 17)])
+    def test_prefix_offset_prefill_parity(self, rng, pps, hit_pages,
+                                          suffix):
+        """Offset prefill over shared pages, including the bucket-
+        overshoot shape."""
+        cfg = self.CFG
+        params = T.init_params(cfg, seed=0)
+        hit = hit_pages * self.PS
+        length = hit + suffix
+        assert length <= self.PS * pps
+        prompt = rng.integers(1, cfg.vocab,
+                              size=length).astype(np.int32)
+        bucket = 1
+        while bucket < suffix:
+            bucket *= 2
+        pad = np.zeros(bucket, np.int32)
+        pad[:suffix] = prompt[hit:]
+        table = jnp.arange(1, 1 + pps, dtype=jnp.int32)
+        # shared prefix pages: a dense full prefill of the whole
+        # prompt wrote them (the cache invariant: shared pages ARE a
+        # previous cold prefill's output) — run as an offset prefill
+        # at hit 0, which handles overshooting prompt buckets too
+        cold = T.build_paged_prefix_prefill(cfg, self.PS, pps,
+                                            donate=False)
+        pbucket = 1
+        while pbucket < length:
+            pbucket *= 2
+        ppad = np.zeros(pbucket, np.int32)
+        ppad[:length] = prompt
+        warm_cache, cold_nxt, cold_logits = cold(
+            params, T.init_paged_kv_cache(cfg, 1 + pps, self.PS),
+            jnp.asarray(ppad), table, jnp.int32(length), jnp.int32(0))
+        outs = {}
+        for impl in ("dense", "pallas_interpret"):
+            f = T.build_paged_prefix_prefill(cfg, self.PS, pps,
+                                             donate=False,
+                                             attn_impl=impl)
+            cache, nxt, logits = f(params, warm_cache,
+                                   jnp.asarray(pad), table,
+                                   jnp.int32(length), jnp.int32(hit))
+            outs[impl] = (int(nxt), np.asarray(logits),
+                          np.asarray(cache["k"]))
+        d, fl = outs["dense"], outs["pallas_interpret"]
+        assert d[0] == fl[0]
+        np.testing.assert_allclose(fl[1], d[1], atol=1e-4, rtol=1e-4)
+        np.testing.assert_allclose(fl[2], d[2], atol=1e-5, rtol=1e-5)
+        # offset prefill is EXACT, not approximate: both engines land
+        # on the cold full-prefill's next token, and neither rewrote a
+        # shared prefix page (rows outside the lane rode scratch)
+        assert d[0] == int(cold_nxt)
+        np.testing.assert_allclose(fl[1], np.asarray(cold_logits),
+                                   atol=1e-4, rtol=1e-4)
+        shared = np.asarray(warm_cache["k"])[:, 1:1 + hit_pages]
+        np.testing.assert_array_equal(
+            fl[2][:, 1:1 + hit_pages], shared)
+
+    def test_unknown_impl_refused_on_every_builder(self):
+        for build in (lambda: T.build_prefill(self.CFG,
+                                              attn_impl="tensor"),
+                      lambda: T.build_paged_prefill(
+                          self.CFG, self.PS, self.PPS,
+                          attn_impl="tensor"),
+                      lambda: T.build_paged_prefix_prefill(
+                          self.CFG, self.PS, self.PPS,
+                          attn_impl="tensor")):
+            with pytest.raises(ValueError, match="attn_impl"):
+                build()

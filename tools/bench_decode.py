@@ -274,6 +274,23 @@ def main() -> int:
         gates["paged_2x_sessions_at_fixed_hbm"] = paged["passed"]
         gates["speculative_speedup"] = spec["passed"]
         gates["prefix_cache_prefill_speedup"] = prefix["passed"]
+        # the ISSUE 17 raw-speed pair: Pallas flash prefill (no [S,S]
+        # score matrix, token parity incl. offset prefill) and int8
+        # on-device compute staged through rollout verify/rollback
+        flash = _bench.bench_prefill_flash()
+        qc = _bench.bench_quantized_compute()
+        out["prefill_flash"] = {k: flash[k] for k in
+                                ("value", "baseline", "vs_baseline",
+                                 "attn_impl", "token_parity",
+                                 "no_ss_in_jaxpr",
+                                 "post_warmup_recompiles", "passed")}
+        out["quantized_compute"] = {k: qc[k] for k in
+                                    ("value", "baseline",
+                                     "vs_baseline", "live_parity_ok",
+                                     "post_flip_recompiles",
+                                     "rollback_drill", "passed")}
+        gates["prefill_flash"] = flash["passed"]
+        gates["quantized_compute"] = qc["passed"]
     out["gates"] = gates
     out["passed"] = all(gates.values())
     print(json.dumps(out, indent=2))

@@ -85,7 +85,7 @@ def _nn_model(wire_dtype: str = "float32"):
                           "num_outputs": 4}, input_shape=(8,), seed=0)
     kw = {}
     if wire_dtype != "float32":
-        # the quantized wire (docs/serving.md "The quantized wire"):
+        # the quantized wire (docs/serving.md "Quantization"):
         # one config drives the server-side cast AND the on-device
         # dequant fused into the model's first layer
         from mmlspark_tpu.serving import QuantizationConfig
