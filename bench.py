@@ -88,6 +88,12 @@ Plus (no era analogue, utilization/latency evidence):
                                    across processes, cooperative
                                    2-process sharded save restored
                                    bit-exact by 1 process
+ 21. slo_overhead_v1             — SLO-plane cost: per-token decode
+                                   timeline stamping (budget 1 us/
+                                   token) + one full burn-rate
+                                   evaluate() over an hour of history
+                                   (off hot path; scrape-interval
+                                   budget)
 
 Every line carries chip metadata (platform/device kind/count) so the
 numbers are interpretable across hosts.
@@ -1398,6 +1404,113 @@ def bench_trace_propagation():
             "chip": _chip()}
 
 
+def bench_slo_overhead():
+    """SLO-plane overhead (ISSUE 18 acceptance gate): the decode
+    timeline's per-token stamping cost and a full burn-rate
+    ``evaluate()`` over a populated history.
+
+    Two numbers, two budgets:
+
+    * **stamping** — the hot-loop timeline cost per emitted token is
+      two attribute stores, a list append, and a counter bump (the
+      TTFT/TPOT histograms are fed once per request at ``_finish``,
+      never per token); budget <= 1 us/token, the same gate the
+      perf-marked test pins.
+    * **evaluation** — one ``SLOEngine.evaluate()`` pass over the full
+      default worker policy set with an hour of 5 s samples in
+      history; it runs only when ``GET /alerts`` / ``GET /slo`` asks,
+      so the budget is scrape-interval scale: <= 50 ms (it measures in
+      the tens of MICROseconds).
+
+    ``vs_baseline`` = stamping budget / measured; ``passed`` gates
+    BOTH budgets.
+    """
+    import threading
+
+    from mmlspark_tpu.core.resilience import ManualClock
+    from mmlspark_tpu.core.telemetry import MetricsRegistry
+    from mmlspark_tpu.models import transformer as T
+    from mmlspark_tpu.serving import DecodeScheduler, TransformerDecoder
+    from mmlspark_tpu.serving.decode import _DecodeRequest
+    from mmlspark_tpu.serving.slo import SLOEngine, SLOPolicy
+
+    # -- stamping: mirror tests/test_serving_slo.py TestStampingBudget
+    cfg = T.TransformerConfig(vocab=64, d_model=16, n_heads=1,
+                              d_head=16, d_ff=32, n_stages=1,
+                              layers_per_stage=1)
+    decoder = TransformerDecoder(T.init_params(cfg, seed=0), cfg,
+                                 n_slots=2, max_len=16)
+    sched = DecodeScheduler(decoder)
+
+    class _Pending:
+        def __init__(self):
+            self.payload = {"prompt": [1]}
+            self.rid = "bench"
+            self.deadline = None
+            self.event = threading.Event()
+            self.callbacks = []
+            self.reply = None
+            self.status = 200
+            self.span = None
+            self.trace = "bench"
+
+    req = _DecodeRequest(_Pending(),
+                         *sched.parse({"prompt": [1, 2, 3],
+                                       "max_new_tokens": 4}))
+    n = 200_000
+    best = float("inf")
+    for _ in range(5):
+        t0 = time.perf_counter_ns()
+        for _ in range(n):
+            t = 1.0
+            req.t_last = t
+            req.produced.append(7)
+            sched.n_tokens += 1
+        best = min(best, (time.perf_counter_ns() - t0) / n)
+        del req.produced[:]
+    stamp_ns = best
+
+    # -- evaluation: availability + TTFT-latency policies over an hour
+    # of history, counters AND histogram buckets moving every sample
+    clock = ManualClock()
+    reg = MetricsRegistry(clock=clock)
+    total = reg.counter("req_total", "t.", labels=("worker",))
+    bad = reg.counter("err_total", "e.", labels=("worker",))
+    ttft = reg.histogram("ttft_ms", "f.", labels=("route",))
+    eng = SLOEngine(reg, [
+        SLOPolicy("availability", "availability", 0.999,
+                  total_metric="req_total", bad_metric="err_total"),
+        SLOPolicy("ttft", "latency", 0.95, metric="ttft_ms",
+                  threshold_ms=500.0),
+    ], clock=clock)
+    for i in range(720):                     # 1 h of 5 s samples
+        total.labels(f"w{i % 3}").inc(50)
+        if i % 40 == 0:
+            bad.labels(f"w{i % 3}").inc(1)
+        ttft.labels("decode").observe(120.0 + (i % 7) * 90.0)
+        clock.advance(5.0)
+        eng.evaluate()
+    t0 = time.perf_counter_ns()
+    rounds = 200
+    for _ in range(rounds):
+        clock.advance(5.0)
+        eng.evaluate()
+    eval_us = (time.perf_counter_ns() - t0) / rounds / 1e3
+
+    stamp_budget_ns = 1000.0
+    eval_budget_us = 50_000.0
+    ok = stamp_ns < stamp_budget_ns and eval_us < eval_budget_us
+    return {"metric": "slo_overhead_v1",
+            "value": round(stamp_ns, 1), "unit": "ns/token_stamp",
+            "evaluate_us": round(eval_us, 1),
+            "eval_budget_us": eval_budget_us,
+            "history_samples": 720, "n_policies": 2,
+            "baseline": stamp_budget_ns,
+            "vs_baseline": round(stamp_budget_ns / max(stamp_ns, 1e-9),
+                                 3),
+            "passed": ok, "chip": _chip()}
+
+
 def bench_decode_continuous():
     """Continuous batching for autoregressive decode vs the static
     whole-batch baseline (ISSUE 9 acceptance gate).
@@ -2524,7 +2637,8 @@ BENCHES = [bench_gbdt_quantile, bench_adult_census, bench_cifar10_scoring,
            bench_transformer_train,
            bench_transformer_train_long, bench_moe_train,
            bench_telemetry_overhead, bench_tracing_overhead,
-           bench_trace_propagation, bench_decode_continuous,
+           bench_trace_propagation, bench_slo_overhead,
+           bench_decode_continuous,
            bench_decode_paged, bench_decode_speculative,
            bench_decode_prefix_cache,
            bench_prefill_flash, bench_quantized_compute,

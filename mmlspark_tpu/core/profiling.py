@@ -143,3 +143,230 @@ def process_rss_bytes() -> Optional[int]:
         return int(peak if sys.platform == "darwin" else peak * 1024)
     except Exception:  # noqa: BLE001 — vitals are best-effort
         return None
+
+
+# ---------------------------------------------------------------------------
+# On-demand device profiling + always-on compute accounting (ISSUE 18)
+# ---------------------------------------------------------------------------
+
+def device_memory_stats() -> Dict[str, int]:
+    """HBM accounting straight from the runtime allocator of local
+    device 0: live bytes, the high-water mark since process start, and
+    the allocator's limit. Empty dict on backends that do not expose
+    ``memory_stats`` (CPU) — callers gauge 0s, they never fail."""
+    try:
+        import jax
+        stats = jax.local_devices()[0].memory_stats()
+    except Exception:  # noqa: BLE001 — vitals are best-effort
+        return {}
+    if not stats:
+        return {}
+    return {"bytes_in_use": int(stats.get("bytes_in_use", 0)),
+            "peak_bytes": int(stats.get("peak_bytes_in_use", 0)),
+            "bytes_limit": int(stats.get("bytes_limit", 0))}
+
+
+class ProfilerBusy(RuntimeError):
+    """A capture window is already running (one at a time, by design:
+    concurrent jax profiler sessions abort the process)."""
+
+
+class DeviceProfiler:
+    """Guarded one-at-a-time ``jax.profiler`` capture windows.
+
+    ``start_window`` kicks off a background daemon thread that opens a
+    trace, sleeps the requested window, and closes it — the caller
+    (a ``POST /profile`` handler on the event loop) returns
+    immediately with the target directory. A second request while a
+    window is open raises :class:`ProfilerBusy` (the route 409s).
+    Output loads in TensorBoard / Perfetto / XProf.
+    """
+
+    def __init__(self, base_dir: Optional[str] = None):
+        import os
+        import tempfile
+        import threading
+        self.base_dir = base_dir or os.path.join(
+            tempfile.gettempdir(), "mmlspark_tpu_profiles")
+        self._lock = threading.Lock()
+        self._active: Optional[Dict[str, object]] = None
+        self.last: Optional[Dict[str, object]] = None
+        self.n_captures = 0
+        self.n_errors = 0
+
+    def start_window(self, duration_s: float = 1.0,
+                     log_dir: Optional[str] = None) -> Dict[str, object]:
+        """Begin one capture window; returns ``{log_dir, duration_s,
+        started_unix}``. Raises :class:`ProfilerBusy` while a prior
+        window is open."""
+        import os
+        import threading
+        duration_s = float(duration_s)
+        with self._lock:
+            if self._active is not None:
+                raise ProfilerBusy(
+                    f"capture already running: {self._active}")
+            if log_dir is None:
+                log_dir = os.path.join(
+                    self.base_dir,
+                    time.strftime("%Y%m%d-%H%M%S"))
+            info: Dict[str, object] = {
+                "log_dir": log_dir, "duration_s": duration_s,
+                "started_unix": time.time()}
+            self._active = info
+        t = threading.Thread(target=self._run, args=(info,),
+                             daemon=True, name="device-profile")
+        t.start()
+        return dict(info)
+
+    def _run(self, info: Dict[str, object]) -> None:
+        try:
+            import jax
+            jax.profiler.start_trace(str(info["log_dir"]))
+            try:
+                time.sleep(float(info["duration_s"]))  # the window
+            finally:
+                jax.profiler.stop_trace()
+            info["ok"] = True
+            with self._lock:
+                self.n_captures += 1
+        except Exception as exc:  # noqa: BLE001 — report, don't die
+            info["ok"] = False
+            info["error"] = f"{type(exc).__name__}: {exc}"
+            with self._lock:
+                self.n_errors += 1
+            from mmlspark_tpu.core.logs import get_logger
+            get_logger("profiling").warning(
+                "device trace capture failed", exc_info=True)
+        finally:
+            info["finished_unix"] = time.time()
+            with self._lock:
+                self.last = dict(info)
+                self._active = None
+
+    @property
+    def busy(self) -> bool:
+        with self._lock:
+            return self._active is not None
+
+    def status(self) -> Dict[str, object]:
+        with self._lock:
+            return {"busy": self._active is not None,
+                    "active": dict(self._active) if self._active else None,
+                    "last": dict(self.last) if self.last else None,
+                    "n_captures": self.n_captures,
+                    "n_errors": self.n_errors,
+                    "base_dir": self.base_dir}
+
+
+class CompileLedger:
+    """Bounded ring of compile events (a new dispatch shape = a jit
+    retrace). One ``note()`` per retrace — by construction off the
+    steady-state hot path, since steady state means zero retraces."""
+
+    def __init__(self, cap: int = 64):
+        import collections
+        import threading
+        self._events: "collections.deque" = collections.deque(
+            maxlen=int(cap))
+        self._lock = threading.Lock()
+        self.n_events = 0
+
+    def note(self, kind: str, shape: str, duration_ms: float,
+             **extra: object) -> None:
+        ev = {"kind": kind, "shape": shape,
+              "duration_ms": round(float(duration_ms), 3),
+              "at_unix": round(time.time(), 3)}
+        ev.update(extra)
+        with self._lock:
+            self._events.append(ev)
+            self.n_events += 1
+
+    def events(self) -> list:
+        with self._lock:
+            return list(self._events)
+
+    def snapshot(self) -> Dict[str, object]:
+        with self._lock:
+            return {"n_events": self.n_events,
+                    "events": list(self._events)}
+
+
+#: peak dense bf16 TFLOP/s per chip, by ``device_kind`` — the MFU
+#: denominator (same table as ``bench.py``; unknown kinds report
+#: flops/s without a utilization ratio)
+_PEAK_BF16_TFLOPS: Dict[str, float] = {
+    "TPU v4": 275.0,
+    "TPU v5 lite": 197.0,
+    "TPU v5e": 197.0,
+    "TPU v5p": 459.0,
+    "TPU v6 lite": 918.0,
+    "TPU v6e": 918.0,
+}
+
+
+class MfuMeter:
+    """Always-on per-bucket MFU estimation.
+
+    ``note(bucket, seconds, flops)`` accumulates dispatch wall-clock
+    per shape bucket and, when the model exposes a flops count for the
+    bucket (``dispatch_flops(df)`` hook or ``cost_analysis``), keeps an
+    EWMA of achieved flops/s and its ratio to the chip's peak. Without
+    flops it still reports per-bucket seconds — the time side of the
+    accounting is never conditional on the model cooperating.
+    """
+
+    def __init__(self, peak_tflops: Optional[float] = None,
+                 alpha: float = 0.2):
+        import threading
+        self._lock = threading.Lock()
+        self.alpha = float(alpha)
+        self.peak_flops: Optional[float] = (
+            peak_tflops * 1e12 if peak_tflops is not None else None)
+        self.device_kind: Optional[str] = None
+        if peak_tflops is None:
+            try:
+                from mmlspark_tpu.core.environment import (
+                    environment_info,
+                )
+                kind = environment_info().get("device_kind")
+                self.device_kind = kind
+                peak = _PEAK_BF16_TFLOPS.get(str(kind))
+                if peak is not None:
+                    self.peak_flops = peak * 1e12
+            except Exception:  # noqa: BLE001 — accounting is optional
+                pass
+        self._buckets: Dict[object, Dict[str, float]] = {}
+
+    def note(self, bucket: object, seconds: float,
+             flops: Optional[float] = None) -> None:
+        with self._lock:
+            row = self._buckets.get(bucket)
+            if row is None:
+                row = self._buckets[bucket] = {
+                    "count": 0, "seconds": 0.0, "flops_per_s": None}
+            row["count"] += 1
+            row["seconds"] += float(seconds)
+            if flops and seconds > 0:
+                achieved = float(flops) / float(seconds)
+                prev = row["flops_per_s"]
+                row["flops_per_s"] = (
+                    achieved if prev is None
+                    else prev + self.alpha * (achieved - prev))
+
+    def snapshot(self) -> Dict[str, object]:
+        with self._lock:
+            buckets = {}
+            for bucket, row in self._buckets.items():
+                out = {"count": int(row["count"]),
+                       "seconds": round(row["seconds"], 4)}
+                fps = row["flops_per_s"]
+                if fps is not None:
+                    out["tflops_per_s"] = round(fps / 1e12, 3)
+                    if self.peak_flops:
+                        out["mfu"] = round(fps / self.peak_flops, 4)
+                buckets[str(bucket)] = out
+            return {"device_kind": self.device_kind,
+                    "peak_tflops": (round(self.peak_flops / 1e12, 1)
+                                    if self.peak_flops else None),
+                    "buckets": buckets}

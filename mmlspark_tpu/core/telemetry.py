@@ -1202,3 +1202,55 @@ def trace_id_from_headers(headers) -> str:
     and journal lines) or mint a fresh one."""
     raw = headers.get(TRACE_HEADER) if headers is not None else None
     return sanitize_trace_id(raw) or new_trace_id()
+
+
+# ---------------------------------------------------------------------------
+# Build info
+# ---------------------------------------------------------------------------
+
+_BUILD_INFO: Optional[Dict[str, str]] = None
+
+
+def build_info() -> Dict[str, str]:
+    """The process's build identity — framework/jax/jaxlib versions
+    and the accelerator kind — computed once (the jax import and
+    device query are not free) and shared by every registration."""
+    global _BUILD_INFO
+    if _BUILD_INFO is None:
+        from mmlspark_tpu.version import __version__
+        info = {"version": __version__, "jax": "none",
+                "jaxlib": "none", "device_kind": "none"}
+        try:
+            import jax
+            info["jax"] = jax.__version__
+            try:
+                import jaxlib
+                info["jaxlib"] = getattr(jaxlib, "__version__",
+                                         jax.__version__)
+            except Exception:
+                info["jaxlib"] = jax.__version__
+            devices = jax.devices()
+            if devices:
+                info["device_kind"] = str(devices[0].device_kind)
+        except Exception:  # pragma: no cover - jax always importable
+            pass
+        _BUILD_INFO = info
+    return dict(_BUILD_INFO)
+
+
+def register_build_info(registry: MetricsRegistry,
+                        frontend: str = "none") -> Dict[str, str]:
+    """Stamp the ``serving_build_info`` gauge (constant 1; identity in
+    the labels — the Prometheus ``*_build_info`` convention) into
+    ``registry`` and return the label dict for ``/stats`` echo.
+    ``frontend`` distinguishes the serving edge in play (``eventloop``
+    / ``threaded`` / ``coordinator``)."""
+    info = build_info()
+    info["frontend"] = str(frontend)
+    g = registry.gauge(
+        "serving_build_info",
+        "Constant 1; build identity in the labels.",
+        labels=("version", "jax", "jaxlib", "device_kind", "frontend"))
+    g.labels(info["version"], info["jax"], info["jaxlib"],
+             info["device_kind"], info["frontend"]).set(1.0)
+    return info

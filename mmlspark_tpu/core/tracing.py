@@ -397,6 +397,14 @@ class Tracer:
         self._record(sp)
         return sp
 
+    def event(self, name: str, t: float, parent: Span,
+              **attrs) -> Span:
+        """Record an instant (zero-duration) event under ``parent`` —
+        a point on the timeline rather than an interval: a decode
+        request's first emitted token, an alert transition. Renders as
+        an ordinary span with ``t0 == t1``."""
+        return self.add(name, t, t, parent, **attrs)
+
     def span(self, name: str, **attrs) -> "_SpanScope":
         """Scoped span: nests under the ambient span, binds itself (and
         its trace id) for the block, finishes on exit — with status

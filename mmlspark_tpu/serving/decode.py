@@ -1080,6 +1080,17 @@ class PrefixCache:
                 "ledger_clean": self.ledger_clean()}
 
 
+#: tokens-per-request histogram ladder (powers of two): its own edges,
+#: NOT the latency buckets — the registry rejects bucket mismatches
+#: per metric name, so the ladder is explicit here
+TOKENS_PER_REQUEST_BUCKETS = (1.0, 2.0, 4.0, 8.0, 16.0, 32.0, 64.0,
+                              128.0, 256.0, 512.0, 1024.0)
+
+#: per-request cap on timeline spans (first_token + spec_round events)
+#: so a 100k-token decode cannot flood the flight recorder ring
+_MAX_TIMELINE_SPANS = 128
+
+
 class _DecodeRequest:
     """Per-request decode state, riding alongside the server's
     ``_PendingRequest`` (``pending`` — reply/status/event/callbacks/
@@ -1087,6 +1098,7 @@ class _DecodeRequest:
 
     __slots__ = ("pending", "prompt", "max_new", "produced", "slot",
                  "cancelled", "t_submit", "t_prefill", "t_decode",
+                 "t_first", "t_last", "n_timeline",
                  "sampler", "spec", "pages", "hit_len")
 
     def __init__(self, pending, prompt: np.ndarray, max_new: int,
@@ -1111,6 +1123,11 @@ class _DecodeRequest:
         self.t_submit: float = 0.0
         self.t_prefill: float = 0.0
         self.t_decode: float = 0.0
+        # token-level timeline stamps (scheduler clock): first emitted
+        # token and the latest emit — TTFT/TPOT fall out at _finish
+        self.t_first: float = 0.0
+        self.t_last: float = 0.0
+        self.n_timeline = 0                 # timeline spans recorded
 
     @property
     def stream(self):
@@ -1222,6 +1239,9 @@ class DecodeScheduler:
         self.spec_proposal_logp = None
         self.n_spec_accepted = 0
         self.releases: Dict[str, int] = {}   # finish_reason -> count
+        # goodput: tokens delivered by CLEAN finishes (eos/length) —
+        # the numerator; n_tokens stays the all-reasons denominator
+        self.n_goodput_tokens = 0
         # tenancy hooks (wired by bind() against the server's
         # registry): slot-release EWMA feeds honest decode-429
         # Retry-After; the fair cycle orders slot claims per tenant
@@ -1232,6 +1252,9 @@ class DecodeScheduler:
         self._m_step = None
         self._m_spec_round = None
         self._m_queue_wait = None
+        self._m_ttft = None
+        self._m_tpot = None
+        self._m_tokens_req = None
         if registry is not None:
             self._register_metrics(registry)
 
@@ -1345,6 +1368,62 @@ class DecodeScheduler:
         self._m_queue_wait = m.histogram(
             "serving_decode_queue_wait_ms",
             "Submit -> slot-claim wait per decode request.")
+        # token-level decode timelines (ISSUE 18): observed once per
+        # request at _finish — EVERY release reason, not just clean EOS
+        self._m_ttft = m.histogram(
+            "serving_decode_ttft_ms",
+            "Time-to-first-token: admit -> first emitted token "
+            "(socket-edge stamp for streamed replies).",
+            labels=("route", "tenant"))
+        self._m_tpot = m.histogram(
+            "serving_decode_tpot_ms",
+            "Time-per-output-token: mean inter-token gap after the "
+            "first.", labels=("route", "tenant"))
+        self._m_tokens_req = m.histogram(
+            "serving_decode_tokens_per_request",
+            "Tokens delivered per request, by finish reason.",
+            labels=("reason",), buckets=TOKENS_PER_REQUEST_BUCKETS)
+        m.counter("serving_decode_goodput_tokens_total",
+                  "Tokens delivered by clean finishes (eos/length) — "
+                  "the goodput numerator; serving_decode_tokens_total "
+                  "is the all-reasons denominator."
+                  ).set_function(lambda: self.n_goodput_tokens)
+        if self.pages is not None:
+            m.gauge("serving_decode_kv_pool_bytes",
+                    "Live bytes held by the paged KV pool."
+                    ).set_function(self._cache_bytes)
+        if self.prefix is not None:
+            m.gauge("serving_decode_prefix_cache_bytes",
+                    "Bytes held by prefix-cache resident pages."
+                    ).set_function(
+                lambda: self._cache_bytes()
+                * self.prefix.n_cached // max(self.pages.n_pages, 1))
+
+    def _cache_bytes(self) -> int:
+        """Exposition-time view: bytes of the decoder's KV tree."""
+        try:
+            from mmlspark_tpu.parallel.dist import tree_bytes
+            return int(tree_bytes(self.decoder.cache))
+        except Exception:  # noqa: BLE001 — a view must never raise
+            return 0
+
+    def _timeline_labels(self, req: _DecodeRequest
+                         ) -> "tuple[str, str]":
+        """``(route, tenant)`` labels for the timeline histograms.
+        Route is the server's decode path; the tenant label rides the
+        tenancy registry's BoundedLabelSet so an unbounded tenant
+        population collapses into 'other' instead of minting children
+        without bound."""
+        route = "decode"
+        tenant = ANONYMOUS_ID
+        srv = self._server
+        if srv is not None:
+            route = getattr(srv, "decode_path", None) or route
+            ten = getattr(srv, "tenancy", None)
+            tid = getattr(req.pending, "tenant", None)
+            if ten is not None and tid:
+                tenant = ten.label_of(tid)
+        return route, tenant
 
     # -- admission (any thread) ----------------------------------------------
 
@@ -1595,12 +1674,37 @@ class DecodeScheduler:
             self._by_rid.pop(req.pending.rid, None)
             self.releases[reason] = self.releases.get(reason, 0) + 1
         p = req.pending
+        # token-level timeline: EVERY release reason lands in the
+        # histograms — cancel/deadline/preempt/fault partial counts
+        # included, so goodput can never undercount failure modes
+        n = len(req.produced)
+        clean = reason in ("eos", "length")
+        if clean:
+            self.n_goodput_tokens += n
+        if self._m_tokens_req is not None:
+            self._m_tokens_req.labels(reason).observe(float(n))
+        if n > 0 and req.t_first > 0.0 and self._m_ttft is not None:
+            route, tenant = self._timeline_labels(req)
+            t_first = req.t_first
+            # streamed replies prefer the SOCKET-EDGE stamp (first
+            # chunk actually written to the client) — comparable to
+            # t_submit only on the real monotonic clock
+            s_edge = getattr(req.stream, "t_first", 0.0) or 0.0
+            if s_edge > 0.0 and self.clock is SYSTEM_CLOCK:
+                t_first = s_edge
+            self._m_ttft.labels(route, tenant).observe(
+                max(t_first - req.t_submit, 0.0) * 1000.0)
+            if n >= 2 and req.t_last >= req.t_first:
+                self._m_tpot.labels(route, tenant).observe(
+                    (req.t_last - req.t_first) / (n - 1) * 1000.0)
         # emitted tokens billed to the owning tenant exactly once, at
         # resolution (partial emissions from preempts/faults included)
         tid = getattr(p, "tenant", None)
         if tid and req.produced and self._server is not None \
                 and getattr(self._server, "tenancy", None) is not None:
-            self._server.tenancy.note_tokens(tid, len(req.produced))
+            self._server.tenancy.note_tokens(tid, n)
+            if clean:
+                self._server.tenancy.note_goodput_tokens(tid, n)
         if status == 200:
             p.status = 200
             body = {"tokens": req.produced,
@@ -1855,6 +1959,16 @@ class DecodeScheduler:
             req.hit_len = hit_len
             req.produced.append(first)
             self.n_tokens += 1
+            # the first token exists HERE (prefill emits it): stamp
+            # both timeline marks and drop the instant event on the
+            # request's span so /trace/<id> shows the cadence start
+            req.t_first = t1
+            req.t_last = t1
+            if req.n_timeline < _MAX_TIMELINE_SPANS:
+                req.n_timeline += 1
+                self._add_span(
+                    req, "first_token", t1, t1,
+                    ttft_ms=round((t1 - req.t_submit) * 1000.0, 3))
             self._tokens[slot] = first
             self._pos[slot] = len(req.prompt)
             with self._lock:
@@ -2021,6 +2135,7 @@ class DecodeScheduler:
                    else req.sampler.sample(logits_np[slot]))
             req.produced.append(tok)
             self.n_tokens += 1
+            req.t_last = t1          # one store/token: the TPOT stamp
             self._pos[slot] += 1
             self._tokens[slot] = tok
             self._emit_stream(req, [tok])
@@ -2110,10 +2225,11 @@ class DecodeScheduler:
                 # its single step
                 tok = (int(out_tok[slot, 0]) if req.sampler is None
                        else req.sampler.sample(logits_np[slot, 0]))
-                self._accept_tokens(req, slot, [tok])
+                self._accept_tokens(req, slot, [tok], t_emit=t1)
                 continue
             self.n_spec_proposed += k
             round_proposed += k
+            acc_before = round_accepted
             emitted: List[int] = []
             if req.sampler is None:
                 for j in range(k):
@@ -2142,17 +2258,28 @@ class DecodeScheduler:
                     emitted.append(smp.draw(resid / tot) if tot > 0
                                    else smp.draw(p_t))
                     break
-            self._accept_tokens(req, slot, emitted)
+            # per-round timeline span: the token cadence a /trace/<id>
+            # tree shows (bounded per request — see _MAX_TIMELINE_SPANS)
+            if req.n_timeline < _MAX_TIMELINE_SPANS:
+                req.n_timeline += 1
+                self._add_span(req, "spec_round", t0, t1,
+                               proposed=k,
+                               accepted=round_accepted - acc_before,
+                               emitted=len(emitted))
+            self._accept_tokens(req, slot, emitted, t_emit=t1)
         if self.spec_policy is not None:
             self.spec_policy.note(round_proposed, round_accepted)
 
     def _accept_tokens(self, req: _DecodeRequest, slot: int,
-                       toks: List[int]) -> None:
+                       toks: List[int],
+                       t_emit: Optional[float] = None) -> None:
         """Fold a burst of emitted tokens into the slot's state,
         stopping at the first terminal condition (EOS / budget / lane
         end / cancel / deadline) — unconsumed acceptances beyond a
         terminal are dropped, their cache rows repaired by later
         writes like any rejected proposal."""
+        if t_emit is not None:
+            req.t_last = t_emit      # whole burst emitted at one wall
         for tok in toks:
             tok = int(tok)
             req.produced.append(tok)
@@ -2256,6 +2383,15 @@ class DecodeScheduler:
                 "n_requests": self.n_requests,
                 "n_steps": self.n_steps,
                 "n_tokens": self.n_tokens,
+                # goodput: tokens from requests that resolved cleanly
+                # (eos/length) vs everything emitted — cancelled/
+                # deadline/preempted work is real device time wasted
+                "goodput": {
+                    "tokens": self.n_goodput_tokens,
+                    "total_tokens": self.n_tokens,
+                    "ratio": (round(self.n_goodput_tokens
+                                    / self.n_tokens, 4)
+                              if self.n_tokens else None)},
                 "n_prefills": self.n_prefills,
                 "n_prompt_tokens": self.n_prompt_tokens,
                 "prefill_s": round(self.prefill_s, 4),

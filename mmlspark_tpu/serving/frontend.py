@@ -328,7 +328,7 @@ class _EventLoopStream:
     overflows (slow consumer) — producers poll it and cancel their
     work; writes after ``closed`` are dropped."""
 
-    __slots__ = ("_loop", "_conn", "_gen", "closed", "done")
+    __slots__ = ("_loop", "_conn", "_gen", "closed", "done", "t_first")
 
     def __init__(self, loop: "_Loop", conn: "_Conn", gen: int):
         self._loop = loop
@@ -336,6 +336,9 @@ class _EventLoopStream:
         self._gen = gen
         self.closed = False
         self.done = False
+        # monotonic stamp of the FIRST chunk hitting the socket write
+        # path — the client-observable TTFT edge (0.0 = none yet)
+        self.t_first = 0.0
 
     def emit(self, data: bytes) -> None:
         if self.closed or self.done:
@@ -512,6 +515,11 @@ class _Loop(threading.Thread):
             # than request_timeout (the threaded frontend's
             # q.get(timeout) analogue)
             conn.t_await = time.monotonic()
+            stream = conn.stream
+            if stream is not None and stream.t_first == 0.0:
+                # socket-edge TTFT: the decode scheduler reads this
+                # at finish in preference to its own loop-side stamp
+                stream.t_first = conn.t_await
             self._write(conn, payload, b"", False)
             return
         payload += b"0\r\n\r\n"                 # terminal chunk

@@ -66,7 +66,9 @@ from collections import deque
 from mmlspark_tpu.core.dataframe import DataFrame
 from mmlspark_tpu.core.logs import get_logger
 from mmlspark_tpu.core.profiling import (
-    StageTimings, process_rss_bytes, process_uptime_s,
+    CompileLedger, DeviceProfiler, MfuMeter, ProfilerBusy,
+    StageTimings, device_memory_stats, process_rss_bytes,
+    process_uptime_s,
 )
 from mmlspark_tpu.parallel.sharding import (
     bucket_ladder, bucket_target, padded_device_batch,
@@ -82,7 +84,8 @@ from mmlspark_tpu.core.telemetry import (
     OPENMETRICS_CONTENT_TYPE as _OPENMETRICS_CONTENT_TYPE,
     MetricsRegistry, REGISTRY,
     TRACE_HEADER, current_trace_id, merge_prometheus, new_trace_id,
-    render_registries, render_samples, trace_context,
+    register_build_info, render_registries, render_samples,
+    trace_context,
 )
 from mmlspark_tpu.core.tracing import (
     CAPTURE_HEADER, PARENT_SPAN_HEADER, TRACER, AdaptiveThreshold,
@@ -95,6 +98,10 @@ from mmlspark_tpu.serving.policy import AdaptiveBatchPolicy
 from mmlspark_tpu.serving.quant import QuantizationConfig
 from mmlspark_tpu.serving.rollout import (
     ModelVersionManager, RolloutError, RolloutOrchestrator,
+)
+from mmlspark_tpu.serving.slo import (
+    AlertNotifier, DEFAULT_WINDOWS, SLOEngine, SLOPolicy,
+    resolve_policies,
 )
 from mmlspark_tpu.serving.tenancy import (
     ANONYMOUS_ID, FairCycle, TenantRegistry, extract_api_key,
@@ -175,12 +182,15 @@ class _ThreadedStream:
     ``closed`` flips on a write error (client gone) or a stalled
     stream; producers poll it and cancel."""
 
-    __slots__ = ("q", "closed", "done")
+    __slots__ = ("q", "closed", "done", "t_first")
 
     def __init__(self):
         self.q: "Queue[tuple]" = Queue()
         self.closed = False
         self.done = False
+        # monotonic stamp of the first chunk actually written to the
+        # client socket — the socket-edge TTFT (0.0 = none yet)
+        self.t_first = 0.0
 
     def emit(self, data: bytes) -> None:
         if not (self.closed or self.done):
@@ -250,6 +260,9 @@ class ServingServer:
                  tls_key: Optional[str] = None,
                  ssl_context=None,
                  tenancy=None,
+                 slo=None,
+                 slo_webhook: Optional[str] = None,
+                 profile_dir: Optional[str] = None,
                  clock: Clock = SYSTEM_CLOCK):
         self.api_path = api_path
         self.max_batch_size = int(max_batch_size)
@@ -340,6 +353,12 @@ class ServingServer:
         self.tracer = tracer if tracer is not None else TRACER
         self.slow_trace_ms = slow_trace_ms
         self.tracer.set_threshold(api_path, slow_trace_ms)
+        if decoder is not None:
+            # the decode route shares the configured threshold — without
+            # this, trace-everything mode (0.0) never applied to decode
+            # requests and their token-timeline spans were unreachable
+            # via GET /trace/<id>
+            self.tracer.set_threshold(decode_path, slow_trace_ms)
         self._m_dispatch = self.registry.histogram(
             "serving_dispatch_latency_ms",
             "Model dispatch wall-clock per shape bucket (label = padded "
@@ -580,6 +599,33 @@ class ServingServer:
             # bound last: bind reads the server's clock/tracer/registry
             # and commit path, all of which must exist first
             self.decoder.bind(self)
+        # -- SLO engine (on by default): declarative burn-rate alerting
+        # over this worker's OWN registry — ``slo`` is False (off), a
+        # policy list / config dict (serving/slo.py), or None for the
+        # stock worker policies (availability + dispatch latency, plus
+        # TTFT/TPOT when the decode plane exists). Evaluation is pulled
+        # by scrapes of ``GET /alerts`` / ``GET /slo`` and by the
+        # firing-gauge exposition callback — nothing runs on the
+        # request hot path. ``slo_webhook`` POSTs each firing/resolved
+        # transition (own breaker board, never blocks evaluation).
+        self.slo: Optional[SLOEngine] = None
+        if slo is not False:
+            self.slo = SLOEngine(
+                self.registry,
+                resolve_policies(slo,
+                                 has_decoder=self.decoder is not None),
+                clock=clock,
+                notifier=(AlertNotifier(slo_webhook)
+                          if slo_webhook else None))
+        # -- device observability: one-at-a-time on-demand profiler
+        # windows (POST /profile -> jax.profiler trace on disk), the
+        # bounded compile-event ledger the dispatch stage feeds, and
+        # the per-bucket MFU meter (flops via the model's
+        # dispatch_flops/cost_analysis hook, when it has one)
+        self.profiler = DeviceProfiler(base_dir=profile_dir)
+        self.compile_ledger = CompileLedger()
+        self.mfu = MfuMeter()
+        self._flops_cache: Dict[tuple, Optional[float]] = {}
         self._register_metric_views()
 
     @property
@@ -638,6 +684,27 @@ class ServingServer:
         m.gauge("serving_journal_entries",
                 "Live replay-journal entries."
                 ).set_function(lambda: len(self._journal))
+        # build identity: a constant-1 gauge whose labels ARE the value
+        # (version/jax/jaxlib/device_kind/frontend) — joinable against
+        # every other serving metric, echoed in /stats as "build"
+        self.build = register_build_info(self.registry,
+                                         frontend=self.frontend)
+        # HBM accounting from the runtime allocator (0s on CPU backends
+        # — the families still exist so dashboards don't 404)
+        for name, help_, key in (
+            ("serving_hbm_bytes_in_use",
+             "Device HBM bytes currently allocated (device 0).",
+             "bytes_in_use"),
+            ("serving_hbm_peak_bytes",
+             "Device HBM high-water mark since process start.",
+             "peak_bytes"),
+            ("serving_hbm_bytes_limit",
+             "Device HBM allocator limit.", "bytes_limit"),
+        ):
+            m.gauge(name, help_).set_function(
+                lambda k=key: device_memory_stats().get(k, 0))
+        if self.slo is not None:
+            self.slo.register_metrics(m)
         if self.tenancy is not None:
             self._register_tenant_metric_views()
         # process vitals belong to the PROCESS-wide registry: two
@@ -675,6 +742,11 @@ class ServingServer:
             "serving_tenant_tokens_total",
             "Decode-plane tokens generated per tenant.",
             labels=("tenant",))
+        c_good = m.counter(
+            "serving_tenant_goodput_tokens_total",
+            "Decode-plane tokens from requests that resolved cleanly "
+            "(eos/length) per tenant — the numerator of per-tenant "
+            "goodput.", labels=("tenant",))
         g_inf = m.gauge(
             "serving_tenant_inflight",
             "Requests currently holding a tenant in-flight slot.",
@@ -690,6 +762,9 @@ class ServingServer:
                 lambda ss=states: sum(s.n_requests for s in ss))
             c_tok.labels(label).set_function(
                 lambda ss=states: sum(s.n_tokens for s in ss))
+            c_good.labels(label).set_function(
+                lambda ss=states:
+                sum(s.n_goodput_tokens for s in ss))
             g_inf.labels(label).set_function(
                 lambda ss=states: sum(s.inflight for s in ss))
             for reason, attr in (("rate", "n_shed_rate"),
@@ -947,6 +1022,8 @@ class ServingServer:
                         if data:
                             self.wfile.write(b"%x\r\n" % len(data)
                                              + data + b"\r\n")
+                            if stream.t_first == 0.0:
+                                stream.t_first = time.monotonic()
                         if end:
                             self.wfile.write(b"0\r\n\r\n")
                             break
@@ -1091,6 +1168,23 @@ class ServingServer:
                     # server runs without a tenant registry
                     "tenancy": (self.tenancy.stats()
                                 if self.tenancy is not None else None),
+                    # build identity (echoes serving_build_info's
+                    # labels): version, jax/jaxlib, device kind,
+                    # frontend — what a fleet diff pins a worker to
+                    "build": self.build,
+                    # SLO engine surface WITHOUT forcing an evaluation
+                    # (GET /slo runs one); None when disabled
+                    "slo": (self.slo.status()
+                            if self.slo is not None else None),
+                    # device observability: profiler window state, the
+                    # bounded compile-event ledger, per-bucket MFU,
+                    # and HBM live/peak/limit bytes
+                    "profiling": {
+                        "profiler": self.profiler.status(),
+                        "compile_events": self.compile_ledger.snapshot(),
+                        "mfu": self.mfu.snapshot(),
+                        "hbm": device_memory_stats(),
+                    },
                 }
             return 200, json.dumps(stats).encode(), "application/json", ()
         if base == "/traces":
@@ -1141,6 +1235,29 @@ class ServingServer:
                 return (404, b'{"error": "no decode plane configured"}',
                         "application/json", ())
             return (200, json.dumps(self.decoder.stats()).encode(),
+                    "application/json", ())
+        if path == "/alerts":
+            # the SLO engine's compact alert view (state machine +
+            # violating window pairs); the GET itself drives an
+            # evaluation pass — pull-based, nothing on the hot path
+            if self.slo is None:
+                return (404, b'{"error": "slo engine disabled"}',
+                        "application/json", ())
+            self.slo.evaluate()
+            return (200, json.dumps(self.slo.alerts()).encode(),
+                    "application/json", ())
+        if path == "/slo":
+            # the full burn-rate report: every policy's long/short
+            # window burns, measured quantiles, and attribution
+            if self.slo is None:
+                return (404, b'{"error": "slo engine disabled"}',
+                        "application/json", ())
+            return (200, json.dumps(self.slo.evaluate()).encode(),
+                    "application/json", ())
+        if path == "/profile":
+            # profiler status (busy flag, last capture window); the
+            # capture itself is POST /profile
+            return (200, json.dumps(self.profiler.status()).encode(),
                     "application/json", ())
         if path != "/status":
             return None
@@ -1196,6 +1313,40 @@ class ServingServer:
         shared by both frontends exactly like ``_get_route`` — only
         ``api_path`` itself takes the data-plane admission path.
         Returns ``(status, body, content_type)`` or None for 404."""
+        if path == "/profile":
+            # on-demand device profiling: open ONE jax.profiler trace
+            # window (duration_ms, clamped) on a background thread and
+            # 202 immediately with the on-disk log_dir; a second POST
+            # while a window runs gets an honest 409, a runtime that
+            # cannot profile (no backend support) a 503
+            try:
+                args = json.loads(body or b"{}")
+                if not isinstance(args, dict):
+                    raise ValueError("body must be a JSON object")
+            except ValueError as e:
+                return (400, json.dumps({"error": f"invalid JSON: {e}"}
+                                        ).encode(), "application/json")
+            duration_ms = args.get("duration_ms", 1000)
+            try:
+                duration_ms = min(max(float(duration_ms), 50.0),
+                                  30000.0)
+            except (TypeError, ValueError):
+                return (400, b'{"error": "duration_ms must be a '
+                             b'number"}', "application/json")
+            try:
+                info = self.profiler.start_window(
+                    duration_s=duration_ms / 1000.0,
+                    log_dir=args.get("log_dir"))
+            except ProfilerBusy as e:
+                return (409, json.dumps(
+                    {"error": str(e),
+                     "status": self.profiler.status()}).encode(),
+                    "application/json")
+            except Exception as e:  # noqa: BLE001 — backend can't
+                return (503, json.dumps(
+                    {"error": f"profiler unavailable: {e}"}).encode(),
+                    "application/json")
+            return 202, json.dumps(info).encode(), "application/json"
         if not path.startswith("/rollout/"):
             return None
         try:
@@ -2026,6 +2177,7 @@ class ServingServer:
             job["version"] = mv.version
             t0 = self.tracer.clock.now()
             qc = mv.quantization
+            new_shape = False
             try:
                 if job.get("wire_qc", qc) != qc:
                     # a flip changed the wire contract between assemble
@@ -2048,7 +2200,8 @@ class ServingServer:
                 for name, nb in wire.items():
                     self._m_wire_bytes.labels(name).inc(nb)
                 with self._stats_lock:
-                    if key not in self._shapes_seen:
+                    new_shape = key not in self._shapes_seen
+                    if new_shape:
                         self.n_recompiles += 1
                         # bounded: adversarial/heterogeneous schemas
                         # (a new field name per request) must not grow
@@ -2070,11 +2223,25 @@ class ServingServer:
                 # its trace id). Per-request exact ids ride the journal
                 # lines; per-request dispatch child spans are recorded
                 # for every live root below.
+                t_d0 = self.tracer.clock.now()
                 with trace_context(job["live"][0].trace), \
                         self.tracer.bind(job["live"][0].span), \
                         self.timings.span("dispatch"), \
                         self._m_dispatch.labels(df.num_rows).time():
                     out = mv.model.transform(df)
+                seconds = self.tracer.clock.now() - t_d0
+                if new_shape:
+                    # a retrace happened inside that dispatch: ledger
+                    # it (bounded ring — /stats "compile_events" and
+                    # the span's compiled=true attribute)
+                    self.compile_ledger.note(
+                        "dispatch", shape=str(key),
+                        duration_ms=seconds * 1000.0,
+                        bucket=df.num_rows, model_version=mv.version)
+                # always-on compute accounting: wall-clock per bucket,
+                # MFU when the model reports flops for the shape
+                self.mfu.note(df.num_rows, seconds,
+                              flops=self._flops_for(mv, df, key))
                 # df.num_rows < n_live only for degenerate frames (e.g.
                 # empty-object payloads -> a zero-column frame): still a
                 # row-count error, never a silent short batch
@@ -2094,6 +2261,10 @@ class ServingServer:
                 job["error"] = e
             span_attrs = {"bucket": df.num_rows,
                           "model_version": mv.version}
+            if new_shape:
+                # a captured slow dispatch that compiled says so —
+                # first-shape latency is expected, not a regression
+                span_attrs["compiled"] = True
             if qc is not None:
                 # a captured slow dispatch says which wire it rode
                 span_attrs["wire_dtype"] = qc.wire_dtype
@@ -2108,6 +2279,35 @@ class ServingServer:
                 status="ok" if job["error"] is None else "error",
                 **span_attrs)
         return job
+
+    def _flops_for(self, mv, df, key) -> Optional[float]:
+        """Per-shape flops for the MFU meter, memoized per (version,
+        shape key): a model may expose ``dispatch_flops(df)`` (exact
+        count) or ``cost_analysis(df)`` (XLA's compiled estimate, a
+        dict with "flops"). Models with neither cost one attribute
+        probe per shape and meter wall-clock only."""
+        ck = (mv.version, key)
+        if ck in self._flops_cache:
+            return self._flops_cache[ck]
+        flops = None
+        for attr in ("dispatch_flops", "cost_analysis"):
+            fn = getattr(mv.model, attr, None)
+            if fn is None:
+                continue
+            try:
+                val = fn(df)
+                if attr == "cost_analysis":
+                    val = (val or {}).get("flops")
+                if val:
+                    flops = float(val)
+                    break
+            except Exception:  # noqa: BLE001 — accounting is optional
+                pass
+        # bounded exactly like _shapes_seen: adversarial schemas must
+        # not grow the memo without limit
+        if len(self._flops_cache) < _MAX_SHAPES_TRACKED:
+            self._flops_cache[ck] = flops
+        return flops
 
     def _encode_replies(self, out: DataFrame, in_cols: List[str],
                         n_live: int) -> List[bytes]:
@@ -2698,7 +2898,8 @@ class ServingCoordinator:
                  stale_after: Optional[float] = None,
                  tracer=None, frontend: str = "eventloop",
                  acceptors: int = 1, reuse_port: bool = False,
-                 rollout_history: int = 32):
+                 rollout_history: int = 32,
+                 slo=None):
         # stale_after: drop workers not re-registered within this many
         # seconds — workers heartbeat (`python -m mmlspark_tpu.serving
         # worker` re-registers every REGISTER_INTERVAL), so dead pods
@@ -2729,6 +2930,43 @@ class ServingCoordinator:
         # rate()-style deltas alongside the lifetime totals (trend
         # needs two scrapes — the ROADMAP fleet-rate item)
         self._prev_totals: Optional[Tuple[float, Dict[str, int]]] = None
+        # -- fleet SLO plane (on by default; ``slo=False`` disables):
+        # the coordinator keeps a PRIVATE registry with per-worker
+        # scrape/scrape-failure counters — every /fleet/alerts and
+        # /fleet/slo request polls the workers, feeds the counters,
+        # and evaluates one fleet_availability burn-rate policy over
+        # them, so a dead worker burns error budget with per-worker
+        # attribution until it ages out of stale_after AND the
+        # windows. ``slo`` takes {"objective", "windows", "for_s",
+        # "resolve_after_s", "webhook"} overrides.
+        cfg = dict(slo) if isinstance(slo, dict) else {}
+        self.registry = MetricsRegistry()
+        self._m_polls = self.registry.counter(
+            "fleet_worker_polls_total",
+            "Worker scrape attempts by the coordinator's SLO plane.",
+            labels=("worker",))
+        self._m_poll_failures = self.registry.counter(
+            "fleet_worker_poll_failures_total",
+            "Worker scrapes that failed (dead/unreachable worker) — "
+            "the fleet availability burn's bad-event counter.",
+            labels=("worker",))
+        self.slo: Optional[SLOEngine] = None
+        if slo is not False:
+            policy = SLOPolicy(
+                name="fleet_availability", kind="availability",
+                objective=float(cfg.get("objective", 0.999)),
+                total_metric="fleet_worker_polls_total",
+                bad_metric="fleet_worker_poll_failures_total",
+                windows=(tuple(tuple(w) for w in cfg["windows"])
+                         if "windows" in cfg else DEFAULT_WINDOWS),
+                for_s=float(cfg.get("for_s", 0.0)),
+                resolve_after_s=float(cfg.get("resolve_after_s",
+                                              60.0)))
+            self.slo = SLOEngine(
+                self.registry, [policy],
+                notifier=(AlertNotifier(cfg["webhook"])
+                          if cfg.get("webhook") else None))
+            self.slo.register_metrics(self.registry)
         coordinator = self
 
         class Handler(BaseHTTPRequestHandler):
@@ -2850,6 +3088,16 @@ class ServingCoordinator:
         if path == "/fleet/metrics":
             return (200, self.fleet_metrics().encode(),
                     _METRICS_CONTENT_TYPE)
+        if path == "/fleet/alerts":
+            # the fleet alert roll-up: the coordinator's own
+            # fleet_availability evaluation (dead workers burn with
+            # per-worker attribution) plus every live worker's compact
+            # alert view, worker-attributed
+            return (200, json.dumps(self.fleet_alerts()).encode(),
+                    "application/json")
+        if path == "/fleet/slo":
+            return (200, json.dumps(self.fleet_slo()).encode(),
+                    "application/json")
         if path == "/fleet/traces":
             # every worker's retained slow/error captures in one
             # listing (concurrent polls; a dead worker degrades to an
@@ -3011,7 +3259,8 @@ class ServingCoordinator:
             try:
                 r = requests.get(f"http://{wk}{path}", timeout=timeout)
                 r.raise_for_status()
-                json_paths = ("/stats", "/traces", "/trace/")
+                json_paths = ("/stats", "/traces", "/trace/",
+                              "/alerts", "/slo")
                 return (wk, r.json() if path.startswith(json_paths)
                         else r.text, None)
             except Exception as e:  # noqa: BLE001 — worker down/old
@@ -3164,7 +3413,71 @@ class ServingCoordinator:
         for wk, _, err in polls:
             merged[("serving_worker_up", (("worker", wk),))] = \
                 0.0 if err is not None else 1.0
+        # the coordinator stamps its OWN build identity into the fleet
+        # exposition (frontend="coordinator"), so a scrape of the one
+        # fleet target also answers "what is the control plane running"
+        from mmlspark_tpu.core.telemetry import build_info
+        info = dict(build_info())
+        info["frontend"] = "coordinator"
+        merged[("serving_build_info",
+                tuple(sorted(info.items())))] = 1.0
         return render_samples(merged)
+
+    # -- fleet SLO roll-up ---------------------------------------------------
+
+    def fleet_alerts(self, timeout: float = 5.0) -> Dict[str, Any]:
+        """The fleet alert view: poll every worker's ``GET /alerts``
+        (each poll feeds the coordinator's per-worker scrape counters
+        — the fleet_availability policy's total/bad events), evaluate
+        the coordinator's own engine, and report both. ``firing``
+        totals the fleet policy and every responding worker's count;
+        a dead worker appears as an ``{"error": ...}`` entry AND as
+        availability burn with its ``worker=host:port`` attribution."""
+        polls = self._poll_slo("alerts", timeout)
+        fleet_view = None
+        firing = 0
+        if self.slo is not None:
+            self.slo.evaluate()
+            fleet_view = self.slo.alerts()
+            firing += int(fleet_view.get("firing", 0))
+        workers: Dict[str, Any] = {}
+        for wk, body, err in polls:
+            if err is not None:
+                workers[wk] = {"error": err}
+                continue
+            workers[wk] = body
+            if isinstance(body, dict):
+                firing += int(body.get("firing", 0))
+        return {"firing": firing, "fleet": fleet_view,
+                "workers": workers}
+
+    def fleet_slo(self, timeout: float = 5.0) -> Dict[str, Any]:
+        """The full fleet burn-rate report: the coordinator policy's
+        evaluation plus every worker's ``GET /slo`` report verbatim,
+        worker-attributed."""
+        polls = self._poll_slo("slo", timeout)
+        fleet_view = self.slo.evaluate() if self.slo is not None \
+            else None
+        workers = {wk: (body if err is None else {"error": err})
+                   for wk, body, err in polls}
+        firing = 0
+        if self.slo is not None:
+            firing += len(self.slo.firing())
+        return {"firing": firing, "fleet": fleet_view,
+                "workers": workers}
+
+    def _poll_slo(self, mode: str, timeout: float
+                  ) -> List[Tuple[str, Any, Optional[str]]]:
+        """Poll every worker's ``/alerts`` or ``/slo``, charging the
+        per-worker scrape counters the fleet availability policy
+        evaluates (success AND failure both count a poll; only
+        failures count bad events)."""
+        polls = self._poll_workers(f"/{mode}", timeout)
+        for wk, _, err in polls:
+            self._m_polls.labels(wk).inc()
+            if err is not None:
+                self._m_poll_failures.labels(wk).inc()
+        return polls
 
     # -- fleet-level trace aggregation ---------------------------------------
 

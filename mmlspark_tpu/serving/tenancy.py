@@ -188,7 +188,7 @@ class TenantState:
     __slots__ = ("tenant", "bucket", "lock", "inflight",
                  "inflight_high_water", "n_requests", "n_shed_rate",
                  "n_shed_concurrency", "n_shed_overload", "n_replayed",
-                 "n_tokens", "n_release_underflow")
+                 "n_tokens", "n_goodput_tokens", "n_release_underflow")
 
     def __init__(self, tenant: Tenant, clock: Clock):
         self.tenant = tenant
@@ -204,6 +204,7 @@ class TenantState:
         self.n_shed_overload = 0
         self.n_replayed = 0
         self.n_tokens = 0
+        self.n_goodput_tokens = 0
         self.n_release_underflow = 0
 
     def stats(self) -> Dict[str, object]:
@@ -218,6 +219,7 @@ class TenantState:
                 "n_shed_concurrency": self.n_shed_concurrency,
                 "n_shed_overload": self.n_shed_overload,
                 "n_tokens": self.n_tokens,
+                "n_goodput_tokens": self.n_goodput_tokens,
                 "n_release_underflow": self.n_release_underflow,
                 "bucket_tokens": (round(self.bucket.tokens, 3)
                                   if self.bucket is not None else None),
@@ -437,6 +439,15 @@ class TenantRegistry:
         if st is not None:
             with st.lock:
                 st.n_tokens += int(n)
+
+    def note_goodput_tokens(self, tenant_id: str, n: int) -> None:
+        """Tokens delivered by a CLEAN decode finish (eos/length) —
+        the per-tenant goodput numerator; ``note_tokens`` above stays
+        the all-reasons denominator."""
+        st = self._states.get(tenant_id)
+        if st is not None:
+            with st.lock:
+                st.n_goodput_tokens += int(n)
 
     def weight_of(self, tenant_id: str) -> float:
         t = self.tenants.get(tenant_id)

@@ -1,0 +1,649 @@
+"""SLO engine + token-level decode timelines (ISSUE 18).
+
+Four pillars:
+
+* **burn-rate math is exact on a ManualClock** — window baselines land
+  on the oldest sample inside ``now - W`` (falling back to the newest
+  sample before it, so partial windows are honest, not zero), counter
+  resets across a worker restart keep the post-reset traffic, and a
+  violation requires BOTH windows of a pair;
+* **the alert state machine cannot flap** — ``for_s`` holds a pending
+  alert back, ``resolve_after_s`` holds a firing alert through a blip
+  of clear evaluations, and a pending alert that clears folds silently
+  back to ok (no notification ever sent);
+* **every decode exit records its timeline** — TTFT/TPOT and the
+  tokens-per-request histogram are fed from ``_finish``, so cancelled /
+  deadline / preempted requests count per release reason, and goodput
+  only credits clean (eos/length) deliveries;
+* **stamping is free** — the per-token timeline stamps stay under the
+  1 us/token budget (perf-marked), and alert evaluation happens only
+  when something asks (off the hot path by construction).
+"""
+
+import json
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from mmlspark_tpu.core.resilience import Deadline, ManualClock
+from mmlspark_tpu.core.telemetry import (
+    DEFAULT_LATENCY_BUCKETS_MS, MetricsRegistry,
+)
+from mmlspark_tpu.models import transformer as T
+from mmlspark_tpu.serving import DecodeScheduler, TransformerDecoder
+from mmlspark_tpu.serving.slo import (
+    DEFAULT_WINDOWS, SLOEngine, SLOPolicy, default_worker_policies,
+    resolve_policies,
+)
+
+# one fast window pair for state-machine tests: 60 s long / 10 s
+# short, burn >= 2 fires
+FAST = ((60.0, 10.0, 2.0),)
+
+
+def _avail_engine(clock, objective=0.9, windows=FAST, for_s=0.0,
+                  resolve_after_s=30.0, labels=None, notifier=None):
+    m = MetricsRegistry(clock=clock)
+    lbl = ("worker",) if labels is not None else ()
+    total = m.counter("req_total", "t.", labels=lbl)
+    bad = m.counter("err_total", "e.", labels=lbl)
+    eng = SLOEngine(m, [SLOPolicy(
+        "avail", "availability", objective,
+        total_metric="req_total", bad_metric="err_total",
+        labels=labels, windows=windows, for_s=for_s,
+        resolve_after_s=resolve_after_s)],
+        clock=clock, notifier=notifier)
+    return m, total, bad, eng
+
+
+class TestPolicyValidation:
+
+    def test_bad_kind_rejected(self):
+        with pytest.raises(ValueError, match="kind"):
+            SLOPolicy("x", "throughput", 0.99, metric="m",
+                      threshold_ms=1.0)
+
+    def test_objective_bounds(self):
+        for bad in (0.0, 1.0, 1.5, -0.1):
+            with pytest.raises(ValueError, match="objective"):
+                SLOPolicy("x", "latency", bad, metric="m",
+                          threshold_ms=1.0)
+
+    def test_availability_needs_both_counters(self):
+        with pytest.raises(ValueError, match="total_metric"):
+            SLOPolicy("x", "availability", 0.99,
+                      total_metric="req_total")
+
+    def test_latency_needs_threshold(self):
+        with pytest.raises(ValueError, match="threshold_ms"):
+            SLOPolicy("x", "latency", 0.99, metric="m")
+
+    def test_windows_must_order_long_over_short(self):
+        with pytest.raises(ValueError, match="windows"):
+            SLOPolicy("x", "latency", 0.99, metric="m",
+                      threshold_ms=1.0, windows=((10.0, 60.0, 2.0),))
+
+    def test_from_value_dict_and_json_roundtrip(self):
+        p = SLOPolicy("ttft", "latency", 0.99,
+                      metric="serving_decode_ttft_ms",
+                      threshold_ms=2500.0, labels={"route": "d"})
+        again = SLOPolicy.from_value(json.dumps(p.to_dict()))
+        assert again.to_dict() == p.to_dict()
+        assert SLOPolicy.from_value(p) is p
+
+    def test_resolve_policies_surface(self):
+        stock = resolve_policies(None)
+        assert [p.name for p in stock] == ["availability",
+                                           "dispatch_latency"]
+        with_decode = resolve_policies(None, has_decoder=True)
+        assert "decode_ttft" in [p.name for p in with_decode]
+        assert "decode_tpot" in [p.name for p in with_decode]
+        # dict form overrides the stock knobs without re-listing them
+        fast = resolve_policies({"windows": FAST, "for_s": 5.0},
+                                has_decoder=True)
+        assert all(p.windows == FAST and p.for_s == 5.0 for p in fast)
+        # explicit policies replace the stock set outright
+        only = resolve_policies({"policies": [
+            {"name": "a", "kind": "availability", "objective": 0.99,
+             "total_metric": "t", "bad_metric": "b"}]})
+        assert [p.name for p in only] == ["a"]
+
+    def test_duplicate_policy_names_rejected(self):
+        m = MetricsRegistry()
+        ps = [SLOPolicy("a", "latency", 0.99, metric="m",
+                        threshold_ms=1.0)] * 2
+        with pytest.raises(ValueError, match="duplicate"):
+            SLOEngine(m, ps)
+
+
+class TestAvailabilityBurn:
+
+    def test_steady_traffic_no_errors_is_quiet(self):
+        clock = ManualClock()
+        m, total, bad, eng = _avail_engine(clock)
+        for _ in range(10):
+            total.labels().inc(50)
+            clock.advance(5.0)
+            rep = eng.evaluate()
+        (p,) = rep["policies"]
+        assert not p["violated"] and p["state"] == "ok"
+        assert p["error_rate"] == 0.0
+        assert rep["firing"] == 0
+
+    def test_total_outage_fires_with_full_attribution_math(self):
+        clock = ManualClock()
+        m, total, bad, eng = _avail_engine(clock, objective=0.9)
+        eng.evaluate()                       # baseline sample
+        clock.advance(10.0)
+        total.labels().inc(100)
+        bad.labels().inc(100)                # 100% errors
+        rep = eng.evaluate()
+        (p,) = rep["policies"]
+        # burn = error_rate / budget = 1.0 / 0.1 = 10x
+        assert p["windows"][0]["burn_long"] == pytest.approx(10.0)
+        assert p["windows"][0]["burn_short"] == pytest.approx(10.0)
+        assert p["violated"] and p["state"] == "firing"
+        assert rep["firing"] == 1
+
+    def test_window_edges_old_errors_age_out_of_the_short_window(self):
+        """Errors older than the short window must not keep the pair
+        violated: the short window is the resolve-fast half of the
+        multi-window trade."""
+        clock = ManualClock()
+        m, total, bad, eng = _avail_engine(clock, objective=0.9,
+                                           resolve_after_s=1e9)
+        eng.evaluate()
+        clock.advance(5.0)
+        total.labels().inc(100)
+        bad.labels().inc(100)
+        rep = eng.evaluate()                 # burst inside both windows
+        assert rep["policies"][0]["state"] == "firing"
+        # healthy traffic for the next 40 s, sampled every 5 s: the
+        # burst ages past the 10 s short window but stays inside the
+        # 60 s long one
+        for _ in range(8):
+            clock.advance(5.0)
+            total.labels().inc(50)
+            rep = eng.evaluate()
+        (p,) = rep["policies"]
+        row = p["windows"][0]
+        assert row["burn_long"] >= 2.0       # burst still in long
+        assert row["burn_short"] == 0.0      # aged out of short
+        assert not p["violated"]
+
+    def test_counter_reset_across_restart_clamps_deltas(self):
+        """A restarted worker's counters restart at zero; the delta
+        must read as 'no traffic', never negative traffic or a
+        phantom burn."""
+        clock = ManualClock()
+        m, total, bad, eng = _avail_engine(clock, objective=0.9)
+        total.labels().inc(1000)
+        bad.labels().inc(5)
+        eng.evaluate()
+        clock.advance(5.0)
+        m.reset()                            # the restart
+        total.labels().inc(10)               # fresh healthy traffic
+        rep = eng.evaluate()
+        (p,) = rep["policies"]
+        assert p["windows"][0]["burn_long"] == 0.0
+        assert p["bad"] == 0.0 and p["total"] == 10.0
+        assert not p["violated"]
+
+    def test_absent_metric_is_zero_burn_not_a_crash(self):
+        clock = ManualClock()
+        m = MetricsRegistry(clock=clock)
+        eng = SLOEngine(m, [SLOPolicy(
+            "ghost", "availability", 0.99,
+            total_metric="nope_total", bad_metric="nope_bad",
+            windows=FAST)], clock=clock)
+        eng.evaluate()
+        clock.advance(5.0)
+        rep = eng.evaluate()
+        (p,) = rep["policies"]
+        assert not p["violated"] and p["state"] == "ok"
+
+    def test_label_filter_scopes_the_policy(self):
+        clock = ManualClock()
+        m, total, bad, eng = _avail_engine(
+            clock, objective=0.9, labels={"worker": "a"})
+        eng.evaluate()
+        clock.advance(5.0)
+        total.labels("a").inc(100)
+        total.labels("b").inc(100)
+        bad.labels("b").inc(100)             # the OTHER worker burns
+        rep = eng.evaluate()
+        (p,) = rep["policies"]
+        assert p["bad"] == 0.0 and p["total"] == 100.0
+        assert not p["violated"]
+
+    def test_attribution_names_the_burning_child(self):
+        clock = ManualClock()
+        m, total, bad, eng = _avail_engine(clock, objective=0.9,
+                                           labels={})
+        eng.evaluate()
+        clock.advance(5.0)
+        total.labels("a").inc(100)
+        total.labels("b").inc(100)
+        bad.labels("b").inc(80)
+        rep = eng.evaluate()
+        (p,) = rep["policies"]
+        assert p["violated"]
+        attr = p["attribution"]
+        assert attr[0]["labels"] == {"worker": "b"}
+        assert attr[0]["bad"] == 80.0
+        assert len(attr) == 1                # healthy child excluded
+
+
+class TestLatencyBurn:
+
+    def _engine(self, clock, threshold_ms=10.0, objective=0.9):
+        m = MetricsRegistry(clock=clock)
+        h = m.histogram("lat_ms", "l.")
+        eng = SLOEngine(m, [SLOPolicy(
+            "lat", "latency", objective, metric="lat_ms",
+            threshold_ms=threshold_ms, quantile=0.5, windows=FAST)],
+            clock=clock)
+        return m, h, eng
+
+    def test_fraction_over_threshold_drives_the_burn(self):
+        clock = ManualClock()
+        m, h, eng = self._engine(clock)       # 10 ms target, 0.9 obj
+        eng.evaluate()
+        clock.advance(5.0)
+        for _ in range(80):
+            h.observe(1.0)                    # good
+        for _ in range(20):
+            h.observe(100.0)                  # over threshold
+        rep = eng.evaluate()
+        (p,) = rep["policies"]
+        # 20% over / 10% budget = 2x burn on both windows
+        assert p["windows"][0]["burn_long"] == pytest.approx(2.0)
+        assert p["violated"]
+        assert p["over_threshold"] == 20.0 and p["total"] == 100.0
+        # the measured quantile rides along for the operator
+        assert p["measured_ms"] is not None and p["measured_ms"] > 0
+
+    def test_all_under_threshold_is_quiet(self):
+        clock = ManualClock()
+        m, h, eng = self._engine(clock)
+        eng.evaluate()
+        clock.advance(5.0)
+        for _ in range(100):
+            h.observe(2.0)
+        rep = eng.evaluate()
+        (p,) = rep["policies"]
+        assert p["windows"][0]["burn_long"] == 0.0
+        assert not p["violated"]
+
+    def test_inf_bucket_is_always_over(self):
+        clock = ManualClock()
+        m, h, eng = self._engine(
+            clock, threshold_ms=DEFAULT_LATENCY_BUCKETS_MS[-1] * 10)
+        eng.evaluate()
+        clock.advance(5.0)
+        h.observe(DEFAULT_LATENCY_BUCKETS_MS[-1] * 100)  # +Inf bucket
+        rep = eng.evaluate()
+        (p,) = rep["policies"]
+        assert p["over_threshold"] == 1.0
+
+
+class TestAlertStateMachine:
+
+    def test_for_s_holds_pending_until_held(self):
+        clock = ManualClock()
+        m, total, bad, eng = _avail_engine(clock, for_s=20.0)
+        eng.evaluate()
+
+        def burn():
+            clock.advance(5.0)
+            total.labels().inc(10)
+            bad.labels().inc(10)
+            return eng.evaluate()["policies"][0]
+
+        assert burn()["state"] == "pending"     # t=5: pending starts
+        assert burn()["state"] == "pending"     # t=10: 5 s held
+        assert burn()["state"] == "pending"     # t=15: 10 s held
+        assert burn()["state"] == "pending"     # t=20: 15 s held
+        p = burn()                               # t=25: 20 s held
+        assert p["state"] == "firing" and p["n_fired"] == 1
+
+    def test_pending_that_clears_folds_back_to_ok_silently(self):
+        sent = []
+
+        class _Fake:
+            def notify(self, ev):
+                sent.append(ev)
+
+        clock = ManualClock()
+        m, total, bad, eng = _avail_engine(clock, for_s=30.0,
+                                           notifier=_Fake())
+        eng.evaluate()
+        clock.advance(5.0)
+        total.labels().inc(10)
+        bad.labels().inc(10)
+        assert eng.evaluate()["policies"][0]["state"] == "pending"
+        for _ in range(12):                     # blip ages out entirely
+            clock.advance(10.0)
+            total.labels().inc(100)
+            rep = eng.evaluate()
+        assert rep["policies"][0]["state"] == "ok"
+        assert rep["policies"][0]["n_fired"] == 0
+        assert sent == []                       # never notified
+
+    def test_firing_resolves_only_after_quiet_period_no_flap(self):
+        clock = ManualClock()
+        m, total, bad, eng = _avail_engine(clock, for_s=0.0,
+                                           resolve_after_s=25.0)
+        eng.evaluate()
+        clock.advance(5.0)
+        total.labels().inc(10)
+        bad.labels().inc(10)
+        assert eng.evaluate()["policies"][0]["state"] == "firing"
+
+        def heal():
+            clock.advance(10.0)
+            total.labels().inc(200)             # healthy flood
+            return eng.evaluate()["policies"][0]
+
+        # burn clears fast (short window floods with good traffic) but
+        # the alert holds through resolve_after_s
+        states = [heal()["state"] for _ in range(2)]
+        assert states == ["firing", "firing"]   # clear 10 s, 20 s
+        p = heal()                               # clear 30 s >= 25 s
+        assert p["state"] == "resolved"
+        assert p["n_fired"] == 1                 # fired exactly once
+
+    def test_reviolation_during_quiet_period_resets_the_clock(self):
+        clock = ManualClock()
+        m, total, bad, eng = _avail_engine(clock, for_s=0.0,
+                                           resolve_after_s=15.0)
+        eng.evaluate()
+        clock.advance(5.0)
+        total.labels().inc(10)
+        bad.labels().inc(10)
+        assert eng.evaluate()["policies"][0]["state"] == "firing"
+        clock.advance(10.0)
+        total.labels().inc(500)                 # clear for 10 s
+        assert eng.evaluate()["policies"][0]["state"] == "firing"
+        clock.advance(5.0)
+        # a burst big enough to push BOTH windows back over burn (the
+        # long window holds the 500 healthy requests too): re-violates
+        # at 15 s, resetting the quiet clock
+        total.labels().inc(120)
+        bad.labels().inc(120)
+        p = eng.evaluate()["policies"][0]
+        assert p["violated"]
+        assert p["state"] == "firing" and p["n_fired"] == 1
+        clock.advance(10.0)
+        total.labels().inc(500)
+        # only 10 s clear since the re-violation: still firing
+        assert eng.evaluate()["policies"][0]["state"] == "firing"
+
+    def test_notifier_sees_firing_then_resolved(self):
+        sent = []
+
+        class _Fake:
+            def notify(self, ev):
+                sent.append(ev)
+
+        clock = ManualClock()
+        m, total, bad, eng = _avail_engine(clock, resolve_after_s=10.0,
+                                           notifier=_Fake())
+        eng.evaluate()
+        clock.advance(5.0)
+        total.labels().inc(10)
+        bad.labels().inc(10)
+        eng.evaluate()
+        for _ in range(4):
+            clock.advance(5.0)
+            total.labels().inc(500)
+            eng.evaluate()
+        kinds = [(e["type"], e["policy"]) for e in sent]
+        assert kinds == [("firing", "avail"), ("resolved", "avail")]
+        assert sent[0]["report"]["violated"] is True
+
+    def test_exposed_gauges_and_transition_counters(self):
+        clock = ManualClock()
+        m, total, bad, eng = _avail_engine(clock, resolve_after_s=10.0)
+        views = MetricsRegistry(clock=clock)
+        eng.register_metrics(views)
+        eng.evaluate()
+        clock.advance(5.0)
+        total.labels().inc(10)
+        bad.labels().inc(10)
+        eng.evaluate()
+        text = views.render()
+        assert 'serving_slo_alerts_firing{policy="avail"} 1' in text
+        assert ('serving_slo_transitions_total'
+                '{policy="avail",state="firing"} 1') in text
+        for _ in range(3):
+            clock.advance(5.0)
+            total.labels().inc(500)
+            eng.evaluate()
+        text = views.render()
+        assert 'serving_slo_alerts_firing{policy="avail"} 0' in text
+        assert ('serving_slo_transitions_total'
+                '{policy="avail",state="resolved"} 1') in text
+
+    def test_alerts_view_is_compact_and_quiet_when_ok(self):
+        clock = ManualClock()
+        m, total, bad, eng = _avail_engine(clock)
+        total.labels().inc(10)
+        view = eng.alerts()
+        assert view["firing"] == 0 and view["alerts"] == []
+        clock.advance(5.0)
+        bad.labels().inc(10)
+        total.labels().inc(10)
+        view = eng.alerts()
+        assert view["firing"] == 1
+        assert view["alerts"][0]["policy"] == "avail"
+        assert view["alerts"][0]["state"] == "firing"
+
+    def test_status_echo_reports_without_evaluating(self):
+        clock = ManualClock()
+        m, total, bad, eng = _avail_engine(clock)
+        n0 = eng.n_evaluations
+        st = eng.status()
+        assert eng.n_evaluations == n0          # status never evaluates
+        assert st["policies"] == {"avail": "ok"}
+        assert st["firing"] == []
+
+
+class TestDefaultPolicies:
+
+    def test_stock_set_matches_served_metric_names(self):
+        names = {p.metrics()[0] for p in
+                 default_worker_policies(has_decoder=True)}
+        assert "serving_requests_total" in names
+        assert "serving_dispatch_latency_ms" in names
+        assert "serving_decode_ttft_ms" in names
+
+    def test_stock_windows_are_the_sre_pairs(self):
+        (p, _) = default_worker_policies()
+        assert p.windows == DEFAULT_WINDOWS
+
+
+# ---------------------------------------------------------------------------
+# Decode timelines: TTFT/TPOT/tokens-per-request from every exit path
+# ---------------------------------------------------------------------------
+
+CFG = T.TransformerConfig(vocab=64, d_model=16, n_heads=2, d_head=8,
+                          d_ff=32, n_stages=1, layers_per_stage=2)
+PARAMS = T.init_params(CFG, seed=0)
+
+
+def _decoder(n_slots=4, max_len=32, **kw) -> TransformerDecoder:
+    return TransformerDecoder(PARAMS, CFG, n_slots=n_slots,
+                              max_len=max_len, **kw)
+
+
+class _Pending:
+    """The slice of _PendingRequest the standalone scheduler touches."""
+
+    def __init__(self, payload, rid, deadline=None):
+        self.payload = payload
+        self.rid = rid
+        self.deadline = deadline
+        self.event = threading.Event()
+        self.callbacks = []
+        self.reply = None
+        self.status = 200
+        self.span = None
+        self.trace = rid
+
+
+def _family(registry, name):
+    for fam in registry.families():
+        if fam.name == name:
+            return fam
+    return None
+
+
+def _child_stats(registry, name):
+    fam = _family(registry, name)
+    assert fam is not None, f"{name} not registered"
+    return {key: child.stats() for key, child in fam.children()}
+
+
+def _prompt(rng, n):
+    return [int(t) for t in rng.integers(0, CFG.vocab, size=n)]
+
+
+class TestDecodeTimelines:
+
+    def _wait_active(self, sched, timeout=10.0):
+        t_end = time.monotonic() + timeout
+        while not sched.stats()["active"] and time.monotonic() < t_end:
+            time.sleep(0.005)
+
+    def test_clean_finish_records_ttft_tpot_tokens_and_goodput(self):
+        m = MetricsRegistry()
+        sched = DecodeScheduler(_decoder(), registry=m).start()
+        try:
+            rng = np.random.default_rng(0)
+            p = _Pending({"prompt": _prompt(rng, 4),
+                          "max_new_tokens": 6}, "ok")
+            sched.submit(p)
+            assert p.event.wait(30)
+            out = json.loads(p.reply)
+            assert out["finish_reason"] == "length"
+            toks = _child_stats(m, "serving_decode_tokens_per_request")
+            assert toks[("length",)]["count"] == 1
+            assert toks[("length",)]["sum"] == 6.0
+            ttft = _child_stats(m, "serving_decode_ttft_ms")
+            (key,) = ttft.keys()
+            assert ttft[key]["count"] == 1 and ttft[key]["sum"] >= 0
+            tpot = _child_stats(m, "serving_decode_tpot_ms")
+            assert tpot[key]["count"] == 1
+            good = sched.stats()["goodput"]
+            assert good["tokens"] == 6 and good["ratio"] == 1.0
+        finally:
+            sched.stop()
+
+    def test_cancel_records_partial_count_under_its_reason(self):
+        m = MetricsRegistry()
+        sched = DecodeScheduler(_decoder(n_slots=2),
+                                registry=m).start()
+        try:
+            rng = np.random.default_rng(1)
+            p = _Pending({"prompt": _prompt(rng, 4),
+                          "max_new_tokens": 10_000}, "long")
+            sched.submit(p)
+            self._wait_active(sched)
+            assert sched.cancel("long") is True
+            assert p.event.wait(10)
+            n = json.loads(p.reply)["n_tokens"]
+            toks = _child_stats(m, "serving_decode_tokens_per_request")
+            assert toks[("cancelled",)]["count"] == 1
+            assert toks[("cancelled",)]["sum"] == float(n)
+            # a cancel is not goodput, even with partial tokens out
+            good = sched.stats()["goodput"]
+            assert good["tokens"] == 0
+            assert good["total_tokens"] == n
+        finally:
+            sched.stop()
+
+    def test_deadline_expiry_records_under_deadline_reason(self):
+        clock = ManualClock()
+        m = MetricsRegistry()
+        sched = DecodeScheduler(_decoder(n_slots=2), clock=clock,
+                                registry=m).start()
+        try:
+            rng = np.random.default_rng(2)
+            p = _Pending({"prompt": _prompt(rng, 4),
+                          "max_new_tokens": 10_000}, "dl",
+                         deadline=Deadline(5.0, clock=clock))
+            sched.submit(p)
+            self._wait_active(sched)
+            clock.advance(6.0)
+            assert p.event.wait(10)
+            assert p.status == 504
+            toks = _child_stats(m, "serving_decode_tokens_per_request")
+            assert toks[("deadline",)]["count"] == 1
+        finally:
+            sched.stop()
+
+    def test_expired_waiter_records_zero_tokens(self):
+        """A request that dies before claiming a slot still lands in
+        the histogram — reason 'deadline', zero tokens — so goodput
+        denominators can never undercount failure modes."""
+        clock = ManualClock()
+        m = MetricsRegistry()
+        sched = DecodeScheduler(_decoder(n_slots=2), clock=clock,
+                                registry=m)
+        p = _Pending({"prompt": [1, 2]}, "doa",
+                     deadline=Deadline(1.0, clock=clock))
+        sched.submit(p)
+        clock.advance(2.0)
+        sched._admit_waiting()
+        assert p.event.is_set() and p.status == 504
+        toks = _child_stats(m, "serving_decode_tokens_per_request")
+        assert toks[("deadline",)]["count"] == 1
+        assert toks[("deadline",)]["sum"] == 0.0
+
+
+@pytest.mark.perf
+class TestStampingBudget:
+
+    def test_per_token_stamping_under_budget(self):
+        """The hot-loop timeline cost is two attribute stores and a
+        counter bump per token; budget <= 1 us/token."""
+        from mmlspark_tpu.serving.decode import _DecodeRequest
+        sched = DecodeScheduler(_decoder())
+        req = _DecodeRequest(_Pending({"prompt": [1]}, "perf"),
+                             *sched.parse({"prompt": [1, 2, 3],
+                                           "max_new_tokens": 4}))
+        n = 200_000
+        best = float("inf")
+        for _ in range(3):
+            t0 = time.perf_counter()
+            for _ in range(n):
+                t = 1.0
+                req.t_last = t
+                req.produced.append(7)
+                sched.n_tokens += 1
+            dt = time.perf_counter() - t0
+            best = min(best, dt)
+            del req.produced[:]
+        per_token_ns = best / n * 1e9
+        assert per_token_ns < 1000.0, \
+            f"{per_token_ns:.0f} ns/token exceeds the 1 us budget"
+
+    def test_evaluation_is_cheap_and_off_hot_path(self):
+        """A full evaluate() over a populated history costs well under
+        a scrape interval — and nothing in the engine runs unless
+        something calls it."""
+        clock = ManualClock()
+        m, total, bad, eng = _avail_engine(clock)
+        for _ in range(120):                   # 10 min of 5 s samples
+            total.labels().inc(50)
+            clock.advance(5.0)
+            eng.evaluate()
+        t0 = time.perf_counter()
+        for _ in range(50):
+            clock.advance(5.0)
+            eng.evaluate()
+        per_eval_ms = (time.perf_counter() - t0) / 50 * 1e3
+        assert per_eval_ms < 50.0, f"{per_eval_ms:.1f} ms/evaluation"

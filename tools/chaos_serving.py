@@ -56,6 +56,15 @@ release underflow), the restarted worker replayed a non-empty
 journal, and the coordinator's ``GET /fleet`` merges both tenants'
 rows. ``--tenancy-requests 0`` skips the phase.
 
+A sixth phase drills the fleet SLO plane (docs/observability.md
+"SLO engine"): a two-worker fleet behind a coordinator running fast
+burn-rate windows, steady traffic proving ZERO false-positive alerts,
+then one worker SIGKILLed — the drill asserts ``GET /fleet/alerts``
+FIRES the ``fleet_availability`` policy with the victim (and only the
+victim) in the per-worker attribution, and that after a replacement
+worker heartbeats in the alert RESOLVES and the healed fleet stays
+quiet. ``--slo-alerts-requests 0`` skips the phase.
+
 Runs on CPU; phases 1-2 need no model artifact (workers serve an
 inline doubler); phase 3 persists real ``ScaleColumn`` checkpoints.
 """
@@ -159,6 +168,29 @@ ServingCoordinator.register_worker(sys.argv[1], srv.host, srv.port)
 print(srv.port, flush=True)
 while True:
     time.sleep(1)
+"""
+
+
+SLO_WORKER_SCRIPT = """
+import sys, time
+from mmlspark_tpu.serving.server import ServingServer, ServingCoordinator
+from mmlspark_tpu.core.stage import Transformer
+import numpy as np
+
+class Doubler(Transformer):
+    def transform(self, df):
+        return df.with_column("y", np.asarray(df["x"], dtype=np.float64) * 2)
+
+srv = ServingServer(Doubler(), max_latency_ms=1,
+                    journal_path=sys.argv[2],
+                    slow_trace_ms=None).start()
+print(srv.port, flush=True)
+while True:
+    # heartbeat: re-register every 0.5 s so the coordinator's
+    # stale_after prunes the SIGKILLed worker but never a live one —
+    # the same contract `python -m mmlspark_tpu.serving worker` keeps
+    ServingCoordinator.register_worker(sys.argv[1], srv.host, srv.port)
+    time.sleep(0.5)
 """
 
 
@@ -647,6 +679,131 @@ def tenancy_drill(tmp: str, seed: int, n_requests: int = 300) -> dict:
     }
 
 
+def slo_alerts_drill(tmp: str, seed: int, n_requests: int = 16) -> dict:
+    """Phase 6: the SLO availability-burn drill (docs/observability.md
+    "SLO engine").
+
+    A two-worker fleet behind a coordinator whose fleet SLO plane runs
+    fast burn windows. Steady-state traffic + ``GET /fleet/alerts``
+    polls must stay QUIET (zero false positives); then worker 0 is
+    SIGKILLed and the drill asserts the ``fleet_availability`` policy
+    FIRES with the victim — and only the victim — in the per-worker
+    attribution; then a replacement worker heartbeats in, the dead
+    registration ages out of ``stale_after``, the burn decays, and the
+    alert must RESOLVE and stay quiet.
+    """
+    import requests
+    from mmlspark_tpu.serving.server import ServingClient, \
+        ServingCoordinator
+
+    # fast windows so the drill runs in seconds: objective 0.9 means a
+    # 1-dead-of-2 fleet (50% poll failures) burns 5x budget — well
+    # over the 1.0 threshold — while a healthy fleet burns 0.
+    coord = ServingCoordinator(
+        stale_after=6.0,
+        slo={"objective": 0.9,
+             "windows": ((15.0, 3.0, 1.0),),
+             "for_s": 0.0,
+             "resolve_after_s": 2.0}).start()
+    coord_url = f"http://{coord.host}:{coord.port}"
+    workers = [spawn_worker(coord_url, os.path.join(tmp, f"slo{i}.jsonl"),
+                            SLO_WORKER_SCRIPT)
+               for i in range(2)]
+    out: dict = {"what": "SIGKILL one of two workers; fleet_availability "
+                         "must fire with victim attribution, then "
+                         "resolve after a replacement heartbeats in"}
+
+    def fleet_alerts():
+        return requests.get(coord_url + "/fleet/alerts",
+                            timeout=10).json()
+
+    def availability_alert(view):
+        for alert in (view.get("fleet") or {}).get("alerts") or []:
+            if alert.get("policy") == "fleet_availability":
+                return alert
+        return None
+
+    try:
+        # wait for both heartbeats to land before judging quiet
+        deadline = time.monotonic() + 10.0
+        while time.monotonic() < deadline:
+            svcs = requests.get(coord_url + "/services",
+                                timeout=10).json()
+            if len(svcs) >= 2:
+                break
+            time.sleep(0.1)
+        client = ServingClient(coord_url, timeout=10)
+        victim = f"127.0.0.1:{workers[0].port}"
+        survivor = f"127.0.0.1:{workers[1].port}"
+
+        # -- steady state: traffic + alert polls, ZERO firing allowed
+        false_firing = 0
+        for i in range(max(n_requests, 4)):
+            client.predict({"x": i}, request_id=f"slo-{seed}-{i}")
+            if fleet_alerts()["firing"]:
+                false_firing += 1
+            time.sleep(0.15)
+        out["steady_polls"] = max(n_requests, 4)
+        out["steady_false_firing"] = false_firing
+
+        # -- kill: poll until the availability policy fires
+        os.kill(workers[0].pid, signal.SIGKILL)
+        workers[0].wait()
+        fired = attributed = False
+        survivor_blamed = False
+        deadline = time.monotonic() + 30.0
+        while time.monotonic() < deadline:
+            alert = availability_alert(fleet_alerts())
+            if alert is not None and alert["state"] == "firing":
+                fired = True
+                blamed = {row["labels"].get("worker")
+                          for row in alert.get("attribution") or []}
+                attributed = victim in blamed
+                survivor_blamed = survivor in blamed
+                break
+            time.sleep(0.25)
+        out["fired"] = fired
+        out["victim_attributed"] = attributed
+        out["survivor_blamed"] = survivor_blamed
+
+        # -- restart: replacement heartbeats in; the dead registration
+        # ages out of stale_after; failures stop; the short window
+        # drains; the alert must resolve within the quiet period
+        workers[0] = spawn_worker(
+            coord_url, os.path.join(tmp, "slo0b.jsonl"),
+            SLO_WORKER_SCRIPT)
+        resolved = False
+        deadline = time.monotonic() + 45.0
+        while time.monotonic() < deadline:
+            view = fleet_alerts()
+            alert = availability_alert(view)
+            state = alert["state"] if alert is not None else "ok"
+            if view["firing"] == 0 and state in ("ok", "resolved"):
+                resolved = True
+                break
+            time.sleep(0.5)
+        out["resolved"] = resolved
+
+        # -- post-resolve: the healed fleet must stay quiet
+        post_false = 0
+        for _ in range(4):
+            if fleet_alerts()["firing"]:
+                post_false += 1
+            time.sleep(0.25)
+        out["post_resolve_false_firing"] = post_false
+        out["ok"] = (false_firing == 0 and fired and attributed
+                     and not survivor_blamed and resolved
+                     and post_false == 0)
+        return out
+    finally:
+        for w in workers:
+            try:
+                w.kill()
+            except Exception:  # noqa: BLE001 — already dead
+                pass
+        coord.stop()
+
+
 def main() -> int:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("--requests", type=int, default=120)
@@ -675,6 +832,10 @@ def main() -> int:
                     help="phase-5 noisy-neighbor drill: interactive "
                          "requests through the flood (0 skips the "
                          "phase)")
+    ap.add_argument("--slo-alerts-requests", type=int, default=16,
+                    help="phase-6 SLO availability-burn drill: steady-"
+                         "state requests before the SIGKILL (0 skips "
+                         "the phase)")
     args = ap.parse_args()
 
     if args.prefix_only:
@@ -772,6 +933,10 @@ def main() -> int:
         if args.tenancy_requests > 0:
             tenancy = tenancy_drill(tmp, args.seed,
                                     n_requests=args.tenancy_requests)
+        slo_alerts = None
+        if args.slo_alerts_requests > 0:
+            slo_alerts = slo_alerts_drill(
+                tmp, args.seed, n_requests=args.slo_alerts_requests)
         wall = time.perf_counter() - t0
 
         per_worker = [worker_status(w.port) for w in workers]
@@ -792,6 +957,8 @@ def main() -> int:
             **({"rollout": rollout} if rollout is not None else {}),
             **({"prefix": prefix} if prefix is not None else {}),
             **({"tenancy": tenancy} if tenancy is not None else {}),
+            **({"slo_alerts": slo_alerts}
+               if slo_alerts is not None else {}),
             "wall_s": round(wall, 3),
         }
         print(json.dumps(report, indent=2))
@@ -808,7 +975,8 @@ def main() -> int:
               and (burst is None or burst["ok"])
               and (rollout is None or rollout["ok"])
               and (prefix is None or prefix["ok"])
-              and (tenancy is None or tenancy["ok"]))
+              and (tenancy is None or tenancy["ok"])
+              and (slo_alerts is None or slo_alerts["ok"]))
         print("RESULT:", "PASS" if ok else "FAIL")
         return 0 if ok else 1
     finally:

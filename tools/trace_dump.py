@@ -27,6 +27,16 @@ export renders each worker in its own lane):
     python tools/trace_dump.py --fleet http://coordinator:8000 <trace-id>
     python tools/trace_dump.py --fleet http://coordinator:8000 --slowest -o t.json
 
+``--alerts`` / ``--slo`` switch to the SLO engine instead of the
+trace store (docs/observability.md "SLOs and alerting"): ``--alerts``
+prints the compact alert view (state, violating window pair,
+attribution), ``--slo`` the full burn-rate report per policy. Both
+compose with ``--fleet`` (merged evaluation, per-worker blocks):
+
+    python tools/trace_dump.py http://worker:8000 --alerts
+    python tools/trace_dump.py http://worker:8000 --slo
+    python tools/trace_dump.py --fleet http://coordinator:8000 --alerts
+
 stdlib-only on the wire (urllib): runs anywhere the worker is
 reachable, no client deps.
 """
@@ -72,6 +82,89 @@ def _print_listing(traces: list, fleet: bool) -> None:
               file=sys.stderr)
 
 
+def _fmt_window(w: dict) -> str:
+    mark = "  << VIOLATED" if w.get("violated") else ""
+    return (f"long {w['long_s']:>6.0f}s burn={w.get('burn_long', 0):>7.2f}"
+            f"  short {w['short_s']:>5.0f}s "
+            f"burn={w.get('burn_short', 0):>7.2f}"
+            f"  (fires at {w['burn_threshold']}x){mark}")
+
+
+def _print_alert(a: dict, depth: int = 0) -> None:
+    pad = "  " * depth
+    print(f"{pad}{a['policy']:<20} [{a['state']:<8}] "
+          f"{a['kind']}  objective={a['objective']}")
+    for w in a.get("windows") or []:
+        print(f"{pad}  {_fmt_window(w)}")
+    for row in a.get("attribution") or []:
+        labels = ",".join(f"{k}={v}"
+                          for k, v in sorted(row["labels"].items()))
+        print(f"{pad}  burning: {labels}  bad={row['bad']:.0f}")
+
+
+def _print_alerts_view(view: dict, depth: int = 0) -> None:
+    pad = "  " * depth
+    alerts = view.get("alerts") or []
+    print(f"{pad}firing={view.get('firing', 0)}  "
+          f"active_alerts={len(alerts)}")
+    for a in alerts:
+        _print_alert(a, depth)
+
+
+def _print_slo_report(rep: dict, depth: int = 0) -> None:
+    pad = "  " * depth
+    for p in rep.get("policies") or []:
+        flag = "  << VIOLATED" if p.get("violated") else ""
+        print(f"{pad}{p['policy']:<20} [{p.get('state', '?'):<8}] "
+              f"{p['kind']}  objective={p['objective']}{flag}")
+        for w in p.get("windows") or []:
+            print(f"{pad}  {_fmt_window(w)}")
+        extras = []
+        if "error_rate" in p:
+            extras.append(f"error_rate={p['error_rate']}")
+            extras.append(f"bad={p.get('bad', 0):.0f}/"
+                          f"{p.get('total', 0):.0f}")
+        if p.get("measured_ms") is not None:
+            extras.append(f"p{int(p.get('quantile', 0.95) * 100)}="
+                          f"{p['measured_ms']}ms "
+                          f"(target {p.get('threshold_ms')}ms)")
+        if extras:
+            print(f"{pad}  {'  '.join(extras)}")
+        for row in p.get("attribution") or []:
+            labels = ",".join(f"{k}={v}"
+                              for k, v in sorted(row["labels"].items()))
+            print(f"{pad}  burning: {labels}  bad={row['bad']:.0f}")
+
+
+def _run_slo_mode(base: str, fleet: bool, mode: str) -> None:
+    """``--alerts`` / ``--slo``: one worker's view, or the
+    coordinator's merged evaluation with per-worker blocks."""
+    if not fleet:
+        body = _get_json(f"{base}/{mode}")
+        if mode == "alerts":
+            _print_alerts_view(body)
+        else:
+            _print_slo_report(body)
+        return
+    body = _get_json(f"{base}/fleet/{mode}")
+    print(f"fleet: firing={body.get('firing', 0)}")
+    fleet_block = body.get("fleet") or {}
+    if mode == "alerts":
+        _print_alerts_view(fleet_block, 1)
+    else:
+        _print_slo_report(fleet_block, 1)
+    for wk, view in sorted((body.get("workers") or {}).items()):
+        if isinstance(view, dict) and "error" in view:
+            print(f"worker {wk}: unreachable ({view['error']})",
+                  file=sys.stderr)
+            continue
+        print(f"worker {wk}:")
+        if mode == "alerts":
+            _print_alerts_view(view, 1)
+        else:
+            _print_slo_report(view, 1)
+
+
 def main() -> None:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("worker", help="worker base url, e.g. "
@@ -83,6 +176,14 @@ def main() -> None:
                     help="URL is a ServingCoordinator: list every "
                          "worker's captures, fetch MERGED distributed "
                          "traces (per-worker Perfetto lanes)")
+    ap.add_argument("--alerts", action="store_true",
+                    help="print the SLO engine's compact alert view "
+                         "(GET /alerts; /fleet/alerts with --fleet) "
+                         "instead of traces")
+    ap.add_argument("--slo", action="store_true",
+                    help="print the full burn-rate report per policy "
+                         "(GET /slo; /fleet/slo with --fleet) instead "
+                         "of traces")
     ap.add_argument("--list", action="store_true",
                     help="list retained traces and exit")
     ap.add_argument("--slow", action="store_true",
@@ -98,6 +199,11 @@ def main() -> None:
     args = ap.parse_args()
     base = args.worker.rstrip("/")
     trace_base = f"{base}/fleet/trace" if args.fleet else f"{base}/trace"
+
+    if args.alerts or args.slo:
+        _run_slo_mode(base, args.fleet,
+                      "alerts" if args.alerts else "slo")
+        return
 
     if args.list or args.slowest:
         if args.fleet:
