@@ -1511,6 +1511,99 @@ def bench_slo_overhead():
             "passed": ok, "chip": _chip()}
 
 
+def bench_tsdb_overhead():
+    """Retrospective-plane overhead (ISSUE 19 acceptance gate): the
+    embedded TSDB must observe the server without becoming a workload
+    of its own.
+
+    Three gates:
+
+    * **ingest** — one full scrape+ingest tick over a loaded registry
+      (10 histogram families x 8 children + 200 counter children,
+      ~760 ingest rows — more series than a real worker exposes) must
+      average under the Recorder's 25 ms default budget;
+    * **bounded memory** — a two-hour synthetic run at the 10 s scrape
+      cadence holds the per-tier point count FLAT between the one-hour
+      and two-hour marks (retention evicts exactly as fast as ingest
+      adds: memory is retention/resolution per series, not runtime);
+    * **query** — a full-retention ``query_range`` (rate over every
+      series, 30 min window, 60 s steps) answers inside one 10 s
+      scrape interval.
+
+    ``vs_baseline`` = ingest budget / measured; ``passed`` gates all
+    three.
+    """
+    from mmlspark_tpu.core.resilience import ManualClock
+    from mmlspark_tpu.core.telemetry import MetricsRegistry
+    from mmlspark_tpu.core.tsdb import TimeSeriesStore, take_scrape
+
+    clock = ManualClock()
+    reg = MetricsRegistry(clock=clock)
+    hists = [reg.histogram(f"h{i}_ms", "x", labels=("k",),
+                           buckets=(1.0, 5.0, 25.0, 100.0))
+             for i in range(10)]
+    ctrs = [reg.counter(f"c{i}_total", "x", labels=("k",))
+            for i in range(20)]
+    for h in hists:
+        for j in range(8):
+            h.labels(str(j)).observe(float(j))
+    for c in ctrs:
+        for j in range(10):
+            c.labels(str(j)).inc()
+
+    # -- ingest: mean scrape+ingest over live ticks at the loaded
+    # registry, with the sources still moving between scrapes
+    store = TimeSeriesStore()
+    n_rows = store.ingest(take_scrape(reg, at=0.0))
+    rounds = 50
+    t0 = time.perf_counter_ns()
+    for i in range(1, rounds + 1):
+        ctrs[i % 20].labels(str(i % 10)).inc()
+        hists[i % 10].labels(str(i % 8)).observe(float(i % 90))
+        store.ingest(take_scrape(reg, at=float(i)))
+    ingest_ms = (time.perf_counter_ns() - t0) / rounds / 1e6
+
+    # -- bounded memory: 7 h of 10 s ticks; the coarsest default tier
+    # retains 6 h, so the point count must be FLAT between the 6 h
+    # and 7 h marks (every tier past its retention by then)
+    def _retained(st):
+        return sum(len(ring) for s in st._series.values()
+                   for ring in s.rings)
+
+    marks = []
+    for i in range(1, 2521):
+        ctrs[0].labels("0").inc()
+        store.ingest(take_scrape(reg, at=50.0 + i * 10.0))
+        if i in (2160, 2520):
+            marks.append(_retained(store))
+    flat = marks[0] == marks[1]
+
+    # -- query: full-retention range query over every counter series
+    t0 = time.perf_counter_ns()
+    n_series = 0
+    for i in range(20):
+        out = store.query_range(f"rate(c{i}_total[300s])",
+                                start=-1800.0, step=60.0)
+        n_series += len(out["series"])
+    query_ms = (time.perf_counter_ns() - t0) / 1e6
+
+    ingest_budget_ms = 25.0
+    query_budget_ms = 10_000.0
+    ok = (ingest_ms < ingest_budget_ms and flat
+          and query_ms < query_budget_ms)
+    return {"metric": "tsdb_overhead_v1",
+            "value": round(ingest_ms, 3), "unit": "ms/scrape_ingest",
+            "n_rows": n_rows, "points_6h": marks[0],
+            "points_7h": marks[1], "rss_flat": flat,
+            "query_range_ms": round(query_ms, 2),
+            "query_series": n_series,
+            "query_budget_ms": query_budget_ms,
+            "baseline": ingest_budget_ms,
+            "vs_baseline": round(ingest_budget_ms /
+                                 max(ingest_ms, 1e-9), 3),
+            "passed": ok, "chip": _chip()}
+
+
 def bench_decode_continuous():
     """Continuous batching for autoregressive decode vs the static
     whole-batch baseline (ISSUE 9 acceptance gate).
@@ -2638,6 +2731,7 @@ BENCHES = [bench_gbdt_quantile, bench_adult_census, bench_cifar10_scoring,
            bench_transformer_train_long, bench_moe_train,
            bench_telemetry_overhead, bench_tracing_overhead,
            bench_trace_propagation, bench_slo_overhead,
+           bench_tsdb_overhead,
            bench_decode_continuous,
            bench_decode_paged, bench_decode_speculative,
            bench_decode_prefix_cache,

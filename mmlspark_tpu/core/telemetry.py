@@ -71,6 +71,7 @@ __all__ = [
     "DEFAULT_LATENCY_BUCKETS_MS", "log_buckets",
     "render_registries", "parse_prometheus", "merge_prometheus",
     "render_samples", "MetricsSnapshot", "snapshot_registries",
+    "write_snapshot",
     "MetricsPusher", "quantile_from_buckets",
     "collect_samples", "encode_write_request", "compress_write_request",
     "snappy_available",
@@ -666,21 +667,25 @@ def render_registries(*registries: MetricsRegistry,
 # Metrics snapshots (batch jobs that exit before a scrape)
 # ---------------------------------------------------------------------------
 
-def snapshot_registries(directory: str, tag: Optional[str] = None,
-                        registries: Iterable[MetricsRegistry] = (),
-                        prefix: str = "metrics", keep: int = 0) -> str:
-    """Write one exposition scrape to ``directory/<prefix>-<tag>.prom``
-    (any io.fs target — a checkpoint dir, gs://...). ``tag`` defaults
-    to a UTC timestamp; ``keep > 0`` prunes the directory to the
-    newest ``keep`` snapshots (tags sort lexically: both timestamps
-    and zero-padded step tags order correctly). Returns the path."""
+def write_snapshot(directory: str, text: str, tag: Optional[str] = None,
+                   prefix: str = "metrics", keep: int = 0) -> str:
+    """Write already-rendered exposition ``text`` to
+    ``directory/<prefix>-<tag>.prom`` (any io.fs target — a checkpoint
+    dir, gs://...). ``tag`` defaults to a UTC timestamp; ``keep > 0``
+    prunes the directory to the newest ``keep`` snapshots (tags sort
+    lexically: both timestamps and zero-padded step tags order
+    correctly). Returns the path.
+
+    This is the shared write path under :func:`snapshot_registries`
+    (which scrapes, then calls here) and the TSDB Recorder (which
+    dumps the SAME scrape it ingests — one scrape per interval, not
+    one per consumer)."""
     from mmlspark_tpu.io import fs as _fs
-    regs = tuple(registries) or (REGISTRY,)
     if tag is None:
         tag = time.strftime("%Y%m%dT%H%M%SZ", time.gmtime())
     _fs.makedirs(directory)
     path = _fs.join(directory, f"{prefix}-{tag}.prom")
-    _fs.write_text(path, render_registries(*regs))
+    _fs.write_text(path, text)
     if keep > 0:
         mine = sorted(
             p for p in _fs.find_files(directory, recursive=False)
@@ -696,6 +701,16 @@ def snapshot_registries(directory: str, tag: Optional[str] = None,
             except Exception:  # noqa: BLE001 — pruning is best-effort
                 pass
     return path
+
+
+def snapshot_registries(directory: str, tag: Optional[str] = None,
+                        registries: Iterable[MetricsRegistry] = (),
+                        prefix: str = "metrics", keep: int = 0) -> str:
+    """Scrape ``registries`` (default: the process-wide one) and write
+    the exposition via :func:`write_snapshot`. Returns the path."""
+    regs = tuple(registries) or (REGISTRY,)
+    return write_snapshot(directory, render_registries(*regs), tag,
+                          prefix, keep)
 
 
 class MetricsSnapshot:

@@ -216,7 +216,12 @@ def run_worker() -> None:
         capture=capture,
         tls_cert=os.environ.get("TLS_CERT") or None,
         tls_key=os.environ.get("TLS_KEY") or None,
-        quantization=(_json_env("QUANTIZATION")))
+        quantization=(_json_env("QUANTIZATION")),
+        # TSDB=0 disables the retrospective plane; a JSON dict
+        # overrides its knobs (interval_s, tiers, snapshot_dir,
+        # rules, watches, ...); unset = the stock plane
+        tsdb=(False if os.environ.get("TSDB") in ("0", "false")
+              else _json_env("TSDB")))
     warm = os.environ.get("WARMUP_PAYLOAD")
     if warm:
         # warm BEFORE start(): the socket is already bound (early

@@ -1255,6 +1255,7 @@ class DecodeScheduler:
         self._m_ttft = None
         self._m_tpot = None
         self._m_tokens_req = None
+        self._m_device = None
         if registry is not None:
             self._register_metrics(registry)
 
@@ -1365,6 +1366,18 @@ class DecodeScheduler:
             "serving_decode_spec_round_latency_ms",
             "Speculative round wall-clock (draft propose + target "
             "verify + host acceptance, all slots at once).")
+        # billing-grade device-time attribution: the same family the
+        # server's dispatch stage charges (get-or-create — one counter
+        # per registry). Steps/spec rounds run ALL active slots at
+        # once, so their wall time is pro-rated equally across the
+        # tenants riding those slots; prefill is per-request and
+        # charges whole.
+        self._m_device = m.counter(
+            "serving_tenant_device_ms_total",
+            "Device wall-clock milliseconds attributed to each tenant: "
+            "batch dispatch pro-rated by rows, decode steps pro-rated "
+            "by active slots, prefill charged to its request.",
+            labels=("tenant",))
         self._m_queue_wait = m.histogram(
             "serving_decode_queue_wait_ms",
             "Submit -> slot-claim wait per decode request.")
@@ -1424,6 +1437,28 @@ class DecodeScheduler:
             if ten is not None and tid:
                 tenant = ten.label_of(tid)
         return route, tenant
+
+    def _charge_device_ms(self, total_ms: float,
+                          reqs: "Iterable[_DecodeRequest]") -> None:
+        """Pro-rate one step/round/prefill's device wall-clock equally
+        across the tenants whose requests rode it (each active slot
+        advances one token per step — equal shares are the honest
+        split). One counter inc per distinct tenant per step; tenant
+        labels ride the tenancy registry's BoundedLabelSet via
+        :meth:`_timeline_labels`."""
+        if self._m_device is None or total_ms <= 0:
+            return
+        counts: "dict[str, int]" = {}
+        n = 0
+        for req in reqs:
+            _, tenant = self._timeline_labels(req)
+            counts[tenant] = counts.get(tenant, 0) + 1
+            n += 1
+        if not n:
+            return
+        share = total_ms / n
+        for tenant, k in counts.items():
+            self._m_device.labels(tenant).inc(share * k)
 
     # -- admission (any thread) ----------------------------------------------
 
@@ -1951,6 +1986,9 @@ class DecodeScheduler:
                     bucket_target(len(req.prompt),
                                   self.decoder.max_len)).observe(
                     (t1 - t0) * 1000.0)
+            # prefill runs ONE request: its whole wall time is that
+            # request's tenant's device time
+            self._charge_device_ms((t1 - t0) * 1000.0, (req,))
             self._add_span(req, "prefill", t0, t1, slot=slot,
                            prompt_len=len(req.prompt),
                            prefix_hit=hit_len)
@@ -2109,6 +2147,8 @@ class DecodeScheduler:
         self.n_steps += 1
         if self._m_step is not None:
             self._m_step.labels().observe((t1 - t0) * 1000.0)
+        self._charge_device_ms((t1 - t0) * 1000.0,
+                               self._active.values())
         if self.decoder.has_draft and any(
                 self._spec_capable(r) for r in self._active.values()):
             # draft-cache catch-up: a spec-capable slot stepping
@@ -2203,6 +2243,8 @@ class DecodeScheduler:
         self.n_spec_rounds += 1
         if self._m_spec_round is not None:
             self._m_spec_round.labels().observe((t1 - t0) * 1000.0)
+        self._charge_device_ms((t1 - t0) * 1000.0,
+                               self._active.values())
         logits_np = None
         if any(r.sampler is not None
                for r in self._active.values()):

@@ -53,6 +53,7 @@ import json
 import os
 import threading
 import time
+import urllib.parse as _urlparse
 import uuid
 from collections import OrderedDict
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
@@ -86,6 +87,11 @@ from mmlspark_tpu.core.telemetry import (
     TRACE_HEADER, current_trace_id, merge_prometheus, new_trace_id,
     register_build_info, render_registries, render_samples,
     trace_context,
+)
+from mmlspark_tpu.core.tsdb import (
+    AnomalyDetector, AnomalyWatch, DEFAULT_TIERS, QueryError, Recorder,
+    RecordingRule, TimeSeriesStore, default_serving_rules,
+    default_serving_watches,
 )
 from mmlspark_tpu.core.tracing import (
     CAPTURE_HEADER, PARENT_SPAN_HEADER, TRACER, AdaptiveThreshold,
@@ -262,6 +268,7 @@ class ServingServer:
                  tenancy=None,
                  slo=None,
                  slo_webhook: Optional[str] = None,
+                 tsdb=None,
                  profile_dir: Optional[str] = None,
                  clock: Clock = SYSTEM_CLOCK):
         self.api_path = api_path
@@ -363,6 +370,16 @@ class ServingServer:
             "serving_dispatch_latency_ms",
             "Model dispatch wall-clock per shape bucket (label = padded "
             "row count actually dispatched).", labels=("bucket",))
+        # billing-grade device-time attribution: each batch's dispatch
+        # wall-clock is pro-rated across the tenants whose rows rode it
+        # (the decode plane pro-rates its step/spec-round/prefill time
+        # the same way through this family — see decode.py)
+        self._m_tenant_device = self.registry.counter(
+            "serving_tenant_device_ms_total",
+            "Device wall-clock milliseconds attributed to each tenant: "
+            "batch dispatch pro-rated by rows, decode steps pro-rated "
+            "by active slots, prefill charged to its request.",
+            labels=("tenant",))
         # -- adaptive tail-capture threshold: once the route has enough
         # dispatch-latency samples (adaptive_min_count — until then the
         # configured slow_trace_ms keeps ruling), the threshold tracks
@@ -617,6 +634,52 @@ class ServingServer:
                 clock=clock,
                 notifier=(AlertNotifier(slo_webhook)
                           if slo_webhook else None))
+        # -- retrospective plane (on by default): the embedded TSDB +
+        # background Recorder (core/tsdb.py). ``tsdb`` is False (off),
+        # None for stock tiers/rules/watches, or a config dict:
+        # interval_s, tiers, max_series, snapshot_dir/keep/prefix,
+        # budget_ms, rules (list of RecordingRule or dicts; None =
+        # stock), watches (likewise), anomaly (False disables
+        # detection). ONE scrape per tick feeds the TSDB, the optional
+        # .prom dumper, and the SLO engine's snapshot history — a
+        # server with a Recorder must not also run a MetricsSnapshot.
+        # ``GET /query`` / ``GET /query_range`` serve the store;
+        # anomaly transitions ride the SLO notifier and merge into
+        # ``GET /alerts``.
+        self.tsdb: Optional[TimeSeriesStore] = None
+        self.recorder: Optional[Recorder] = None
+        self.anomalies: Optional[AnomalyDetector] = None
+        if tsdb is not False:
+            cfg = dict(tsdb) if isinstance(tsdb, dict) else {}
+            has_decoder = self.decoder is not None
+            self.tsdb = TimeSeriesStore(
+                tiers=cfg.get("tiers", DEFAULT_TIERS),
+                max_series=cfg.get("max_series", 8192))
+            rules = cfg.get("rules")
+            rules = (default_serving_rules(
+                         has_decoder=has_decoder,
+                         has_tenancy=self.tenancy is not None)
+                     if rules is None
+                     else [RecordingRule.from_value(r) for r in rules])
+            if cfg.get("anomaly", True):
+                watches = cfg.get("watches")
+                watches = (default_serving_watches(
+                               has_decoder=has_decoder)
+                           if watches is None
+                           else [AnomalyWatch.from_value(w)
+                                 for w in watches])
+                self.anomalies = AnomalyDetector(
+                    self.tsdb, watches, clock=clock,
+                    notifier=(self.slo.notifier
+                              if self.slo is not None else None))
+            self.recorder = Recorder(
+                (self.registry, REGISTRY), store=self.tsdb,
+                interval_s=cfg.get("interval_s", 10.0), clock=clock,
+                snapshot_dir=cfg.get("snapshot_dir"),
+                snapshot_keep=cfg.get("keep", 24),
+                snapshot_prefix=cfg.get("prefix", "metrics"),
+                slo=self.slo, rules=rules, detector=self.anomalies,
+                ingest_budget_ms=cfg.get("budget_ms", 25.0))
         # -- device observability: one-at-a-time on-demand profiler
         # windows (POST /profile -> jax.profiler trace on disk), the
         # bounded compile-event ledger the dispatch stage feeds, and
@@ -637,6 +700,29 @@ class ServingServer:
         (model + version label together, so a mid-batch flip can't
         split them)."""
         return self.versions.active.model
+
+    def _charge_tenant_device(self, pendings, total_ms: float) -> None:
+        """Pro-rate one batch's dispatch wall-clock across the tenants
+        whose rows rode it (equal share per row — rows are what the
+        batch is made of). Tenant ids resolve to their bounded metric
+        labels via the tenant registry; unattributed traffic charges
+        to the anonymous tenant. One counter inc per distinct tenant
+        per batch — micro-cost on the dispatch (not request) path."""
+        if total_ms <= 0 or not pendings:
+            return
+        counts: Dict[Optional[str], int] = {}
+        for p in pendings:
+            tid = getattr(p, "tenant", None)
+            counts[tid] = counts.get(tid, 0) + 1
+        share = total_ms / len(pendings)
+        for tid, n in counts.items():
+            if tid is None:
+                label = ANONYMOUS_ID
+            elif self.tenancy is not None:
+                label = self.tenancy.label_of(tid)
+            else:
+                label = str(tid)
+            self._m_tenant_device.labels(label).inc(share * n)
 
     def _register_metric_views(self) -> None:
         """Expose the server's existing counters/state as registry
@@ -1176,6 +1262,11 @@ class ServingServer:
                     # (GET /slo runs one); None when disabled
                     "slo": (self.slo.status()
                             if self.slo is not None else None),
+                    # the retrospective plane: recorder cadence/budget,
+                    # store size per tier, anomaly detector state; None
+                    # when the TSDB is disabled
+                    "tsdb": (self.recorder.status()
+                             if self.recorder is not None else None),
                     # device observability: profiler window state, the
                     # bounded compile-event ledger, per-bucket MFU,
                     # and HBM live/peak/limit bytes
@@ -1239,12 +1330,20 @@ class ServingServer:
         if path == "/alerts":
             # the SLO engine's compact alert view (state machine +
             # violating window pairs); the GET itself drives an
-            # evaluation pass — pull-based, nothing on the hot path
+            # evaluation pass — pull-based, nothing on the hot path.
+            # Anomaly-watch states ride along under "anomalies" (their
+            # firing count adds into "firing"), so one endpoint answers
+            # "is anything wrong" for both alert sources.
             if self.slo is None:
                 return (404, b'{"error": "slo engine disabled"}',
                         "application/json", ())
             self.slo.evaluate()
-            return (200, json.dumps(self.slo.alerts()).encode(),
+            body = self.slo.alerts()
+            if self.anomalies is not None:
+                an = self.anomalies.alerts()
+                body["anomalies"] = an["alerts"]
+                body["firing"] = body.get("firing", 0) + an["firing"]
+            return (200, json.dumps(body).encode(),
                     "application/json", ())
         if path == "/slo":
             # the full burn-rate report: every policy's long/short
@@ -1253,6 +1352,39 @@ class ServingServer:
                 return (404, b'{"error": "slo engine disabled"}',
                         "application/json", ())
             return (200, json.dumps(self.slo.evaluate()).encode(),
+                    "application/json", ())
+        if base in ("/query", "/query_range"):
+            # the retrospective plane's query surface (core/tsdb.py):
+            # ?expr=<selector | rate(sel[w]) | increase(sel[w]) |
+            # quantile(q, hist[w])> — /query takes ?at=, /query_range
+            # takes ?start=&end=&step= (timestamps on the worker's
+            # monotonic clock, defaulting to the newest recorded data).
+            # Malformed expressions are a 400, never a 500.
+            if self.tsdb is None:
+                return (404, b'{"error": "tsdb disabled"}',
+                        "application/json", ())
+            params = _urlparse.parse_qs(
+                path.partition("?")[2], keep_blank_values=True)
+            expr = (params.get("expr") or [""])[0]
+            try:
+                if base == "/query":
+                    at = params.get("at")
+                    body = self.tsdb.query(
+                        expr, at=float(at[0]) if at else None)
+                else:
+                    start = params.get("start")
+                    end = params.get("end")
+                    step = (params.get("step") or ["10"])[0]
+                    body = self.tsdb.query_range(
+                        expr,
+                        start=float(start[0]) if start else None,
+                        end=float(end[0]) if end else None,
+                        step=float(step))
+            except (QueryError, ValueError) as e:
+                return (400, json.dumps({"error": str(e),
+                                         "expr": expr}).encode(),
+                        "application/json", ())
+            return (200, json.dumps(body).encode(),
                     "application/json", ())
         if path == "/profile":
             # profiler status (busy flag, last capture window); the
@@ -2242,6 +2374,8 @@ class ServingServer:
                 # MFU when the model reports flops for the shape
                 self.mfu.note(df.num_rows, seconds,
                               flops=self._flops_for(mv, df, key))
+                self._charge_tenant_device(job["live"],
+                                           seconds * 1000.0)
                 # df.num_rows < n_live only for degenerate frames (e.g.
                 # empty-object payloads -> a zero-column frame): still a
                 # row-count error, never a silent short batch
@@ -2796,6 +2930,11 @@ class ServingServer:
             self._threads.append(self._journal_thread)
         if self.decoder is not None:
             self.decoder.start()
+        if self.recorder is not None:
+            # the retrospective plane's pump: one scrape per interval
+            # feeding the TSDB, the SLO history, recording rules, the
+            # anomaly detector, and (when configured) the .prom dumper
+            self.recorder.start()
         return self
 
     def stop(self, drain: bool = True, drain_timeout: float = 5.0):
@@ -2856,6 +2995,10 @@ class ServingServer:
         if self.capture is not None:
             # flush queued capture rows so a clean stop loses nothing
             self.capture.stop()
+        if self.recorder is not None:
+            # final tick: the terminal counters land in the store (and
+            # on disk when dumping) before the process exits
+            self.recorder.stop()
         if self._journal_fh is not None:
             jt = getattr(self, "_journal_thread", None)
             if jt is not None and jt.is_alive():
@@ -3131,6 +3274,18 @@ class ServingCoordinator:
                 out["workers_failed"] = errors
                 body = json.dumps(out).encode()
             return 200, body, "application/json"
+        if path.startswith("/fleet/query"):
+            # the one-stop fleet view over the retrospective plane:
+            # /fleet/query and /fleet/query_range fan the expression
+            # out to every worker's TSDB and merge the answers under
+            # worker=host:port labels (same query grammar; dead
+            # workers degrade to error entries, never a 5xx)
+            sub = path[len("/fleet"):]
+            base = sub.split("?", 1)[0]
+            if base not in ("/query", "/query_range"):
+                return None
+            return (200, json.dumps(self.fleet_query(sub)).encode(),
+                    "application/json")
         if path == "/rollout":
             return (200, json.dumps(self.rollout_status()).encode(),
                     "application/json")
@@ -3260,7 +3415,7 @@ class ServingCoordinator:
                 r = requests.get(f"http://{wk}{path}", timeout=timeout)
                 r.raise_for_status()
                 json_paths = ("/stats", "/traces", "/trace/",
-                              "/alerts", "/slo")
+                              "/alerts", "/slo", "/query")
                 return (wk, r.json() if path.startswith(json_paths)
                         else r.text, None)
             except Exception as e:  # noqa: BLE001 — worker down/old
@@ -3478,6 +3633,42 @@ class ServingCoordinator:
             if err is not None:
                 self._m_poll_failures.labels(wk).inc()
         return polls
+
+    def fleet_query(self, path_with_query: str, timeout: float = 5.0
+                    ) -> Dict[str, Any]:
+        """Fan one ``/query`` or ``/query_range`` (path WITH its query
+        string) out to every worker's TSDB and merge the per-worker
+        answers: every result/series gains a ``worker: host:port``
+        label, so a fleet-wide ``rate(serving_requests_total[60s])``
+        comes back as one list with per-worker attribution. A dead
+        worker (or a worker-side 400) contributes an ``errors`` entry
+        instead of failing the view; the query echo (expr/at or
+        start/end/step) is taken from the first responding worker."""
+        merged: List[Dict[str, Any]] = []
+        errors: Dict[str, str] = {}
+        echo: Dict[str, Any] = {}
+        key = None
+        polls = self._poll_workers(path_with_query, timeout)
+        for wk, body, err in polls:
+            if err is not None or not isinstance(body, dict):
+                errors[wk] = err or "malformed worker response"
+                continue
+            if key is None:
+                key = "series" if "series" in body else "results"
+                echo = {k: body[k] for k in
+                        ("expr", "at", "start", "end", "step")
+                        if k in body}
+            for row in body.get(key) or []:
+                entry = dict(row)
+                entry["labels"] = dict(entry.get("labels") or {})
+                entry["labels"]["worker"] = wk
+                merged.append(entry)
+        out = dict(echo)
+        out.update({"n_workers": len(polls),
+                    "n_responding": len(polls) - len(errors),
+                    "errors": errors,
+                    (key or "results"): merged})
+        return out
 
     # -- fleet-level trace aggregation ---------------------------------------
 

@@ -445,8 +445,24 @@ class SLOEngine:
             return self._evaluate_locked(
                 self.clock.now() if now is None else float(now))
 
-    def _evaluate_locked(self, now: float) -> Dict[str, Any]:
-        snap = self._collect()
+    def observe(self, now: float, snap: dict) -> Dict[str, Any]:
+        """Evaluate against an externally-collected snapshot (the
+        TSDB Recorder's unified scrape — see
+        :meth:`mmlspark_tpu.core.tsdb.Scrape.slo_snapshot`), so one
+        scrape per interval feeds the dumper, the TSDB, AND this
+        engine's history instead of each taking its own."""
+        with self._lock:
+            return self._evaluate_locked(float(now), snap)
+
+    def wanted_metrics(self) -> set:
+        """The metric names the policies reference — what an external
+        snapshot must cover."""
+        return set(self._wanted)
+
+    def _evaluate_locked(self, now: float,
+                         snap: Optional[dict] = None) -> Dict[str, Any]:
+        if snap is None:
+            snap = self._collect()
         if self._history and self._history[-1][0] >= now:
             # same (or rewound) instant: replace rather than duplicate
             self._history.pop()

@@ -1,0 +1,637 @@
+"""The retrospective plane (ISSUE 19): embedded TSDB + query plane +
+baseline-relative regression detection.
+
+Five pillars:
+
+* **one scrape, three consumers** — ``take_scrape`` captures kinds,
+  edges, and child values in one registry pass; the exposition it
+  renders is byte-identical to the registry's own, the ingest rows
+  mirror the ``_bucket``/``_sum``/``_count`` expansion a Prometheus
+  would scrape, and the SLO snapshot it derives feeds the engine's
+  history without a second scrape;
+* **downsampling and retention are exact on a ManualClock** — the
+  10s/60s tiers keep the LAST sample per resolution bucket (correct
+  for cumulative counters), flush when the bucket advances, and evict
+  strictly by per-tier retention, so memory is bounded by
+  retention/resolution per series;
+* **counter math survives restarts** — every point carries a
+  reset-adjusted cumulative value (the SLOEngine delta clamp), so
+  ``rate()``/``increase()``/``quantile()`` are exact across a worker
+  restart and hand-computed goldens hold;
+* **the anomaly detector cannot flap** — no verdict before warm-up,
+  ``for_s`` holds pending back, ``resolve_after_s`` holds firing
+  through blips, the baseline is frozen while violated (a sustained
+  regression cannot teach itself normal), and a steady noisy series
+  produces zero transitions ever;
+* **the fleet view degrades, never 5xxs** — a dead worker contributes
+  an error entry to ``/fleet/query_range`` while live workers' series
+  come back under ``worker=host:port`` labels.
+"""
+
+import math
+import os
+import time
+
+import numpy as np
+import pytest
+
+from mmlspark_tpu.core.resilience import ManualClock
+from mmlspark_tpu.core.telemetry import (
+    MetricsRegistry, quantile_from_buckets, render_registries,
+)
+from mmlspark_tpu.core.tsdb import (
+    AnomalyDetector, AnomalyWatch, QueryError, Recorder, RecordingRule,
+    TimeSeriesStore, default_serving_rules, default_serving_watches,
+    parse_duration, parse_expr, take_scrape,
+)
+
+EDGES = (1.0, 5.0, 25.0, 100.0)
+
+# small tiers for downsample/retention goldens: raw 10s, one point per
+# 10s for 60s, one point per 60s for 600s
+TIERS = ((0.0, 10.0), (10.0, 60.0), (60.0, 600.0))
+
+
+def _registry(clock):
+    m = MetricsRegistry(clock=clock)
+    c = m.counter("serving_requests_total", "req", labels=("route",))
+    h = m.histogram("serving_dispatch_latency_ms", "lat",
+                    labels=("bucket",), buckets=EDGES)
+    g = m.gauge("inflight", "cur")
+    return m, c, h, g
+
+
+class TestScrape:
+
+    def test_render_matches_registry_exposition(self):
+        """The scrape's exposition is byte-identical to the
+        registry's own render (escapes and all) — the .prom dumper can
+        ride the shared scrape without changing its output format."""
+        clock = ManualClock()
+        m, c, h, g = _registry(clock)
+        c.labels('/a"b\\c\n').inc(3)
+        h.labels("8").observe(7.5)
+        g.set(2.5)
+        assert take_scrape(m, at=1.0).render() == render_registries(m)
+
+    def test_rows_expand_histograms_like_the_exposition(self):
+        """Ingest rows carry the cumulative ``_bucket`` + ``_sum`` +
+        ``_count`` expansion with +Inf last — the same numbers a
+        Prometheus scraping /metrics would store."""
+        clock = ManualClock()
+        m, c, h, g = _registry(clock)
+        h.labels("4").observe(0.5)
+        h.labels("4").observe(3.0)
+        h.labels("4").observe(50.0)
+        g.set(2.5)
+        rows = {(name, labels): (value, kind)
+                for name, labels, value, kind
+                in take_scrape(m, at=1.0).rows()}
+        lbl = (("bucket", "4"),)
+        assert rows[("serving_dispatch_latency_ms_bucket",
+                     lbl + (("le", "1"),))] == (1.0, "c")
+        assert rows[("serving_dispatch_latency_ms_bucket",
+                     lbl + (("le", "5"),))] == (2.0, "c")
+        assert rows[("serving_dispatch_latency_ms_bucket",
+                     lbl + (("le", "100"),))] == (3.0, "c")
+        assert rows[("serving_dispatch_latency_ms_bucket",
+                     lbl + (("le", "+Inf"),))] == (3.0, "c")
+        assert rows[("serving_dispatch_latency_ms_sum", lbl)] == \
+            (53.5, "c")
+        assert rows[("serving_dispatch_latency_ms_count", lbl)] == \
+            (3.0, "c")
+        assert rows[("inflight", ())] == (2.5, "g")
+
+    def test_slo_snapshot_matches_engine_collect(self):
+        """The snapshot the scrape derives is the exact dict shape
+        SLOEngine._collect builds — the one-scrape unification is a
+        drop-in feed."""
+        from mmlspark_tpu.serving.slo import SLOEngine, SLOPolicy
+        clock = ManualClock()
+        m, c, h, g = _registry(clock)
+        c.labels("/a").inc(7)
+        h.labels("8").observe(2.0)
+        eng = SLOEngine(m, [SLOPolicy(
+            "lat", "latency", 0.95,
+            metric="serving_dispatch_latency_ms", threshold_ms=100.0,
+            windows=((60.0, 10.0, 2.0),))], clock=clock)
+        snap = take_scrape(m, at=1.0).slo_snapshot(eng.wanted_metrics())
+        assert snap == eng._collect()
+
+
+class TestDownsampling:
+
+    def test_tier_goldens_raw_10s_60s(self):
+        """Scraping a gauge (value = its timestamp) every second for
+        125 s: the raw ring keeps the trailing 10 s, the 10 s tier
+        keeps each closed bucket's LAST sample inside its 60 s
+        retention, the 60 s tier likewise — hand-enumerated."""
+        store = TimeSeriesStore(tiers=TIERS)
+        for ts in range(1, 126):
+            store.write(float(ts), "g", {}, float(ts), kind="g")
+        s = store._series[("g", ())]
+        assert [p[0] for p in s.rings[0]] == \
+            [float(t) for t in range(115, 126)]
+        # closed 10s buckets end at 9,19,...,119; eviction at the last
+        # flush (ts=120) drops everything older than 120-60
+        assert [p[0] for p in s.rings[1]] == \
+            [69.0, 79.0, 89.0, 99.0, 109.0, 119.0]
+        assert s.pending[1][0] == 125.0
+        # closed 60s buckets end at 59 and 119; 600s retention keeps both
+        assert [p[0] for p in s.rings[2]] == [59.0, 119.0]
+        assert s.pending[2][0] == 125.0
+        # the open buckets are query-visible: an instant query at 125
+        # sees the newest point even though no bucket has closed on it
+        assert store.query("g")["results"][0]["value"] == 125.0
+
+    def test_last_sample_wins_within_bucket(self):
+        """Two samples inside one 10 s bucket: the flushed point is
+        the LATER one (cumulative counters: the last sample IS the
+        state at the bucket edge)."""
+        store = TimeSeriesStore(tiers=TIERS)
+        store.write(11.0, "c", {}, 5.0, kind="c")
+        store.write(17.0, "c", {}, 9.0, kind="c")
+        store.write(21.0, "c", {}, 12.0, kind="c")   # closes bucket 1
+        s = store._series[("c", ())]
+        assert [(p[0], p[1]) for p in s.rings[1]] == [(17.0, 9.0)]
+
+    def test_window_reads_merge_tiers(self):
+        """A window spanning evicted-raw history still reads the
+        coarser tiers: old points come from the 10s/60s rings, recent
+        points from raw, duplicates collapse."""
+        store = TimeSeriesStore(tiers=TIERS)
+        for ts in range(1, 126):
+            store.write(float(ts), "c", {}, float(ts) * 2.0, kind="c")
+        s = store._series[("c", ())]
+        pts = store._window_points(s, 0.0, 125.0)
+        tss = [p[0] for p in pts]
+        assert tss == sorted(set(tss))            # merged + deduped
+        assert 59.0 in tss and 69.0 in tss        # coarse history
+        assert tss[-1] == 125.0                   # raw recency
+        # increase over the whole span uses the 60s tier's oldest
+        # point (59) — exact on the adjusted value
+        inc = store.query("increase(c[1h])")["results"][0]["value"]
+        assert inc == 125.0 * 2.0 - 59.0 * 2.0
+
+
+class TestRetention:
+
+    def test_eviction_at_tier_boundaries(self):
+        """A long run holds every tier at its retention bound: points
+        never outlive retention, and per-tier counts stay flat between
+        hour 1 and hour 2 (the bounded-memory contract the bench
+        gates)."""
+        store = TimeSeriesStore(tiers=TIERS)
+        counts = []
+        for ts in range(1, 7201):
+            store.write(float(ts), "g", {}, 1.0, kind="g")
+            if ts in (3600, 7200):
+                s = store._series[("g", ())]
+                counts.append([len(r) for r in s.rings])
+                for i, (res, keep) in enumerate(TIERS):
+                    for p in s.rings[i]:
+                        assert ts - p[0] <= keep
+        assert counts[0] == counts[1]             # flat, not growing
+
+    def test_max_series_bound(self):
+        """Past ``max_series`` new series are dropped and counted —
+        label-cardinality explosions cannot grow memory without
+        bound."""
+        store = TimeSeriesStore(tiers=TIERS, max_series=5)
+        for i in range(10):
+            store.write(1.0, "m", {"k": str(i)}, 1.0, kind="g")
+        assert len(store._series) == 5
+        assert store.n_dropped_series == 5
+        assert store.status()["n_dropped_series"] == 5
+
+
+class TestCounterResetContinuity:
+
+    def test_increase_is_exact_across_a_restart(self):
+        """10 -> 50, restart to 5, -> 20: real traffic is 40 + 5 + 15;
+        increase() over the window reports exactly that (the SLOEngine
+        delta clamp at ingest), while the instant query still returns
+        the RAW last value."""
+        store = TimeSeriesStore(tiers=TIERS)
+        for ts, v in ((1.0, 10.0), (2.0, 50.0), (3.0, 5.0),
+                      (4.0, 20.0)):
+            store.write(ts, "c", {}, v, kind="c")
+        inc = store.query("increase(c[10s])")["results"][0]["value"]
+        assert inc == 60.0
+        assert store.query("c")["results"][0]["value"] == 20.0
+        # rate over the same points: 60 adjusted over a 3 s span
+        rate = store.query("rate(c[10s])")["results"][0]["value"]
+        assert rate == pytest.approx(20.0)
+
+    def test_reset_survives_downsampling(self):
+        """The adjusted value rides every tier: a window whose oldest
+        point comes from the 60 s ring still differences reset-adjusted
+        values, not raws."""
+        store = TimeSeriesStore(tiers=TIERS)
+        v = 0.0
+        for ts in range(1, 126):
+            v += 3.0
+            if ts == 70:
+                v = 1.0                           # restart mid-run
+            store.write(float(ts), "c", {}, v, kind="c")
+        inc = store.query("increase(c[1h])")["results"][0]["value"]
+        # oldest surviving point is ts=59 (adjusted 177); total real
+        # traffic after it: 10 more incs to 69 (30), the reset sample
+        # (1), then 55 incs of 3
+        assert inc == pytest.approx(30.0 + 1.0 + 55 * 3.0)
+
+
+class TestQueryGoldens:
+
+    def test_rate_uses_actual_point_span(self):
+        """rate() divides the adjusted delta by the span between the
+        points actually found in the window — two points 30 s apart
+        give delta/30, not delta/window."""
+        store = TimeSeriesStore(tiers=TIERS)
+        store.write(10.0, "c", {}, 100.0, kind="c")
+        store.write(40.0, "c", {}, 250.0, kind="c")
+        out = store.query("rate(c[60s])", at=40.0)["results"]
+        assert out[0]["value"] == pytest.approx(150.0 / 30.0)
+        # fewer than two points in the window: no answer, not a bogus 0
+        assert store.query("rate(c[5s])", at=40.0)["results"] == []
+
+    def test_quantile_golden_vs_hand_computed(self):
+        """quantile() reconstructs per-bucket counts from cumulative
+        adjusted deltas and must agree with quantile_from_buckets on
+        hand-fed counts: observations {0.5, 3, 3, 10, 50} -> p50 = 4.0
+        (rank 2.5 lands in (1, 5]; 1 + (2.5-1)/2 * 4)."""
+        clock = ManualClock()
+        m, c, h, g = _registry(clock)
+        store = TimeSeriesStore(tiers=TIERS)
+        h.labels("8")                  # create the child at zero
+        store.ingest(take_scrape(m, at=1.0))      # zero baseline
+        for v in (0.5, 3.0, 3.0, 10.0, 50.0):
+            h.labels("8").observe(v)
+        store.ingest(take_scrape(m, at=2.0))
+        out = store.query(
+            "quantile(0.5, serving_dispatch_latency_ms[10s])",
+            at=2.0)["results"]
+        assert out == [{"labels": {"bucket": "8"}, "value": 4.0}]
+        assert quantile_from_buckets(
+            EDGES, [1.0, 2.0, 1.0, 1.0, 0.0], 0.5) == 4.0
+
+    def test_query_range_series_shape(self):
+        """query_range returns one labeled series with one [ts, value]
+        point per step; a negative start is relative to end (the
+        remote-caller form — monotonic timestamps aren't knowable
+        client-side)."""
+        store = TimeSeriesStore(tiers=TIERS)
+        for ts in range(1, 61):
+            store.write(float(ts), "c", {"route": "/a"},
+                        float(ts) * 2.0, kind="c")
+        out = store.query_range("rate(c[30s])", start=-20.0, step=5.0)
+        assert out["start"] == 40.0 and out["end"] == 60.0
+        (series,) = out["series"]
+        assert series["labels"] == {"route": "/a"}
+        assert [p[0] for p in series["points"]] == \
+            [40.0, 45.0, 50.0, 55.0, 60.0]
+        assert all(p[1] == pytest.approx(2.0)
+                   for p in series["points"])
+
+
+class TestLabelMatchers:
+
+    @pytest.fixture()
+    def store(self):
+        st = TimeSeriesStore(tiers=TIERS)
+        for route, tenant in (("/a", "t1"), ("/a", "t2"),
+                              ("/ab", "t1")):
+            st.write(1.0, "m", {"route": route, "tenant": tenant},
+                     1.0, kind="g")
+        return st
+
+    def _routes(self, store, expr):
+        return sorted((r["labels"]["route"], r["labels"]["tenant"])
+                      for r in store.query(expr)["results"])
+
+    def test_eq_and_neq(self, store):
+        assert self._routes(store, 'm{route="/a"}') == \
+            [("/a", "t1"), ("/a", "t2")]
+        assert self._routes(store, 'm{route="/a",tenant="t1"}') == \
+            [("/a", "t1")]
+        assert self._routes(store, 'm{tenant!="t1"}') == [("/a", "t2")]
+
+    def test_regex_is_anchored(self, store):
+        """=~ must match the WHOLE value (the PromQL contract):
+        ``/a`` does not match ``/ab``."""
+        assert self._routes(store, 'm{route=~"/a"}') == \
+            [("/a", "t1"), ("/a", "t2")]
+        assert self._routes(store, 'm{route=~"/a.*"}') == \
+            [("/a", "t1"), ("/a", "t2"), ("/ab", "t1")]
+        assert self._routes(store, 'm{route!~"/a"}') == [("/ab", "t1")]
+
+    def test_missing_label_matches_empty(self, store):
+        """A matcher on an absent label sees '' — ``{other!=\"x\"}``
+        matches everything, ``{other=\"x\"}`` nothing."""
+        assert len(self._routes(store, 'm{other!="x"}')) == 3
+        assert self._routes(store, 'm{other="x"}') == []
+
+    def test_malformed_expressions_raise_query_error(self, store):
+        for bad in ("rate(oops", "m{route=}", 'm{route~"x"}',
+                    "quantile(2, m[10s])", 'm{route=~"["}',
+                    "rate(m[10q])", ""):
+            with pytest.raises(QueryError):
+                parsed = parse_expr(bad)
+        with pytest.raises(QueryError):
+            store.query_range("m", step=0.0)
+
+    def test_duration_units(self):
+        assert parse_duration("150ms") == pytest.approx(0.15)
+        assert parse_duration("10s") == 10.0
+        assert parse_duration("5m") == 300.0
+        assert parse_duration("1h") == 3600.0
+
+
+class TestRecordingRules:
+
+    def test_rule_writes_derived_series(self):
+        """A rule's instant result lands as a colon-named gauge series
+        carrying the source labels — /query_range then answers over
+        precomputed history."""
+        store = TimeSeriesStore(tiers=TIERS)
+        rule = RecordingRule("m:rate1m", "rate(src[60s])")
+        for ts in range(1, 31):
+            store.write(float(ts), "src", {"route": "/a"},
+                        float(ts) * 4.0, kind="c")
+            rule.evaluate(store, float(ts))
+        out = store.query("m:rate1m")["results"]
+        assert out == [{"labels": {"route": "/a"}, "value": 4.0}]
+
+    def test_default_rules_parse(self):
+        for rule in default_serving_rules(has_decoder=True,
+                                          has_tenancy=True):
+            assert rule._parsed[0] in ("rate", "increase", "quantile")
+        for w in default_serving_watches(has_decoder=True):
+            parse_expr(w.expr)
+
+
+class _Notifier:
+    def __init__(self):
+        self.events = []
+
+    def notify(self, event):
+        self.events.append(event)
+
+
+def _detector(store, **kw):
+    defaults = dict(min_samples=10, z_threshold=4.0, min_abs=5.0,
+                    alpha=0.2, for_s=0.0, resolve_after_s=3.0)
+    defaults.update(kw)
+    notifier = _Notifier()
+    det = AnomalyDetector(
+        store, [AnomalyWatch("watch", "m", **defaults)],
+        notifier=notifier)
+    return det, notifier
+
+
+class TestAnomalyDetector:
+
+    def test_warmup_guard_no_verdict_before_min_samples(self):
+        """Wild values during warm-up never fire — the baseline must
+        earn min_samples points before any z-score counts."""
+        store = TimeSeriesStore(tiers=TIERS)
+        det, notifier = _detector(store)
+        for ts in range(1, 10):
+            store.write(float(ts), "m", {}, 1e6 if ts % 2 else 0.0,
+                        kind="g")
+            assert det.observe(float(ts)) == []
+        assert notifier.events == []
+
+    def test_fire_resolve_cycle_with_attribution(self):
+        """Steady 100s, then a level shift to 200: fires once with the
+        series labels as attribution; reverting holds through
+        resolve_after_s and then resolves once. The frozen baseline
+        keeps the alert up for the regression's whole duration."""
+        store = TimeSeriesStore(tiers=TIERS)
+        det, notifier = _detector(store)
+        ts = 0.0
+        for _ in range(20):
+            ts += 1.0
+            store.write(ts, "m", {"bucket": "8"}, 100.0, kind="g")
+            det.observe(ts)
+        for _ in range(10):                        # regression holds
+            ts += 1.0
+            store.write(ts, "m", {"bucket": "8"}, 200.0, kind="g")
+            det.observe(ts)
+        firing = [e for e in notifier.events if e["type"] == "firing"]
+        assert len(firing) == 1
+        assert firing[0]["labels"] == {"bucket": "8"}
+        assert firing[0]["policy"] == "watch"
+        assert det.alerts()["firing"] == 1
+        for _ in range(10):                        # revert
+            ts += 1.0
+            store.write(ts, "m", {"bucket": "8"}, 100.0, kind="g")
+            det.observe(ts)
+        kinds = [e["type"] for e in notifier.events]
+        assert kinds == ["firing", "resolved"]
+        assert det.alerts()["firing"] == 0
+
+    def test_for_s_holds_a_blip_pending(self):
+        """With for_s=2, a single violating tick folds back to ok
+        silently — no event is ever sent for it (the SLO state-machine
+        contract)."""
+        store = TimeSeriesStore(tiers=TIERS)
+        det, notifier = _detector(store, for_s=2.0)
+        ts = 0.0
+        for _ in range(15):
+            ts += 1.0
+            store.write(ts, "m", {}, 100.0, kind="g")
+            det.observe(ts)
+        ts += 1.0                                  # one-tick blip
+        store.write(ts, "m", {}, 500.0, kind="g")
+        det.observe(ts)
+        ts += 1.0                                  # back to normal
+        store.write(ts, "m", {}, 100.0, kind="g")
+        det.observe(ts)
+        assert notifier.events == []
+
+    def test_zero_flap_on_steady_noise(self):
+        """200 ticks of bounded deterministic noise: zero transitions,
+        ever — the acceptance bar for steady-state false positives."""
+        store = TimeSeriesStore(tiers=TIERS)
+        det, notifier = _detector(store)
+        for ts in range(1, 201):
+            v = 100.0 + 3.0 * math.sin(ts * 0.7) + (ts % 5) * 0.4
+            store.write(float(ts), "m", {}, v, kind="g")
+            det.observe(float(ts))
+        assert notifier.events == []
+        assert det.status()["n_fired"] == 0
+
+
+class TestRecorderUnification:
+
+    def test_one_scrape_feeds_store_slo_and_dumper(self, tmp_path):
+        """One record_now tick: the TSDB gains the scrape's points,
+        the SLO engine's history gains the SAME snapshot (no second
+        scrape), and the .prom dump is the registry exposition — all
+        three consumers off one scrape."""
+        from mmlspark_tpu.serving.slo import SLOEngine, SLOPolicy
+        clock = ManualClock()
+        m, c, h, g = _registry(clock)
+        eng = SLOEngine(m, [SLOPolicy(
+            "lat", "latency", 0.95,
+            metric="serving_dispatch_latency_ms", threshold_ms=100.0,
+            windows=((60.0, 10.0, 2.0),))], clock=clock)
+        store = TimeSeriesStore(tiers=TIERS)
+        rec = Recorder((m,), store=store, interval_s=1.0, clock=clock,
+                       snapshot_dir=str(tmp_path), snapshot_keep=2,
+                       slo=eng)
+        c.labels("/a").inc(5)
+        h.labels("8").observe(2.0)
+        clock.advance(1.0)
+        rec.record_now()
+        assert store.query("serving_requests_total")["results"] == \
+            [{"labels": {"route": "/a"}, "value": 5.0}]
+        assert len(eng._history) == 1
+        _, snap = eng._history[-1]
+        kind, edges, label_names, children = \
+            snap["serving_dispatch_latency_ms"]
+        assert kind == "h" and edges == EDGES
+        assert sum(children[("8",)]) == 1.0
+        proms = [p for p in os.listdir(tmp_path)
+                 if p.endswith(".prom")]
+        assert len(proms) == 1
+        assert (tmp_path / proms[0]).read_text() == \
+            render_registries(m)
+        assert rec.status()["n_scrapes"] == 1
+
+    def test_snapshot_keep_prunes(self, tmp_path):
+        clock = ManualClock()
+        m, c, h, g = _registry(clock)
+        rec = Recorder((m,), store=TimeSeriesStore(tiers=TIERS),
+                       clock=clock, snapshot_dir=str(tmp_path),
+                       snapshot_keep=2)
+        for i in range(4):
+            clock.advance(1.0)
+            rec.record_now()
+            time.sleep(1.1)  # distinct UTC-second snapshot tags
+        proms = [p for p in os.listdir(tmp_path)
+                 if p.endswith(".prom")]
+        assert len(proms) == 2
+
+
+class TestFleetQueryMerge:
+
+    def test_dead_worker_degrades_to_error_entry(self):
+        """/fleet/query_range with one live and one dead worker: 200,
+        the live worker's series under its worker label, the dead one
+        an errors entry — never a 5xx."""
+        import requests
+        from mmlspark_tpu.core.stage import Transformer
+        from mmlspark_tpu.serving import ServingServer
+        from mmlspark_tpu.serving.server import ServingCoordinator
+
+        class Doubler(Transformer):
+            def transform(self, df):
+                return df.with_column(
+                    "y", np.asarray(df["x"], dtype=np.float64) * 2)
+
+        with ServingServer(Doubler(), max_batch_size=4,
+                           max_latency_ms=10,
+                           tsdb={"interval_s": 0.1}) as srv:
+            for i in range(8):
+                requests.post(srv.address, json={"x": float(i)},
+                              timeout=10)
+            deadline = time.monotonic() + 5.0
+            while srv.recorder.n_scrapes < 2 and \
+                    time.monotonic() < deadline:
+                time.sleep(0.05)
+            coord = ServingCoordinator()
+            coord.start()
+            try:
+                cbase = f"http://{coord.host}:{coord.port}"
+                requests.post(f"{cbase}/register",
+                              json={"host": srv.host,
+                                    "port": srv.port}, timeout=10)
+                requests.post(f"{cbase}/register",
+                              json={"host": "127.0.0.1", "port": 1},
+                              timeout=10)
+                r = requests.get(
+                    f"{cbase}/fleet/query_range"
+                    "?expr=rate(serving_requests_total[60s])"
+                    "&start=-30&step=0.5", timeout=15)
+                assert r.status_code == 200
+                body = r.json()
+                assert body["n_workers"] == 2
+                assert body["n_responding"] == 1
+                assert set(body["errors"]) == {"127.0.0.1:1"}
+                workers = {s["labels"].get("worker")
+                           for s in body["series"]}
+                assert workers == {f"{srv.host}:{srv.port}"}
+                assert any(p[1] > 0 for s in body["series"]
+                           for p in s["points"])
+                # instant fan-out rides the same merge
+                r = requests.get(
+                    f"{cbase}/fleet/query"
+                    "?expr=serving_tenant_device_ms_total",
+                    timeout=15)
+                assert r.status_code == 200
+                res = r.json()["results"]
+                assert res and all("worker" in row["labels"]
+                                   for row in res)
+            finally:
+                coord.stop()
+
+
+@pytest.mark.perf
+class TestIngestBudget:
+
+    def test_scrape_plus_ingest_under_budget_at_loaded_registry(self):
+        """A loaded registry (~1.5k ingest rows: 10 histogram families
+        x 8 children + 200 counter children) scrapes AND ingests well
+        inside the 25 ms recorder budget — the observer must cost less
+        than a rounding error of its 10 s cadence."""
+        clock = ManualClock()
+        m = MetricsRegistry(clock=clock)
+        hists = [m.histogram(f"h{i}_ms", "x", labels=("k",),
+                             buckets=EDGES) for i in range(10)]
+        ctrs = [m.counter(f"c{i}_total", "x", labels=("k",))
+                for i in range(20)]
+        for h in hists:
+            for j in range(8):
+                h.labels(str(j)).observe(float(j))
+        for c in ctrs:
+            for j in range(10):
+                c.labels(str(j)).inc()
+        store = TimeSeriesStore()
+        n_rows = store.ingest(take_scrape(m, at=0.0))
+        assert n_rows > 700                        # genuinely loaded
+        n_iter = 20
+        t0 = time.perf_counter_ns()
+        for i in range(1, n_iter + 1):
+            store.ingest(take_scrape(m, at=float(i)))
+        mean_ms = (time.perf_counter_ns() - t0) / n_iter / 1e6
+        assert mean_ms < 25.0, \
+            f"scrape+ingest {mean_ms:.2f}ms exceeds the 25ms budget"
+
+    def test_query_latency_under_a_scrape_interval(self):
+        """A full-retention query_range over a populated store answers
+        far inside one 10 s scrape interval."""
+        store = TimeSeriesStore()
+        for ts in range(0, 3600, 10):
+            for k in range(8):
+                store.write(float(ts), "m", {"k": str(k)},
+                            float(ts + k), kind="c")
+        t0 = time.perf_counter_ns()
+        out = store.query_range("rate(m[60s])", start=-1800.0,
+                                step=60.0)
+        ms = (time.perf_counter_ns() - t0) / 1e6
+        assert len(out["series"]) == 8
+        assert ms < 1000.0, f"query_range took {ms:.1f}ms"
+
+    def test_recorder_budget_accounting(self):
+        """An impossible budget marks every tick over-budget — the
+        /stats tsdb block makes recorder overruns visible."""
+        clock = ManualClock()
+        m, c, h, g = _registry(clock)
+        rec = Recorder((m,), store=TimeSeriesStore(tiers=TIERS),
+                       clock=clock, ingest_budget_ms=0.0)
+        clock.advance(1.0)
+        rec.record_now()
+        assert rec.n_over_budget == 1
+        assert rec.status()["last_ingest_ms"] >= 0.0

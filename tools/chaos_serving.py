@@ -65,6 +65,18 @@ victim) in the per-worker attribution, and that after a replacement
 worker heartbeats in the alert RESOLVES and the healed fleet stays
 quiet. ``--slo-alerts-requests 0`` skips the phase.
 
+A seventh phase drills the RETROSPECTIVE PLANE's baseline-relative
+regression detection (docs/observability.md "The retrospective
+plane"): an in-process worker with an embedded TSDB running a fast
+recording rule over dispatch-latency p95 and an anomaly watch on the
+rule's series. Steady traffic establishes the EWMA+MAD baseline
+(ZERO false positives allowed); then the model is made 80 ms slower
+mid-traffic and the drill asserts the ``dispatch_p95_regression``
+anomaly FIRES on ``GET /alerts`` with per-bucket attribution; then
+the slowdown is reverted, the short quantile window drains, and the
+alert must RESOLVE and stay quiet. ``--regression-requests 0`` skips
+the phase.
+
 Runs on CPU; phases 1-2 need no model artifact (workers serve an
 inline doubler); phase 3 persists real ``ScaleColumn`` checkpoints.
 """
@@ -804,6 +816,134 @@ def slo_alerts_drill(tmp: str, seed: int, n_requests: int = 16) -> dict:
         coord.stop()
 
 
+def regression_drill(tmp: str, seed: int, n_requests: int = 60) -> dict:
+    """Phase 7: the latency-regression anomaly drill
+    (docs/observability.md "The retrospective plane").
+
+    One in-process worker whose embedded TSDB runs a FAST recording
+    rule (``chaos:dispatch_p95`` = p95 of dispatch latency over a 4 s
+    window, 0.1 s scrape cadence) and an anomaly watch on that rule's
+    series. Steady traffic warms the EWMA+MAD baseline and must stay
+    QUIET (zero false positives); then the model is made 80 ms slower
+    mid-traffic — the watch must FIRE on ``GET /alerts`` with the
+    dispatch histogram's per-bucket labels as attribution; then the
+    slowdown is reverted, the 4 s window drains the slow
+    observations, and the alert must RESOLVE within the quiet period
+    and stay quiet after."""
+    import numpy as np
+    import requests
+
+    from mmlspark_tpu.core.stage import Transformer
+    from mmlspark_tpu.serving import ServingServer
+
+    class SlowableDoubler(Transformer):
+        delay_s = 0.0
+
+        def transform(self, df):
+            if self.delay_s:
+                time.sleep(self.delay_s)
+            return df.with_column(
+                "y", np.asarray(df["x"], dtype=np.float64) * 2)
+
+    model = SlowableDoubler()
+    # the rule's 4 s quantile window is what lets the drill resolve in
+    # seconds: after the revert, the slow observations age out of the
+    # window and the p95 series comes back to baseline. min_abs=10ms
+    # floors the z-score against a near-zero steady MAD (dispatch of
+    # a doubler is sub-millisecond), so only the injected regression
+    # can violate.
+    tsdb_cfg = {
+        "interval_s": 0.1,
+        "rules": [{"record": "chaos:dispatch_p95",
+                   "expr":
+                       "quantile(0.95, serving_dispatch_latency_ms[4s])"}],
+        "watches": [{"name": "dispatch_p95_regression",
+                     "expr": "chaos:dispatch_p95",
+                     "direction": "high", "z_threshold": 4.0,
+                     "min_samples": 20, "min_abs": 10.0,
+                     "for_s": 0.3, "resolve_after_s": 1.0}],
+    }
+    out: dict = {"what": "inject an 80ms model slowdown mid-traffic; "
+                         "the dispatch-p95 anomaly watch must fire "
+                         "with bucket attribution, then resolve on "
+                         "revert"}
+
+    with ServingServer(model, max_batch_size=4, max_latency_ms=5,
+                       tsdb=tsdb_cfg) as srv:
+        base = srv.address.rsplit("/", 1)[0]
+
+        def anomaly(view):
+            for alert in view.get("anomalies") or []:
+                if alert.get("watch") == "dispatch_p95_regression":
+                    return alert
+            return None
+
+        def pump(stop_fn, max_s, gap_s=0.03):
+            """Send traffic until ``stop_fn`` returns truthy or the
+            deadline passes; returns (stop_fn result, n_firing_polls,
+            n_requests_sent)."""
+            i = 0
+            firing_polls = 0
+            deadline = time.monotonic() + max_s
+            while time.monotonic() < deadline:
+                requests.post(srv.address,
+                              json={"x": float(i % 7)}, timeout=10)
+                i += 1
+                if i % 4 == 0:
+                    view = requests.get(base + "/alerts",
+                                        timeout=10).json()
+                    if view["firing"]:
+                        firing_polls += 1
+                    got = stop_fn(view)
+                    if got:
+                        return got, firing_polls, i
+                time.sleep(gap_s)
+            return None, firing_polls, i
+
+        # -- steady state: warm the baseline well past min_samples
+        # (20 ticks at 0.1 s) and prove the watch stays quiet
+        warm = max(n_requests, 40)
+        steady_end = time.monotonic() + max(warm * 0.05, 5.0)
+        _, false_polls, n_sent = pump(
+            lambda view: time.monotonic() >= steady_end,
+            max_s=max(warm * 0.05, 5.0) + 5.0)
+        out["steady_requests"] = n_sent
+        out["steady_false_firing"] = false_polls
+
+        # -- inject: 80 ms regression; the watch must fire with the
+        # dispatch histogram's bucket label as attribution
+        model.delay_s = 0.08
+        alert, _, _ = pump(
+            lambda view: (a := anomaly(view)) is not None
+            and a["state"] == "firing" and a, max_s=25.0)
+        out["fired"] = alert is not None
+        out["attributed"] = bool(
+            alert and "bucket" in (alert.get("labels") or {}))
+        out["fired_value_ms"] = alert and alert.get("value")
+        out["baseline_ms"] = alert and alert.get("baseline")
+
+        # -- revert: the window drains, the alert must resolve
+        model.delay_s = 0.0
+        resolved, _, _ = pump(
+            lambda view: view["firing"] == 0
+            and (a := anomaly(view)) is not None
+            and a["state"] in ("ok", "resolved") and a, max_s=30.0)
+        out["resolved"] = resolved is not None
+
+        # -- post-resolve: healed traffic must stay quiet
+        _, post_false, _ = pump(lambda view: False, max_s=2.0)
+        out["post_resolve_false_firing"] = post_false
+        out["recorder"] = {
+            k: srv.recorder.status()[k]
+            for k in ("n_scrapes", "ewma_ingest_ms", "n_over_budget",
+                      "n_rule_errors")}
+        out["ok"] = (false_polls == 0 and out["fired"]
+                     and out["attributed"] and out["resolved"]
+                     and post_false == 0
+                     and out["recorder"]["n_rule_errors"] == 0)
+        return out
+
+
 def main() -> int:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("--requests", type=int, default=120)
@@ -836,6 +976,10 @@ def main() -> int:
                     help="phase-6 SLO availability-burn drill: steady-"
                          "state requests before the SIGKILL (0 skips "
                          "the phase)")
+    ap.add_argument("--regression-requests", type=int, default=60,
+                    help="phase-7 latency-regression anomaly drill: "
+                         "steady-state requests before the injected "
+                         "slowdown (0 skips the phase)")
     args = ap.parse_args()
 
     if args.prefix_only:
@@ -937,6 +1081,10 @@ def main() -> int:
         if args.slo_alerts_requests > 0:
             slo_alerts = slo_alerts_drill(
                 tmp, args.seed, n_requests=args.slo_alerts_requests)
+        regression = None
+        if args.regression_requests > 0:
+            regression = regression_drill(
+                tmp, args.seed, n_requests=args.regression_requests)
         wall = time.perf_counter() - t0
 
         per_worker = [worker_status(w.port) for w in workers]
@@ -959,6 +1107,8 @@ def main() -> int:
             **({"tenancy": tenancy} if tenancy is not None else {}),
             **({"slo_alerts": slo_alerts}
                if slo_alerts is not None else {}),
+            **({"regression": regression}
+               if regression is not None else {}),
             "wall_s": round(wall, 3),
         }
         print(json.dumps(report, indent=2))
@@ -976,7 +1126,8 @@ def main() -> int:
               and (rollout is None or rollout["ok"])
               and (prefix is None or prefix["ok"])
               and (tenancy is None or tenancy["ok"])
-              and (slo_alerts is None or slo_alerts["ok"]))
+              and (slo_alerts is None or slo_alerts["ok"])
+              and (regression is None or regression["ok"]))
         print("RESULT:", "PASS" if ok else "FAIL")
         return 0 if ok else 1
     finally:

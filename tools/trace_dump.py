@@ -37,6 +37,21 @@ compose with ``--fleet`` (merged evaluation, per-worker blocks):
     python tools/trace_dump.py http://worker:8000 --slo
     python tools/trace_dump.py --fleet http://coordinator:8000 --alerts
 
+``--query`` / ``--range`` switch to the retrospective plane (the
+embedded TSDB — docs/observability.md "The retrospective plane"):
+``--query EXPR`` prints the instant result table, ``--range EXPR``
+renders each returned series as an ANSI sparkline row (min/max/last
+alongside). Both compose with ``--fleet`` (the coordinator fans the
+expression out and merges the series under worker labels):
+
+    python tools/trace_dump.py http://worker:8000 \\
+        --query 'rate(serving_requests_total[60s])'
+    python tools/trace_dump.py http://worker:8000 \\
+        --range 'quantile(0.95, serving_dispatch_latency_ms[300s])' \\
+        --window 600 --step 10
+    python tools/trace_dump.py --fleet http://coordinator:8000 \\
+        --range 'serving:decode_ttft_ms:p95'
+
 stdlib-only on the wire (urllib): runs anywhere the worker is
 reachable, no client deps.
 """
@@ -47,6 +62,7 @@ import argparse
 import json
 import sys
 from urllib.error import HTTPError
+from urllib.parse import quote
 from urllib.request import urlopen
 
 
@@ -165,6 +181,107 @@ def _run_slo_mode(base: str, fleet: bool, mode: str) -> None:
             _print_slo_report(view, 1)
 
 
+_BLOCKS = "▁▂▃▄▅▆▇█"
+
+
+def _dim(s: str) -> str:
+    return f"\x1b[2m{s}\x1b[0m" if sys.stdout.isatty() else s
+
+
+def _bold(s: str) -> str:
+    return f"\x1b[1m{s}\x1b[0m" if sys.stdout.isatty() else s
+
+
+def _labels_str(labels: dict) -> str:
+    return ",".join(f"{k}={v}" for k, v in sorted(labels.items())) \
+        or "(no labels)"
+
+
+def _sparkline(values: list) -> str:
+    """One series as unicode block characters, normalized to its own
+    min/max (shape over scale: a latency series and a rate series are
+    both readable at a glance)."""
+    lo, hi = min(values), max(values)
+    span = hi - lo
+    if span <= 0:
+        return _BLOCKS[0] * len(values)
+    return "".join(
+        _BLOCKS[int((v - lo) / span * (len(_BLOCKS) - 1))]
+        for v in values)
+
+
+def _fmt_val(v: float) -> str:
+    if v == 0:
+        return "0"
+    if abs(v) >= 1000:
+        return f"{v:.0f}"
+    if abs(v) >= 1:
+        return f"{v:.2f}".rstrip("0").rstrip(".")
+    return f"{v:.4g}"
+
+
+def _print_query_errors(body: dict) -> None:
+    for wk, err in sorted((body.get("errors") or {}).items()):
+        print(f"(worker {wk} unreachable: {err})", file=sys.stderr)
+
+
+def _run_query_mode(base: str, fleet: bool, expr: str) -> None:
+    """``--query``: the instant value table (one row per labelset,
+    worker-attributed with --fleet)."""
+    url = (f"{base}/fleet/query" if fleet else f"{base}/query") \
+        + f"?expr={quote(expr, safe='')}"
+    body = _get_json(url)
+    _print_query_errors(body)
+    results = body.get("results") or []
+    print(_dim(f"{expr}  at={body.get('at')}  "
+               f"{len(results)} result(s)"))
+    if not results:
+        print("(no data — is the recorder running and the series "
+              "populated?)", file=sys.stderr)
+        return
+    width = max(len(_labels_str(r.get("labels") or {}))
+                for r in results)
+    for r in results:
+        print(f"  {_labels_str(r.get('labels') or {}):<{width}}  "
+              f"{_bold(_fmt_val(r['value']))}")
+
+
+def _run_range_mode(base: str, fleet: bool, expr: str,
+                    window: float, step: float) -> None:
+    """``--range``: one ANSI sparkline row per returned series —
+    ``/query_range`` over the trailing ``window`` seconds at ``step``
+    resolution, the worker's newest recorded data as the right
+    edge."""
+    url = (f"{base}/fleet/query_range" if fleet
+           else f"{base}/query_range") \
+        + (f"?expr={quote(expr, safe='')}&start=-{window}"
+           f"&step={step}")
+    body = _get_json(url)
+    _print_query_errors(body)
+    series = body.get("series") or []
+    start, end = body.get("start"), body.get("end")
+    span = f"[{start:.0f}s .. {end:.0f}s]" \
+        if start is not None and end is not None else ""
+    print(_dim(f"{expr}  {span} step={body.get('step', step)}s  "
+               f"{len(series)} series"))
+    if not series:
+        print("(no data — is the recorder running and the series "
+              "populated?)", file=sys.stderr)
+        return
+    width = max(len(_labels_str(s.get("labels") or {}))
+                for s in series)
+    for s in series:
+        vals = [p[1] for p in s.get("points") or []
+                if p[1] is not None]
+        if not vals:
+            continue
+        print(f"  {_labels_str(s.get('labels') or {}):<{width}}  "
+              f"{_sparkline(vals)}  "
+              + _dim(f"min={_fmt_val(min(vals))} "
+                     f"max={_fmt_val(max(vals))} "
+                     f"last={_fmt_val(vals[-1])} n={len(vals)}"))
+
+
 def main() -> None:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("worker", help="worker base url, e.g. "
@@ -184,6 +301,20 @@ def main() -> None:
                     help="print the full burn-rate report per policy "
                          "(GET /slo; /fleet/slo with --fleet) instead "
                          "of traces")
+    ap.add_argument("--query", metavar="EXPR",
+                    help="instant TSDB query (GET /query; /fleet/query "
+                         "with --fleet): a selector, rate(sel[w]), "
+                         "increase(sel[w]), or quantile(q, hist[w])")
+    ap.add_argument("--range", metavar="EXPR", dest="range_expr",
+                    help="range TSDB query rendered as ANSI sparklines "
+                         "(GET /query_range; /fleet/query_range with "
+                         "--fleet)")
+    ap.add_argument("--window", type=float, default=300.0,
+                    help="with --range: trailing seconds to render "
+                         "(default 300)")
+    ap.add_argument("--step", type=float, default=10.0,
+                    help="with --range: evaluation step seconds "
+                         "(default 10)")
     ap.add_argument("--list", action="store_true",
                     help="list retained traces and exit")
     ap.add_argument("--slow", action="store_true",
@@ -203,6 +334,14 @@ def main() -> None:
     if args.alerts or args.slo:
         _run_slo_mode(base, args.fleet,
                       "alerts" if args.alerts else "slo")
+        return
+
+    if args.query:
+        _run_query_mode(base, args.fleet, args.query)
+        return
+    if args.range_expr:
+        _run_range_mode(base, args.fleet, args.range_expr,
+                        args.window, args.step)
         return
 
     if args.list or args.slowest:
