@@ -1604,6 +1604,103 @@ def bench_tsdb_overhead():
             "passed": ok, "chip": _chip()}
 
 
+def bench_profiler_overhead():
+    """Always-on sampling profiler overhead (ISSUE 20 acceptance
+    gate): the postmortem plane's CPU sampler must be cheap enough to
+    leave on in production.
+
+    Two gates:
+
+    * **throughput** — serving rps A/B with the profiler off vs on at
+      the default 50 hz, interleaved rounds (off/on/off/on...) with
+      the MEDIAN of each arm compared, so host drift lands on both
+      arms: the on-arm must hold within 3% of the off-arm;
+    * **flat memory** — a long synthetic run (3x the ring's capacity
+      in samples) holds the sample ring EXACTLY at its cap and the
+      interned-stack table flat between the 2x and 3x marks (the ring
+      is a deque(maxlen), stacks are interned once — memory is
+      retention x hz, not runtime).
+
+    ``vs_baseline`` = measured delta / the 3% budget (<1 passes).
+    """
+    import threading
+
+    from mmlspark_tpu.core.profiler import SamplingProfiler
+    from mmlspark_tpu.serving import ServingServer
+    from mmlspark_tpu.testing.load import drive_keepalive
+
+    def run_arm(profiler_cfg):
+        with ServingServer(_identity_model(), max_latency_ms=2,
+                           max_batch_size=256, max_queue=4096,
+                           cpu_profiler=profiler_cfg) as srv:
+            srv.warmup({"x": 0.0})
+            out = drive_keepalive(
+                srv.host, srv.port, srv.api_path, b'{"x": 0.0}',
+                n_connections=16, duration_s=2.0)
+            return out["rps"]
+
+    run_arm(False)                 # warm the stack off the record
+    offs, ons = [], []
+    for _ in range(5):
+        offs.append(run_arm(False))
+        ons.append(run_arm(None))  # None = the stock always-on 50 hz
+
+    def med(xs):
+        return sorted(xs)[len(xs) // 2]
+
+    rps_off, rps_on = med(offs), med(ons)
+    delta = (rps_off - rps_on) / max(rps_off, 1e-9)
+
+    # -- flat memory: sample far past the ring's capacity and check
+    # both bounds (ring pinned at maxlen, intern table flat once the
+    # process's thread stacks have all been seen). A pair of busy
+    # worker threads gives the sampler real stacks to intern —
+    # sampling only an idle main thread would prove nothing.
+    prof = SamplingProfiler(hz=50.0, retention_s=2.0)
+    stop = threading.Event()
+
+    def _churn():
+        while not stop.is_set():
+            sum(i * i for i in range(200))
+            stop.wait(0.0005)
+
+    workers = [threading.Thread(target=_churn, daemon=True)
+               for _ in range(2)]
+    for w in workers:
+        w.start()
+    cap = prof._ring.maxlen
+    marks = []
+    try:
+        for i in range(1, cap * 3 + 1):
+            prof.sample_once()
+            if i in (cap * 2, cap * 3):
+                marks.append((len(prof._ring), len(prof._stacks)))
+    finally:
+        stop.set()
+        for w in workers:
+            w.join(timeout=2)
+    ring_flat = (marks[0][0] == cap and marks[1][0] == cap
+                 and marks[0][1] > 0)
+    # tolerance: late-arriving thread states may intern a few new
+    # stacks between the marks, but growth must have saturated
+    stacks_flat = (marks[1][1] - marks[0][1]) <= max(8, marks[0][1]
+                                                     // 10)
+
+    budget = 0.03
+    ok = delta < budget and ring_flat and stacks_flat
+    return {"metric": "profiler_overhead_v1",
+            "value": round(delta * 100, 2), "unit": "% rps_delta",
+            "rps_off": round(rps_off, 1), "rps_on": round(rps_on, 1),
+            "rounds": 5, "hz": 50.0,
+            "ring_cap": cap, "ring_flat": ring_flat,
+            "stacks_2x": marks[0][1], "stacks_3x": marks[1][1],
+            "stacks_flat": stacks_flat,
+            "ewma_sample_ms": round(prof.ewma_sample_ms, 4),
+            "baseline": budget * 100,
+            "vs_baseline": round((delta * 100) / (budget * 100), 3),
+            "passed": ok, "chip": _chip()}
+
+
 def bench_decode_continuous():
     """Continuous batching for autoregressive decode vs the static
     whole-batch baseline (ISSUE 9 acceptance gate).
@@ -2732,6 +2829,7 @@ BENCHES = [bench_gbdt_quantile, bench_adult_census, bench_cifar10_scoring,
            bench_telemetry_overhead, bench_tracing_overhead,
            bench_trace_propagation, bench_slo_overhead,
            bench_tsdb_overhead,
+           bench_profiler_overhead,
            bench_decode_continuous,
            bench_decode_paged, bench_decode_speculative,
            bench_decode_prefix_cache,

@@ -26,6 +26,9 @@ from __future__ import annotations
 
 import json as _json
 import logging as _logging
+import threading as _threading
+import time as _time
+from collections import deque as _deque
 
 _ROOT = "mmlspark_tpu"
 _configured = False
@@ -148,3 +151,101 @@ def get_logger(namespace: str) -> _logging.Logger:
     """Logger at ``mmlspark_tpu.<namespace>`` (created on first use)."""
     _ensure_root()
     return _logging.getLogger(f"{_ROOT}.{namespace}")
+
+
+class LogRing(_logging.Handler):
+    """Bounded in-memory ring of the last N log records.
+
+    The postmortem plane's log surface: a worker serves the ring at
+    ``GET /logs?trace=<id>&level=<name>`` and the incident bundle
+    snapshots the *same* ring — what the operator greps and what the
+    bundle preserves are one buffer, not two codepaths.
+
+    Records are stored as plain dicts (``ts``/``level``/``levelno``/
+    ``logger``/``message``/``trace``/``span``) at emit time, so reading
+    the ring never touches live ``LogRecord`` objects. ``level`` is the
+    handler's severity floor (records below it never enter the ring);
+    :meth:`records` can filter further by trace id and level name.
+    ``emit`` swallows its own errors — a broken record loses one line,
+    never the caller.
+    """
+
+    def __init__(self, capacity: int = 2048,
+                 level: int = _logging.INFO):
+        super().__init__(level=level)
+        self.capacity = int(capacity)
+        self._ring = _deque(maxlen=self.capacity)
+        self._rlock = _threading.Lock()
+        self.n_emitted = 0
+        self.addFilter(_TraceFilter())
+
+    def emit(self, record: _logging.LogRecord) -> None:
+        try:
+            entry = {
+                "ts": getattr(record, "created", None) or _time.time(),
+                "level": record.levelname,
+                "levelno": record.levelno,
+                "logger": record.name,
+                "message": record.getMessage(),
+                "trace": _record_trace_id(record),
+                "span": _record_span_name(record),
+            }
+            if record.exc_info:
+                try:
+                    entry["exc"] = _logging.Formatter().formatException(
+                        record.exc_info)
+                except Exception:
+                    pass
+            with self._rlock:
+                self._ring.append(entry)
+                self.n_emitted += 1
+        except Exception:       # pragma: no cover - defensive
+            pass
+
+    def records(self, trace: str = None, level: str = None,
+                limit: int = None) -> list:
+        """Newest-last snapshot, optionally filtered by trace id and/or
+        minimum level name; ``limit`` keeps only the newest N."""
+        floor = None
+        if level:
+            floor = getattr(_logging, str(level).upper(), None)
+        with self._rlock:
+            out = list(self._ring)
+        if trace:
+            out = [r for r in out if r.get("trace") == trace]
+        if floor is not None:
+            out = [r for r in out if r.get("levelno", 0) >= floor]
+        if limit is not None and limit >= 0:
+            out = out[-limit:]
+        return out
+
+    def status(self) -> dict:
+        with self._rlock:
+            return {"capacity": self.capacity, "len": len(self._ring),
+                    "emitted": self.n_emitted,
+                    "floor": _logging.getLevelName(self.level)}
+
+
+_log_ring: LogRing = None
+_ring_lock = _threading.Lock()
+
+
+def install_log_ring(capacity: int = 2048,
+                     level: int = _logging.INFO) -> LogRing:
+    """Attach one process-wide :class:`LogRing` to the ``mmlspark_tpu``
+    root logger (idempotent — repeated calls return the same ring, so
+    every :class:`~mmlspark_tpu.serving.server.ServingServer` in a
+    process shares one buffer, matching the shared stream handler)."""
+    global _log_ring
+    with _ring_lock:
+        if _log_ring is None:
+            _ensure_root()
+            ring = LogRing(capacity=capacity, level=level)
+            _logging.getLogger(_ROOT).addHandler(ring)
+            _log_ring = ring
+        return _log_ring
+
+
+def get_log_ring() -> LogRing:
+    """The installed ring, or ``None`` before :func:`install_log_ring`."""
+    return _log_ring

@@ -27,6 +27,7 @@ from mmlspark_tpu.serving.decode import (
     SlotPool, TransformerDecoder,
 )
 from mmlspark_tpu.serving.frontend import EventLoopFrontend
+from mmlspark_tpu.serving.incident import FanoutNotifier, IncidentManager
 from mmlspark_tpu.serving.policy import (
     AdaptiveBatchPolicy, PriorityShedPolicy, SpeculationPolicy,
 )
@@ -47,4 +48,5 @@ __all__ = ["ServingServer", "ServingCoordinator", "ServingClient",
            "QuantizationConfig",
            "SpeculationPolicy", "Sampler", "TrafficCapture",
            "Tenant", "TenantRegistry", "TokenBucket", "FairCycle",
-           "PriorityShedPolicy", "extract_api_key"]
+           "PriorityShedPolicy", "extract_api_key",
+           "IncidentManager", "FanoutNotifier"]

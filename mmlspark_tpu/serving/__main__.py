@@ -129,6 +129,26 @@ Environment:
                    30) through the resilient HTTP client, with a
                    final flush on shutdown — telemetry for fleets
                    without a scraping Prometheus
+  PROFILER_HZ      (worker, optional) the always-on sampling CPU
+                   profiler's rate (default 50; served at
+                   ``GET /profile/cpu``, windows/diffs over a bounded
+                   in-memory ring — docs/observability.md "The
+                   postmortem plane"). ``0`` or ``false`` disables
+                   the sampler entirely
+  INCIDENTS_DIR    (worker, optional) directory for anomaly-triggered
+                   incident bundles: when set, every SLO/anomaly
+                   firing transition snapshots alert + series +
+                   traces + profile window + logs + stats to
+                   ``<dir>/<id>/`` (bounded retention, one bundle per
+                   alert per cooldown; ``GET /incidents`` lists them,
+                   the coordinator merges the fleet at
+                   ``GET /fleet/incidents``). Unset, ``0`` or
+                   ``false`` disables capture — nothing is written
+  INCIDENT_COOLDOWN_S / INCIDENT_MAX
+                   (worker, optional) incident-capture knobs: minimum
+                   seconds between bundles for the same alert
+                   (default 300) and the on-disk bundle cap (default
+                   16, oldest evicted)
 """
 
 import os
@@ -221,7 +241,23 @@ def run_worker() -> None:
         # overrides its knobs (interval_s, tiers, snapshot_dir,
         # rules, watches, ...); unset = the stock plane
         tsdb=(False if os.environ.get("TSDB") in ("0", "false")
-              else _json_env("TSDB")))
+              else _json_env("TSDB")),
+        # PROFILER_HZ=0/false disables the always-on sampler; any
+        # other value overrides the 50 hz default
+        cpu_profiler=(False
+                      if os.environ.get("PROFILER_HZ") in ("0", "false")
+                      else ({"hz": _env_float("PROFILER_HZ", 50.0)}
+                            if os.environ.get("PROFILER_HZ")
+                            else None)),
+        # INCIDENTS_DIR enables anomaly-triggered incident capture
+        incidents=(None
+                   if os.environ.get("INCIDENTS_DIR") in (None, "", "0",
+                                                          "false")
+                   else {"dir": os.environ["INCIDENTS_DIR"],
+                         "cooldown_s": _env_float(
+                             "INCIDENT_COOLDOWN_S", 300.0),
+                         "max_incidents": int(_env_float(
+                             "INCIDENT_MAX", 16))}))
     warm = os.environ.get("WARMUP_PAYLOAD")
     if warm:
         # warm BEFORE start(): the socket is already bound (early

@@ -65,7 +65,8 @@ import numpy as np
 from collections import deque
 
 from mmlspark_tpu.core.dataframe import DataFrame
-from mmlspark_tpu.core.logs import get_logger
+from mmlspark_tpu.core.logs import get_logger, install_log_ring
+from mmlspark_tpu.core.profiler import SamplingProfiler
 from mmlspark_tpu.core.profiling import (
     CompileLedger, DeviceProfiler, MfuMeter, ProfilerBusy,
     StageTimings, device_memory_stats, process_rss_bytes,
@@ -100,6 +101,7 @@ from mmlspark_tpu.core.tracing import (
 )
 from mmlspark_tpu.serving.decode import DecodeOverloaded, DecodeScheduler
 from mmlspark_tpu.serving.frontend import EventLoopFrontend, batched_replies
+from mmlspark_tpu.serving.incident import FanoutNotifier, IncidentManager
 from mmlspark_tpu.serving.policy import AdaptiveBatchPolicy
 from mmlspark_tpu.serving.quant import QuantizationConfig
 from mmlspark_tpu.serving.rollout import (
@@ -270,6 +272,8 @@ class ServingServer:
                  slo_webhook: Optional[str] = None,
                  tsdb=None,
                  profile_dir: Optional[str] = None,
+                 cpu_profiler=None,
+                 incidents=None,
                  clock: Clock = SYSTEM_CLOCK):
         self.api_path = api_path
         self.max_batch_size = int(max_batch_size)
@@ -661,6 +665,8 @@ class ServingServer:
                          has_tenancy=self.tenancy is not None)
                      if rules is None
                      else [RecordingRule.from_value(r) for r in rules])
+            # incident bundles dump exactly these precomputed series
+            self._tsdb_rules = rules
             if cfg.get("anomaly", True):
                 watches = cfg.get("watches")
                 watches = (default_serving_watches(
@@ -689,6 +695,64 @@ class ServingServer:
         self.compile_ledger = CompileLedger()
         self.mfu = MfuMeter()
         self._flops_cache: Dict[tuple, Optional[float]] = {}
+        # -- postmortem plane: always-on sampling CPU profiler +
+        # anomaly-triggered incident capture. ``cpu_profiler`` is None
+        # for the stock always-on sampler (50 hz, ~3 min retention),
+        # False/{"hz": 0} to disable, or a config dict (hz,
+        # retention_s, max_depth, max_stacks). ``GET /profile/cpu``
+        # serves windows/diffs; the incident bundle reads the same
+        # ring. ``incidents`` is None/False (off — nothing written
+        # unless asked), a directory path, or a config dict (dir,
+        # cooldown_s, max_incidents, profile_pre_s, profile_post_s,
+        # lookback_s, series_step_s): when set, every SLO/anomaly
+        # pending->firing transition snapshots an evidence bundle to
+        # ``<dir>/<id>/`` — see serving/incident.py and
+        # docs/observability.md "The postmortem plane".
+        self.cpu_profiler: Optional[SamplingProfiler] = None
+        if cpu_profiler is not False:
+            pcfg = (dict(cpu_profiler) if isinstance(cpu_profiler, dict)
+                    else {})
+            if float(pcfg.get("hz", 50.0)) > 0:
+                self.cpu_profiler = SamplingProfiler(
+                    hz=pcfg.get("hz", 50.0),
+                    retention_s=pcfg.get("retention_s", 180.0),
+                    max_depth=pcfg.get("max_depth", 48),
+                    max_stacks=pcfg.get("max_stacks", 8192),
+                    clock=clock)
+        # the process-wide log ring (core/logs.py): what GET /logs
+        # serves and what the incident bundle snapshots
+        self.log_ring = install_log_ring()
+        self.incidents: Optional[IncidentManager] = None
+        if incidents:
+            icfg = ({"dir": incidents} if isinstance(incidents, str)
+                    else dict(incidents))
+            self.incidents = IncidentManager(
+                icfg["dir"],
+                tsdb=self.tsdb,
+                tracer=self.tracer,
+                profiler=self.cpu_profiler,
+                log_ring=self.log_ring,
+                stats_fn=self._incident_stats,
+                related_exprs=[r.record for r in
+                               getattr(self, "_tsdb_rules", [])],
+                cooldown_s=icfg.get("cooldown_s", 300.0),
+                max_incidents=icfg.get("max_incidents", 16),
+                profile_pre_s=icfg.get("profile_pre_s", 60.0),
+                profile_post_s=icfg.get("profile_post_s", 30.0),
+                lookback_s=icfg.get("lookback_s", 600.0),
+                series_step_s=icfg.get("series_step_s", 10.0),
+                clock=clock)
+            # fan alert transitions out to BOTH the webhook notifier
+            # (when configured) and the incident manager — the SLO
+            # engine and the anomaly detector keep their single
+            # notifier slot, the fan-out sits behind it
+            fan = FanoutNotifier(
+                self.slo.notifier if self.slo is not None else None,
+                self.incidents)
+            if self.slo is not None:
+                self.slo.notifier = fan
+            if self.anomalies is not None:
+                self.anomalies.notifier = fan
         self._register_metric_views()
 
     @property
@@ -1276,6 +1340,19 @@ class ServingServer:
                         "mfu": self.mfu.snapshot(),
                         "hbm": device_memory_stats(),
                     },
+                    # the postmortem plane: sampling-profiler ring
+                    # health, incident-capture counters, log-ring
+                    # fill — docs/observability.md "The postmortem
+                    # plane"
+                    "postmortem": {
+                        "cpu_profiler": (self.cpu_profiler.status()
+                                         if self.cpu_profiler
+                                         is not None else None),
+                        "incidents": (self.incidents.status()
+                                      if self.incidents is not None
+                                      else None),
+                        "log_ring": self.log_ring.status(),
+                    },
                 }
             return 200, json.dumps(stats).encode(), "application/json", ()
         if base == "/traces":
@@ -1386,6 +1463,91 @@ class ServingServer:
                         "application/json", ())
             return (200, json.dumps(body).encode(),
                     "application/json", ())
+        if base == "/profile/cpu":
+            # the always-on sampling profiler (core/profiler.py):
+            # ?window_s=N aggregates the last N seconds (JSON
+            # top-table by default; &format=collapsed for folded
+            # flamegraph text, &format=trace for Chrome trace_event
+            # JSON); &baseline_s=M returns the differential profile —
+            # the last window_s vs the baseline_s before it, frames
+            # ranked by how much hotter they got
+            if self.cpu_profiler is None:
+                return (404, b'{"error": "cpu profiler disabled"}',
+                        "application/json", ())
+            params = _urlparse.parse_qs(
+                path.partition("?")[2], keep_blank_values=True)
+            try:
+                window_s = float((params.get("window_s") or ["30"])[0])
+                baseline = params.get("baseline_s")
+                fmt = (params.get("format") or ["json"])[0]
+                if baseline:
+                    body = self.cpu_profiler.diff(
+                        window_s, float(baseline[0]))
+                elif fmt == "collapsed":
+                    text = self.cpu_profiler.render_collapsed(window_s)
+                    return (200, text.encode(),
+                            "text/plain; charset=utf-8", ())
+                elif fmt == "trace":
+                    body = self.cpu_profiler.chrome_trace(window_s)
+                else:
+                    body = self.cpu_profiler.profile(window_s)
+            except ValueError as e:
+                return (400, json.dumps({"error": str(e)}).encode(),
+                        "application/json", ())
+            return (200, json.dumps(body).encode(),
+                    "application/json", ())
+        if base == "/logs":
+            # the bounded in-memory log ring (core/logs.py):
+            # ?trace=<id> filters to one request's records (the
+            # injected trace field), ?level=<name> floors severity,
+            # ?n= keeps the newest N. Same ring the incident bundle
+            # snapshots.
+            params = _urlparse.parse_qs(
+                path.partition("?")[2], keep_blank_values=True)
+            trace = (params.get("trace") or [None])[0]
+            level = (params.get("level") or [None])[0]
+            n = (params.get("n") or [None])[0]
+            try:
+                limit = int(n) if n else None
+            except ValueError:
+                return (400, b'{"error": "n must be an integer"}',
+                        "application/json", ())
+            body = {"status": self.log_ring.status(),
+                    "records": self.log_ring.records(
+                        trace=trace, level=level, limit=limit)}
+            return (200, json.dumps(body).encode(),
+                    "application/json", ())
+        if base == "/incidents" or base.startswith("/incidents/"):
+            # the postmortem bundles (serving/incident.py): list,
+            # per-bundle manifest + inventory, and raw artifacts
+            # (/incidents/<id>/<file>, whitelisted names only)
+            if self.incidents is None:
+                return (404, b'{"error": "incident capture disabled '
+                        b'(configure incidents=<dir>)"}',
+                        "application/json", ())
+            if base == "/incidents":
+                body = {"incidents": self.incidents.list(),
+                        "status": self.incidents.status()}
+                return (200, json.dumps(body).encode(),
+                        "application/json", ())
+            rest = base[len("/incidents/"):]
+            inc_id, _, artifact = rest.partition("/")
+            if artifact:
+                art = self.incidents.artifact(inc_id, artifact)
+                if art is None:
+                    return (404, json.dumps(
+                        {"error": "no such incident artifact",
+                         "id": inc_id,
+                         "artifact": artifact}).encode(),
+                        "application/json", ())
+                return 200, art["body"], art["content_type"], ()
+            info = self.incidents.get(inc_id)
+            if info is None:
+                return (404, json.dumps(
+                    {"error": "no such incident",
+                     "id": inc_id}).encode(), "application/json", ())
+            return (200, json.dumps(info).encode(),
+                    "application/json", ())
         if path == "/profile":
             # profiler status (busy flag, last capture window); the
             # capture itself is POST /profile
@@ -1414,6 +1576,24 @@ class ServingServer:
                 "journal_recovered": self.n_journal_recovered,
             }
         return 200, json.dumps(status).encode(), "application/json", ()
+
+    def _incident_stats(self) -> dict:
+        """The worker-state snapshot an incident bundle embeds:
+        ``/stats`` + ``/decode/stats`` + placement, captured through
+        the same route table the frontends serve (one codepath, no
+        drift). Runs on the capture thread — never the hot path."""
+        out: Dict[str, Any] = {}
+        for key, route in (("stats", "/stats"),
+                           ("decode_stats", "/decode/stats"),
+                           ("status", "/status")):
+            try:
+                r = self._get_route(route, None)
+                if r is not None and r[0] == 200:
+                    out[key] = json.loads(r[1])
+            except Exception as exc:  # noqa: BLE001 — capture survives
+                out[key] = {"error": str(exc)}
+        out["placement"] = self._model_placement()
+        return out
 
     def _model_placement(self) -> Optional[dict]:
         """The active model's device placement, when it reports one
@@ -2906,26 +3086,34 @@ class ServingServer:
                                       daemon=True)
             t_http.start()
             self._threads.append(t_http)
-        t_batch = threading.Thread(target=self._batch_loop, daemon=True)
+        # stage threads are NAMED: the sampling profiler attributes
+        # samples to pipeline stages by thread name (core/profiler.py
+        # STAGE_PREFIXES), so a profile reads collector/dispatch/
+        # encoder, not Thread-7
+        t_batch = threading.Thread(target=self._batch_loop, daemon=True,
+                                   name="serving-collector")
         t_batch.start()
         self._threads.append(t_batch)
         self._stage_threads = [t_batch]
         if self.pipeline:
             t_exec = threading.Thread(target=self._executor_loop,
-                                      daemon=True)
+                                      daemon=True,
+                                      name="serving-executor")
             t_exec.start()
             self._threads.append(t_exec)
             self._stage_threads.append(t_exec)
-            for _ in range(self.encoder_threads):
+            for i in range(self.encoder_threads):
                 t_enc = threading.Thread(target=self._encoder_loop,
-                                         daemon=True)
+                                         daemon=True,
+                                         name=f"serving-encoder-{i}")
                 t_enc.start()
                 self._threads.append(t_enc)
                 self._stage_threads.append(t_enc)
         self._journal_thread = None
         if self._journal_fh is not None:
             self._journal_thread = threading.Thread(
-                target=self._journal_loop, daemon=True)
+                target=self._journal_loop, daemon=True,
+                name="serving-journal")
             self._journal_thread.start()
             self._threads.append(self._journal_thread)
         if self.decoder is not None:
@@ -2935,6 +3123,12 @@ class ServingServer:
             # feeding the TSDB, the SLO history, recording rules, the
             # anomaly detector, and (when configured) the .prom dumper
             self.recorder.start()
+        if self.cpu_profiler is not None:
+            # always-on: the CPU history must already be in the ring
+            # when a detector fires — see docs/observability.md
+            self.cpu_profiler.start()
+        if self.incidents is not None:
+            self.incidents.start()
         return self
 
     def stop(self, drain: bool = True, drain_timeout: float = 5.0):
@@ -2999,6 +3193,12 @@ class ServingServer:
             # final tick: the terminal counters land in the store (and
             # on disk when dumping) before the process exits
             self.recorder.stop()
+        if self.incidents is not None:
+            # before the profiler: an in-flight capture still gets its
+            # profile window from the (stopped but readable) ring
+            self.incidents.stop()
+        if self.cpu_profiler is not None:
+            self.cpu_profiler.stop()
         if self._journal_fh is not None:
             jt = getattr(self, "_journal_thread", None)
             if jt is not None and jt.is_alive():
@@ -3247,6 +3447,15 @@ class ServingCoordinator:
             # error entry, never a 5xx here)
             return (200, json.dumps(self.fleet_traces()).encode(),
                     "application/json")
+        if path == "/fleet/incidents":
+            # the fleet postmortem inventory: every worker's captured
+            # incident bundles, worker-attributed, newest first — one
+            # fleet-wide regression reads as one correlated evidence
+            # set (fetch a bundle from its worker via
+            # /incidents/<id>/<artifact>; tools/trace_dump.py
+            # --incidents --fetch does this)
+            return (200, json.dumps(self.fleet_incidents()).encode(),
+                    "application/json")
         if path.startswith("/fleet/trace/"):
             raw, _, query = path[len("/fleet/trace/"):].partition("?")
             # same charset as trace ids: the id is spliced into
@@ -3415,7 +3624,8 @@ class ServingCoordinator:
                 r = requests.get(f"http://{wk}{path}", timeout=timeout)
                 r.raise_for_status()
                 json_paths = ("/stats", "/traces", "/trace/",
-                              "/alerts", "/slo", "/query")
+                              "/alerts", "/slo", "/query",
+                              "/incidents")
                 return (wk, r.json() if path.startswith(json_paths)
                         else r.text, None)
             except Exception as e:  # noqa: BLE001 — worker down/old
@@ -3694,6 +3904,29 @@ class ServingCoordinator:
         return {"n_workers": len(polls),
                 "n_responding": len(polls) - len(errors),
                 "traces": traces, "errors": errors}
+
+    def fleet_incidents(self, timeout: float = 5.0) -> Dict[str, Any]:
+        """Every worker's incident-bundle inventory in one place:
+        polls each worker's ``GET /incidents`` concurrently and
+        flattens the listings with per-worker attribution, newest
+        first. A worker with incident capture disabled (its 404) or a
+        dead worker contributes an ``errors`` entry instead of failing
+        the view."""
+        incidents: List[Dict[str, Any]] = []
+        errors: Dict[str, str] = {}
+        polls = self._poll_workers("/incidents", timeout)
+        for wk, payload, err in polls:
+            if err is not None:
+                errors[wk] = err
+                continue
+            for inc in payload.get("incidents", []):
+                entry = dict(inc)
+                entry["worker"] = wk
+                incidents.append(entry)
+        incidents.sort(key=lambda i: -(i.get("at_unix") or 0.0))
+        return {"n_workers": len(polls),
+                "n_responding": len(polls) - len(errors),
+                "incidents": incidents, "errors": errors}
 
     def fleet_trace(self, trace_id: str, timeout: float = 5.0
                     ) -> Tuple[Optional[Dict[str, Any]], Dict[str, str]]:

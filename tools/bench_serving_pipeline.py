@@ -38,6 +38,15 @@ request's Perfetto ``trace_event`` JSON to ``PATH.<mode>.trace.json``
 where that mode's worst request spent its time (queue wait vs pad vs
 dispatch vs encode).
 
+``--profiler-ab`` switches the harness to the POSTMORTEM-PLANE A/B
+instead: the same pipelined plane with the always-on sampling CPU
+profiler off vs on (stock 50 hz), interleaved rounds with medians
+compared — gates the on-arm within 3% of the off-arm (the
+``bench.py profiler_overhead_v1`` budget, run as a harness mode; see
+docs/observability.md "The postmortem plane"):
+
+    python tools/bench_serving_pipeline.py --profiler-ab
+
 ``--connections N`` switches the harness to the SOCKET-EDGE A/B
 instead: the same pipelined data plane behind each of the two
 frontends (``eventloop`` vs ``threaded`` — docs/serving.md "The
@@ -275,6 +284,50 @@ def run_connections(frontend: str, model_kind: str, n_connections: int,
     return out
 
 
+def run_profiler_ab(model_kind: str, n_connections: int, cycles: int,
+                    max_batch_size: int, rounds: int = 3) -> dict:
+    """Always-on sampling profiler A/B on the pipelined plane: the
+    SAME keep-alive load with ``cpu_profiler`` off vs on (the stock
+    50 hz sampler), interleaved off/on rounds so host drift lands on
+    both arms, medians compared. The on-arm must hold within the 3%
+    budget ``bench.py profiler_overhead_v1`` gates in CI."""
+    from mmlspark_tpu.serving import ServingServer
+    from mmlspark_tpu.testing.load import drive_keepalive
+
+    def arm(profiler_cfg):
+        model = (_nn_model() if model_kind == "nn" else _identity_model())
+        with ServingServer(model, max_latency_ms=2,
+                           max_batch_size=max_batch_size,
+                           max_queue=max(4 * n_connections, 1024),
+                           cpu_profiler=profiler_cfg) as srv:
+            srv.warmup(json.loads(_payload(model_kind, 0)))
+            out = drive_keepalive(
+                srv.host, srv.port, srv.api_path,
+                _payload(model_kind, 0),
+                n_connections=n_connections, requests_per_conn=cycles)
+            status = (srv.cpu_profiler.status()
+                      if srv.cpu_profiler is not None else None)
+        return out["rps"], status
+
+    arm(False)  # warm the stack off the record
+    offs, ons, prof_status = [], [], None
+    for _ in range(rounds):
+        offs.append(arm(False)[0])
+        rps_on, prof_status = arm(None)  # None = stock always-on 50 hz
+        ons.append(rps_on)
+
+    def med(xs):
+        return sorted(xs)[len(xs) // 2]
+
+    rps_off, rps_on = med(offs), med(ons)
+    delta = (rps_off - rps_on) / max(rps_off, 1e-9)
+    return {"metric": "serving_profiler_ab", "model": model_kind,
+            "connections": n_connections, "rounds": rounds,
+            "rps_off": round(rps_off, 1), "rps_on": round(rps_on, 1),
+            "rps_delta_pct": round(100 * delta, 2), "budget_pct": 3.0,
+            "profiler": prof_status, "passed": delta < 0.03}
+
+
 def main() -> None:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("--smoke", action="store_true",
@@ -293,6 +346,11 @@ def main() -> None:
                     help="capture every request (slow_trace_ms=0) and "
                          "write the slowest one's Perfetto trace_event "
                          "JSON to PATH.<mode>.trace.json")
+    ap.add_argument("--profiler-ab", action="store_true",
+                    help="postmortem-plane A/B instead: pipelined "
+                         "plane with the sampling CPU profiler off vs "
+                         "on (stock 50 hz), interleaved rounds, gates "
+                         "the on-arm within 3% of the off-arm")
     ap.add_argument("--connections", type=int, default=0, metavar="N",
                     help="socket-edge A/B instead: drive N concurrent "
                          "keep-alive connections against each frontend "
@@ -320,6 +378,16 @@ def main() -> None:
     if args.smoke:
         args.clients, args.seconds = min(args.clients, 4), 1.0
         args.max_batch_size = min(args.max_batch_size, 32)
+    if args.profiler_ab:
+        r = run_profiler_ab(args.model, args.connections or 16,
+                            args.cycles, args.max_batch_size,
+                            rounds=(1 if args.smoke else 3))
+        print(json.dumps(r), flush=True)
+        if not r["passed"]:
+            raise SystemExit(
+                f"FAIL: always-on profiler cost {r['rps_delta_pct']}% "
+                "rps on the pipelined plane (budget 3%)")
+        return
     if args.connections > 0:
         if args.tls:
             # TLS A/B: encrypted vs plaintext, both on the event loop

@@ -944,6 +944,198 @@ def regression_drill(tmp: str, seed: int, n_requests: int = 60) -> dict:
         return out
 
 
+def postmortem_drill(tmp: str, seed: int, n_requests: int = 40) -> dict:
+    """Phase 8: the incident-capture drill (docs/observability.md
+    "The postmortem plane").
+
+    The phase-7 latency regression, re-run against a worker with the
+    always-on sampling profiler and an IncidentManager wired to the
+    anomaly notifier. Steady traffic must produce ZERO bundles; the
+    injected 80 ms slowdown must (a) fire the anomaly, (b) land one
+    COMPLETE on-disk bundle containing a non-empty profile, at least
+    one retained trace, and the violated series range, and (c) show
+    the injected-delay frame (this drill's ``transform``) in the
+    differential profile's top hotter-frames table; the revert must
+    resolve the alert; a second regression inside the cooldown must
+    be suppressed (no duplicate bundle)."""
+    import numpy as np
+    import requests
+
+    from mmlspark_tpu.core.stage import Transformer
+    from mmlspark_tpu.serving import ServingServer
+
+    class SlowableDoubler(Transformer):
+        delay_s = 0.0
+
+        def transform(self, df):
+            if self.delay_s:
+                time.sleep(self.delay_s)
+            return df.with_column(
+                "y", np.asarray(df["x"], dtype=np.float64) * 2)
+
+    model = SlowableDoubler()
+    # phase 7's fast detector (4 s rule window, 0.1 s cadence,
+    # min_abs=10ms floor) plus the postmortem plane: tight incident
+    # knobs so the drill runs in seconds (a short profile post-window,
+    # a 30 s series lookback at 0.5 s resolution) and a 60 s cooldown
+    # long enough that the second injection below MUST be suppressed.
+    tsdb_cfg = {
+        "interval_s": 0.1,
+        "rules": [{"record": "chaos:dispatch_p95",
+                   "expr":
+                       "quantile(0.95, serving_dispatch_latency_ms[4s])"}],
+        "watches": [{"name": "dispatch_p95_regression",
+                     "expr": "chaos:dispatch_p95",
+                     "direction": "high", "z_threshold": 4.0,
+                     "min_samples": 20, "min_abs": 10.0,
+                     "for_s": 0.3, "resolve_after_s": 1.0}],
+    }
+    inc_dir = os.path.join(tmp, "incidents")
+    incidents_cfg = {"dir": inc_dir, "cooldown_s": 60.0,
+                     "profile_pre_s": 8.0, "profile_post_s": 0.5,
+                     "lookback_s": 30.0, "series_step_s": 0.5}
+    out: dict = {"what": "phase-7 regression with incident capture: "
+                         "firing must snapshot a complete bundle "
+                         "(profile + traces + series + logs + stats), "
+                         "steady state must write nothing, a repeat "
+                         "inside the cooldown must be suppressed"}
+
+    with ServingServer(model, max_batch_size=4, max_latency_ms=5,
+                       tsdb=tsdb_cfg, incidents=incidents_cfg,
+                       slow_trace_ms=40.0,
+                       adaptive_slow_trace=False) as srv:
+        base = srv.address.rsplit("/", 1)[0]
+
+        def anomaly(view):
+            for alert in view.get("anomalies") or []:
+                if alert.get("watch") == "dispatch_p95_regression":
+                    return alert
+            return None
+
+        def pump(stop_fn, max_s, gap_s=0.03):
+            i = 0
+            deadline = time.monotonic() + max_s
+            while time.monotonic() < deadline:
+                requests.post(srv.address,
+                              json={"x": float(i % 7)}, timeout=10)
+                i += 1
+                if i % 4 == 0:
+                    view = requests.get(base + "/alerts",
+                                        timeout=10).json()
+                    got = stop_fn(view)
+                    if got:
+                        return got
+                time.sleep(gap_s)
+            return None
+
+        # -- steady state: warm the baseline; nothing may be captured
+        warm_s = max(max(n_requests, 40) * 0.05, 5.0)
+        steady_end = time.monotonic() + warm_s
+        pump(lambda view: time.monotonic() >= steady_end,
+             max_s=warm_s + 5.0)
+        steady = requests.get(base + "/incidents", timeout=10).json()
+        out["steady_bundles"] = steady["status"]["captured"]
+
+        # -- inject: the watch fires AND the incident manager captures
+        model.delay_s = 0.08
+        t_inject = time.monotonic()
+        alert = pump(
+            lambda view: (a := anomaly(view)) is not None
+            and a["state"] == "firing" and a, max_s=25.0)
+        out["fired"] = alert is not None
+
+        # differential profile WHILE the regression runs: the injected
+        # delay (this drill's ``transform``, parked in time.sleep)
+        # must top the hotter-frames table
+        time.sleep(1.0)        # let the hot window accumulate samples
+        window_s = max(time.monotonic() - t_inject, 2.0)
+        diff = requests.get(
+            base + f"/profile/cpu?window_s={window_s:.1f}"
+                   f"&baseline_s=8", timeout=10).json()
+        hot = [r["frame"] for r in (diff.get("hotter") or [])[:10]]
+        out["diff_top_hotter"] = hot[:5]
+        out["diff_names_delay_frame"] = any(
+            ":transform:" in f for f in hot)
+
+        # the bundle: wait for the capture thread (profile post-window
+        # is 0.5 s), then verify completeness + contents over HTTP —
+        # exactly what an operator's tooling would read
+        srv.incidents.wait_idle(timeout=20.0)
+        listing = requests.get(base + "/incidents", timeout=10).json()
+        out["bundles_after_fire"] = listing["status"]["captured"]
+        bundle_ok = profile_ok = traces_ok = series_ok = False
+        if listing["incidents"]:
+            inc = listing["incidents"][0]
+            inc_id = inc["id"]
+            out["incident_id"] = inc_id
+            info = requests.get(base + f"/incidents/{inc_id}",
+                                timeout=10).json()
+            bundle_ok = info["complete"] and all(
+                f in info["present"] for f in
+                ("alert.json", "series.json", "traces.json",
+                 "logs.json", "stats.json", "profile.collapsed",
+                 "manifest.json"))
+            prof = requests.get(
+                base + f"/incidents/{inc_id}/profile.collapsed",
+                timeout=10).text
+            profile_ok = len(prof.strip()) > 0
+            traces = requests.get(
+                base + f"/incidents/{inc_id}/traces.json",
+                timeout=10).json()
+            traces_ok = len(traces.get("traces") or []) >= 1
+            series = requests.get(
+                base + f"/incidents/{inc_id}/series.json",
+                timeout=10).json()
+            own = (series.get("series") or {}).get("chaos:dispatch_p95",
+                                                   {})
+            vals = [p[1] for s in own.get("series") or []
+                    for p in s.get("points") or []
+                    if p[1] is not None]
+            # the violated range: the regressed p95 (>= the watch's
+            # 10 ms min_abs floor; steady state is sub-millisecond)
+            series_ok = bool(vals) and max(vals) >= 10.0
+            out["series_max_ms"] = max(vals) if vals else None
+        out["bundle_complete"] = bundle_ok
+        out["profile_nonempty"] = profile_ok
+        out["traces_retained"] = traces_ok
+        out["series_violated_range"] = series_ok
+
+        # -- revert: the alert must resolve, and resolving must NOT
+        # write another bundle
+        model.delay_s = 0.0
+        resolved = pump(
+            lambda view: view["firing"] == 0
+            and (a := anomaly(view)) is not None
+            and a["state"] in ("ok", "resolved") and a, max_s=30.0)
+        out["resolved"] = resolved is not None
+
+        # -- duplicate suppression: a second regression inside the
+        # 60 s cooldown fires again but must NOT produce a new bundle
+        model.delay_s = 0.08
+        refired = pump(
+            lambda view: (a := anomaly(view)) is not None
+            and a["state"] == "firing" and a, max_s=25.0)
+        model.delay_s = 0.0
+        srv.incidents.wait_idle(timeout=20.0)
+        status = requests.get(base + "/incidents",
+                              timeout=10).json()["status"]
+        out["refired"] = refired is not None
+        out["bundles_after_refire"] = status["captured"]
+        out["suppressed_by_cooldown"] = status["suppressed_cooldown"]
+
+        out["ok"] = (out["steady_bundles"] == 0
+                     and out["fired"]
+                     and out["bundles_after_fire"] == 1
+                     and bundle_ok and profile_ok and traces_ok
+                     and series_ok
+                     and out["diff_names_delay_frame"]
+                     and out["resolved"]
+                     and out["refired"]
+                     and out["bundles_after_refire"] == 1
+                     and out["suppressed_by_cooldown"] >= 1)
+        return out
+
+
 def main() -> int:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("--requests", type=int, default=120)
@@ -980,6 +1172,11 @@ def main() -> int:
                     help="phase-7 latency-regression anomaly drill: "
                          "steady-state requests before the injected "
                          "slowdown (0 skips the phase)")
+    ap.add_argument("--postmortem-requests", type=int, default=40,
+                    help="phase-8 incident-capture drill: steady-state "
+                         "requests before the injected regression that "
+                         "must land a complete on-disk incident bundle "
+                         "(0 skips the phase)")
     args = ap.parse_args()
 
     if args.prefix_only:
@@ -1085,6 +1282,10 @@ def main() -> int:
         if args.regression_requests > 0:
             regression = regression_drill(
                 tmp, args.seed, n_requests=args.regression_requests)
+        postmortem = None
+        if args.postmortem_requests > 0:
+            postmortem = postmortem_drill(
+                tmp, args.seed, n_requests=args.postmortem_requests)
         wall = time.perf_counter() - t0
 
         per_worker = [worker_status(w.port) for w in workers]
@@ -1109,6 +1310,8 @@ def main() -> int:
                if slo_alerts is not None else {}),
             **({"regression": regression}
                if regression is not None else {}),
+            **({"postmortem": postmortem}
+               if postmortem is not None else {}),
             "wall_s": round(wall, 3),
         }
         print(json.dumps(report, indent=2))
@@ -1127,7 +1330,8 @@ def main() -> int:
               and (prefix is None or prefix["ok"])
               and (tenancy is None or tenancy["ok"])
               and (slo_alerts is None or slo_alerts["ok"])
-              and (regression is None or regression["ok"]))
+              and (regression is None or regression["ok"])
+              and (postmortem is None or postmortem["ok"]))
         print("RESULT:", "PASS" if ok else "FAIL")
         return 0 if ok else 1
     finally:

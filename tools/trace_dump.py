@@ -52,6 +52,22 @@ expression out and merges the series under worker labels):
     python tools/trace_dump.py --fleet http://coordinator:8000 \\
         --range 'serving:decode_ttft_ms:p95'
 
+``--incidents`` / ``--profile`` switch to the postmortem plane
+(docs/observability.md "The postmortem plane"): ``--incidents`` lists
+captured incident bundles (fleet-wide and worker-attributed with
+``--fleet``), ``--fetch <id> -o dir`` downloads one bundle's artifacts
+into a directory (verifying the manifest digests), and ``--profile``
+renders a collapsed-stack top-table from the always-on sampling
+profiler's ``GET /profile/cpu`` (``--baseline N`` switches to the
+differential "which frames got hotter" table):
+
+    python tools/trace_dump.py http://worker:8000 --incidents
+    python tools/trace_dump.py --fleet http://coordinator:8000 --incidents
+    python tools/trace_dump.py http://worker:8000 --incidents \\
+        --fetch inc-... -o ./bundle
+    python tools/trace_dump.py http://worker:8000 --profile --window 30
+    python tools/trace_dump.py http://worker:8000 --profile --baseline 60
+
 stdlib-only on the wire (urllib): runs anywhere the worker is
 reachable, no client deps.
 """
@@ -282,6 +298,124 @@ def _run_range_mode(base: str, fleet: bool, expr: str,
                      f"last={_fmt_val(vals[-1])} n={len(vals)}"))
 
 
+def _get_bytes(url: str, timeout: float = 30.0) -> bytes:
+    with urlopen(url, timeout=timeout) as resp:
+        return resp.read()
+
+
+def _fmt_ts(unix) -> str:
+    if not unix:
+        return "-"
+    import datetime
+    return datetime.datetime.fromtimestamp(float(unix)) \
+        .strftime("%Y-%m-%d %H:%M:%S")
+
+
+def _run_incidents_mode(base: str, fleet: bool) -> None:
+    """``--incidents``: the captured-bundle inventory (fleet-wide and
+    worker-attributed with --fleet), newest first."""
+    if fleet:
+        body = _get_json(f"{base}/fleet/incidents")
+        incidents = body.get("incidents") or []
+        for wk, err in sorted((body.get("errors") or {}).items()):
+            print(f"(worker {wk}: {err})", file=sys.stderr)
+    else:
+        incidents = _get_json(f"{base}/incidents").get("incidents") or []
+    for inc in incidents:
+        wcol = f" {inc.get('worker', ''):<22}" if fleet else ""
+        size_kb = (inc.get("bytes") or 0) / 1024.0
+        state = "complete" if inc.get("complete") else "PARTIAL"
+        print(f"{inc['id']:<44}{wcol} {inc.get('policy') or '?':<22} "
+              f"{_fmt_ts(inc.get('at_unix')):<20} {state:<9} "
+              f"files={inc.get('n_files', 0)} {size_kb:8.1f}KiB")
+    if not incidents:
+        print("(no incident bundles — nothing has fired, or capture "
+              "is disabled)", file=sys.stderr)
+
+
+def _run_fetch_mode(base: str, fleet: bool, inc_id: str,
+                    out_dir: str) -> None:
+    """``--fetch <id> -o dir``: download one bundle's artifacts,
+    verifying each file against the manifest's SHA-256 digest. With
+    --fleet the bundle is located via /fleet/incidents and fetched
+    from the worker that holds it."""
+    import hashlib
+    import os
+    if fleet:
+        listing = _get_json(f"{base}/fleet/incidents")
+        match = next((i for i in listing.get("incidents") or []
+                      if i["id"] == inc_id), None)
+        if match is None:
+            raise SystemExit(f"incident {inc_id} not found on any "
+                             f"worker (see --incidents)")
+        base = f"http://{match['worker']}"
+    info = _get_json(f"{base}/incidents/{quote(inc_id, safe='')}")
+    manifest = info.get("manifest") or {}
+    files = manifest.get("files") or {}
+    names = sorted(set(info.get("present") or []) | set(files))
+    if not names:
+        raise SystemExit(f"incident {inc_id} has no artifacts")
+    os.makedirs(out_dir, exist_ok=True)
+    for name in names:
+        body = _get_bytes(
+            f"{base}/incidents/{quote(inc_id, safe='')}/{name}")
+        with open(os.path.join(out_dir, name), "wb") as f:
+            f.write(body)
+        want = (files.get(name) or {}).get("sha256")
+        got = hashlib.sha256(body).hexdigest()
+        mark = ("ok" if want == got else
+                ("UNVERIFIED" if want is None else "DIGEST MISMATCH"))
+        print(f"  {name:<22} {len(body):>9} bytes  {mark}")
+    print(f"fetched {len(names)} artifacts to {out_dir} "
+          f"(complete={bool(manifest.get('complete'))})")
+
+
+def _run_profile_mode(base: str, window: float,
+                      baseline: float) -> None:
+    """``--profile``: the always-on sampling profiler's window as a
+    collapsed-stack top-table; with ``--baseline N`` the differential
+    hotter-frames table instead."""
+    if baseline:
+        body = _get_json(f"{base}/profile/cpu?window_s={window}"
+                         f"&baseline_s={baseline}")
+        print(_dim(f"differential: last {window:.0f}s "
+                   f"({body.get('cur_samples', 0)} samples) vs prior "
+                   f"{baseline:.0f}s ({body.get('base_samples', 0)} "
+                   f"samples)"))
+        print(_bold(f"{'delta':>8} {'cur':>7} {'base':>7}  frame "
+                    f"(hotter)"))
+        for r in body.get("hotter") or []:
+            print(f"{r['delta_share']:>+8.1%} {r['cur_share']:>7.1%} "
+                  f"{r['base_share']:>7.1%}  {r['frame']}")
+        cold = body.get("colder") or []
+        if cold:
+            print(_bold(f"{'delta':>8} {'cur':>7} {'base':>7}  frame "
+                        f"(colder)"))
+            for r in cold[:5]:
+                print(f"{r['delta_share']:>+8.1%} "
+                      f"{r['cur_share']:>7.1%} "
+                      f"{r['base_share']:>7.1%}  {r['frame']}")
+        return
+    body = _get_json(f"{base}/profile/cpu?window_s={window}")
+    stages = body.get("stages") or {}
+    total = body.get("thread_samples") or 0
+    print(_dim(f"cpu profile: last {window:.0f}s, "
+               f"{body.get('samples', 0)} samples at "
+               f"{body.get('hz', 0):.0f}hz"))
+    if total:
+        print("stages: " + "  ".join(
+            f"{k}={v / total:.0%}" for k, v in stages.items()))
+    print(_bold(f"{'samples':>8} {'share':>7}  stack (leaf last)"))
+    for row in body.get("top_stacks") or []:
+        stack = row["stack"]
+        if len(stack) > 160:
+            stack = "..." + stack[-157:]
+        print(f"{row['count']:>8} {row['share']:>7.1%}  {stack}")
+    if not body.get("top_stacks"):
+        print("(no samples in the window — is the profiler enabled?)",
+              file=sys.stderr)
+
+
 def main() -> None:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("worker", help="worker base url, e.g. "
@@ -309,9 +443,26 @@ def main() -> None:
                     help="range TSDB query rendered as ANSI sparklines "
                          "(GET /query_range; /fleet/query_range with "
                          "--fleet)")
+    ap.add_argument("--incidents", action="store_true",
+                    help="list captured incident bundles (GET "
+                         "/incidents; /fleet/incidents with --fleet) — "
+                         "docs/observability.md 'The postmortem plane'")
+    ap.add_argument("--fetch", metavar="INCIDENT_ID",
+                    help="with --incidents: download one bundle's "
+                         "artifacts into the -o directory, verifying "
+                         "manifest digests")
+    ap.add_argument("--profile", action="store_true",
+                    help="render a collapsed-stack top-table from the "
+                         "always-on sampling profiler (GET "
+                         "/profile/cpu?window_s=<--window>)")
+    ap.add_argument("--baseline", type=float, default=0.0,
+                    help="with --profile: differential mode — diff the "
+                         "window against the N seconds before it and "
+                         "rank frames by how much hotter they got")
     ap.add_argument("--window", type=float, default=300.0,
                     help="with --range: trailing seconds to render "
-                         "(default 300)")
+                         "(default 300); with --profile: the profile "
+                         "window")
     ap.add_argument("--step", type=float, default=10.0,
                     help="with --range: evaluation step seconds "
                          "(default 10)")
@@ -334,6 +485,20 @@ def main() -> None:
     if args.alerts or args.slo:
         _run_slo_mode(base, args.fleet,
                       "alerts" if args.alerts else "slo")
+        return
+
+    if args.incidents or args.fetch:
+        if args.fetch:
+            _run_fetch_mode(base, args.fleet, args.fetch,
+                            args.out or args.fetch)
+        else:
+            _run_incidents_mode(base, args.fleet)
+        return
+    if args.profile:
+        # --window's 300s default is the --range window; profiles
+        # default to the last 30s (the ring holds ~180s)
+        window = args.window if args.window != 300.0 else 30.0
+        _run_profile_mode(base, window, args.baseline)
         return
 
     if args.query:
